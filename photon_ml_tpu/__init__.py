@@ -1,0 +1,46 @@
+"""photon_ml_tpu — a TPU-native framework with the capabilities of Photon ML.
+
+Photon ML (reference: kaituozhe528/photon-ml, a Scala/Spark library) trains
+large-scale Generalized Linear Models (GLMs) and GAME (Generalized Additive
+Mixed Effects) models.  This package rebuilds those capabilities TPU-first:
+
+- ``ops``        — pointwise GLM losses and sparse linear algebra (XLA/Pallas),
+                   the analogue of the reference's Breeze/BLAS layer.
+- ``optim``      — fully on-device convex optimizers (L-BFGS, OWL-QN, TRON)
+                   as ``lax.while_loop`` programs; the analogue of
+                   photon-lib's ``com.linkedin.photon.ml.optimization``.
+- ``models``     — GLM model classes and GAME model containers; the analogue
+                   of ``...ml.model`` / ``...ml.supervised``.
+- ``parallel``   — device meshes, row/feature/entity shardings, and the
+                   ``psum``-based distributed objective that replaces Spark's
+                   ``RDD.treeAggregate`` gradient reduction.
+- ``data``       — datasets (dense + CSR shards), LIBSVM ingest, feature index
+                   maps, normalization, summary stats, down-sampling, and the
+                   random-effect grouping/bucketing layer.
+- ``game``       — coordinates, block coordinate descent, estimator and
+                   transformer; the analogue of ``...ml.algorithm`` /
+                   ``...ml.estimators``.
+- ``evaluation`` — AUC / RMSE / log-loss / Poisson-loss / precision@k and
+                   grouped (per-query) evaluators.
+- ``hyperparameter`` — random search and Gaussian-process (Matérn + EI)
+                   Bayesian search over regularization weights.
+- ``drivers``    — end-to-end CLI drivers mirroring the reference's
+                   ``Driver`` (legacy GLM), ``GameTrainingDriver``,
+                   ``GameScoringDriver``, ``FeatureIndexingDriver``.
+- ``io``         — model/data serialization incl. a dependency-free Avro
+                   container codec (the reference stores everything as Avro).
+- ``utils``      — logging, timing, optimization-state tracking.
+
+Design stance (see SURVEY.md §7): Spark is *replaced*, not translated.  Rows
+are sharded over a ``jax.sharding.Mesh`` and gradients reduced with ``psum``
+over ICI; per-entity random-effect solves are ``vmap``-batched over
+size-bucketed entity blocks instead of per-partition Spark tasks.
+
+NOTE: this is the target layout; subpackages land incrementally (check
+``photon_ml_tpu/<name>/__init__.py`` existence, or the git log, for what has
+shipped so far).
+"""
+
+__version__ = "0.1.0"
+
+from photon_ml_tpu.ops import losses  # noqa: F401

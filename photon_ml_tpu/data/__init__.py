@@ -1,0 +1,1 @@
+from photon_ml_tpu.data.dataset import GlmData  # noqa: F401

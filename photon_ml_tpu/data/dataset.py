@@ -1,0 +1,110 @@
+"""On-device GLM datasets.
+
+The analogue of the reference's ``LabeledPoint`` RDDs and ``FixedEffectDataset``
+(SURVEY.md §2, "GAME data layer"), reshaped for TPU: instead of millions of
+per-row objects scattered across JVM partitions, one statically-shaped pytree
+per shard — features as a :class:`~photon_ml_tpu.ops.sparse.FeatureMatrix`,
+labels / weights / offsets as flat arrays.  Padding rows (needed to make every
+device's shard the same size) carry ``weight = 0`` so they contribute nothing
+to any weighted sum, which is how all downstream math stays mask-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.ops.sparse import DenseMatrix, FeatureMatrix, from_scipy_csr
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["features", "labels", "weights", "offsets"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class GlmData:
+    """One shard of GLM training data.
+
+    Mirrors the reference's ``LabeledPoint`` (label, features, offset, weight)
+    but batched: all arrays have leading dimension ``n_rows``.
+    """
+
+    features: FeatureMatrix
+    labels: Array  # (n_rows,)
+    weights: Array  # (n_rows,) — 0 for padding rows
+    offsets: Array  # (n_rows,) — fixed per-row margin offsets
+
+    @property
+    def n_rows(self) -> int:
+        return self.features.shape[0]
+
+    @property
+    def n_features(self) -> int:
+        return self.features.shape[1]
+
+    @property
+    def weight_sum(self) -> Array:
+        return jnp.sum(self.weights)
+
+
+def make_glm_data(
+    features,
+    labels,
+    weights=None,
+    offsets=None,
+    pad_rows: int | None = None,
+    pad_nnz: int | None = None,
+    dtype=jnp.float32,
+) -> GlmData:
+    """Build a GlmData shard from host data.
+
+    ``features`` may be a numpy 2-D array (→ DenseMatrix) or a scipy sparse
+    matrix (→ SparseMatrix).  ``pad_rows`` pads the row dimension with
+    zero-weight rows up to a static budget.
+    """
+    import scipy.sparse as sp
+
+    n = features.shape[0]
+    labels = np.asarray(labels, dtype=np.float32)
+    weights = (
+        np.ones(n, np.float32) if weights is None else np.asarray(weights, np.float32)
+    )
+    offsets = (
+        np.zeros(n, np.float32) if offsets is None else np.asarray(offsets, np.float32)
+    )
+    target_rows = pad_rows if pad_rows is not None else n
+    if target_rows < n:
+        raise ValueError(f"pad_rows={target_rows} < n_rows={n}")
+    pad = target_rows - n
+    if pad:
+        labels = np.concatenate([labels, np.zeros(pad, np.float32)])
+        weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+        offsets = np.concatenate([offsets, np.zeros(pad, np.float32)])
+
+    if sp.issparse(features):
+        if pad:
+            features = sp.vstack(
+                [features.tocsr(), sp.csr_matrix((pad, features.shape[1]))]
+            )
+        fm: FeatureMatrix = from_scipy_csr(features, pad_nnz=pad_nnz, dtype=dtype)
+    else:
+        dense = np.asarray(features)
+        if pad:
+            dense = np.concatenate(
+                [dense, np.zeros((pad, dense.shape[1]), dense.dtype)]
+            )
+        fm = DenseMatrix(jnp.asarray(dense, dtype=dtype))
+
+    return GlmData(
+        features=fm,
+        labels=jnp.asarray(labels),
+        weights=jnp.asarray(weights),
+        offsets=jnp.asarray(offsets),
+    )

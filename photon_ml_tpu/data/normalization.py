@@ -1,0 +1,130 @@
+"""Feature normalization without touching the data.
+
+The analogue of the reference's ``NormalizationContext`` /
+``NormalizationType`` (SURVEY.md §2): training operates in a *scaled*
+coefficient space while the (cached, shared, sparse) data stays unscaled.
+For scaled feature x'ⱼ = (xⱼ - shiftⱼ)·factorⱼ, the margin of scaled-space
+coefficients w is
+
+    m = Σⱼ wⱼ·factorⱼ·xⱼ  -  Σⱼ wⱼ·factorⱼ·shiftⱼ
+
+so the objective only needs two hooks: component-wise coefficient scaling by
+``factors`` and a scalar margin correction ``-<w, factors·shifts>``.  Shifts
+require an intercept term (exactly the reference's constraint for
+STANDARDIZATION).
+
+Conversion back to the original space (for model output) is
+``w_original = w_model · factors`` with the intercept absorbing
+``-<w_model, factors·shifts>``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+class NormalizationType(enum.Enum):
+    NONE = "none"
+    SCALE_WITH_STANDARD_DEVIATION = "scale_with_standard_deviation"
+    SCALE_WITH_MAX_MAGNITUDE = "scale_with_max_magnitude"
+    STANDARDIZATION = "standardization"
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["factors", "shifts"],
+    meta_fields=["intercept_index"],
+)
+@dataclasses.dataclass
+class NormalizationContext:
+    """Broadcast-once normalization state (the reference broadcasts this too).
+
+    ``factors`` / ``shifts`` have shape (n_features,).  ``intercept_index``
+    is the column holding the constant-1 intercept feature (or None).  The
+    intercept's own factor is 1 and shift is 0 by construction.
+    """
+
+    factors: Array
+    shifts: Array
+    intercept_index: Optional[int] = None
+
+    # -- coefficient-space transforms -------------------------------------
+    def model_to_original(self, w_model: Array) -> Array:
+        """Map scaled-space coefficients to original-space coefficients."""
+        w = w_model * self.factors
+        if self.intercept_index is not None:
+            corr = -jnp.dot(w_model, self.factors * self.shifts)
+            w = w.at[self.intercept_index].add(corr)
+        return w
+
+    def original_to_model(self, w_orig: Array) -> Array:
+        """Inverse of :meth:`model_to_original` (factors must be nonzero)."""
+        w = w_orig / self.factors
+        if self.intercept_index is not None:
+            # Undo the intercept correction: w_orig[i] = w_model[i]·f[i] + corr
+            # where corr depends only on non-intercept coords (shift[i] = 0).
+            corr = -jnp.dot(w, self.factors * self.shifts)
+            w = w.at[self.intercept_index].add(-corr / self.factors[self.intercept_index])
+        return w
+
+    @staticmethod
+    def identity(n_features: int) -> "NormalizationContext":
+        return NormalizationContext(
+            factors=jnp.ones((n_features,), jnp.float32),
+            shifts=jnp.zeros((n_features,), jnp.float32),
+            intercept_index=None,
+        )
+
+
+def build_normalization(
+    norm_type: NormalizationType,
+    summary,  # BasicStatisticalSummary (data/stats.py); duck-typed
+    intercept_index: Optional[int] = None,
+) -> NormalizationContext:
+    """Build a NormalizationContext from per-feature summary statistics,
+    mirroring the reference's ``NormalizationContext(normalizationType,
+    summary, interceptId)`` factory."""
+    mean = np.asarray(summary.mean, np.float32)
+    std = np.sqrt(np.asarray(summary.variance, np.float32))
+    max_mag = np.maximum(
+        np.abs(np.asarray(summary.max, np.float32)),
+        np.abs(np.asarray(summary.min, np.float32)),
+    )
+    n = mean.shape[0]
+    factors = np.ones(n, np.float32)
+    shifts = np.zeros(n, np.float32)
+
+    if norm_type is NormalizationType.NONE:
+        pass
+    elif norm_type is NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factors = 1.0 / np.where(std > 0, std, 1.0)
+    elif norm_type is NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        factors = 1.0 / np.where(max_mag > 0, max_mag, 1.0)
+    elif norm_type is NormalizationType.STANDARDIZATION:
+        if intercept_index is None:
+            raise ValueError(
+                "STANDARDIZATION requires an intercept term (as in the reference)"
+            )
+        factors = 1.0 / np.where(std > 0, std, 1.0)
+        shifts = mean.copy()
+    else:
+        raise ValueError(f"unknown normalization type {norm_type}")
+
+    if intercept_index is not None:
+        factors[intercept_index] = 1.0
+        shifts[intercept_index] = 0.0
+
+    return NormalizationContext(
+        factors=jnp.asarray(factors),
+        shifts=jnp.asarray(shifts),
+        intercept_index=intercept_index,
+    )

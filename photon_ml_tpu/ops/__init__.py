@@ -1,0 +1,2 @@
+from photon_ml_tpu.ops import losses  # noqa: F401
+from photon_ml_tpu.ops import sparse  # noqa: F401

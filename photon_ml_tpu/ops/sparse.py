@@ -1,0 +1,166 @@
+"""Feature-matrix representations: dense and sparse, TPU-first.
+
+The reference keeps examples as Breeze ``SparseVector``s inside RDD
+partitions and runs BLAS dot/axpy per row inside its aggregators
+(SURVEY.md §2, "Gradient/HVP aggregators").  TPUs want the opposite layout:
+one large, statically-shaped, padded structure per shard that XLA can tile
+onto the MXU / VPU.  Two interchangeable representations:
+
+- ``DenseMatrix``: a plain ``(n_rows, n_cols)`` array; margins are a single
+  matmul on the MXU.  Right for narrow feature spaces (a1a has 123 features)
+  and for the padded per-entity blocks of random-effect solves.
+
+- ``SparseMatrix``: flat COO with a static nnz budget (padding entries carry
+  ``value = 0`` and point at row 0 / col 0, so they contribute nothing).
+  ``matvec`` is gather + ``segment_sum`` over row ids; ``rmatvec`` (the Xᵀu
+  needed for gradients) is gather + ``segment_sum`` over column ids.  Row ids
+  are kept sorted so ``indices_are_sorted`` lets XLA lower the row reduction
+  efficiently.
+
+Both are registered as pytrees, so they can live inside ``jit``/``shard_map``
+programs and be device-put once and reused across optimizer iterations
+(the analogue of the reference persisting its RDDs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["data"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class DenseMatrix:
+    """Dense feature matrix of shape (n_rows, n_cols)."""
+
+    data: Array
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return self.data.shape
+
+    @property
+    def n_rows(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.data.shape[1]
+
+    def matvec(self, w: Array) -> Array:
+        """X @ w → (n_rows,) margins."""
+        return self.data @ w
+
+    def rmatvec(self, u: Array) -> Array:
+        """Xᵀ @ u → (n_cols,) — the gradient-side reduction."""
+        return self.data.T @ u
+
+    def row_sq_matvec(self, v: Array) -> Array:
+        """(X ⊙ X) @ v — used for diagonal-Hessian preconditioners."""
+        return (self.data * self.data) @ v
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["row_ids", "col_ids", "values"],
+    meta_fields=["n_rows", "n_cols"],
+)
+@dataclasses.dataclass
+class SparseMatrix:
+    """Flat COO sparse matrix with a static (padded) nnz budget.
+
+    Invariants: ``row_ids`` sorted ascending; padding entries have
+    ``values == 0`` (their row/col ids are arbitrary but in-range).
+    """
+
+    row_ids: Array  # (nnz,) int32, sorted
+    col_ids: Array  # (nnz,) int32
+    values: Array  # (nnz,) float
+    n_rows: int
+    n_cols: int
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return (self.n_rows, self.n_cols)
+
+    @property
+    def nnz(self) -> int:
+        return self.values.shape[0]
+
+    def matvec(self, w: Array) -> Array:
+        contrib = self.values * jnp.take(w, self.col_ids)
+        return jax.ops.segment_sum(
+            contrib, self.row_ids, num_segments=self.n_rows, indices_are_sorted=True
+        )
+
+    def rmatvec(self, u: Array) -> Array:
+        contrib = self.values * jnp.take(u, self.row_ids)
+        return jax.ops.segment_sum(contrib, self.col_ids, num_segments=self.n_cols)
+
+    def row_sq_matvec(self, v: Array) -> Array:
+        contrib = self.values * self.values * jnp.take(v, self.col_ids)
+        return jax.ops.segment_sum(
+            contrib, self.row_ids, num_segments=self.n_rows, indices_are_sorted=True
+        )
+
+    def to_dense(self) -> DenseMatrix:
+        dense = jnp.zeros(self.shape, dtype=self.values.dtype)
+        dense = dense.at[self.row_ids, self.col_ids].add(self.values)
+        return DenseMatrix(dense)
+
+
+FeatureMatrix = Union[DenseMatrix, SparseMatrix]
+
+
+def from_scipy_csr(csr, pad_nnz: int | None = None, dtype=jnp.float32) -> SparseMatrix:
+    """Build a SparseMatrix from a scipy CSR matrix, padding nnz to a static budget."""
+    csr = csr.tocsr()
+    coo = csr.tocoo()
+    return from_coo(
+        coo.row, coo.col, coo.data, csr.shape[0], csr.shape[1], pad_nnz, dtype
+    )
+
+
+def from_coo(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    n_cols: int,
+    pad_nnz: int | None = None,
+    dtype=jnp.float32,
+) -> SparseMatrix:
+    """Build a SparseMatrix from host COO triples (sorts by row, pads nnz)."""
+    order = np.argsort(rows, kind="stable")
+    rows = np.asarray(rows)[order].astype(np.int32)
+    cols = np.asarray(cols)[order].astype(np.int32)
+    vals = np.asarray(vals)[order]
+    nnz = rows.shape[0]
+    budget = pad_nnz if pad_nnz is not None else nnz
+    if budget < nnz:
+        raise ValueError(f"pad_nnz={budget} < actual nnz={nnz}")
+    pad = budget - nnz
+    if pad:
+        # Pad at the end with the last row id to keep the sorted invariant.
+        pad_row = rows[-1] if nnz else 0
+        rows = np.concatenate([rows, np.full(pad, pad_row, np.int32)])
+        cols = np.concatenate([cols, np.zeros(pad, np.int32)])
+        vals = np.concatenate([vals, np.zeros(pad, vals.dtype)])
+    return SparseMatrix(
+        row_ids=jnp.asarray(rows),
+        col_ids=jnp.asarray(cols),
+        values=jnp.asarray(vals, dtype=dtype),
+        n_rows=int(n_rows),
+        n_cols=int(n_cols),
+    )

@@ -1,0 +1,2 @@
+from photon_ml_tpu.optim.objective import GlmObjective  # noqa: F401
+from photon_ml_tpu.optim.regularization import RegularizationContext  # noqa: F401

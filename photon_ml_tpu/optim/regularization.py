@@ -1,0 +1,60 @@
+"""Regularization configuration.
+
+The analogue of the reference's ``RegularizationContext`` /
+``RegularizationType`` (SURVEY.md §2): L2 is folded into the differentiable
+objective (value, gradient, Hessian all see it); L1 is *not* differentiable
+and is handled by the OWL-QN optimizer's orthant machinery; elastic net
+splits one regularization weight λ into α·λ toward L1 and (1-α)·λ toward L2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class RegularizationType(enum.Enum):
+    NONE = "none"
+    L1 = "l1"
+    L2 = "l2"
+    ELASTIC_NET = "elastic_net"
+
+
+@dataclasses.dataclass(frozen=True)
+class RegularizationContext:
+    """Splits a total regularization weight into its L1 and L2 components."""
+
+    reg_type: RegularizationType = RegularizationType.NONE
+    # Elastic-net mixing weight α: fraction of λ applied as L1 (as in the
+    # reference's ElasticNetRegularizationContext).
+    alpha: float = 0.5
+
+    def l1_weight(self, reg_weight: float) -> float:
+        if self.reg_type is RegularizationType.L1:
+            return reg_weight
+        if self.reg_type is RegularizationType.ELASTIC_NET:
+            return self.alpha * reg_weight
+        return 0.0
+
+    def l2_weight(self, reg_weight: float) -> float:
+        if self.reg_type is RegularizationType.L2:
+            return reg_weight
+        if self.reg_type is RegularizationType.ELASTIC_NET:
+            return (1.0 - self.alpha) * reg_weight
+        return 0.0
+
+    @staticmethod
+    def none() -> "RegularizationContext":
+        return RegularizationContext(RegularizationType.NONE)
+
+    @staticmethod
+    def l1() -> "RegularizationContext":
+        return RegularizationContext(RegularizationType.L1)
+
+    @staticmethod
+    def l2() -> "RegularizationContext":
+        return RegularizationContext(RegularizationType.L2)
+
+    @staticmethod
+    def elastic_net(alpha: float) -> "RegularizationContext":
+        return RegularizationContext(RegularizationType.ELASTIC_NET, alpha)

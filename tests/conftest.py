@@ -1,0 +1,42 @@
+"""Test fixtures.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the reference runs its
+"distributed" integration tests on `local[*]` Spark with multiple partitions;
+the TPU-native analogue is a virtual 8-device CPU mesh via
+``--xla_force_host_platform_device_count=8``, which exercises real psum /
+sharding semantics without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax initializes any backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# Float64 for finite-difference oracles and scipy parity checks.  Library
+# data paths pin float32 explicitly, so this only affects test-constructed
+# float64 arrays.
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260729)
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual CPU devices, got {len(devices)}"
+    return devices[:8]

@@ -1,0 +1,85 @@
+"""Unit tests for pointwise losses: derivatives vs finite differences and
+autodiff — the TPU-native mirror of the reference's loss-function unit tests
+(SURVEY.md §4: "loss tests check value/gradient/Hessian against finite
+differences")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops import losses
+
+ALL_LOSSES = [losses.logistic, losses.squared, losses.poisson, losses.smoothed_hinge]
+
+
+def _labels_for(loss, rng, n):
+    if loss.name in ("logistic", "smoothed_hinge"):
+        return rng.integers(0, 2, n).astype(np.float32)
+    if loss.name == "poisson":
+        return rng.poisson(2.0, n).astype(np.float32)
+    return rng.normal(size=n).astype(np.float32)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_d1_matches_finite_difference(loss, rng):
+    n = 64
+    # Stay away from the hinge's (measure-zero) kink points z ∈ {0, 1}.
+    m = rng.uniform(-3.0, 3.0, n).astype(np.float64)
+    y = _labels_for(loss, rng, n).astype(np.float64)
+    eps = 1e-5
+    num = (np.asarray(loss.value(m + eps, y), np.float64) -
+           np.asarray(loss.value(m - eps, y), np.float64)) / (2 * eps)
+    ana = np.asarray(loss.d1(m, y), np.float64)
+    np.testing.assert_allclose(ana, num, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_d2_matches_finite_difference(loss, rng):
+    n = 64
+    m = rng.uniform(-3.0, 3.0, n).astype(np.float64)
+    # Keep margins off the hinge's kink neighborhoods.
+    m = np.where(np.abs(m) < 0.05, 0.5, m)
+    m = np.where(np.abs(m - 1.0) < 0.05, 0.5, m)
+    m = np.where(np.abs(m + 1.0) < 0.05, -0.5, m)
+    y = _labels_for(loss, rng, n).astype(np.float64)
+    eps = 1e-5
+    num = (np.asarray(loss.d1(m + eps, y), np.float64) -
+           np.asarray(loss.d1(m - eps, y), np.float64)) / (2 * eps)
+    ana = np.asarray(loss.d2(m, y), np.float64)
+    np.testing.assert_allclose(ana, num, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_d1_matches_autodiff(loss, rng):
+    m = jnp.asarray(rng.uniform(-3.0, 3.0, 32), jnp.float32)
+    y = jnp.asarray(_labels_for(loss, rng, 32))
+    auto = jax.vmap(jax.grad(lambda mm, yy: loss.value(mm, yy)))(m, y)
+    np.testing.assert_allclose(np.asarray(loss.d1(m, y)), np.asarray(auto),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_logistic_value_is_negative_log_likelihood():
+    m = jnp.asarray([0.0, 2.0, -2.0])
+    y = jnp.asarray([1.0, 1.0, 0.0])
+    p = jax.nn.sigmoid(m)
+    expected = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+    np.testing.assert_allclose(
+        np.asarray(losses.logistic.value(m, y)), np.asarray(expected), rtol=1e-6
+    )
+
+
+def test_convexity_d2_nonnegative(rng):
+    m = jnp.asarray(rng.uniform(-5, 5, 100), jnp.float32)
+    for loss in ALL_LOSSES:
+        y = jnp.asarray(_labels_for(loss, rng, 100))
+        assert np.all(np.asarray(loss.d2(m, y)) >= 0.0), loss.name
+
+
+def test_registry_lookup_and_aliases():
+    assert losses.get("LOGISTIC_REGRESSION") is losses.logistic
+    assert losses.get("linear_regression") is losses.squared
+    assert losses.get("POISSON_REGRESSION") is losses.poisson
+    assert losses.get("smoothed_hinge_loss_linear_svm") is losses.smoothed_hinge
+    with pytest.raises(KeyError):
+        losses.get("hubber")

@@ -1,0 +1,140 @@
+"""Objective tests: closed-form gradient/HVP vs autodiff oracle, sparse vs
+dense agreement, and normalization-context semantics — mirroring the
+reference's distributed-vs-single-node numerical parity pattern
+(SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.dataset import make_glm_data
+from photon_ml_tpu.data.normalization import NormalizationContext
+from photon_ml_tpu.ops import losses, sparse
+from photon_ml_tpu.optim.objective import GlmObjective
+
+
+def _random_problem(rng, n=50, d=8, density=0.4, loss=losses.logistic):
+    X = sp.random(n, d, density=density, random_state=np.random.RandomState(0),
+                  format="csr", dtype=np.float64)
+    if loss.name == "poisson":
+        y = rng.poisson(1.5, n).astype(np.float32)
+    elif loss.name in ("logistic", "smoothed_hinge"):
+        y = rng.integers(0, 2, n).astype(np.float32)
+    else:
+        y = rng.normal(size=n).astype(np.float32)
+    w_rows = rng.uniform(0.5, 2.0, n).astype(np.float32)
+    offs = rng.normal(size=n).astype(np.float32) * 0.1
+    return X, y, w_rows, offs
+
+
+@pytest.mark.parametrize("loss", [losses.logistic, losses.squared, losses.poisson],
+                         ids=lambda l: l.name)
+@pytest.mark.parametrize("dense", [True, False], ids=["dense", "sparse"])
+def test_grad_matches_autodiff(rng, loss, dense):
+    X, y, w_rows, offs = _random_problem(rng, loss=loss)
+    feats = X.toarray() if dense else X
+    data = make_glm_data(feats, y, w_rows, offs)
+    obj = GlmObjective(loss)
+    w = jnp.asarray(rng.normal(size=X.shape[1]) * 0.3, jnp.float32)
+    l2 = 0.7
+
+    val, grad = obj.value_and_grad(w, data, l2)
+    auto_val, auto_grad = jax.value_and_grad(lambda ww: obj.value(ww, data, l2))(w)
+    np.testing.assert_allclose(float(val), float(auto_val), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(auto_grad),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("loss", [losses.logistic, losses.squared, losses.poisson],
+                         ids=lambda l: l.name)
+def test_hvp_matches_autodiff(rng, loss):
+    X, y, w_rows, offs = _random_problem(rng, loss=loss)
+    data = make_glm_data(X, y, w_rows, offs)
+    obj = GlmObjective(loss)
+    w = jnp.asarray(rng.normal(size=X.shape[1]) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.normal(size=X.shape[1]), jnp.float32)
+    l2 = 0.3
+
+    hvp = obj.hvp(w, v, data, l2)
+    # Forward-over-reverse oracle. For GLM losses the Gauss-Newton form IS
+    # the true Hessian (margins are linear in w), so these must agree.
+    auto = jax.jvp(jax.grad(lambda ww: obj.value(ww, data, l2)), (w,), (v,))[1]
+    np.testing.assert_allclose(np.asarray(hvp), np.asarray(auto),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_sparse_matches_dense(rng):
+    X, y, w_rows, offs = _random_problem(rng)
+    obj = GlmObjective(losses.logistic)
+    d_sparse = make_glm_data(X, y, w_rows, offs)
+    d_dense = make_glm_data(X.toarray(), y, w_rows, offs)
+    w = jnp.asarray(rng.normal(size=X.shape[1]), jnp.float32)
+    v_s, g_s = obj.value_and_grad(w, d_sparse, 0.1)
+    v_d, g_d = obj.value_and_grad(w, d_dense, 0.1)
+    np.testing.assert_allclose(float(v_s), float(v_d), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_s), np.asarray(g_d), rtol=1e-4, atol=1e-5)
+
+
+def test_nnz_padding_is_inert(rng):
+    X, y, w_rows, offs = _random_problem(rng)
+    obj = GlmObjective(losses.logistic)
+    d0 = make_glm_data(X, y, w_rows, offs)
+    d_pad = make_glm_data(X, y, w_rows, offs, pad_rows=64, pad_nnz=X.nnz + 37)
+    w = jnp.asarray(rng.normal(size=X.shape[1]), jnp.float32)
+    v0, g0 = obj.value_and_grad(w, d0, 0.0)
+    v1, g1 = obj.value_and_grad(w, d_pad, 0.0)
+    np.testing.assert_allclose(float(v0), float(v1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1), rtol=1e-4, atol=1e-5)
+
+
+def test_normalization_context_equals_pre_scaled_data(rng):
+    """Training with a NormalizationContext on raw data must equal training on
+    explicitly standardized data — the reference's core normalization claim."""
+    n, d = 40, 5
+    Xd = rng.normal(size=(n, d)).astype(np.float64) * 3.0 + 1.0
+    Xd[:, -1] = 1.0  # intercept column
+    y = rng.integers(0, 2, n).astype(np.float32)
+    mean = Xd.mean(axis=0)
+    std = Xd.std(axis=0, ddof=0)
+    factors = 1.0 / np.where(std > 0, std, 1.0)
+    shifts = mean.copy()
+    factors[-1], shifts[-1] = 1.0, 0.0
+
+    Xs = (Xd - shifts) * factors  # explicitly standardized
+    norm = NormalizationContext(
+        factors=jnp.asarray(factors, jnp.float32),
+        shifts=jnp.asarray(shifts, jnp.float32),
+        intercept_index=d - 1,
+    )
+    obj_norm = GlmObjective(losses.logistic, normalization=norm)
+    obj_plain = GlmObjective(losses.logistic)
+    data_raw = make_glm_data(Xd, y)
+    data_scaled = make_glm_data(Xs, y)
+
+    w = jnp.asarray(rng.normal(size=d), jnp.float32)
+    v_n, g_n = obj_norm.value_and_grad(w, data_raw, 0.5)
+    v_s, g_s = obj_plain.value_and_grad(w, data_scaled, 0.5)
+    np.testing.assert_allclose(float(v_n), float(v_s), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(g_n), np.asarray(g_s), rtol=1e-3, atol=1e-3)
+
+    # Round-trip of the coefficient-space transforms.
+    w_orig = norm.model_to_original(w)
+    w_back = norm.original_to_model(w_orig)
+    np.testing.assert_allclose(np.asarray(w_back), np.asarray(w), rtol=1e-4, atol=1e-5)
+
+    # Margins computed in model space on raw data == margins of original-space
+    # coefficients on raw data.
+    m_model = obj_norm.margins(w, data_raw)
+    m_orig = obj_plain.margins(w_orig, data_raw)
+    np.testing.assert_allclose(np.asarray(m_model), np.asarray(m_orig),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_sparse_to_dense_roundtrip(rng):
+    X = sp.random(20, 7, density=0.5, random_state=np.random.RandomState(1),
+                  format="csr")
+    sm = sparse.from_scipy_csr(X, pad_nnz=X.nnz + 11)
+    np.testing.assert_allclose(np.asarray(sm.to_dense().data), X.toarray(),
+                               rtol=1e-6, atol=1e-6)
