@@ -126,6 +126,7 @@ FeatureMatrix = Union[DenseMatrix, SparseMatrix]
 def from_scipy_csr(csr, pad_nnz: int | None = None, dtype=jnp.float32) -> SparseMatrix:
     """Build a SparseMatrix from a scipy CSR matrix, padding nnz to a static budget."""
     csr = csr.tocsr()
+    csr.sum_duplicates()
     coo = csr.tocoo()
     return from_coo(
         coo.row, coo.col, coo.data, csr.shape[0], csr.shape[1], pad_nnz, dtype
@@ -141,11 +142,23 @@ def from_coo(
     pad_nnz: int | None = None,
     dtype=jnp.float32,
 ) -> SparseMatrix:
-    """Build a SparseMatrix from host COO triples (sorts by row, pads nnz)."""
+    """Build a SparseMatrix from host COO triples (dedups duplicate (row, col)
+    entries by summing, sorts by row, pads nnz)."""
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    vals = np.asarray(vals)
+    # Canonicalize: duplicate coordinates must be summed, or row_sq_matvec
+    # (which squares per-entry values) diverges from the dense equivalent.
+    keys = rows.astype(np.int64) * np.int64(n_cols) + cols.astype(np.int64)
+    uniq, inverse = np.unique(keys, return_inverse=True)
+    if uniq.shape[0] != keys.shape[0]:
+        summed = np.zeros(uniq.shape[0], dtype=vals.dtype)
+        np.add.at(summed, inverse, vals)
+        rows, cols, vals = (uniq // n_cols), (uniq % n_cols), summed
     order = np.argsort(rows, kind="stable")
-    rows = np.asarray(rows)[order].astype(np.int32)
-    cols = np.asarray(cols)[order].astype(np.int32)
-    vals = np.asarray(vals)[order]
+    rows = rows[order].astype(np.int32)
+    cols = cols[order].astype(np.int32)
+    vals = vals[order]
     nnz = rows.shape[0]
     budget = pad_nnz if pad_nnz is not None else nnz
     if budget < nnz:

@@ -1,0 +1,183 @@
+"""Weak-Wolfe line search as a bounded ``lax.while_loop``.
+
+The reference's L-BFGS delegates line search to Breeze's
+``StrongWolfeLineSearch`` (SURVEY.md §2, Optimizers).  On TPU the line search
+must live *inside* the jitted optimizer step — a host round-trip per trial
+point would dominate the epoch time — so we use the classic bisection /
+doubling weak-Wolfe search (Lewis & Overton style): it needs no nested zoom
+stage, is branchless-friendly, and terminates in a bounded number of
+objective evaluations, which is exactly what ``lax.while_loop`` wants.
+
+Each trial point costs one fused value+gradient evaluation (for distributed
+objectives, one ``psum`` over ICI — the analogue of one ``treeAggregate``
+round in the reference's hot loop, SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+# f(w) -> (value, grad): the only thing the line search needs.
+ValueAndGrad = Callable[[Array], tuple[Array, Array]]
+
+
+@dataclasses.dataclass(frozen=True)
+class LineSearchConfig:
+    c1: float = 1e-4  # Armijo (sufficient decrease) constant
+    c2: float = 0.9  # curvature constant (0.9 is standard for quasi-Newton)
+    max_evals: int = 30
+    min_step: float = 1e-20
+    max_step: float = 1e20
+
+
+class LineSearchResult(NamedTuple):
+    step: Array  # accepted step size t
+    w: Array  # w0 + t * direction
+    value: Array  # f(w)
+    grad: Array  # ∇f(w)
+    n_evals: Array  # objective evaluations used
+    success: Array  # bool — both Wolfe conditions met
+
+
+class _SearchState(NamedTuple):
+    lo: Array  # lower bracket (largest t known to satisfy Armijo)
+    hi: Array  # upper bracket (smallest t known to violate Armijo); inf if none
+    t: Array
+    w: Array
+    value: Array
+    grad: Array
+    dg: Array  # directional derivative at t
+    n_evals: Array
+    done: Array
+
+
+def wolfe_line_search(
+    value_and_grad: ValueAndGrad,
+    w0: Array,
+    f0: Array,
+    g0: Array,
+    direction: Array,
+    initial_step: Array | float = 1.0,
+    config: LineSearchConfig = LineSearchConfig(),
+) -> LineSearchResult:
+    """Find t satisfying the weak Wolfe conditions along ``direction``.
+
+    Bisection bracketing: Armijo failure shrinks the upper bracket, curvature
+    failure grows the lower bracket; the next trial is the midpoint (or 2·lo
+    while unbracketed).  Always returns the last evaluated point; ``success``
+    reports whether the Wolfe conditions actually held (callers fall back to
+    steepest descent / skip the curvature pair when it is False).
+    """
+    dg0 = jnp.vdot(direction, g0)
+    t0 = jnp.asarray(initial_step, dtype=f0.dtype)
+
+    def evaluate(t):
+        w = w0 + t * direction
+        value, grad = value_and_grad(w)
+        return w, value, grad, jnp.vdot(direction, grad)
+
+    def cond(s: _SearchState):
+        return jnp.logical_and(~s.done, s.n_evals < config.max_evals)
+
+    def body(s: _SearchState):
+        armijo_ok = s.value <= f0 + config.c1 * s.t * dg0
+        curvature_ok = s.dg >= config.c2 * dg0
+        done = jnp.logical_and(armijo_ok, curvature_ok)
+
+        # Armijo fails → bracket from above; curvature fails → from below.
+        hi = jnp.where(armijo_ok, s.hi, jnp.minimum(s.hi, s.t))
+        lo = jnp.where(armijo_ok, jnp.maximum(s.lo, s.t), s.lo)
+        t_next = jnp.where(jnp.isinf(hi), 2.0 * lo, 0.5 * (lo + hi))
+        t_next = jnp.clip(t_next, config.min_step, config.max_step)
+
+        # Degenerate bracket → stop where we are.
+        stuck = jnp.logical_or(t_next == s.t, hi - lo < config.min_step)
+        done = jnp.logical_or(done, stuck)
+
+        def step(_):
+            w, value, grad, dg = evaluate(t_next)
+            return _SearchState(
+                lo, hi, t_next, w, value, grad, dg, s.n_evals + 1, done
+            )
+
+        def stay(_):
+            return _SearchState(
+                lo, hi, s.t, s.w, s.value, s.grad, s.dg, s.n_evals, done
+            )
+
+        return lax.cond(done, stay, step, None)
+
+    w1, f1, g1, dg1 = evaluate(t0)
+    init = _SearchState(
+        lo=jnp.zeros_like(t0),
+        hi=jnp.full_like(t0, jnp.inf),
+        t=t0,
+        w=w1,
+        value=f1,
+        grad=g1,
+        dg=dg1,
+        n_evals=jnp.asarray(1, jnp.int32),
+        done=jnp.asarray(False),
+    )
+    final = lax.while_loop(cond, body, init)
+
+    armijo_ok = final.value <= f0 + config.c1 * final.t * dg0
+    curvature_ok = final.dg >= config.c2 * dg0
+    success = jnp.logical_and(armijo_ok, curvature_ok)
+    return LineSearchResult(
+        step=final.t,
+        w=final.w,
+        value=final.value,
+        grad=final.grad,
+        n_evals=final.n_evals,
+        success=success,
+    )
+
+
+def backtracking_line_search(
+    value_fn: Callable[[Array], Array],
+    w0: Array,
+    f0: Array,
+    dg0: Array,
+    direction: Array,
+    initial_step: Array | float = 1.0,
+    c1: float = 1e-4,
+    shrink: float = 0.5,
+    max_evals: int = 30,
+) -> tuple[Array, Array, Array]:
+    """Armijo-only backtracking (used by OWL-QN, whose curvature condition is
+    replaced by orthant projection).  ``dg0`` is the directional derivative of
+    the *search* model at 0 (for OWL-QN, measured with the pseudo-gradient).
+
+    Returns ``(t, w, value)`` of the accepted point.
+    """
+    t0 = jnp.asarray(initial_step, dtype=f0.dtype)
+
+    def evaluate(t):
+        w = w0 + t * direction
+        return w, value_fn(w)
+
+    def cond(s):
+        t, _, value, n = s
+        return jnp.logical_and(
+            value > f0 + c1 * t * dg0, n < max_evals
+        )
+
+    def body(s):
+        t, _, _, n = s
+        t_next = t * shrink
+        w, value = evaluate(t_next)
+        return (t_next, w, value, n + 1)
+
+    w1, f1 = evaluate(t0)
+    t, w, value, _ = lax.while_loop(
+        cond, body, (t0, w1, f1, jnp.asarray(1, jnp.int32))
+    )
+    return t, w, value

@@ -1,0 +1,224 @@
+"""Optimizer convergence tests.
+
+Mirrors the reference's optimizer unit-test strategy (SURVEY.md §4):
+convergence on small convex objectives with known minima, plus parity
+against scipy oracles (the stand-in for Breeze until the reference tree is
+readable).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+
+from photon_ml_tpu.data.dataset import make_glm_data
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.optim.lbfgs import LBFGSConfig, lbfgs_solve
+from photon_ml_tpu.optim.objective import GlmObjective
+from photon_ml_tpu.optim.owlqn import OWLQNConfig, owlqn_solve
+from photon_ml_tpu.optim.tron import TRONConfig, tron_solve
+
+
+def _logistic_problem(rng, n=200, d=10, dtype=np.float64):
+    X = rng.normal(size=(n, d)).astype(dtype)
+    w_true = rng.normal(size=d).astype(dtype)
+    p = 1.0 / (1.0 + np.exp(-X @ w_true))
+    y = (rng.uniform(size=n) < p).astype(dtype)
+    data = make_glm_data(X, y, dtype=jnp.float64)
+    obj = GlmObjective(losses.logistic)
+    return X, y, data, obj
+
+
+def _scipy_logistic_min(X, y, l2):
+    def f(w):
+        m = X @ w
+        val = np.sum(np.logaddexp(0, m) - y * m) + 0.5 * l2 * w @ w
+        g = X.T @ (1 / (1 + np.exp(-m)) - y) + l2 * w
+        return val, g
+
+    res = scipy.optimize.minimize(
+        f, np.zeros(X.shape[1]), jac=True, method="L-BFGS-B",
+        options={"maxiter": 500, "ftol": 1e-14, "gtol": 1e-10},
+    )
+    return res
+
+
+class TestLBFGS:
+    def test_quadratic_exact(self):
+        d = 20
+        diag = jnp.linspace(1.0, 50.0, d)
+        target = jnp.arange(1.0, d + 1.0)
+
+        def vg(w):
+            r = w - target
+            return 0.5 * jnp.vdot(r, diag * r), diag * r
+
+        res = lbfgs_solve(vg, jnp.zeros(d), LBFGSConfig(tolerance=1e-10))
+        np.testing.assert_allclose(np.asarray(res.w), np.asarray(target), atol=1e-6)
+        assert bool(res.converged)
+
+    def test_logistic_matches_scipy(self, rng):
+        X, y, data, obj = _logistic_problem(rng)
+        l2 = 0.1
+
+        def vg(w):
+            return obj.value_and_grad(w, data, l2_weight=l2)
+
+        res = lbfgs_solve(vg, jnp.zeros(X.shape[1], jnp.float64),
+                          LBFGSConfig(tolerance=1e-9, max_iters=200))
+        oracle = _scipy_logistic_min(X, y, l2)
+        assert float(res.value) <= oracle.fun + 1e-6
+        np.testing.assert_allclose(np.asarray(res.w), oracle.x, atol=1e-3)
+
+    def test_jit_and_tracker(self, rng):
+        X, y, data, obj = _logistic_problem(rng, n=50, d=5)
+
+        @jax.jit
+        def solve(w0):
+            return lbfgs_solve(
+                lambda w: obj.value_and_grad(w, data, l2_weight=1.0),
+                w0,
+                LBFGSConfig(max_iters=50),
+            )
+
+        res = solve(jnp.zeros(5, jnp.float64))
+        vals = np.asarray(res.values)
+        vals = vals[~np.isnan(vals)]
+        # Objective decreases monotonically under Wolfe line search.
+        assert np.all(np.diff(vals) <= 1e-10)
+        assert len(vals) == int(res.iterations) + 1
+
+    def test_vmap_batched_solves(self, rng):
+        # The random-effect pattern: many independent small problems at once.
+        B, n, d = 4, 30, 3
+        Xs = rng.normal(size=(B, n, d))
+        ys = (rng.uniform(size=(B, n)) < 0.5).astype(np.float64)
+
+        def solve_one(X, y):
+            def vg(w):
+                m = X @ w
+                val = jnp.sum(jax.nn.softplus(m) - y * m) + 0.5 * jnp.vdot(w, w)
+                g = X.T @ (jax.nn.sigmoid(m) - y) + w
+                return val, g
+
+            return lbfgs_solve(vg, jnp.zeros(d, jnp.float64),
+                               LBFGSConfig(max_iters=50)).w
+
+    # noqa: solve each batch member independently and compare with vmap
+        batched = jax.vmap(solve_one)(jnp.asarray(Xs), jnp.asarray(ys))
+        for b in range(B):
+            single = solve_one(jnp.asarray(Xs[b]), jnp.asarray(ys[b]))
+            np.testing.assert_allclose(
+                np.asarray(batched[b]), np.asarray(single), atol=1e-5
+            )
+
+
+class TestOWLQN:
+    def test_soft_threshold_closed_form(self):
+        # min ½‖w - a‖² + λ‖w‖₁ has solution soft(a, λ).
+        a = jnp.array([3.0, -2.0, 0.5, -0.1, 0.0])
+        lam = 1.0
+
+        def vg(w):
+            r = w - a
+            return 0.5 * jnp.vdot(r, r), r
+
+        res = owlqn_solve(vg, jnp.zeros(5, jnp.float64), lam,
+                          OWLQNConfig(tolerance=1e-10))
+        expected = np.sign(np.asarray(a)) * np.maximum(np.abs(np.asarray(a)) - lam, 0)
+        np.testing.assert_allclose(np.asarray(res.w), expected, atol=1e-6)
+
+    def test_l1_logistic_sparsity_and_optimality(self, rng):
+        X, y, data, obj = _logistic_problem(rng, n=300, d=20)
+        lam = 20.0
+
+        def vg(w):
+            return obj.value_and_grad(w, data)
+
+        res = owlqn_solve(vg, jnp.zeros(20, jnp.float64), lam,
+                          OWLQNConfig(max_iters=300, tolerance=1e-9))
+        w = np.asarray(res.w)
+        # Strong L1 ⇒ some exact zeros.
+        assert np.sum(w == 0.0) > 0
+        # KKT: |grad_i| <= lam where w_i == 0; grad_i = -lam*sign(w_i) otherwise.
+        _, g = obj.value_and_grad(res.w, data)
+        g = np.asarray(g)
+        assert np.all(np.abs(g[w == 0.0]) <= lam + 1e-4)
+        np.testing.assert_allclose(
+            g[w != 0.0], -lam * np.sign(w[w != 0.0]), atol=1e-4
+        )
+
+    def test_l1_mask_exempts_intercept(self, rng):
+        # With a huge penalty on all-but-intercept, only intercept survives.
+        n = 200
+        X = np.concatenate(
+            [np.ones((n, 1)), rng.normal(size=(n, 3))], axis=1
+        )
+        y = (rng.uniform(size=n) < 0.8).astype(np.float64)
+        data = make_glm_data(X, y, dtype=jnp.float64)
+        obj = GlmObjective(losses.logistic)
+        mask = jnp.array([0.0, 1.0, 1.0, 1.0])
+
+        res = owlqn_solve(
+            lambda w: obj.value_and_grad(w, data),
+            jnp.zeros(4, jnp.float64),
+            1e4,
+            OWLQNConfig(max_iters=200),
+            l1_mask=mask,
+        )
+        w = np.asarray(res.w)
+        np.testing.assert_allclose(w[1:], 0.0, atol=1e-8)
+        # Intercept ≈ logit of base rate.
+        expected = np.log(np.mean(y) / (1 - np.mean(y)))
+        np.testing.assert_allclose(w[0], expected, atol=1e-2)
+
+
+class TestTRON:
+    def test_quadratic_one_newton_step(self):
+        d = 10
+        diag = jnp.linspace(1.0, 10.0, d)
+        target = jnp.ones(d)
+
+        def vg(w):
+            r = w - target
+            return 0.5 * jnp.vdot(r, diag * r), diag * r
+
+        def hvp(w, v, aux):
+            return diag * v
+
+        res = tron_solve(vg, hvp, jnp.zeros(d, jnp.float64),
+                         TRONConfig(tolerance=1e-10))
+        np.testing.assert_allclose(np.asarray(res.w), np.asarray(target), atol=1e-6)
+        # Inexact CG (forcing tol 0.1·||g||) needs a handful of outer steps.
+        assert int(res.iterations) <= 15
+
+    def test_logistic_matches_lbfgs(self, rng):
+        X, y, data, obj = _logistic_problem(rng)
+        l2 = 0.5
+
+        def vg(w):
+            return obj.value_and_grad(w, data, l2_weight=l2)
+
+        res_tron = tron_solve(
+            vg,
+            lambda w, v, aux: obj.hvp(w, v, data, l2_weight=l2, d2w=aux),
+            jnp.zeros(X.shape[1], jnp.float64),
+            TRONConfig(tolerance=1e-9, max_iters=100),
+            d2_fn=lambda w: obj.d2_weights(w, data),
+        )
+        oracle = _scipy_logistic_min(X, y, l2)
+        assert float(res_tron.value) <= oracle.fun + 1e-6
+        np.testing.assert_allclose(np.asarray(res_tron.w), oracle.x, atol=1e-3)
+        assert bool(res_tron.converged)
+
+    def test_hvp_matches_finite_difference(self, rng):
+        X, y, data, obj = _logistic_problem(rng, n=60, d=6)
+        w = jnp.asarray(rng.normal(size=6))
+        v = jnp.asarray(rng.normal(size=6))
+        eps = 1e-6
+        _, g_plus = obj.value_and_grad(w + eps * v, data)
+        _, g_minus = obj.value_and_grad(w - eps * v, data)
+        fd = (np.asarray(g_plus) - np.asarray(g_minus)) / (2 * eps)
+        hvp = np.asarray(obj.hvp(w, v, data))
+        np.testing.assert_allclose(hvp, fd, rtol=1e-5, atol=1e-5)
