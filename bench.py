@@ -1,0 +1,109 @@
+"""Headline benchmark: logistic-GLM epoch throughput on one chip.
+
+Measures the hot loop of BASELINE.json's headline metric ("1B-row logistic
+GLM epoch time"): fused value+gradient evaluations of a sparse logistic
+objective — the exact op Spark's ``treeAggregate`` performs per L-BFGS
+iteration in the reference (SURVEY.md §3.1) — and reports rows/second.
+Epoch time for any row count divides out: 1B rows / (rows/sec) = epoch
+seconds per objective evaluation.
+
+No reference number is recorded in BASELINE.json (``published`` is {}), so
+``vs_baseline`` is the ratio against the committed ``bench_baseline.json``
+(first measured value on this hardware, round 1); it tracks round-over-round
+progress until a real reference number exists.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+N_ROWS = 1 << 20  # 1,048,576
+N_FEATURES = 1 << 13  # 8,192
+NNZ_PER_ROW = 32
+N_TIMED = 30
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_baseline.json")
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.dataset import GlmData
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops.sparse import SparseMatrix
+    from photon_ml_tpu.optim.objective import GlmObjective
+
+    rng = np.random.default_rng(0)
+    nnz = N_ROWS * NNZ_PER_ROW
+    # Row-sorted COO by construction: each row holds NNZ_PER_ROW entries.
+    row_ids = np.repeat(np.arange(N_ROWS, dtype=np.int32), NNZ_PER_ROW)
+    col_ids = rng.integers(0, N_FEATURES, size=nnz, dtype=np.int32)
+    values = rng.normal(size=nnz).astype(np.float32)
+    w_true = (rng.normal(size=N_FEATURES) *
+              (rng.uniform(size=N_FEATURES) < 0.2)).astype(np.float32)
+
+    X = SparseMatrix(
+        row_ids=jnp.asarray(row_ids),
+        col_ids=jnp.asarray(col_ids),
+        values=jnp.asarray(values),
+        n_rows=N_ROWS,
+        n_cols=N_FEATURES,
+    )
+    margins_true = np.zeros(N_ROWS, np.float32)
+    np.add.at(margins_true, row_ids, values * w_true[col_ids])
+    y = (rng.uniform(size=N_ROWS) < 1 / (1 + np.exp(-margins_true))).astype(
+        np.float32
+    )
+    data = GlmData(
+        features=X,
+        labels=jnp.asarray(y),
+        weights=jnp.ones(N_ROWS, jnp.float32),
+        offsets=jnp.zeros(N_ROWS, jnp.float32),
+    )
+    obj = GlmObjective(losses.logistic)
+
+    # Data is an ARGUMENT, not a closure constant: closed-over arrays get
+    # baked into the HLO as literals, which bloats the program (and overflows
+    # the axon remote-compile transport).
+    @jax.jit
+    def value_and_grad(w, data):
+        return obj.value_and_grad(w, data, l2_weight=1.0)
+
+    data = jax.device_put(data)
+    w = jnp.zeros(N_FEATURES, jnp.float32)
+    # Warmup: compile + first execution.
+    val, grad = value_and_grad(w, data)
+    jax.block_until_ready(grad)
+
+    start = time.perf_counter()
+    for _ in range(N_TIMED):
+        val, grad = value_and_grad(w, data)
+        # New iterate each call so XLA can't fold the loop away.
+        w = w - 1e-4 * grad
+    jax.block_until_ready(w)
+    elapsed = time.perf_counter() - start
+
+    rows_per_sec = N_ROWS * N_TIMED / elapsed
+
+    vs_baseline = 1.0
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            base = json.load(f).get("logistic_glm_rows_per_sec")
+        if base:
+            vs_baseline = rows_per_sec / base
+
+    print(json.dumps({
+        "metric": "logistic_glm_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""LIBSVM-format ingest (host side).
+
+The reference's driver tests train on classic LIBSVM datasets such as ``a1a``
+(SURVEY.md §4; BASELINE.json: "L2 logistic regression on a1a (LIBSVM)").
+This is the host-side text→CSR path; Avro ingest lives in io/avro.py.
+
+Pure NumPy parsing — the output feeds
+:func:`photon_ml_tpu.data.dataset.make_glm_data` which pads to static shapes
+before anything touches the device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+
+def read_libsvm(
+    path: str,
+    n_features: int | None = None,
+    zero_based: bool = False,
+    binary_labels_to_01: bool = True,
+    add_intercept: bool = False,
+):
+    """Read a LIBSVM/SVMlight text file.
+
+    Returns ``(X, y)`` with X a scipy CSR matrix and y float32 labels.
+    ``±1`` labels are mapped to ``{0, 1}`` when ``binary_labels_to_01`` (the
+    losses' convention).  ``add_intercept`` appends a constant-1 column at
+    index ``n_features`` (the reference appends its intercept last as well).
+    """
+    labels: list[float] = []
+    indptr = [0]
+    indices: list[int] = []
+    values: list[float] = []
+    offset = 0 if zero_based else 1
+    max_col = -1
+
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            for item in parts[1:]:
+                idx_s, val_s = item.split(":")
+                col = int(idx_s) - offset
+                if col < 0:
+                    raise ValueError(
+                        f"negative feature index {col} — wrong zero_based setting?"
+                    )
+                max_col = max(max_col, col)
+                indices.append(col)
+                values.append(float(val_s))
+            indptr.append(len(indices))
+
+    n_rows = len(labels)
+    d = n_features if n_features is not None else max_col + 1
+    if max_col >= d:
+        raise ValueError(f"feature index {max_col} >= n_features={d}")
+    X = sp.csr_matrix(
+        (
+            np.asarray(values, np.float32),
+            np.asarray(indices, np.int32),
+            np.asarray(indptr, np.int64),
+        ),
+        shape=(n_rows, d),
+    )
+    y = np.asarray(labels, np.float32)
+    if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:
+        y = (y + 1.0) / 2.0
+    if add_intercept:
+        X = sp.hstack([X, np.ones((n_rows, 1), np.float32)], format="csr")
+    return X, y
+
+
+def write_libsvm(path: str, X, y, zero_based: bool = False) -> None:
+    """Inverse of :func:`read_libsvm` (test round-trips, synthetic fixtures)."""
+    X = sp.csr_matrix(X)
+    offset = 0 if zero_based else 1
+    with open(path, "w") as f:
+        for i in range(X.shape[0]):
+            start, end = X.indptr[i], X.indptr[i + 1]
+            feats = " ".join(
+                f"{int(c) + offset}:{v:.17g}"
+                for c, v in zip(X.indices[start:end], X.data[start:end])
+            )
+            f.write(f"{y[i]:.17g} {feats}\n".rstrip() + "\n")

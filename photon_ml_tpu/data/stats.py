@@ -1,0 +1,66 @@
+"""Per-feature summary statistics.
+
+The analogue of the reference's ``BasicStatisticalSummary`` /
+``FeatureDataStatistics`` (SURVEY.md §2, Statistics): weighted per-feature
+mean, variance, min, max, and nonzero counts, computed on-device in one pass
+of (sparse) column reductions — the reference computes the same via a Spark
+aggregate over partitions.  Feeds normalization (data/normalization.py) and
+the feature-summary output of the legacy driver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.dataset import GlmData
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["mean", "variance", "min", "max", "nnz", "count"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class BasicStatisticalSummary:
+    mean: Array  # (n_features,) weighted mean
+    variance: Array  # (n_features,) weighted (population) variance
+    min: Array  # (n_features,)
+    max: Array  # (n_features,)
+    nnz: Array  # (n_features,) int32 — unweighted nonzero counts
+    count: Array  # scalar — total weight
+
+
+def summarize(data: GlmData, axis_name: str | None = None) -> BasicStatisticalSummary:
+    """One-pass weighted feature summary.  Jit-safe; pass ``axis_name`` inside
+    ``shard_map`` to psum the moments across row shards (the treeAggregate
+    analogue of the reference's distributed summarization)."""
+    X = data.features
+    w = data.weights
+    w_sum = jnp.sum(w)
+    s1 = X.rmatvec(w)  # Σ w·x per feature
+    s2 = X.sq_rmatvec(w)  # Σ w·x² per feature
+    # Padding rows (weight 0) must not leak their zeros into nnz/min/max;
+    # the weighted moments exclude them via w already.
+    row_mask = w > 0
+    nnz = X.col_nnz(row_mask)
+    mins, maxs = X.col_min_max(row_mask)
+
+    if axis_name is not None:
+        from jax import lax
+
+        w_sum, s1, s2, nnz = lax.psum((w_sum, s1, s2, nnz), axis_name)
+        mins = lax.pmin(mins, axis_name)
+        maxs = lax.pmax(maxs, axis_name)
+
+    denom = jnp.maximum(w_sum, 1e-30)
+    mean = s1 / denom
+    variance = jnp.maximum(s2 / denom - mean * mean, 0.0)
+    return BasicStatisticalSummary(
+        mean=mean, variance=variance, min=mins, max=maxs, nnz=nnz, count=w_sum
+    )

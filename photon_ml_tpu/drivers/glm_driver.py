@@ -1,0 +1,236 @@
+"""Legacy GLM training driver.
+
+The analogue of the reference's ``com.linkedin.photon.ml.Driver`` ("GLMDriver"
+— [CONFIRMED-BASELINE], SURVEY.md §2, §3.1): the end-to-end single-GLM
+pipeline
+
+    read → index → summarize → normalize → train over a regularization-weight
+    grid (warm-started) → validate → select best → write model(s)
+
+run as stages with artifacts written to the output directory.  Where the
+reference launches a Spark job per stage, here ingest happens on the host and
+every training stage is one jitted TPU program; with >1 device the grid runs
+data-parallel over the mesh (parallel/distributed.py).
+
+Usage:
+    python -m photon_ml_tpu.drivers.glm_driver \
+        --train-data a1a --task logistic --reg-type l2 \
+        --reg-weights 0.1,1,10 --output-dir /tmp/out
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data import libsvm
+from photon_ml_tpu.data.dataset import make_glm_data
+from photon_ml_tpu.data.index_map import INTERCEPT_KEY, IndexMap
+from photon_ml_tpu.data.normalization import (
+    NormalizationContext,
+    NormalizationType,
+    build_normalization,
+)
+from photon_ml_tpu.data.stats import summarize
+from photon_ml_tpu.evaluation.evaluators import (
+    default_evaluator_for_task,
+    get_evaluator,
+)
+from photon_ml_tpu.io.model_store import save_glm_model
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.optim.problem import (
+    GlmOptimizationConfig,
+    GlmOptimizationProblem,
+    OptimizerConfig,
+    OptimizerType,
+)
+from photon_ml_tpu.optim.regularization import RegularizationContext, RegularizationType
+from photon_ml_tpu.utils.logging import PhotonLogger
+from photon_ml_tpu.utils.timer import Timer
+from photon_ml_tpu.utils.tracker import OptimizationStatesTracker
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    """CLI surface mirroring the reference Driver's ``Params``."""
+    p = argparse.ArgumentParser(
+        prog="glm_driver", description="TPU-native GLM training driver"
+    )
+    p.add_argument("--train-data", required=True, help="LIBSVM training file")
+    p.add_argument("--validate-data", help="LIBSVM validation file (optional)")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument(
+        "--task",
+        default="logistic",
+        help="logistic | linear | poisson | smoothed_hinge (or reference "
+        "TaskType names like LOGISTIC_REGRESSION)",
+    )
+    p.add_argument(
+        "--optimizer", default="lbfgs", choices=[t.value for t in OptimizerType]
+    )
+    p.add_argument(
+        "--reg-type",
+        default="none",
+        choices=[t.value for t in RegularizationType],
+    )
+    p.add_argument("--reg-weights", default="0.0", help="comma-separated λ grid")
+    p.add_argument("--elastic-net-alpha", type=float, default=0.5)
+    p.add_argument(
+        "--normalization",
+        default="none",
+        choices=[t.value for t in NormalizationType],
+    )
+    p.add_argument("--max-iters", type=int, default=100)
+    p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--intercept", action="store_true", default=True)
+    p.add_argument("--no-intercept", dest="intercept", action="store_false")
+    p.add_argument("--compute-variances", action="store_true")
+    p.add_argument("--evaluator", help="AUC | RMSE | ... (default: per task)")
+    p.add_argument(
+        "--output-mode",
+        default="best",
+        choices=["best", "all"],
+        help="write only the selected model or every grid point "
+        "(the reference's ModelOutputMode)",
+    )
+    p.add_argument("--n-features", type=int, help="fixed feature-space width")
+    return p
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    args = build_arg_parser().parse_args(argv)
+    os.makedirs(args.output_dir, exist_ok=True)
+    logger = PhotonLogger(args.output_dir)
+    timer = Timer().start()
+
+    # Stage 1: read ---------------------------------------------------------
+    X_train, y_train = libsvm.read_libsvm(
+        args.train_data, n_features=args.n_features, add_intercept=args.intercept
+    )
+    d = X_train.shape[1]
+    logger.info(
+        "read %d rows x %d features from %s", X_train.shape[0], d, args.train_data
+    )
+    # The LIBSVM path has positional features; the index map gives them names
+    # (feature "j" + intercept last), as FeatureIndexingDriver would.
+    names = [f"f{j}" for j in range(d - 1)] if args.intercept else [
+        f"f{j}" for j in range(d)
+    ]
+    index_map = IndexMap.build(names, add_intercept=args.intercept)
+
+    # Stage 2: summarize + normalization ------------------------------------
+    train_data = make_glm_data(X_train, y_train)
+    summary = summarize(train_data)
+    norm_type = NormalizationType(args.normalization)
+    normalization = (
+        None
+        if norm_type is NormalizationType.NONE
+        else build_normalization(norm_type, summary, index_map.intercept_index)
+    )
+    summary_out = {
+        "mean": np.asarray(summary.mean).tolist(),
+        "variance": np.asarray(summary.variance).tolist(),
+        "min": np.asarray(summary.min).tolist(),
+        "max": np.asarray(summary.max).tolist(),
+        "nnz": np.asarray(summary.nnz).tolist(),
+        "count": float(summary.count),
+    }
+    with open(os.path.join(args.output_dir, "feature_summary.json"), "w") as f:
+        json.dump(summary_out, f)
+
+    # Stage 3: train over the λ grid ----------------------------------------
+    problem = GlmOptimizationProblem(
+        args.task,
+        GlmOptimizationConfig(
+            optimizer=OptimizerConfig(
+                optimizer=OptimizerType(args.optimizer),
+                max_iters=args.max_iters,
+                tolerance=args.tolerance,
+            ),
+            regularization=RegularizationContext(
+                RegularizationType(args.reg_type), args.elastic_net_alpha
+            ),
+            compute_variances=args.compute_variances,
+        ),
+        normalization=normalization,
+    )
+    reg_weights = [float(s) for s in args.reg_weights.split(",")]
+    l1_mask = None
+    if args.intercept and index_map.intercept_index is not None:
+        l1_mask = jnp.ones((d,), jnp.float32).at[index_map.intercept_index].set(0.0)
+
+    grid = problem.run_grid(train_data, reg_weights, l1_mask=l1_mask)
+    for lam, _, res in grid:
+        tracker = OptimizationStatesTracker.from_solve_result(res)
+        logger.info(
+            "lambda=%g: value=%.8g iters=%d converged=%s",
+            lam, float(res.value), tracker.iterations, tracker.converged,
+        )
+
+    # Stage 4: validate + select --------------------------------------------
+    evaluator = (
+        get_evaluator(args.evaluator)
+        if args.evaluator
+        else default_evaluator_for_task(problem.task)
+    )
+    if args.validate_data:
+        X_val, y_val = libsvm.read_libsvm(
+            args.validate_data, n_features=d - (1 if args.intercept else 0),
+            add_intercept=args.intercept,
+        )
+        val_data = make_glm_data(X_val, y_val)
+    else:
+        val_data = train_data
+        y_val = y_train
+
+    metrics = {}
+    best: tuple[float, GeneralizedLinearModel] | None = None
+    best_metric = None
+    for lam, model, _ in grid:
+        scores = np.asarray(model.compute_score(val_data))
+        m = evaluator.evaluate(scores, y_val, np.asarray(val_data.weights))
+        metrics[lam] = m
+        logger.info("lambda=%g: %s=%.6f", lam, type(evaluator).__name__, m)
+        if best_metric is None or evaluator.better_than(m, best_metric):
+            best_metric, best = m, (lam, model)
+
+    # Stage 5: write --------------------------------------------------------
+    assert best is not None
+    best_lam, best_model = best
+    to_write = grid if args.output_mode == "all" else [
+        (lam, mdl, res) for lam, mdl, res in grid if lam == best_lam
+    ]
+    for lam, model, _ in to_write:
+        out = os.path.join(args.output_dir, f"model_lambda_{lam:g}.avro")
+        save_glm_model(model, index_map, out, model_id=f"lambda={lam:g}")
+    index_map.save(args.output_dir)
+    result = {
+        "best_lambda": best_lam,
+        "metrics": {str(k): v for k, v in metrics.items()},
+        "evaluator": type(evaluator).__name__,
+        "n_rows": int(X_train.shape[0]),
+        "n_features": int(d),
+        "wall_seconds": timer.stop(),
+    }
+    with open(os.path.join(args.output_dir, "training_result.json"), "w") as f:
+        json.dump(result, f, indent=2)
+    logger.info(
+        "selected lambda=%g (%s=%.6f) in %.2fs",
+        best_lam, type(evaluator).__name__, best_metric, result["wall_seconds"],
+    )
+    logger.close()
+    return result
+
+
+def main() -> None:
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+from photon_ml_tpu.evaluation.evaluators import (  # noqa: F401
+    AreaUnderROCCurveEvaluator,
+    Evaluator,
+    EvaluatorType,
+    LogisticLossEvaluator,
+    PoissonLossEvaluator,
+    PrecisionAtKEvaluator,
+    RMSEEvaluator,
+    SquaredLossEvaluator,
+    get_evaluator,
+)

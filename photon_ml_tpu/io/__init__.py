@@ -1,0 +1,4 @@
+from photon_ml_tpu.io.model_store import (  # noqa: F401
+    load_glm_model,
+    save_glm_model,
+)

@@ -1,0 +1,305 @@
+"""Minimal Avro object-container-file codec, dependency-free.
+
+The reference's wire/storage format is Avro everywhere — training examples,
+model coefficients (``BayesianLinearModelAvro``), scores (SURVEY.md §2,
+"Avro IO" / "Avro schemas") — so this package speaks real Avro too.  No
+Avro library is available in this environment, so this implements the Avro
+1.x object container spec directly: files written here are readable by
+standard Avro tooling and vice versa.
+
+Supported schema subset (all the reference's schemas need): primitives
+(null, boolean, int, long, float, double, bytes, string), records, arrays,
+maps, unions, and enums.  Codec: null (uncompressed) and deflate.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json
+import os
+import struct
+import zlib
+from typing import Any, BinaryIO, Iterable, Iterator
+
+MAGIC = b"Obj\x01"
+
+
+# ---------------------------------------------------------------------------
+# Primitive binary encoding
+# ---------------------------------------------------------------------------
+
+def _zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def write_long(buf: BinaryIO, n: int) -> None:
+    n = _zigzag_encode(n)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            buf.write(bytes([b | 0x80]))
+        else:
+            buf.write(bytes([b]))
+            return
+
+
+def read_long(buf: BinaryIO) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        byte = buf.read(1)
+        if not byte:
+            raise EOFError("truncated varint")
+        b = byte[0]
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return _zigzag_decode(acc)
+        shift += 7
+
+
+def write_bytes(buf: BinaryIO, data: bytes) -> None:
+    write_long(buf, len(data))
+    buf.write(data)
+
+
+def read_bytes(buf: BinaryIO) -> bytes:
+    n = read_long(buf)
+    data = buf.read(n)
+    if len(data) != n:
+        raise EOFError("truncated bytes")
+    return data
+
+
+# ---------------------------------------------------------------------------
+# Schema-directed datum encoding
+# ---------------------------------------------------------------------------
+
+def _resolve(schema: Any) -> Any:
+    """Normalize shorthand string schemas ("string") to dict form."""
+    if isinstance(schema, str):
+        return {"type": schema}
+    return schema
+
+
+def write_datum(buf: BinaryIO, schema: Any, datum: Any) -> None:
+    if isinstance(schema, list):  # union
+        for i, branch in enumerate(schema):
+            if _matches(branch, datum):
+                write_long(buf, i)
+                write_datum(buf, branch, datum)
+                return
+        raise TypeError(f"datum {datum!r} matches no union branch in {schema}")
+    s = _resolve(schema)
+    t = s["type"]
+    if t == "null":
+        return
+    if t == "boolean":
+        buf.write(b"\x01" if datum else b"\x00")
+    elif t in ("int", "long"):
+        write_long(buf, int(datum))
+    elif t == "float":
+        buf.write(struct.pack("<f", float(datum)))
+    elif t == "double":
+        buf.write(struct.pack("<d", float(datum)))
+    elif t == "bytes":
+        write_bytes(buf, bytes(datum))
+    elif t == "string":
+        write_bytes(buf, datum.encode("utf-8"))
+    elif t == "enum":
+        write_long(buf, s["symbols"].index(datum))
+    elif t == "record":
+        for field in s["fields"]:
+            try:
+                write_datum(buf, field["type"], datum[field["name"]])
+            except (KeyError, TypeError) as e:
+                raise TypeError(
+                    f"record field {field['name']!r}: {e}"
+                ) from e
+    elif t == "array":
+        items = list(datum)
+        if items:
+            write_long(buf, len(items))
+            for item in items:
+                write_datum(buf, s["items"], item)
+        write_long(buf, 0)
+    elif t == "map":
+        entries = dict(datum)
+        if entries:
+            write_long(buf, len(entries))
+            for k, v in entries.items():
+                write_bytes(buf, k.encode("utf-8"))
+                write_datum(buf, s["values"], v)
+        write_long(buf, 0)
+    else:
+        raise TypeError(f"unsupported Avro type {t!r}")
+
+
+def _matches(schema: Any, datum: Any) -> bool:
+    s = _resolve(schema)
+    t = s["type"]
+    if t == "null":
+        return datum is None
+    if t == "boolean":
+        return isinstance(datum, bool)
+    if t in ("int", "long"):
+        return isinstance(datum, int) and not isinstance(datum, bool)
+    if t in ("float", "double"):
+        return isinstance(datum, float) or (
+            isinstance(datum, int) and not isinstance(datum, bool)
+        )
+    if t == "bytes":
+        return isinstance(datum, (bytes, bytearray))
+    if t == "string":
+        return isinstance(datum, str)
+    if t == "enum":
+        return isinstance(datum, str) and datum in s["symbols"]
+    if t == "record":
+        return isinstance(datum, dict)
+    if t == "array":
+        return isinstance(datum, (list, tuple))
+    if t == "map":
+        return isinstance(datum, dict)
+    return False
+
+
+def read_datum(buf: BinaryIO, schema: Any) -> Any:
+    if isinstance(schema, list):  # union
+        idx = read_long(buf)
+        return read_datum(buf, schema[idx])
+    s = _resolve(schema)
+    t = s["type"]
+    if t == "null":
+        return None
+    if t == "boolean":
+        return buf.read(1) == b"\x01"
+    if t in ("int", "long"):
+        return read_long(buf)
+    if t == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if t == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if t == "bytes":
+        return read_bytes(buf)
+    if t == "string":
+        return read_bytes(buf).decode("utf-8")
+    if t == "enum":
+        return s["symbols"][read_long(buf)]
+    if t == "record":
+        return {
+            field["name"]: read_datum(buf, field["type"]) for field in s["fields"]
+        }
+    if t == "array":
+        out = []
+        while True:
+            count = read_long(buf)
+            if count == 0:
+                return out
+            if count < 0:  # block with byte size prefix
+                count = -count
+                read_long(buf)
+            for _ in range(count):
+                out.append(read_datum(buf, s["items"]))
+    if t == "map":
+        out = {}
+        while True:
+            count = read_long(buf)
+            if count == 0:
+                return out
+            if count < 0:
+                count = -count
+                read_long(buf)
+            for _ in range(count):
+                k = read_bytes(buf).decode("utf-8")
+                out[k] = read_datum(buf, s["values"])
+    raise TypeError(f"unsupported Avro type {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# Object container files
+# ---------------------------------------------------------------------------
+
+_META_SCHEMA = {"type": "map", "values": "bytes"}
+_SYNC = bytes(
+    [0x70, 0x68, 0x6F, 0x74, 0x6F, 0x6E, 0x2D, 0x74,
+     0x70, 0x75, 0x2D, 0x73, 0x79, 0x6E, 0x63, 0x21]
+)  # deterministic marker ("photon-tpu-sync!") — valid per spec
+
+
+def write_container(
+    path: str,
+    schema: Any,
+    records: Iterable[Any],
+    codec: str = "deflate",
+    records_per_block: int = 4096,
+) -> None:
+    assert codec in ("null", "deflate")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        meta = {
+            "avro.schema": json.dumps(schema).encode("utf-8"),
+            "avro.codec": codec.encode("utf-8"),
+        }
+        write_datum(f, _META_SCHEMA, meta)
+        f.write(_SYNC)
+
+        block: list[Any] = []
+
+        def flush():
+            if not block:
+                return
+            body = _io.BytesIO()
+            for rec in block:
+                write_datum(body, schema, rec)
+            payload = body.getvalue()
+            if codec == "deflate":
+                payload = zlib.compress(payload)[2:-4]  # raw deflate per spec
+            write_long(f, len(block))
+            write_bytes(f, payload)
+            f.write(_SYNC)
+            block.clear()
+
+        for rec in records:
+            block.append(rec)
+            if len(block) >= records_per_block:
+                flush()
+        flush()
+
+
+def read_container(path: str) -> tuple[Any, list[Any]]:
+    """Read an Avro object container file → (schema, records)."""
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        meta = read_datum(f, _META_SCHEMA)
+        schema = json.loads(meta["avro.schema"].decode("utf-8"))
+        codec = meta.get("avro.codec", b"null").decode("utf-8")
+        sync = f.read(16)
+        records: list[Any] = []
+        while True:
+            head = f.read(1)
+            if not head:
+                break
+            f.seek(-1, 1)
+            count = read_long(f)
+            payload = read_bytes(f)
+            if codec == "deflate":
+                payload = zlib.decompress(payload, -15)
+            elif codec != "null":
+                raise ValueError(f"unsupported codec {codec!r}")
+            body = _io.BytesIO(payload)
+            for _ in range(count):
+                records.append(read_datum(body, schema))
+            if f.read(16) != sync:
+                raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
+        return schema, records
+
+
+def iter_container(path: str) -> Iterator[Any]:
+    _, records = read_container(path)
+    yield from records

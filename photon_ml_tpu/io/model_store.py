@@ -1,0 +1,108 @@
+"""GLM model persistence.
+
+The analogue of the reference's ``ModelProcessingUtils`` save/load path
+(SURVEY.md §2, "Avro IO"): coefficients are written as real Avro
+(``BayesianLinearModelAvro``-shaped records, one coefficient per
+name/term/value entry) so models interchange with reference tooling.
+Coefficients with value 0 are not written (the reference's sparse model
+files do the same); loading uses an index map to place named coefficients.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.io import avro
+from photon_ml_tpu.io.schemas import BAYESIAN_LINEAR_MODEL
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+
+_MODEL_CLASS = {
+    "logistic": "LogisticRegressionModel",
+    "squared": "LinearRegressionModel",
+    "poisson": "PoissonRegressionModel",
+    "smoothed_hinge": "SmoothedHingeLossLinearSVMModel",
+}
+_CLASS_TO_TASK = {v: k for k, v in _MODEL_CLASS.items()}
+
+
+def _split_key(key: str) -> tuple[str, str]:
+    name, sep, term = key.partition("\x01")
+    return name, term if sep else ""
+
+
+def save_glm_model(
+    model: GeneralizedLinearModel,
+    index_map: IndexMap,
+    path: str,
+    model_id: str = "",
+    sparsify: bool = True,
+) -> None:
+    """Write a model as an Avro container file (.avro)."""
+    means = np.asarray(model.coefficients.means, np.float64)
+    variances = (
+        None
+        if model.coefficients.variances is None
+        else np.asarray(model.coefficients.variances, np.float64)
+    )
+
+    def entries(vec):
+        out = []
+        for j, v in enumerate(vec):
+            if sparsify and v == 0.0:
+                continue
+            name, term = _split_key(index_map.index_to_name(j))
+            out.append({"name": name, "term": term, "value": float(v)})
+        return out
+
+    record = {
+        "modelId": model_id,
+        "modelClass": _MODEL_CLASS[model.task],
+        "lossFunction": model.task,
+        "means": entries(means),
+        "variances": None if variances is None else entries(variances),
+    }
+    avro.write_container(path, BAYESIAN_LINEAR_MODEL, [record])
+
+
+def load_glm_model(
+    path: str, index_map: Optional[IndexMap] = None
+) -> tuple[GeneralizedLinearModel, IndexMap]:
+    """Read a model written by :func:`save_glm_model`.
+
+    Without an index map, one is reconstructed from the coefficient names in
+    file order (sufficient for scoring data indexed with the same map)."""
+    _, records = avro.read_container(path)
+    if len(records) != 1:
+        raise ValueError(f"{path}: expected 1 model record, found {len(records)}")
+    rec = records[0]
+    task = _CLASS_TO_TASK.get(rec["modelClass"], rec["lossFunction"])
+
+    keys = [feature_key(e["name"], e["term"]) for e in rec["means"]]
+    if index_map is None:
+        index_map = IndexMap.build(keys)
+    d = len(index_map)
+    means = np.zeros(d, np.float32)
+    for e, key in zip(rec["means"], keys):
+        idx = index_map.get_index(key)
+        if idx >= 0:
+            means[idx] = e["value"]
+    variances = None
+    if rec["variances"] is not None:
+        variances = np.zeros(d, np.float32)
+        for e in rec["variances"]:
+            idx = index_map.get_index(feature_key(e["name"], e["term"]))
+            if idx >= 0:
+                variances[idx] = e["value"]
+    model = GeneralizedLinearModel(
+        Coefficients(
+            jnp.asarray(means),
+            None if variances is None else jnp.asarray(variances),
+        ),
+        task,
+    )
+    return model, index_map

@@ -1,0 +1,85 @@
+"""Avro schemas for models, training data, and scores.
+
+The analogue of the reference's ``photon-avro-schemas`` module (SURVEY.md §2):
+``TrainingExampleAvro`` (response + weight + offset + features as
+name/term/value triples), ``BayesianLinearModelAvro`` (coefficient means with
+optional variances), and ``ScoringResultAvro``.  Field names follow the
+reference's conventions (name/term/value feature triples, ``(INTERCEPT)``
+magic name) so data round-trips between the two systems.
+"""
+
+NAME_TERM_VALUE = {
+    "type": "record",
+    "name": "NameTermValueAvro",
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string"},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+TRAINING_EXAMPLE = {
+    "type": "record",
+    "name": "TrainingExampleAvro",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"]},
+        {"name": "response", "type": "double"},
+        {"name": "weight", "type": ["null", "double"]},
+        {"name": "offset", "type": ["null", "double"]},
+        {"name": "features", "type": {"type": "array", "items": NAME_TERM_VALUE}},
+    ],
+}
+
+BAYESIAN_LINEAR_MODEL = {
+    "type": "record",
+    "name": "BayesianLinearModelAvro",
+    "fields": [
+        {"name": "modelId", "type": "string"},
+        {"name": "modelClass", "type": "string"},
+        {"name": "lossFunction", "type": "string"},
+        {
+            "name": "means",
+            "type": {
+                "type": "array",
+                "items": {
+                    "type": "record",
+                    "name": "CoefficientAvro",
+                    "fields": [
+                        {"name": "name", "type": "string"},
+                        {"name": "term", "type": "string"},
+                        {"name": "value", "type": "double"},
+                    ],
+                },
+            },
+        },
+        {
+            "name": "variances",
+            "type": [
+                "null",
+                {
+                    "type": "array",
+                    "items": {
+                        "type": "record",
+                        "name": "CoefficientVarianceAvro",
+                        "fields": [
+                            {"name": "name", "type": "string"},
+                            {"name": "term", "type": "string"},
+                            {"name": "value", "type": "double"},
+                        ],
+                    },
+                },
+            ],
+        },
+    ],
+}
+
+SCORING_RESULT = {
+    "type": "record",
+    "name": "ScoringResultAvro",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"]},
+        {"name": "predictionScore", "type": "double"},
+        {"name": "label", "type": ["null", "double"]},
+        {"name": "ids", "type": {"type": "map", "values": "string"}},
+    ],
+}

@@ -1,0 +1,8 @@
+from photon_ml_tpu.models.glm import (  # noqa: F401
+    Coefficients,
+    GeneralizedLinearModel,
+    LinearRegressionModel,
+    LogisticRegressionModel,
+    PoissonRegressionModel,
+    SmoothedHingeLossLinearSVMModel,
+)

@@ -70,6 +70,29 @@ class DenseMatrix:
         """(X ⊙ X) @ v — used for diagonal-Hessian preconditioners."""
         return (self.data * self.data) @ v
 
+    def sq_rmatvec(self, u: Array) -> Array:
+        """(X ⊙ X)ᵀ @ u — per-feature squared reductions (Hessian diagonal
+        ``diag(XᵀDX) = (X⊙X)ᵀ d``, second moments for summary stats)."""
+        return (self.data * self.data).T @ u
+
+    def col_nnz(self, row_mask: Array | None = None) -> Array:
+        """Per-feature nonzero counts (summary stats).  ``row_mask`` excludes
+        padding / zero-weight rows."""
+        nz = self.data != 0
+        if row_mask is not None:
+            nz = jnp.logical_and(nz, row_mask[:, None])
+        return jnp.sum(nz, axis=0)
+
+    def col_min_max(self, row_mask: Array | None = None) -> tuple[Array, Array]:
+        """Per-feature (min, max); rows excluded by ``row_mask`` (padding,
+        zero-weight) contribute nothing."""
+        if row_mask is None:
+            return jnp.min(self.data, axis=0), jnp.max(self.data, axis=0)
+        m = row_mask[:, None]
+        mins = jnp.min(jnp.where(m, self.data, jnp.inf), axis=0)
+        maxs = jnp.max(jnp.where(m, self.data, -jnp.inf), axis=0)
+        return mins, maxs
+
 
 @partial(
     jax.tree_util.register_dataclass,
@@ -113,6 +136,53 @@ class SparseMatrix:
         return jax.ops.segment_sum(
             contrib, self.row_ids, num_segments=self.n_rows, indices_are_sorted=True
         )
+
+    def sq_rmatvec(self, u: Array) -> Array:
+        """(X ⊙ X)ᵀ @ u — per-feature squared reductions."""
+        contrib = self.values * self.values * jnp.take(u, self.row_ids)
+        return jax.ops.segment_sum(contrib, self.col_ids, num_segments=self.n_cols)
+
+    def _live_entries(self, row_mask: Array | None) -> Array:
+        """Entries that represent a real stored value: nonzero (padding
+        entries carry value 0) and, with ``row_mask``, in a live row."""
+        live = self.values != 0
+        if row_mask is not None:
+            live = jnp.logical_and(live, jnp.take(row_mask, self.row_ids))
+        return live
+
+    def col_nnz(self, row_mask: Array | None = None) -> Array:
+        """Per-feature nonzero counts.  ``row_mask`` excludes padding /
+        zero-weight rows."""
+        return jax.ops.segment_sum(
+            self._live_entries(row_mask).astype(jnp.int32),
+            self.col_ids,
+            num_segments=self.n_cols,
+        )
+
+    def col_min_max(self, row_mask: Array | None = None) -> tuple[Array, Array]:
+        """Per-feature (min, max) over stored entries of live rows, folded
+        with the implicit zeros of unstored entries (a column with fewer
+        stored values than live rows necessarily contains a zero)."""
+        live = self._live_entries(row_mask)
+        nnz = jax.ops.segment_sum(
+            live.astype(jnp.int32), self.col_ids, num_segments=self.n_cols
+        )
+        n_live_rows = (
+            self.n_rows
+            if row_mask is None
+            else jnp.sum(row_mask.astype(jnp.int32))
+        )
+        has_zero = nnz < n_live_rows
+        # Non-live entries are neutralized to ±inf so they can't pollute the
+        # column they point at; the has_zero fold restores the 0 that
+        # zero-valued entries represent (and repairs empty segments).
+        vals_min = jnp.where(live, self.values, jnp.inf)
+        vals_max = jnp.where(live, self.values, -jnp.inf)
+        mins = jax.ops.segment_min(vals_min, self.col_ids, num_segments=self.n_cols)
+        maxs = jax.ops.segment_max(vals_max, self.col_ids, num_segments=self.n_cols)
+        mins = jnp.where(has_zero, jnp.minimum(mins, 0.0), mins)
+        maxs = jnp.where(has_zero, jnp.maximum(maxs, 0.0), maxs)
+        return mins, maxs
 
     def to_dense(self) -> DenseMatrix:
         dense = jnp.zeros(self.shape, dtype=self.values.dtype)

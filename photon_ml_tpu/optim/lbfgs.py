@@ -115,6 +115,25 @@ def _two_loop(grad: Array, S: Array, Y: Array, rho: Array, gamma: Array,
     return r
 
 
+def update_history(
+    S: Array, Y: Array, rho: Array, gamma: Array, n_pairs: Array,
+    s_vec: Array, y_vec: Array,
+) -> tuple[Array, Array, Array, Array, Array]:
+    """Insert a curvature pair into the circular history, skipping it when
+    <s, y> is not safely positive (standard safeguard).  Shared by L-BFGS
+    and OWL-QN so the history rules cannot drift apart."""
+    m = S.shape[0]
+    sy = jnp.vdot(s_vec, y_vec)
+    good = sy > 1e-10 * jnp.linalg.norm(s_vec) * jnp.linalg.norm(y_vec)
+    slot = n_pairs % m
+    S = jnp.where(good, S.at[slot].set(s_vec), S)
+    Y = jnp.where(good, Y.at[slot].set(y_vec), Y)
+    rho = jnp.where(good, rho.at[slot].set(1.0 / sy), rho)
+    gamma = jnp.where(good, sy / jnp.vdot(y_vec, y_vec), gamma)
+    n_pairs = jnp.where(good, n_pairs + 1, n_pairs)
+    return S, Y, rho, gamma, n_pairs
+
+
 def lbfgs_solve(
     value_and_grad: ValueAndGrad,
     w0: Array,
@@ -174,20 +193,9 @@ def lbfgs_solve(
             initial_step=init_step, config=config.line_search,
         )
 
-        s_vec = ls.w - s.w
-        y_vec = ls.grad - s.grad
-        sy = jnp.vdot(s_vec, y_vec)
-        # Curvature safeguard: skip the pair if <s,y> is not safely positive.
-        good_pair = sy > 1e-10 * jnp.linalg.norm(s_vec) * jnp.linalg.norm(y_vec)
-        slot = s.n_pairs % m
-        S = jnp.where(good_pair, s.S.at[slot].set(s_vec), s.S)
-        Y = jnp.where(good_pair, s.Y.at[slot].set(y_vec), s.Y)
-        rho = jnp.where(
-            good_pair, s.rho.at[slot].set(1.0 / sy), s.rho.at[slot].set(0.0)
+        S, Y, rho, gamma, n_pairs = update_history(
+            s.S, s.Y, s.rho, s.gamma, s.n_pairs, ls.w - s.w, ls.grad - s.grad
         )
-        rho = jnp.where(good_pair, rho, s.rho)
-        gamma = jnp.where(good_pair, sy / jnp.vdot(y_vec, y_vec), s.gamma)
-        n_pairs = jnp.where(good_pair, s.n_pairs + 1, s.n_pairs)
 
         k = s.k + 1
         g_norm = jnp.linalg.norm(ls.grad)

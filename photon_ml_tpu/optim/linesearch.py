@@ -141,43 +141,5 @@ def wolfe_line_search(
     )
 
 
-def backtracking_line_search(
-    value_fn: Callable[[Array], Array],
-    w0: Array,
-    f0: Array,
-    dg0: Array,
-    direction: Array,
-    initial_step: Array | float = 1.0,
-    c1: float = 1e-4,
-    shrink: float = 0.5,
-    max_evals: int = 30,
-) -> tuple[Array, Array, Array]:
-    """Armijo-only backtracking (used by OWL-QN, whose curvature condition is
-    replaced by orthant projection).  ``dg0`` is the directional derivative of
-    the *search* model at 0 (for OWL-QN, measured with the pseudo-gradient).
-
-    Returns ``(t, w, value)`` of the accepted point.
-    """
-    t0 = jnp.asarray(initial_step, dtype=f0.dtype)
-
-    def evaluate(t):
-        w = w0 + t * direction
-        return w, value_fn(w)
-
-    def cond(s):
-        t, _, value, n = s
-        return jnp.logical_and(
-            value > f0 + c1 * t * dg0, n < max_evals
-        )
-
-    def body(s):
-        t, _, _, n = s
-        t_next = t * shrink
-        w, value = evaluate(t_next)
-        return (t_next, w, value, n + 1)
-
-    w1, f1 = evaluate(t0)
-    t, w, value, _ = lax.while_loop(
-        cond, body, (t0, w1, f1, jnp.asarray(1, jnp.int32))
-    )
-    return t, w, value
+# (OWL-QN's Armijo backtracking lives inline in owlqn.py because each trial
+# point must be orthant-projected before evaluation.)

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from photon_ml_tpu.optim.lbfgs import SolveResult, _two_loop
+from photon_ml_tpu.optim.lbfgs import SolveResult, _two_loop, update_history
 from photon_ml_tpu.optim.linesearch import ValueAndGrad
 
 Array = jax.Array
@@ -169,16 +169,9 @@ def owlqn_solve(
         )
 
         # History pairs use the SMOOTH gradient (standard OWL-QN).
-        s_vec = w_new - s.w
-        y_vec = g_new - s.grad
-        sy = jnp.vdot(s_vec, y_vec)
-        good_pair = sy > 1e-10 * jnp.linalg.norm(s_vec) * jnp.linalg.norm(y_vec)
-        slot = s.n_pairs % m
-        S = jnp.where(good_pair, s.S.at[slot].set(s_vec), s.S)
-        Y = jnp.where(good_pair, s.Y.at[slot].set(y_vec), s.Y)
-        rho = jnp.where(good_pair, s.rho.at[slot].set(1.0 / sy), s.rho)
-        gamma = jnp.where(good_pair, sy / jnp.vdot(y_vec, y_vec), s.gamma)
-        n_pairs = jnp.where(good_pair, s.n_pairs + 1, s.n_pairs)
+        S, Y, rho, gamma, n_pairs = update_history(
+            s.S, s.Y, s.rho, s.gamma, s.n_pairs, w_new - s.w, g_new - s.grad
+        )
 
         k = s.k + 1
         pg_new = _pseudo_gradient(w_new, g_new, l1, mask)

@@ -1,0 +1,208 @@
+"""Optimization problems: objective + optimizer + regularization + normalization.
+
+The analogue of the reference's ``GeneralizedLinearOptimizationProblem`` /
+``DistributedOptimizationProblem`` / ``SingleNodeOptimizationProblem`` and
+their ``OptimizationProblemConfig`` (SURVEY.md §2): bind everything needed to
+produce a trained ``GeneralizedLinearModel``, optionally with coefficient
+variances, and sweep a regularization-weight grid with warm starts (the
+reference's ``ModelTraining`` trains the λ grid chained — SURVEY.md §3.1).
+
+The distributed/single-node split is ONE class here: ``axis_name=None`` is
+single-device; an axis name + ``shard_map`` (parallel/distributed.py) is the
+distributed problem.  λ is a runtime argument, so one compiled solver serves
+the whole grid without recompilation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.dataset import GlmData
+from photon_ml_tpu.data.normalization import NormalizationContext
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.ops import losses as losses_lib
+from photon_ml_tpu.optim.lbfgs import LBFGSConfig, SolveResult, lbfgs_solve
+from photon_ml_tpu.optim.objective import GlmObjective
+from photon_ml_tpu.optim.owlqn import OWLQNConfig, owlqn_solve
+from photon_ml_tpu.optim.regularization import RegularizationContext
+from photon_ml_tpu.optim.tron import TRONConfig, tron_solve
+
+Array = jax.Array
+
+
+class OptimizerType(enum.Enum):
+    LBFGS = "lbfgs"
+    OWLQN = "owlqn"
+    TRON = "tron"
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Mirrors the reference's ``OptimizerConfig`` (optimizerType,
+    maximumIterations, tolerance)."""
+
+    optimizer: OptimizerType = OptimizerType.LBFGS
+    max_iters: int = 100
+    tolerance: float = 1e-7
+    history: int = 10  # L-BFGS/OWL-QN corrections
+
+
+@dataclasses.dataclass(frozen=True)
+class GlmOptimizationConfig:
+    """Mirrors the reference's per-coordinate ``GLMOptimizationConfiguration``:
+    optimizer config + regularization context + weight(s) + variance flag."""
+
+    optimizer: OptimizerConfig = OptimizerConfig()
+    regularization: RegularizationContext = RegularizationContext.none()
+    compute_variances: bool = False
+
+
+class GlmOptimizationProblem:
+    """Trains GLMs for a task under a config.
+
+    All solve paths are pure jittable functions; this class only does static
+    dispatch (optimizer type, loss) and host-side bookkeeping, so it can be
+    used identically on one device or inside ``shard_map``.
+    """
+
+    def __init__(
+        self,
+        task: str,
+        config: GlmOptimizationConfig = GlmOptimizationConfig(),
+        normalization: Optional[NormalizationContext] = None,
+    ):
+        self.task = losses_lib.get(task).name  # canonicalize aliases
+        self.config = config
+        self.objective = GlmObjective(losses_lib.get(task), normalization)
+        self.normalization = normalization
+
+    # -- core solve (jit/shard_map-safe) -----------------------------------
+    def solve(
+        self,
+        data: GlmData,
+        reg_weight: Array | float = 0.0,
+        w0: Optional[Array] = None,
+        axis_name: Optional[str] = None,
+        l1_mask: Optional[Array] = None,
+    ) -> SolveResult:
+        """One optimization run at one regularization weight.
+
+        ``reg_weight`` may be a traced scalar: the split into L1/L2 uses only
+        the (static) regularization type.
+        """
+        obj = self.objective
+        cfg = self.config
+        d = data.n_features
+        if w0 is None:
+            w0 = jnp.zeros((d,), jnp.float32)
+        reg_weight = jnp.asarray(reg_weight, w0.dtype)
+        # Static split coefficients (floats), dynamic weight (traced scalar).
+        l1 = cfg.regularization.l1_weight(1.0) * reg_weight
+        l2 = cfg.regularization.l2_weight(1.0) * reg_weight
+        opt = cfg.optimizer
+
+        if opt.optimizer is OptimizerType.OWLQN:
+            return owlqn_solve(
+                lambda w: obj.value_and_grad(
+                    w, data, l2_weight=l2, axis_name=axis_name
+                ),
+                w0,
+                l1,
+                OWLQNConfig(
+                    max_iters=opt.max_iters,
+                    tolerance=opt.tolerance,
+                    history=opt.history,
+                ),
+                l1_mask=l1_mask,
+            )
+        if opt.optimizer is OptimizerType.TRON:
+            return tron_solve(
+                lambda w: obj.value_and_grad(
+                    w, data, l2_weight=l2, axis_name=axis_name
+                ),
+                lambda w, v, aux: obj.hvp(
+                    w, v, data, l2_weight=l2, axis_name=axis_name, d2w=aux
+                ),
+                w0,
+                TRONConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
+                d2_fn=lambda w: obj.d2_weights(w, data),
+            )
+        return lbfgs_solve(
+            lambda w: obj.value_and_grad(w, data, l2_weight=l2, axis_name=axis_name),
+            w0,
+            LBFGSConfig(
+                max_iters=opt.max_iters,
+                tolerance=opt.tolerance,
+                history=opt.history,
+            ),
+        )
+
+    # -- variances (reference: optional coefficient variance computation) ---
+    def coefficient_variances(
+        self,
+        w: Array,
+        data: GlmData,
+        reg_weight: Array | float = 0.0,
+        axis_name: Optional[str] = None,
+    ) -> Array:
+        """Diagonal-inverse-Hessian approximation ``1 / H_jj`` — the
+        reference's ``VarianceComputationType.SIMPLE``.  ``H_jj = Σ_i wᵢ·d2ᵢ·
+        X²ᵢⱼ + λ₂``, one squared-column reduction."""
+        l2 = self.config.regularization.l2_weight(1.0) * jnp.asarray(
+            reg_weight, w.dtype
+        )
+        d2w = self.objective.d2_weights(w, data)
+        diag = data.features.sq_rmatvec(d2w)
+        if axis_name is not None:
+            from jax import lax
+
+            diag = lax.psum(diag, axis_name)
+        return 1.0 / jnp.maximum(diag + l2, 1e-12)
+
+    # -- model construction (host side) ------------------------------------
+    def make_model(
+        self, w: Array, variances: Optional[Array] = None
+    ) -> GeneralizedLinearModel:
+        """Map scaled-space coefficients back to the original feature space
+        (normalization) and wrap them as a model."""
+        if self.normalization is not None:
+            w = self.normalization.model_to_original(w)
+            # Variances are not transformed through normalization shifts;
+            # scale-only transforms square the factors (as the reference's
+            # coefficient summaries do).
+            if variances is not None:
+                variances = variances * self.normalization.factors**2
+        return GeneralizedLinearModel(Coefficients(w, variances), self.task)
+
+    # -- grid sweep with warm start (the reference's ModelTraining loop) ----
+    def run_grid(
+        self,
+        data: GlmData,
+        reg_weights: Sequence[float],
+        w0: Optional[Array] = None,
+        axis_name: Optional[str] = None,
+        l1_mask: Optional[Array] = None,
+        warm_start: bool = True,
+    ) -> list[tuple[float, GeneralizedLinearModel, SolveResult]]:
+        """Train one model per regularization weight, warm-starting each run
+        from the previous solution (λs are sorted descending so the most
+        regularized — smoothest — problem is solved first, as the reference
+        does for its warm-start chain)."""
+        results = []
+        w_prev = w0
+        for lam in sorted(reg_weights, reverse=True):
+            res = self.solve(data, lam, w_prev, axis_name, l1_mask)
+            variances = (
+                self.coefficient_variances(res.w, data, lam, axis_name)
+                if self.config.compute_variances
+                else None
+            )
+            results.append((lam, self.make_model(res.w, variances), res))
+            if warm_start:
+                w_prev = res.w
+        return results

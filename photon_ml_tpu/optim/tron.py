@@ -74,20 +74,21 @@ def _steihaug_cg(
 ) -> tuple[Array, Array]:
     """Approximately minimize g·s + ½ sᵀHs subject to ‖s‖ ≤ delta.
 
-    Returns (s, hit_boundary).  Negative-curvature and radius-crossing cases
-    move to the trust-region boundary along the current direction.
+    Returns (s, r, hit_boundary) with r = -g - H·s the final residual
+    (kept consistent with s even on boundary exits, so sᵀHs is recoverable
+    without another HVP).  Negative-curvature and radius-crossing cases move
+    to the trust-region boundary along the current direction.
     """
     d = g.shape[0]
     dtype = g.dtype
 
-    def to_boundary(s, p):
+    def boundary_tau(s, p):
         # Solve ‖s + τ p‖ = delta for τ ≥ 0.
         pp = jnp.vdot(p, p)
         sp = jnp.vdot(s, p)
         ss = jnp.vdot(s, s)
         disc = jnp.maximum(sp * sp + pp * (delta * delta - ss), 0.0)
-        tau = (-sp + jnp.sqrt(disc)) / jnp.maximum(pp, 1e-30)
-        return s + tau * p
+        return (-sp + jnp.sqrt(disc)) / jnp.maximum(pp, 1e-30)
 
     init = _CGState(
         s=jnp.zeros((d,), dtype),
@@ -113,11 +114,15 @@ def _steihaug_cg(
         s_next = c.s + alpha * c.p
         crosses = jnp.linalg.norm(s_next) >= delta
 
-        boundary_s = to_boundary(c.s, c.p)
         take_boundary = jnp.logical_or(neg_curv, crosses)
-        s_new = jnp.where(take_boundary, boundary_s, s_next)
+        tau = boundary_tau(c.s, c.p)
+        step_len = jnp.where(take_boundary, tau, alpha)
+        s_new = c.s + step_len * c.p
+        # Maintain r = -g - H s for the RETURNED step, including the
+        # boundary case, so callers can recover sᵀHs from r without an
+        # extra Hessian-vector product.
+        r_new = c.r - step_len * Hp
 
-        r_new = c.r - alpha * Hp
         rr_new = jnp.vdot(r_new, r_new)
         small = jnp.sqrt(rr_new) <= tol
         beta = rr_new / jnp.maximum(c.rr, 1e-30)
@@ -126,16 +131,16 @@ def _steihaug_cg(
         done = jnp.logical_or(take_boundary, small)
         return _CGState(
             s=s_new,
-            r=jnp.where(take_boundary, c.r, r_new),
+            r=r_new,
             p=jnp.where(take_boundary, c.p, p_new),
-            rr=jnp.where(take_boundary, c.rr, rr_new),
+            rr=rr_new,
             i=c.i + 1,
             done=done,
             hit_boundary=jnp.logical_or(c.hit_boundary, take_boundary),
         )
 
     final = lax.while_loop(cond, body, init)
-    return final.s, final.hit_boundary
+    return final.s, final.r, final.hit_boundary
 
 
 class _TRONState(NamedTuple):
@@ -193,7 +198,7 @@ def tron_solve(
 
     def body(s: _TRONState):
         cg_tol = config.cg_tol * jnp.linalg.norm(s.grad)
-        step, _ = _steihaug_cg(
+        step, residual, _ = _steihaug_cg(
             lambda v: hvp_fn(s.w, v, s.aux),
             s.grad,
             s.delta,
@@ -205,7 +210,9 @@ def tron_solve(
         f_try, g_try = value_and_grad(w_try)
 
         gs = jnp.vdot(s.grad, step)
-        sHs = jnp.vdot(step, hvp_fn(s.w, step, s.aux))
+        # r = -g - H·s  ⇒  sᵀHs = -s·r - s·g; saves one HVP (and its psum
+        # round when distributed) per outer iteration, as LIBLINEAR does.
+        sHs = -jnp.vdot(step, residual) - gs
         pred = -(gs + 0.5 * sHs)
         ared = s.value - f_try
         rho = ared / jnp.where(pred > 0, pred, 1e-30)

@@ -1,0 +1,7 @@
+from photon_ml_tpu.parallel.distributed import (  # noqa: F401
+    DATA_AXIS,
+    DistributedGlmData,
+    data_mesh,
+    distributed_solve,
+    shard_glm_data,
+)

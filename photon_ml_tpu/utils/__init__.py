@@ -1,0 +1,3 @@
+from photon_ml_tpu.utils.logging import PhotonLogger  # noqa: F401
+from photon_ml_tpu.utils.timer import Timer  # noqa: F401
+from photon_ml_tpu.utils.tracker import OptimizationStatesTracker  # noqa: F401

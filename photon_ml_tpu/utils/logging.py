@@ -1,0 +1,53 @@
+"""Logging that also lands in the job's output directory.
+
+The analogue of the reference's ``PhotonLogger`` (SURVEY.md §2 Util, §5.5):
+a log4j-backed logger duplicated to an HDFS file so the training log ships
+with the model artifacts.  Here: stdlib logging duplicated to a file in the
+driver's output dir.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+class PhotonLogger:
+    """Console + file logger; the file lives next to the job's outputs."""
+
+    def __init__(self, output_dir: str | None = None, name: str = "photon_ml_tpu"):
+        self._logger = logging.getLogger(f"{name}.{id(self):x}")
+        self._logger.setLevel(logging.INFO)
+        self._logger.propagate = False
+        fmt = logging.Formatter(
+            "%(asctime)s %(levelname)s %(message)s", "%Y-%m-%d %H:%M:%S"
+        )
+        console = logging.StreamHandler(sys.stderr)
+        console.setFormatter(fmt)
+        self._logger.addHandler(console)
+        self._file_handler = None
+        if output_dir is not None:
+            os.makedirs(output_dir, exist_ok=True)
+            self._file_handler = logging.FileHandler(
+                os.path.join(output_dir, "photon.log")
+            )
+            self._file_handler.setFormatter(fmt)
+            self._logger.addHandler(self._file_handler)
+
+    def info(self, msg: str, *args) -> None:
+        self._logger.info(msg, *args)
+
+    def warning(self, msg: str, *args) -> None:
+        self._logger.warning(msg, *args)
+
+    def error(self, msg: str, *args) -> None:
+        self._logger.error(msg, *args)
+
+    def debug(self, msg: str, *args) -> None:
+        self._logger.debug(msg, *args)
+
+    def close(self) -> None:
+        for h in list(self._logger.handlers):
+            h.close()
+            self._logger.removeHandler(h)

@@ -1,0 +1,40 @@
+"""Wall-clock timing utility (the reference's ``Timer`` — SURVEY.md §2 Util).
+
+Used around device computations; callers must block on results
+(``jax.block_until_ready``) for the measurement to mean anything, which
+:meth:`stop_blocking` does for them.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    def __init__(self):
+        self._start: float | None = None
+        self.elapsed: float = 0.0
+
+    def start(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def stop(self) -> float:
+        assert self._start is not None, "Timer not started"
+        self.elapsed = time.perf_counter() - self._start
+        self._start = None
+        return self.elapsed
+
+    def stop_blocking(self, *arrays) -> float:
+        """Block until device arrays are ready, then stop."""
+        import jax
+
+        for a in arrays:
+            jax.block_until_ready(a)
+        return self.stop()
+
+    def __enter__(self) -> "Timer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,52 @@
+"""Convergence-trace bookkeeping.
+
+The analogue of the reference's ``OptimizationStatesTracker`` (SURVEY.md §5.1):
+per-iteration objective value and gradient norm for each optimizer run.  The
+on-device side already records these into the fixed-size nan-padded arrays of
+``SolveResult`` (optim/lbfgs.py); this host-side class turns them into the
+human-readable trace the reference logs, plus wall-clock attribution the
+device can't know.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class OptimizationStatesTracker:
+    values: np.ndarray  # (iterations+1,)
+    grad_norms: np.ndarray  # (iterations+1,)
+    iterations: int
+    converged: bool
+    wall_seconds: float = float("nan")
+
+    @staticmethod
+    def from_solve_result(res, wall_seconds: float = float("nan")):
+        values = np.asarray(res.values, np.float64)
+        keep = ~np.isnan(values)
+        return OptimizationStatesTracker(
+            values=values[keep],
+            grad_norms=np.asarray(res.grad_norms, np.float64)[keep],
+            iterations=int(res.iterations),
+            converged=bool(res.converged),
+            wall_seconds=wall_seconds,
+        )
+
+    def summary(self) -> str:
+        lines = [
+            f"iter {i:4d}: value={v:.8g} |grad|={g:.4g}"
+            for i, (v, g) in enumerate(zip(self.values, self.grad_norms))
+        ]
+        status = "converged" if self.converged else "NOT converged"
+        lines.append(
+            f"{status} after {self.iterations} iterations"
+            + (
+                f" in {self.wall_seconds:.3f}s"
+                if not np.isnan(self.wall_seconds)
+                else ""
+            )
+        )
+        return "\n".join(lines)

@@ -19,6 +19,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax
 
+# The env var alone is not honored in this environment (an "axon" TPU plugin
+# wins platform selection); the config flag is.
+jax.config.update("jax_platforms", "cpu")
+
 # Float64 for finite-difference oracles and scipy parity checks.  Library
 # data paths pin float32 explicitly, so this only affects test-constructed
 # float64 arrays.

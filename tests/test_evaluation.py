@@ -1,0 +1,95 @@
+"""Evaluator tests vs sklearn oracles."""
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from photon_ml_tpu.evaluation.evaluators import (
+    AreaUnderROCCurveEvaluator,
+    LogisticLossEvaluator,
+    PoissonLossEvaluator,
+    PrecisionAtKEvaluator,
+    RMSEEvaluator,
+    get_evaluator,
+)
+
+
+class TestAUC:
+    def test_matches_sklearn(self, rng):
+        y = (rng.uniform(size=500) < 0.3).astype(float)
+        s = rng.normal(size=500) + y
+        ours = AreaUnderROCCurveEvaluator().evaluate(s, y)
+        np.testing.assert_allclose(ours, skm.roc_auc_score(y, s), atol=1e-12)
+
+    def test_ties_match_sklearn(self, rng):
+        y = (rng.uniform(size=300) < 0.4).astype(float)
+        s = np.round(rng.normal(size=300), 1)  # heavy ties
+        ours = AreaUnderROCCurveEvaluator().evaluate(s, y)
+        np.testing.assert_allclose(ours, skm.roc_auc_score(y, s), atol=1e-12)
+
+    def test_weighted_matches_sklearn(self, rng):
+        y = (rng.uniform(size=400) < 0.5).astype(float)
+        s = rng.normal(size=400) + 0.5 * y
+        w = rng.uniform(0.1, 3.0, size=400)
+        ours = AreaUnderROCCurveEvaluator().evaluate(s, y, w)
+        np.testing.assert_allclose(
+            ours, skm.roc_auc_score(y, s, sample_weight=w), atol=1e-10
+        )
+
+    def test_zero_weight_rows_excluded(self, rng):
+        y = np.array([1.0, 0.0, 1.0, 0.0])
+        s = np.array([2.0, 1.0, -5.0, -6.0])
+        w = np.array([1.0, 1.0, 0.0, 0.0])  # padding rows
+        ours = AreaUnderROCCurveEvaluator().evaluate(s, y, w)
+        assert ours == 1.0
+
+    def test_grouped_auc(self, rng):
+        y = (rng.uniform(size=200) < 0.5).astype(float)
+        s = rng.normal(size=200) + y
+        g = rng.integers(0, 5, size=200)
+        ours = AreaUnderROCCurveEvaluator().evaluate(s, y, group_ids=g)
+        per_group = [
+            skm.roc_auc_score(y[g == k], s[g == k])
+            for k in range(5)
+            if len(np.unique(y[g == k])) == 2
+        ]
+        np.testing.assert_allclose(ours, np.mean(per_group), atol=1e-12)
+
+
+class TestOtherMetrics:
+    def test_rmse(self, rng):
+        y = rng.normal(size=100)
+        s = y + rng.normal(size=100)
+        ours = RMSEEvaluator().evaluate(s, y)
+        np.testing.assert_allclose(
+            ours, np.sqrt(skm.mean_squared_error(y, s)), atol=1e-12
+        )
+
+    def test_logloss_matches_sklearn(self, rng):
+        y = (rng.uniform(size=200) < 0.5).astype(float)
+        margins = rng.normal(size=200)
+        p = 1 / (1 + np.exp(-margins))
+        ours = LogisticLossEvaluator().evaluate(margins, y)
+        np.testing.assert_allclose(ours, skm.log_loss(y, p), atol=1e-10)
+
+    def test_poisson_loss_decreases_with_fit(self, rng):
+        y = rng.poisson(3.0, size=200).astype(float)
+        good = np.log(np.maximum(y, 0.5))
+        bad = np.zeros(200)
+        ev = PoissonLossEvaluator()
+        assert ev.evaluate(good, y) < ev.evaluate(bad, y)
+        assert not ev.larger_is_better
+
+    def test_precision_at_k(self):
+        # Two groups; top-2 hits are (1,0) and (1,1) → mean precision 0.75.
+        s = np.array([3.0, 2.0, 1.0, 9.0, 8.0, 7.0])
+        y = np.array([1.0, 0.0, 1.0, 1.0, 1.0, 0.0])
+        g = np.array([0, 0, 0, 1, 1, 1])
+        ours = PrecisionAtKEvaluator(k=2).evaluate(s, y, group_ids=g)
+        assert ours == pytest.approx(0.75)
+
+    def test_get_evaluator_specs(self):
+        assert isinstance(get_evaluator("AUC"), AreaUnderROCCurveEvaluator)
+        assert get_evaluator("precision@5").k == 5
+        with pytest.raises(KeyError):
+            get_evaluator("nope")

@@ -1,0 +1,200 @@
+"""IO tests: Avro codec, model store, LIBSVM, index maps, stats."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data import libsvm
+from photon_ml_tpu.data.dataset import make_glm_data
+from photon_ml_tpu.data.index_map import (
+    INTERCEPT_KEY,
+    BinaryIndexMap,
+    IndexMap,
+    feature_key,
+)
+from photon_ml_tpu.data.stats import summarize
+from photon_ml_tpu.io import avro
+from photon_ml_tpu.io.model_store import load_glm_model, save_glm_model
+from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+
+
+class TestAvroCodec:
+    def test_roundtrip_records(self, tmp_path):
+        schema = TRAINING_EXAMPLE
+        records = [
+            {
+                "uid": "r1",
+                "response": 1.0,
+                "weight": 2.0,
+                "offset": None,
+                "features": [
+                    {"name": "age", "term": "", "value": 0.5},
+                    {"name": "geo", "term": "us", "value": 1.0},
+                ],
+            },
+            {
+                "uid": None,
+                "response": 0.0,
+                "weight": None,
+                "offset": -1.5,
+                "features": [],
+            },
+        ]
+        path = str(tmp_path / "data.avro")
+        avro.write_container(path, schema, records)
+        rschema, rrecords = avro.read_container(path)
+        assert rschema == schema
+        assert rrecords == records
+
+    def test_null_codec_and_many_blocks(self, tmp_path):
+        schema = {"type": "record", "name": "R",
+                  "fields": [{"name": "x", "type": "long"}]}
+        records = [{"x": i} for i in range(10000)]
+        path = str(tmp_path / "many.avro")
+        avro.write_container(path, schema, records, codec="null",
+                             records_per_block=100)
+        _, out = avro.read_container(path)
+        assert out == records
+
+    def test_varint_extremes(self, tmp_path):
+        schema = {"type": "record", "name": "R",
+                  "fields": [{"name": "x", "type": "long"}]}
+        vals = [0, -1, 1, 2**62, -(2**62), 127, -128]
+        path = str(tmp_path / "ints.avro")
+        avro.write_container(path, schema, [{"x": v} for v in vals])
+        _, out = avro.read_container(path)
+        assert [r["x"] for r in out] == vals
+
+    def test_corrupt_magic_rejected(self, tmp_path):
+        path = tmp_path / "bad.avro"
+        path.write_bytes(b"nope")
+        with pytest.raises(ValueError, match="not an Avro container"):
+            avro.read_container(str(path))
+
+
+class TestModelStore:
+    def test_roundtrip_with_variances(self, tmp_path, rng):
+        imap = IndexMap.build(["a", feature_key("b", "t1"), "c"],
+                              add_intercept=True)
+        means = jnp.asarray(np.array([1.5, 0.0, -2.0, 0.25], np.float32))
+        variances = jnp.asarray(np.array([0.1, 0.2, 0.3, 0.4], np.float32))
+        model = GeneralizedLinearModel(Coefficients(means, variances), "logistic")
+        path = str(tmp_path / "model.avro")
+        save_glm_model(model, imap, path, sparsify=False)
+        loaded, imap2 = load_glm_model(path, imap)
+        np.testing.assert_allclose(np.asarray(loaded.coefficients.means),
+                                   np.asarray(means))
+        np.testing.assert_allclose(np.asarray(loaded.coefficients.variances),
+                                   np.asarray(variances))
+        assert loaded.task == "logistic"
+
+    def test_sparsified_save_drops_zeros(self, tmp_path):
+        imap = IndexMap.build(["a", "b", "c"])
+        means = jnp.asarray(np.array([1.0, 0.0, 3.0], np.float32))
+        model = GeneralizedLinearModel(Coefficients(means), "squared")
+        path = str(tmp_path / "model.avro")
+        save_glm_model(model, imap, path)
+        _, records = avro.read_container(path)
+        assert len(records[0]["means"]) == 2
+        loaded, _ = load_glm_model(path, imap)
+        np.testing.assert_allclose(np.asarray(loaded.coefficients.means),
+                                   np.asarray(means))
+
+
+class TestLibsvm:
+    def test_roundtrip(self, tmp_path, rng):
+        X = sp.random(50, 20, density=0.3, random_state=7, format="csr")
+        y = (rng.uniform(size=50) < 0.5).astype(np.float32) * 2 - 1
+        path = str(tmp_path / "data.libsvm")
+        libsvm.write_libsvm(path, X, y)
+        X2, y2 = libsvm.read_libsvm(path, n_features=20,
+                                    binary_labels_to_01=False)
+        np.testing.assert_allclose(X2.toarray(), X.toarray(), rtol=1e-6)
+        np.testing.assert_allclose(y2, y)
+
+    def test_pm1_to_01_mapping_and_intercept(self, tmp_path):
+        path = str(tmp_path / "pm1.libsvm")
+        with open(path, "w") as f:
+            f.write("+1 1:0.5 3:1\n-1 2:2\n")
+        X, y = libsvm.read_libsvm(path, add_intercept=True)
+        np.testing.assert_allclose(y, [1.0, 0.0])
+        assert X.shape == (2, 4)
+        np.testing.assert_allclose(X.toarray()[:, -1], 1.0)
+
+
+class TestIndexMap:
+    def test_build_lookup_reverse(self):
+        imap = IndexMap.build(["x", "y", "z"], add_intercept=True)
+        assert imap["x"] == 0 and imap[INTERCEPT_KEY] == 3
+        assert imap.intercept_index == 3
+        assert imap.index_to_name(1) == "y"
+        assert imap.get_index("missing") == -1
+        assert len(imap) == 4
+
+    def test_save_load(self, tmp_path):
+        imap = IndexMap.build([f"f{i}" for i in range(100)])
+        imap.save(str(tmp_path))
+        loaded = IndexMap.load(str(tmp_path))
+        assert dict(loaded) == dict(imap)
+
+    def test_binary_map(self, tmp_path):
+        imap = IndexMap.build([f"feat_{i}" for i in range(1000)])
+        imap.save_binary(str(tmp_path))
+        bmap = BinaryIndexMap(str(tmp_path))
+        assert len(bmap) == 1000
+        for probe in ["feat_0", "feat_123", "feat_999"]:
+            assert bmap.get_index(probe) == imap[probe]
+        assert bmap.get_index("nope") == -1
+
+
+class TestStats:
+    def test_matches_numpy_weighted(self, rng):
+        X = rng.normal(size=(100, 6))
+        X[X < 0.3] = 0.0
+        w = rng.uniform(0.5, 2.0, size=100)
+        data = make_glm_data(X, np.zeros(100), weights=w)
+        s = summarize(data)
+        mean = np.average(X, axis=0, weights=w)
+        var = np.average((X - mean) ** 2, axis=0, weights=w)
+        np.testing.assert_allclose(np.asarray(s.mean), mean, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(s.variance), var, rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(s.min), X.min(axis=0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(s.max), X.max(axis=0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(s.nnz), (X != 0).sum(axis=0))
+
+    def test_sparse_matches_dense(self, rng):
+        Xd = rng.normal(size=(60, 5)) * (rng.uniform(size=(60, 5)) < 0.4)
+        data_d = make_glm_data(Xd, np.zeros(60))
+        data_s = make_glm_data(sp.csr_matrix(Xd), np.zeros(60))
+        sd, ss = summarize(data_d), summarize(data_s)
+        for field in ("mean", "variance", "min", "max"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(ss, field)),
+                np.asarray(getattr(sd, field)),
+                rtol=1e-5, atol=1e-6,
+            )
+        # Padded rows must not change stats.
+        data_p = make_glm_data(sp.csr_matrix(Xd), np.zeros(60), pad_rows=64,
+                               pad_nnz=200)
+        sp_ = summarize(data_p)
+        np.testing.assert_allclose(np.asarray(sp_.mean), np.asarray(sd.mean),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(sp_.min), np.asarray(sd.min),
+                                   rtol=1e-5)
+
+    def test_padding_does_not_leak_into_min_max(self, rng):
+        # Regression: an all-positive dense column must keep its positive min
+        # even when zero-weight padding rows are appended.
+        X = rng.uniform(1.0, 2.0, size=(20, 3))
+        for features in (X, sp.csr_matrix(X)):
+            data = make_glm_data(features, np.zeros(20), pad_rows=32,
+                                 pad_nnz=100 if sp.issparse(features) else None)
+            s = summarize(data)
+            np.testing.assert_allclose(np.asarray(s.min), X.min(axis=0),
+                                       rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(s.max), X.max(axis=0),
+                                       rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(s.nnz), [20, 20, 20])
