@@ -1,0 +1,131 @@
+"""GAME training-data ingest (Avro).
+
+The analogue of the reference's ``AvroDataReader`` for GAME data
+(SURVEY.md §2 "Avro IO", §3.2): each record carries response / weight /
+offset, an ``ids`` map (entity id columns: userId, itemId, ...), and
+feature bags as a map shard-name → [ {name, term, value} ] — the reference's
+"feature shards"/"bags".  Reading produces per-shard CSR matrices over
+per-shard feature index maps (built on the fly or supplied, the reference's
+``IndexMapLoader`` behaviors).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.index_map import INTERCEPT_KEY, IndexMap, feature_key
+from photon_ml_tpu.io import avro
+
+GAME_EXAMPLE_SCHEMA = {
+    "type": "record",
+    "name": "GameTrainingExampleAvro",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"]},
+        {"name": "response", "type": "double"},
+        {"name": "weight", "type": ["null", "double"]},
+        {"name": "offset", "type": ["null", "double"]},
+        {"name": "ids", "type": {"type": "map", "values": "string"}},
+        {
+            "name": "features",
+            "type": {
+                "type": "map",
+                "values": {
+                    "type": "array",
+                    "items": {
+                        "type": "record",
+                        "name": "GameFeatureAvro",
+                        "fields": [
+                            {"name": "name", "type": "string"},
+                            {"name": "term", "type": "string"},
+                            {"name": "value", "type": "double"},
+                        ],
+                    },
+                },
+            },
+        },
+    ],
+}
+
+
+def write_game_avro(path: str, rows: list[dict]) -> None:
+    """Write GAME examples (dicts shaped like GAME_EXAMPLE_SCHEMA)."""
+    avro.write_container(path, GAME_EXAMPLE_SCHEMA, rows)
+
+
+def read_game_avro(
+    path: str,
+    index_maps: Optional[dict] = None,
+    add_intercept_shards: tuple[str, ...] = (),
+):
+    """Read GAME Avro data.
+
+    Returns ``(shards, ids, response, weight, offset, uids, index_maps)``
+    where ``shards`` maps shard name → CSR matrix indexed by
+    ``index_maps[shard]`` (built from the data when not supplied — supplying
+    them is the scoring path, where unseen features are dropped, as the
+    reference's scoring driver does).
+    """
+    _, records = avro.read_container(path)
+    n = len(records)
+    response = np.zeros(n, np.float32)
+    weight = np.ones(n, np.float32)
+    offset = np.zeros(n, np.float32)
+    uids: list[Optional[str]] = []
+    id_cols: dict[str, list] = {}
+    shard_rows: dict[str, tuple[list, list, list]] = {}  # rows, cols, vals
+    building = index_maps is None
+    if building:
+        index_maps = {}
+    forward: dict[str, dict] = {
+        s: dict(m) for s, m in (index_maps or {}).items()
+    }
+
+    for i, rec in enumerate(records):
+        response[i] = rec["response"]
+        if rec["weight"] is not None:
+            weight[i] = rec["weight"]
+        if rec["offset"] is not None:
+            offset[i] = rec["offset"]
+        uids.append(rec["uid"])
+        for k, v in rec["ids"].items():
+            id_cols.setdefault(k, [None] * n)[i] = v
+        for shard, feats in rec["features"].items():
+            rows, cols, vals = shard_rows.setdefault(shard, ([], [], []))
+            fwd = forward.setdefault(shard, {})
+            for f in feats:
+                key = feature_key(f["name"], f["term"])
+                idx = fwd.get(key)
+                if idx is None:
+                    if not building:
+                        continue  # scoring path: drop unseen features
+                    idx = len(fwd)
+                    fwd[key] = idx
+                rows.append(i)
+                cols.append(idx)
+                vals.append(f["value"])
+
+    shards: dict = {}
+    out_maps: dict = {}
+    for shard, (rows, cols, vals) in shard_rows.items():
+        fwd = forward[shard]
+        if building and shard in add_intercept_shards:
+            fwd.setdefault(INTERCEPT_KEY, len(fwd))
+        d = len(fwd)
+        imap = index_maps[shard] if not building else IndexMap.build(fwd)
+        if shard in add_intercept_shards and INTERCEPT_KEY in imap:
+            icol = imap[INTERCEPT_KEY]
+            rows = rows + list(range(n))
+            cols = cols + [icol] * n
+            vals = vals + [1.0] * n
+        shards[shard] = sp.csr_matrix(
+            (np.asarray(vals, np.float32),
+             (np.asarray(rows, np.int64), np.asarray(cols, np.int64))),
+            shape=(n, d),
+        )
+        out_maps[shard] = imap
+
+    ids = {k: np.asarray(v) for k, v in id_cols.items()}
+    return shards, ids, response, weight, offset, uids, out_maps

@@ -21,6 +21,7 @@ def read_libsvm(
     zero_based: bool = False,
     binary_labels_to_01: bool = True,
     add_intercept: bool = False,
+    drop_out_of_range: bool = False,
 ):
     """Read a LIBSVM/SVMlight text file.
 
@@ -28,6 +29,9 @@ def read_libsvm(
     ``±1`` labels are mapped to ``{0, 1}`` when ``binary_labels_to_01`` (the
     losses' convention).  ``add_intercept`` appends a constant-1 column at
     index ``n_features`` (the reference appends its intercept last as well).
+    ``drop_out_of_range`` silently drops features with index >= n_features —
+    the scoring/validation convention (features unseen at training time
+    contribute nothing), matching the GAME reader's scoring path.
     """
     labels: list[float] = []
     indptr = [0]
@@ -50,6 +54,12 @@ def read_libsvm(
                     raise ValueError(
                         f"negative feature index {col} — wrong zero_based setting?"
                     )
+                if (
+                    drop_out_of_range
+                    and n_features is not None
+                    and col >= n_features
+                ):
+                    continue
                 max_col = max(max_col, col)
                 indices.append(col)
                 values.append(float(val_s))

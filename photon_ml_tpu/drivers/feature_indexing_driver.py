@@ -1,0 +1,63 @@
+"""Feature indexing driver.
+
+The analogue of the reference's ``FeatureIndexingDriver`` (SURVEY.md §2,
+"Feature index maps"): scan training data once and persist per-shard
+feature-name → column-index maps, so training/scoring jobs can share a
+stable feature space without re-deriving it.  ``--binary`` additionally
+writes the hash-sorted mmap layout (the PalDB analogue) for very wide
+spaces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+from photon_ml_tpu.data.game_reader import read_game_avro
+from photon_ml_tpu.utils.logging import PhotonLogger
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="feature_indexing_driver",
+        description="Build feature index maps from GAME Avro data",
+    )
+    p.add_argument("--data", required=True, help="GAME Avro file")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--add-intercept", action="store_true")
+    p.add_argument("--binary", action="store_true",
+                   help="also write the mmap binary layout")
+    return p
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    args = build_arg_parser().parse_args(argv)
+    os.makedirs(args.output_dir, exist_ok=True)
+    logger = PhotonLogger(args.output_dir)
+    shards, _, response, _, _, _, index_maps = read_game_avro(args.data)
+    if args.add_intercept:
+        # Shard names are only known after a first read; re-read with an
+        # intercept column appended to every shard.
+        shards, _, response, _, _, _, index_maps = read_game_avro(
+            args.data, add_intercept_shards=tuple(shards)
+        )
+    sizes = {}
+    for shard, imap in index_maps.items():
+        target = os.path.join(args.output_dir, shard)
+        imap.save(target)
+        if args.binary:
+            imap.save_binary(target)
+        sizes[shard] = len(imap)
+        logger.info("shard %s: %d features -> %s", shard, len(imap), target)
+    logger.close()
+    return {"shards": sizes, "n_rows": int(len(response))}
+
+
+def main() -> None:
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+"""GAME training driver.
+
+The analogue of the reference's ``GameTrainingDriver``
+([CONFIRMED-BASELINE], SURVEY.md §2, §3.2): validate params → read GAME Avro
+data → build feature index maps → ``GameEstimator.fit`` over the coordinate
+configuration → evaluate → save the GameModel (fixed-effect + per-entity
+coefficient Avro files).
+
+The coordinate configuration comes from a JSON file (the reference's
+spark.ml ``Param`` surface), e.g.::
+
+    {
+      "task": "logistic",
+      "iterations": 3,
+      "evaluator": "auc",
+      "coordinates": [
+        {"name": "fixed", "type": "fixed", "feature_shard": "global",
+         "optimizer": "lbfgs", "max_iters": 50, "tolerance": 1e-7,
+         "reg_type": "l2", "reg_weight": 1.0},
+        {"name": "per_user", "type": "random", "feature_shard": "userFeatures",
+         "entity_key": "userId", "optimizer": "lbfgs", "max_iters": 30,
+         "reg_type": "l2", "reg_weight": 1.0, "max_rows_per_entity": 4096}
+      ]
+    }
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.data.game_reader import read_game_avro
+from photon_ml_tpu.evaluation.evaluators import (
+    default_evaluator_for_task,
+    get_evaluator,
+)
+from photon_ml_tpu.game.estimator import (
+    FixedEffectCoordinateConfig,
+    GameEstimator,
+    GameTransformer,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.io.game_store import save_game_model
+from photon_ml_tpu.optim.problem import (
+    GlmOptimizationConfig,
+    OptimizerConfig,
+    OptimizerType,
+)
+from photon_ml_tpu.optim.regularization import RegularizationContext, RegularizationType
+from photon_ml_tpu.ops import losses as losses_lib
+from photon_ml_tpu.utils.logging import PhotonLogger
+from photon_ml_tpu.utils.timer import Timer
+
+
+def parse_coordinate_config(spec: dict):
+    """One JSON coordinate spec → (name, CoordinateConfig)."""
+    opt = GlmOptimizationConfig(
+        optimizer=OptimizerConfig(
+            optimizer=OptimizerType(spec.get("optimizer", "lbfgs")),
+            max_iters=int(spec.get("max_iters", 100)),
+            tolerance=float(spec.get("tolerance", 1e-7)),
+        ),
+        regularization=RegularizationContext(
+            RegularizationType(spec.get("reg_type", "none")),
+            float(spec.get("elastic_net_alpha", 0.5)),
+        ),
+    )
+    name = spec["name"]
+    if spec["type"] == "fixed":
+        return name, FixedEffectCoordinateConfig(
+            feature_shard=spec["feature_shard"],
+            optimization=opt,
+            reg_weight=float(spec.get("reg_weight", 0.0)),
+        )
+    if spec["type"] == "random":
+        return name, RandomEffectCoordinateConfig(
+            feature_shard=spec["feature_shard"],
+            entity_key=spec["entity_key"],
+            optimization=opt,
+            reg_weight=float(spec.get("reg_weight", 0.0)),
+            max_rows_per_entity=spec.get("max_rows_per_entity"),
+        )
+    raise ValueError(f"unknown coordinate type {spec['type']!r}")
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="game_training_driver", description="TPU-native GAME training"
+    )
+    p.add_argument("--train-data", required=True, help="GAME Avro file")
+    p.add_argument("--validate-data", help="GAME Avro validation file")
+    p.add_argument("--config", required=True, help="coordinate config JSON")
+    p.add_argument("--output-dir", required=True)
+    return p
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    args = build_arg_parser().parse_args(argv)
+    os.makedirs(args.output_dir, exist_ok=True)
+    logger = PhotonLogger(args.output_dir)
+    timer = Timer().start()
+
+    with open(args.config) as f:
+        config = json.load(f)
+    task = config.get("task", "logistic")
+    coordinate_configs = dict(
+        parse_coordinate_config(spec) for spec in config["coordinates"]
+    )
+    evaluator = (
+        get_evaluator(config["evaluator"])
+        if "evaluator" in config
+        else default_evaluator_for_task(losses_lib.get(task).name)
+    )
+
+    shards, ids, response, weight, offset, _, index_maps = read_game_avro(
+        args.train_data
+    )
+    logger.info(
+        "read %d rows; shards: %s; id columns: %s",
+        len(response),
+        {k: v.shape for k, v in shards.items()},
+        list(ids),
+    )
+
+    estimator = GameEstimator(
+        task,
+        coordinate_configs,
+        n_iterations=int(config.get("iterations", 1)),
+        logger=logger,
+    )
+    model, history = estimator.fit(
+        shards, ids, response, weight=weight, offset=offset, evaluator=evaluator
+    )
+
+    result = {
+        "task": task,
+        "n_rows": int(len(response)),
+        "history": history,
+        "train_metric": history[-1].get("train_metric") if history else None,
+    }
+
+    if args.validate_data:
+        v_shards, v_ids, v_resp, v_weight, v_offset, _, _ = read_game_avro(
+            args.validate_data, index_maps=index_maps
+        )
+        v_scores = GameTransformer(model).transform(v_shards, v_ids, v_offset)
+        result["validation_metric"] = evaluator.evaluate(
+            v_scores, v_resp, v_weight
+        )
+        logger.info(
+            "validation %s = %.6f",
+            type(evaluator).__name__, result["validation_metric"],
+        )
+
+    save_game_model(model, index_maps, os.path.join(args.output_dir, "models"))
+    result["wall_seconds"] = timer.stop()
+    with open(os.path.join(args.output_dir, "training_result.json"), "w") as f:
+        json.dump(result, f, indent=2)
+    logger.info("GAME training done in %.2fs", result["wall_seconds"])
+    logger.close()
+    return result
+
+
+def main() -> None:
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

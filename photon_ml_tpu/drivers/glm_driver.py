@@ -183,6 +183,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         X_val, y_val = libsvm.read_libsvm(
             args.validate_data, n_features=d - (1 if args.intercept else 0),
             add_intercept=args.intercept,
+            # Features unseen at training time contribute nothing, they must
+            # not abort the job after all training compute is spent.
+            drop_out_of_range=True,
         )
         val_data = make_glm_data(X_val, y_val)
     else:

@@ -1,0 +1,19 @@
+from photon_ml_tpu.game.data import (  # noqa: F401
+    EntityBlock,
+    FixedEffectDataset,
+    GameData,
+    RandomEffectDataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.game.model import (  # noqa: F401
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.game.coordinates import (  # noqa: F401
+    Coordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.descent import CoordinateDescent  # noqa: F401
+from photon_ml_tpu.game.estimator import GameEstimator, GameTransformer  # noqa: F401

@@ -1,0 +1,254 @@
+"""GAME coordinates: per-effect training and scoring units.
+
+The analogue of the reference's ``...ml.algorithm`` coordinates
+([CONFIRMED-BASELINE], SURVEY.md §2, §3.2):
+
+- ``FixedEffectCoordinate`` — one distributed GLM fit over all rows (the
+  stage-3.1 solver with per-row offsets from the other coordinates);
+- ``RandomEffectCoordinate`` — millions of independent per-entity GLM fits.
+  The reference runs them inside Spark ``mapPartitions`` (executor-local
+  L-BFGS per entity, zero communication — SURVEY.md §3.2); here each
+  size-bucket block solves as ONE ``vmap``'d L-BFGS/OWL-QN ``while_loop``
+  over its entity lanes, one jitted program per block shape.  Converged
+  lanes freeze (lax batching selects old carries), so ragged per-entity
+  convergence inside a batch is handled by construction.
+
+Coordinates hold their (device-resident) datasets — the analogue of the
+reference persisting per-coordinate RDDs — and expose
+``train(offsets, warm) → state`` / ``score(state) → per-row scores``,
+mirroring the reference's ``Coordinate.trainModel`` / ``score``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.dataset import GlmData
+from photon_ml_tpu.game.data import (
+    EntityBlock,
+    FixedEffectDataset,
+    RandomEffectDataset,
+)
+from photon_ml_tpu.game.model import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.ops import losses as losses_lib
+from photon_ml_tpu.optim.lbfgs import LBFGSConfig, lbfgs_solve
+from photon_ml_tpu.optim.owlqn import OWLQNConfig, owlqn_solve
+from photon_ml_tpu.optim.problem import GlmOptimizationConfig, OptimizerType
+
+Array = jax.Array
+
+
+class Coordinate:
+    """Protocol: train against offsets, score into the global row space."""
+
+    name: str
+
+    def train(self, offsets: Array, warm_state=None):
+        raise NotImplementedError
+
+    def score(self, state) -> Array:
+        raise NotImplementedError
+
+    def finalize(self, state):
+        """Turn device state into the host-side model object."""
+        raise NotImplementedError
+
+
+class FixedEffectCoordinate(Coordinate):
+    """Reference: ``FixedEffectCoordinate`` — DistributedOptimizationProblem
+    over the full dataset (SURVEY.md §3.2)."""
+
+    def __init__(
+        self,
+        name: str,
+        dataset: FixedEffectDataset,
+        task: str,
+        config: GlmOptimizationConfig,
+        reg_weight: float = 0.0,
+        feature_shard: str = "global",
+        axis_name: Optional[str] = None,
+    ):
+        from photon_ml_tpu.optim.problem import GlmOptimizationProblem
+
+        self.name = name
+        self.dataset = dataset
+        self.task = losses_lib.get(task).name
+        self.problem = GlmOptimizationProblem(task, config)
+        self.reg_weight = reg_weight
+        self.feature_shard = feature_shard
+        self.axis_name = axis_name
+
+        # Dataset is a jit ARGUMENT (not a closure constant): closures bake
+        # device arrays into the HLO, forcing recompiles per dataset and
+        # oversized programs.
+        def _train(data: GlmData, offsets: Array, w0: Array) -> Array:
+            data = dataclasses.replace(data, offsets=offsets)
+            return self.problem.solve(
+                data, self.reg_weight, w0, axis_name=self.axis_name
+            ).w
+
+        def _score(data: GlmData, w: Array) -> Array:
+            # Margin WITHOUT offsets: coordinate scores are additive pieces.
+            return data.features.matvec(w)
+
+        self._train_jit = jax.jit(_train)
+        self._score_jit = jax.jit(_score)
+
+    def train(self, offsets: Array, warm_state: Optional[Array] = None) -> Array:
+        w0 = (
+            jnp.zeros((self.dataset.data.n_features,), jnp.float32)
+            if warm_state is None
+            else warm_state
+        )
+        return self._train_jit(self.dataset.data, offsets, w0)
+
+    def score(self, state: Array) -> Array:
+        return self._score_jit(self.dataset.data, state)
+
+    def finalize(self, state: Array) -> FixedEffectModel:
+        return FixedEffectModel(
+            GeneralizedLinearModel(Coefficients(state), self.task),
+            self.feature_shard,
+        )
+
+
+def _make_block_solver(task: str, config: GlmOptimizationConfig, reg_weight: float):
+    """Build a jitted (block, offsets, w0) → (E, D) batched solver."""
+    loss = losses_lib.get(task)
+    l1 = config.regularization.l1_weight(reg_weight)
+    l2 = config.regularization.l2_weight(reg_weight)
+    opt = config.optimizer
+    use_owlqn = (
+        opt.optimizer is OptimizerType.OWLQN or l1 > 0.0
+    )
+
+    def solve_one(X, y, wts, off, w0):
+        def vg(w):
+            m = X @ w + off
+            val = jnp.sum(wts * loss.value(m, y)) + 0.5 * l2 * jnp.vdot(w, w)
+            g = X.T @ (wts * loss.d1(m, y)) + l2 * w
+            return val, g
+
+        if use_owlqn:
+            return owlqn_solve(
+                vg,
+                w0,
+                l1,
+                OWLQNConfig(
+                    max_iters=opt.max_iters,
+                    tolerance=opt.tolerance,
+                    history=opt.history,
+                ),
+            ).w
+        return lbfgs_solve(
+            vg,
+            w0,
+            LBFGSConfig(
+                max_iters=opt.max_iters,
+                tolerance=opt.tolerance,
+                history=opt.history,
+            ),
+        ).w
+
+    @jax.jit
+    def solve_block(block: EntityBlock, offsets_block: Array, w0: Array) -> Array:
+        return jax.vmap(solve_one)(
+            block.X, block.labels, block.weights, offsets_block, w0
+        )
+
+    return solve_block
+
+
+class RandomEffectCoordinate(Coordinate):
+    """Reference: ``RandomEffectCoordinate`` — per-entity solves, batched.
+
+    State is a list of per-bucket coefficient arrays ``(E, D)`` in each
+    block's LOCAL (projected) column space.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        dataset: RandomEffectDataset,
+        task: str,
+        config: GlmOptimizationConfig,
+        reg_weight: float = 0.0,
+        feature_shard: str = "global",
+        entity_key: str = "",
+    ):
+        self.name = name
+        self.dataset = dataset
+        self.task = losses_lib.get(task).name
+        self.config = config
+        self.reg_weight = reg_weight
+        self.feature_shard = feature_shard
+        self.entity_key = entity_key or name
+        self._solver = _make_block_solver(task, config, reg_weight)
+
+        @jax.jit
+        def score_block(block: EntityBlock, coefs: Array) -> tuple[Array, Array]:
+            scores = jnp.einsum("erd,ed->er", block.X, coefs)
+            # Padding rows (sentinel index) scatter into the trailing slot.
+            return block.row_index.ravel(), scores.ravel()
+
+        self._score_block = score_block
+
+    def _gather_offsets(self, offsets: Array, block: EntityBlock) -> Array:
+        padded = jnp.concatenate([offsets, jnp.zeros((1,), offsets.dtype)])
+        return jnp.take(padded, block.row_index, axis=0)
+
+    def train(self, offsets: Array, warm_state=None) -> list[Array]:
+        state = []
+        for bi, block in enumerate(self.dataset.blocks):
+            off_b = self._gather_offsets(offsets, block)
+            w0 = (
+                warm_state[bi]
+                if warm_state is not None
+                else jnp.zeros((block.n_entities, block.block_dim), jnp.float32)
+            )
+            state.append(self._solver(block, off_b, w0))
+        return state
+
+    def score(self, state: list[Array]) -> Array:
+        n = self.dataset.n_global_rows
+        total = jnp.zeros((n + 1,), jnp.float32)
+        passive = self.dataset.passive_blocks or [None] * len(self.dataset.blocks)
+        for block, passive_block, coefs in zip(
+            self.dataset.blocks, passive, state
+        ):
+            idx, vals = self._score_block(block, coefs)
+            total = total.at[idx].add(vals)
+            if passive_block is not None:
+                # Active/passive split: capped-out rows are never trained on
+                # but MUST be scored, or other coordinates would see offsets
+                # missing this coordinate for those rows.
+                idx_p, vals_p = self._score_block(passive_block, coefs)
+                total = total.at[idx_p].add(vals_p)
+        return total[:n]
+
+    def finalize(self, state: list[Array]) -> RandomEffectModel:
+        table: dict = {}
+        for block, ids, coefs in zip(
+            self.dataset.blocks, self.dataset.entity_ids, state
+        ):
+            cmap = np.asarray(block.col_map)
+            w = np.asarray(coefs)
+            for lane, key in enumerate(ids):
+                keep = cmap[lane] >= 0
+                cols = cmap[lane][keep]
+                vals = w[lane][keep]
+                nz = vals != 0
+                table[key] = (cols[nz].astype(np.int32), vals[nz].astype(np.float32))
+        return RandomEffectModel(
+            coefficients=table,
+            feature_shard=self.feature_shard,
+            entity_key=self.entity_key,
+            task=self.task,
+            n_features=self.dataset.n_features,
+        )

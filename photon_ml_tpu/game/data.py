@@ -1,0 +1,266 @@
+"""GAME data layer: fixed-effect and random-effect datasets.
+
+The analogue of the reference's ``...ml.data`` GAME layer (SURVEY.md §2):
+``GameDatum`` (per-row response/weight/offset + features-by-shard + entity
+ids), ``FixedEffectDataset`` (all rows, one feature shard), and
+``RandomEffectDataset`` — in the reference an RDD keyed by entity id with a
+custom partitioner colocating each entity's rows, so per-entity GLMs solve
+locally inside ``mapPartitions``.
+
+TPU-first reshape: instead of per-entity JVM objects, entities are
+
+1. **grouped** (all rows of an entity gathered together),
+2. **projected** — each entity's rows only reference the feature columns that
+   entity actually observes, so tiny per-entity problems don't carry the
+   global dimensionality (the reference's ``LinearSubspaceProjector``), and
+3. **bucketed by size** — entities with similar row counts / active-feature
+   counts share one dense padded block ``(E, R, D)`` that a ``vmap``'d
+   solver minimizes in one jitted program (SURVEY.md §7 step 6).
+
+Padding discipline matches the rest of the framework: padding rows carry
+weight 0; padding columns map to global column -1 and carry value 0; padding
+*entities* (to fill a bucket) have all-zero weights and solve to w=0 under
+any L2.
+
+Row bookkeeping: each block row remembers its global row index so coordinate
+descent can gather per-row offsets in and scatter per-row scores out
+(the analogue of the reference's score joins on unique id).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.dataset import GlmData
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["X", "labels", "weights", "col_map", "row_index"],
+    meta_fields=["n_entities", "rows_per_entity", "block_dim"],
+)
+@dataclasses.dataclass
+class EntityBlock:
+    """One size-bucket of entities as a dense padded batch.
+
+    ``X[e, r, k]`` is the value of local feature k in row r of entity e;
+    ``col_map[e, k]`` maps local feature k to its global column (or -1).
+    ``row_index[e, r]`` is the row's index in the global dataset (or the
+    sentinel ``n_global_rows`` for padding — callers gather from arrays
+    padded with one trailing zero slot).
+    """
+
+    X: Array  # (E, R, D) float
+    labels: Array  # (E, R)
+    weights: Array  # (E, R) — 0 for padding rows / entities
+    col_map: Array  # (E, D) int32 — global column ids, -1 pad
+    row_index: Array  # (E, R) int32 — global row ids, sentinel pad
+    n_entities: int
+    rows_per_entity: int
+    block_dim: int
+
+
+@dataclasses.dataclass
+class RandomEffectDataset:
+    """All buckets for one random-effect coordinate + host-side id maps.
+
+    ``entity_ids[b][e]`` is the entity key of lane e in bucket b;
+    ``entity_to_slot`` maps entity key → (bucket, lane).
+
+    ``passive_blocks[b]`` (None when no entity in bucket b exceeds the
+    active-set cap) holds the rows beyond ``max_rows_per_entity`` — the
+    reference's active/passive split: passive rows are never TRAINED on, but
+    they must still be SCORED during coordinate descent or the other
+    coordinates would train against offsets missing this coordinate's
+    contribution for those rows.  Lanes align with the active block (same
+    entity order, same col_map), so the trained (E, D) coefficients apply
+    directly; passive-row features outside the entity's active subspace drop,
+    as the reference's projector-based scoring does.
+    """
+
+    blocks: list[EntityBlock]
+    entity_ids: list[list]
+    entity_to_slot: dict
+    n_global_rows: int
+    n_features: int  # global feature-space width of this coordinate's shard
+    passive_blocks: list[Optional[EntityBlock]] = dataclasses.field(
+        default_factory=list
+    )
+
+    @property
+    def n_entities(self) -> int:
+        return len(self.entity_to_slot)
+
+
+@dataclasses.dataclass
+class FixedEffectDataset:
+    """All rows against one feature shard (reference: FixedEffectDataset)."""
+
+    data: GlmData
+    n_global_rows: int
+
+
+@dataclasses.dataclass
+class GameData:
+    """Per-coordinate datasets over one global row space (the analogue of the
+    reference's per-coordinate dataset map inside GameEstimator).
+
+    labels/weights are global row arrays shared by every coordinate;
+    ``base_offsets`` are the user-supplied per-row offsets (GameDatum.offset).
+    """
+
+    coordinates: dict  # name -> FixedEffectDataset | RandomEffectDataset
+    labels: np.ndarray
+    weights: np.ndarray
+    base_offsets: np.ndarray
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.labels)
+
+
+def _round_up_pow2(n: int, floor: int = 1) -> int:
+    n = max(n, floor)
+    return 1 << (n - 1).bit_length()
+
+
+def build_random_effect_dataset(
+    entity_keys: Sequence,
+    rows_csr,  # scipy CSR (n_rows, d) — this coordinate's feature shard
+    labels: np.ndarray,
+    weights: np.ndarray,
+    max_rows_per_entity: Optional[int] = None,
+    dtype=jnp.float32,
+) -> RandomEffectDataset:
+    """Group rows by entity, project to per-entity subspaces, bucket by size.
+
+    ``max_rows_per_entity`` is the reference's active-set cap: entities with
+    more rows train on a uniformly-spaced subset; the remaining (passive)
+    rows land in score-only ``passive_blocks``.
+    """
+    import scipy.sparse as sp
+
+    rows_csr = sp.csr_matrix(rows_csr)
+    rows_csr.sum_duplicates()
+    n_rows, d = rows_csr.shape
+    entity_keys = np.asarray(entity_keys)
+    assert entity_keys.shape[0] == n_rows
+
+    # Group row indices by entity.
+    order = np.argsort(entity_keys, kind="stable")
+    sorted_keys = entity_keys[order]
+    boundaries = np.flatnonzero(
+        np.concatenate([[True], sorted_keys[1:] != sorted_keys[:-1]])
+    )
+    groups: list[tuple] = []  # (key, active_rows, passive_rows, active_cols)
+    for gi, start in enumerate(boundaries):
+        end = boundaries[gi + 1] if gi + 1 < len(boundaries) else len(order)
+        ridx = order[start:end]
+        passive = np.empty(0, ridx.dtype)
+        if max_rows_per_entity is not None and len(ridx) > max_rows_per_entity:
+            keep = np.linspace(0, len(ridx) - 1, max_rows_per_entity).astype(int)
+            mask = np.zeros(len(ridx), bool)
+            mask[keep] = True
+            passive = ridx[~mask]
+            ridx = ridx[mask]
+        sub = rows_csr[ridx]
+        active = np.unique(sub.indices)
+        groups.append((sorted_keys[start], ridx, passive, active))
+
+    # Bucket by (padded row count, padded active-feature count).
+    buckets: dict[tuple[int, int], list[int]] = {}
+    for i, (_, ridx, _passive, active) in enumerate(groups):
+        key = (_round_up_pow2(len(ridx)), _round_up_pow2(len(active)))
+        buckets.setdefault(key, []).append(i)
+
+    blocks: list[EntityBlock] = []
+    passive_blocks: list[Optional[EntityBlock]] = []
+    ids_per_block: list[list] = []
+    entity_to_slot: dict = {}
+    for (R, D), members in sorted(buckets.items()):
+        E = len(members)
+        X = np.zeros((E, R, D), np.float32)
+        lab = np.zeros((E, R), np.float32)
+        wts = np.zeros((E, R), np.float32)
+        cmap = np.full((E, D), -1, np.int32)
+        rindex = np.full((E, R), n_rows, np.int32)  # sentinel
+        ids: list = []
+        for lane, gi in enumerate(members):
+            key, ridx, _passive, active = groups[gi]
+            ids.append(key)
+            entity_to_slot[key] = (len(blocks), lane)
+            cmap[lane, : len(active)] = active
+            # Project this entity's rows into its active subspace.
+            sub = rows_csr[ridx][:, active].toarray()
+            X[lane, : len(ridx), : len(active)] = sub
+            lab[lane, : len(ridx)] = labels[ridx]
+            wts[lane, : len(ridx)] = weights[ridx]
+            rindex[lane, : len(ridx)] = ridx
+        blocks.append(
+            EntityBlock(
+                X=jnp.asarray(X, dtype),
+                labels=jnp.asarray(lab),
+                weights=jnp.asarray(wts),
+                col_map=jnp.asarray(cmap),
+                row_index=jnp.asarray(rindex),
+                n_entities=E,
+                rows_per_entity=R,
+                block_dim=D,
+            )
+        )
+        ids_per_block.append(ids)
+
+        # Score-only passive companion block, lane-aligned with the active
+        # block (same entity order and col_map).
+        max_passive = max(
+            (len(groups[gi][2]) for gi in members), default=0
+        )
+        if max_passive == 0:
+            passive_blocks.append(None)
+            continue
+        Rp = _round_up_pow2(max_passive)
+        Xp = np.zeros((E, Rp, D), np.float32)
+        labp = np.zeros((E, Rp), np.float32)
+        wtsp = np.zeros((E, Rp), np.float32)
+        rindexp = np.full((E, Rp), n_rows, np.int32)
+        for lane, gi in enumerate(members):
+            _key, _ridx, passive, active = groups[gi]
+            if len(passive) == 0:
+                continue
+            # Features outside the entity's ACTIVE subspace drop here, as in
+            # the reference's projected scoring.
+            Xp[lane, : len(passive), : len(active)] = (
+                rows_csr[passive][:, active].toarray()
+            )
+            labp[lane, : len(passive)] = labels[passive]
+            wtsp[lane, : len(passive)] = weights[passive]
+            rindexp[lane, : len(passive)] = passive
+        passive_blocks.append(
+            EntityBlock(
+                X=jnp.asarray(Xp, dtype),
+                labels=jnp.asarray(labp),
+                weights=jnp.asarray(wtsp),
+                col_map=blocks[-1].col_map,
+                row_index=jnp.asarray(rindexp),
+                n_entities=E,
+                rows_per_entity=Rp,
+                block_dim=D,
+            )
+        )
+
+    return RandomEffectDataset(
+        blocks=blocks,
+        entity_ids=ids_per_block,
+        entity_to_slot=entity_to_slot,
+        n_global_rows=n_rows,
+        n_features=d,
+        passive_blocks=passive_blocks,
+    )

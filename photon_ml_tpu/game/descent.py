@@ -1,0 +1,85 @@
+"""Block coordinate descent over GAME coordinates.
+
+The analogue of the reference's ``CoordinateDescent`` ([CONFIRMED-BASELINE],
+SURVEY.md §2, §3.2): iterate the (ordered) coordinate list; train each
+coordinate against the *residual* scores of all the others (per-row offsets =
+base offsets + sum of other coordinates' scores); refresh that coordinate's
+scores; optionally evaluate validation metrics per iteration.
+
+Device-side bookkeeping mirrors the reference's score RDD joins as pure
+array updates: ``total`` holds base + Σ coordinate scores, and training
+coordinate c uses ``total - scores[c]`` as its offsets — one subtract
+instead of an (n-1)-way join.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.coordinates import Coordinate
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class CoordinateDescentResult:
+    states: dict  # coordinate name -> device state
+    scores: dict  # coordinate name -> (N,) device scores
+    history: list  # per (iteration, coordinate) log entries
+
+
+class CoordinateDescent:
+    """Reference: ``CoordinateDescent.optimize(coordinates, iterations)``."""
+
+    def __init__(self, coordinates: Sequence[Coordinate]):
+        names = [c.name for c in coordinates]
+        assert len(set(names)) == len(names), f"duplicate coordinate names: {names}"
+        self.coordinates = list(coordinates)
+
+    def run(
+        self,
+        base_offsets: Array,
+        n_iterations: int = 1,
+        eval_fn: Optional[Callable[[int, str, dict], dict]] = None,
+        logger=None,
+    ) -> CoordinateDescentResult:
+        """``eval_fn(iteration, coordinate_name, scores_by_coordinate)`` is
+        called after each coordinate update (the reference evaluates its
+        validation suite there); its dict return is recorded in history."""
+        base_offsets = jnp.asarray(base_offsets, jnp.float32)
+        scores: dict[str, Array] = {
+            c.name: jnp.zeros_like(base_offsets) for c in self.coordinates
+        }
+        states: dict[str, object] = {c.name: None for c in self.coordinates}
+        total = base_offsets
+        history: list[dict] = []
+
+        for it in range(n_iterations):
+            for coord in self.coordinates:
+                offsets = total - scores[coord.name]
+                state = coord.train(offsets, warm_state=states[coord.name])
+                new_score = coord.score(state)
+                states[coord.name] = state
+                total = offsets + new_score
+                scores[coord.name] = new_score
+
+                entry = {
+                    "iteration": it,
+                    "coordinate": coord.name,
+                    "score_norm": float(jnp.linalg.norm(new_score)),
+                }
+                if eval_fn is not None:
+                    entry.update(eval_fn(it, coord.name, scores))
+                history.append(entry)
+                if logger is not None:
+                    logger.info(
+                        "CD iter %d coordinate %s: %s", it, coord.name,
+                        {k: v for k, v in entry.items()
+                         if k not in ("iteration", "coordinate")},
+                    )
+        return CoordinateDescentResult(states=states, scores=scores, history=history)

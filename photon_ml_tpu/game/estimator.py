@@ -1,0 +1,230 @@
+"""GAME estimator / transformer: the programmatic API.
+
+The analogue of the reference's spark.ml-style ``GameEstimator`` /
+``GameTransformer`` (SURVEY.md §2, §3.4): ``fit`` builds per-coordinate
+datasets from feature shards + entity-id columns, runs coordinate descent,
+and returns a ``GameModel``; ``transform`` scores data with a trained model
+(unseen entities contribute 0, as in the reference).
+
+Reference call shape (SURVEY.md §3.2):
+    GameEstimator.fit(trainData, validationData, coordinateConfigs)
+Here the "DataFrame" is (shards, ids, response, weight, offset) host arrays:
+``shards`` maps feature-shard name → scipy CSR (the reference's per-shard
+feature bags), ``ids`` maps id-column name → per-row entity keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.dataset import make_glm_data
+from photon_ml_tpu.evaluation.evaluators import (
+    Evaluator,
+    default_evaluator_for_task,
+)
+from photon_ml_tpu.game.coordinates import (
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.data import (
+    FixedEffectDataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.game.descent import CoordinateDescent
+from photon_ml_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.ops import losses as losses_lib
+from photon_ml_tpu.optim.problem import GlmOptimizationConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectCoordinateConfig:
+    """Reference: ``FixedEffectCoordinateConfiguration``."""
+
+    feature_shard: str
+    optimization: GlmOptimizationConfig = GlmOptimizationConfig()
+    reg_weight: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectCoordinateConfig:
+    """Reference: ``RandomEffectCoordinateConfiguration`` (entity id column +
+    feature shard + optimization; ``max_rows_per_entity`` is the active-set
+    cap of the reference's active/passive split)."""
+
+    feature_shard: str
+    entity_key: str
+    optimization: GlmOptimizationConfig = GlmOptimizationConfig()
+    reg_weight: float = 0.0
+    max_rows_per_entity: Optional[int] = None
+
+
+CoordinateConfig = FixedEffectCoordinateConfig | RandomEffectCoordinateConfig
+
+
+class GameEstimator:
+    """Reference: ``GameEstimator`` (SURVEY.md §3.4).
+
+    ``coordinate_configs`` is an ORDERED name→config mapping; coordinate
+    update order is the reference's ``coordinateUpdateSequence``.
+    """
+
+    def __init__(
+        self,
+        task: str,
+        coordinate_configs: dict[str, CoordinateConfig],
+        n_iterations: int = 1,
+        logger=None,
+    ):
+        self.task = task
+        self.coordinate_configs = dict(coordinate_configs)
+        self.n_iterations = n_iterations
+        self.logger = logger
+
+    def _build_coordinates(self, shards, ids, response, weight, offset):
+        n = len(response)
+        weight = np.ones(n, np.float32) if weight is None else np.asarray(weight, np.float32)
+        coordinates = []
+        for name, cfg in self.coordinate_configs.items():
+            shard = shards[cfg.feature_shard]
+            if isinstance(cfg, FixedEffectCoordinateConfig):
+                data = make_glm_data(shard, response, weights=weight)
+                coordinates.append(
+                    FixedEffectCoordinate(
+                        name,
+                        FixedEffectDataset(data=data, n_global_rows=n),
+                        self.task,
+                        cfg.optimization,
+                        cfg.reg_weight,
+                        feature_shard=cfg.feature_shard,
+                    )
+                )
+            else:
+                dataset = build_random_effect_dataset(
+                    ids[cfg.entity_key],
+                    shard,
+                    np.asarray(response, np.float32),
+                    weight,
+                    max_rows_per_entity=cfg.max_rows_per_entity,
+                )
+                coordinates.append(
+                    RandomEffectCoordinate(
+                        name,
+                        dataset,
+                        self.task,
+                        cfg.optimization,
+                        cfg.reg_weight,
+                        feature_shard=cfg.feature_shard,
+                        entity_key=cfg.entity_key,
+                    )
+                )
+        return coordinates
+
+    def fit(
+        self,
+        shards: dict,
+        ids: dict,
+        response: np.ndarray,
+        weight: Optional[np.ndarray] = None,
+        offset: Optional[np.ndarray] = None,
+        evaluator: Optional[Evaluator] = None,
+    ) -> tuple[GameModel, list]:
+        """Train; returns (model, per-coordinate-update history).
+
+        History entries include the training-set metric after each
+        coordinate update (the reference logs its validation suite there;
+        validation metrics here come from scoring with GameTransformer)."""
+        n = len(response)
+        response = np.asarray(response, np.float32)
+        base_offsets = (
+            np.zeros(n, np.float32) if offset is None else np.asarray(offset, np.float32)
+        )
+        evaluator = evaluator or default_evaluator_for_task(
+            losses_lib.get(self.task).name
+        )
+        coordinates = self._build_coordinates(shards, ids, response, weight, offset)
+
+        w_host = None if weight is None else np.asarray(weight, np.float32)
+
+        def eval_fn(it, cname, scores):
+            total = base_offsets + np.sum(
+                [np.asarray(s) for s in scores.values()], axis=0
+            )
+            return {
+                "train_metric": evaluator.evaluate(total, response, w_host),
+                "evaluator": type(evaluator).__name__,
+            }
+
+        cd = CoordinateDescent(coordinates)
+        result = cd.run(
+            jnp.asarray(base_offsets),
+            n_iterations=self.n_iterations,
+            eval_fn=eval_fn,
+            logger=self.logger,
+        )
+        models = {
+            c.name: c.finalize(result.states[c.name]) for c in coordinates
+        }
+        return GameModel(models=models, task=self.task), result.history
+
+
+class GameTransformer:
+    """Reference: ``GameTransformer`` — batch scoring with a GameModel
+    (SURVEY.md §3.3): fixed effect = one matvec; each random effect = block
+    gather of per-entity coefficients; total = sum + offset."""
+
+    def __init__(self, model: GameModel, logger=None):
+        self.model = model
+        self.logger = logger
+
+    def transform(
+        self,
+        shards: dict,
+        ids: dict,
+        offset: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        some_shard = next(iter(shards.values()))
+        n = some_shard.shape[0]
+        total = (
+            np.zeros(n, np.float32) if offset is None else np.asarray(offset, np.float32).copy()
+        )
+        for name, sub in self.model.models.items():
+            if isinstance(sub, FixedEffectModel):
+                data = make_glm_data(shards[sub.feature_shard], np.zeros(n))
+                total += np.asarray(sub.model.compute_score(data))
+            else:
+                total += self._score_random_effect(sub, shards[sub.feature_shard], ids)
+        return total
+
+    @staticmethod
+    def _score_random_effect(
+        model: RandomEffectModel, shard, ids: dict
+    ) -> np.ndarray:
+        """Score through the same block pipeline as training; entities
+        without trained coefficients (or padding) contribute zero."""
+        entity_col = np.asarray(ids[model.entity_key])
+        n = shard.shape[0]
+        dataset = build_random_effect_dataset(
+            entity_col, shard, np.zeros(n, np.float32), np.ones(n, np.float32)
+        )
+        out = np.zeros(n + 1, np.float32)
+        for block, block_ids in zip(dataset.blocks, dataset.entity_ids):
+            coefs = model.coefficient_matrix_for(
+                np.asarray(block.col_map), block_ids
+            )
+            scores = np.einsum(
+                "erd,ed->er", np.asarray(block.X), coefs, dtype=np.float32
+            )
+            np.add.at(out, np.asarray(block.row_index).ravel(), scores.ravel())
+        return out[:n]
+
+    def transform_with_mean(self, shards, ids, offset=None) -> np.ndarray:
+        """Scores passed through the task's inverse link (probabilities for
+        logistic, rates for Poisson)."""
+        from photon_ml_tpu.ops import losses as losses_lib
+
+        margins = self.transform(shards, ids, offset)
+        return np.asarray(losses_lib.get(self.model.task).mean_fn(jnp.asarray(margins)))

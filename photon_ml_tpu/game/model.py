@@ -1,0 +1,87 @@
+"""GAME model containers.
+
+The analogue of the reference's ``...ml.model`` GAME classes (SURVEY.md §2):
+``GameModel`` (container of per-coordinate models; scoring = sum of
+coordinate scores), ``FixedEffectModel`` (one coefficient vector, broadcast
+in the reference — replicated here), and ``RandomEffectModel`` (per-entity
+coefficients, an RDD in the reference — a host-side entity→sparse-coefficient
+table here, materialized into dense device blocks when scoring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+
+
+@dataclasses.dataclass
+class FixedEffectModel:
+    """Reference: ``FixedEffectModel(model, featureShardId)``."""
+
+    model: GeneralizedLinearModel
+    feature_shard: str
+
+
+@dataclasses.dataclass
+class RandomEffectModel:
+    """Per-entity GLMs over one feature shard.
+
+    ``coefficients`` maps entity key → (global_cols int32[], values float32[])
+    with columns sorted ascending — the sparse original-space coefficient
+    vector of that entity (the
+    reference stores per-entity ``Coefficients`` in projected space and
+    carries the projector; storing sparse global-space pairs is equivalent
+    and projector-free).  Entities never seen at training time score 0, as
+    in the reference.
+    """
+
+    coefficients: dict
+    feature_shard: str
+    entity_key: str
+    task: str
+    n_features: int
+
+    @property
+    def n_entities(self) -> int:
+        return len(self.coefficients)
+
+    def coefficient_matrix_for(
+        self, col_map: np.ndarray, entity_ids: list
+    ) -> np.ndarray:
+        """Project stored coefficients into a block's local column layout:
+        returns (E, D) with w_local[e, k] = w_e[col_map[e, k]].  Used when
+        scoring new data through the block pipeline.  Vectorized per lane via
+        searchsorted over the entity's (sorted) coefficient columns."""
+        E, D = col_map.shape
+        out = np.zeros((E, D), np.float32)
+        for lane, key in enumerate(entity_ids):
+            entry = self.coefficients.get(key)
+            if entry is None or len(entry[0]) == 0:
+                continue
+            cols, vals = entry  # cols sorted ascending (store invariant)
+            cm = col_map[lane]
+            pos = np.searchsorted(cols, cm)
+            pos_c = np.minimum(pos, len(cols) - 1)
+            hit = (cm >= 0) & (pos < len(cols)) & (cols[pos_c] == cm)
+            out[lane, hit] = vals[pos_c[hit]]
+        return out
+
+
+@dataclasses.dataclass
+class GameModel:
+    """Reference: ``GameModel`` — ordered per-coordinate models; the overall
+    score of a row is the sum of its coordinate scores (plus offset)."""
+
+    models: dict  # coordinate name -> FixedEffectModel | RandomEffectModel
+    task: str
+
+    def __getitem__(self, name: str):
+        return self.models[name]
+
+    @property
+    def coordinate_names(self) -> list[str]:
+        return list(self.models)

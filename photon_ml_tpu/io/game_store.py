@@ -1,0 +1,143 @@
+"""GAME model persistence.
+
+The analogue of the reference's ``ModelProcessingUtils`` GAME save/load
+(SURVEY.md §3.2 "save GameModel ... Avro: fixed-effect + per-entity
+coefficient files"): a directory with one Avro file per coordinate —
+``fixed-effect/<name>/coefficients.avro`` holding one
+BayesianLinearModelAvro record, ``random-effect/<name>/coefficients.avro``
+holding one record per entity — plus per-shard index maps and a metadata
+manifest for coordinate order/types.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.io import avro
+from photon_ml_tpu.io.model_store import load_glm_model, save_glm_model
+
+RANDOM_EFFECT_MODEL_SCHEMA = {
+    "type": "record",
+    "name": "RandomEffectCoefficientsAvro",
+    "fields": [
+        {"name": "entityId", "type": "string"},
+        {
+            "name": "coefficients",
+            "type": {
+                "type": "array",
+                "items": {
+                    "type": "record",
+                    "name": "EntityCoefficientAvro",
+                    "fields": [
+                        {"name": "name", "type": "string"},
+                        {"name": "term", "type": "string"},
+                        {"name": "value", "type": "double"},
+                    ],
+                },
+            },
+        },
+    ],
+}
+
+
+def save_game_model(
+    model: GameModel, index_maps: dict, directory: str
+) -> None:
+    """``index_maps`` maps feature-shard name → IndexMap."""
+    os.makedirs(directory, exist_ok=True)
+    manifest = {"task": model.task, "coordinates": []}
+    for name, sub in model.models.items():
+        if isinstance(sub, FixedEffectModel):
+            sub_dir = os.path.join(directory, "fixed-effect", name)
+            os.makedirs(sub_dir, exist_ok=True)
+            save_glm_model(
+                sub.model,
+                index_maps[sub.feature_shard],
+                os.path.join(sub_dir, "coefficients.avro"),
+                model_id=name,
+            )
+            manifest["coordinates"].append(
+                {"name": name, "type": "fixed", "feature_shard": sub.feature_shard}
+            )
+        else:
+            sub_dir = os.path.join(directory, "random-effect", name)
+            os.makedirs(sub_dir, exist_ok=True)
+            imap = index_maps[sub.feature_shard]
+            records = []
+            for entity, (cols, vals) in sub.coefficients.items():
+                coefs = []
+                for c, v in zip(cols, vals):
+                    fname, _, term = imap.index_to_name(int(c)).partition("\x01")
+                    coefs.append({"name": fname, "term": term, "value": float(v)})
+                records.append({"entityId": str(entity), "coefficients": coefs})
+            avro.write_container(
+                os.path.join(sub_dir, "coefficients.avro"),
+                RANDOM_EFFECT_MODEL_SCHEMA,
+                records,
+            )
+            manifest["coordinates"].append({
+                "name": name,
+                "type": "random",
+                "feature_shard": sub.feature_shard,
+                "entity_key": sub.entity_key,
+                "n_features": sub.n_features,
+            })
+    for shard, imap in index_maps.items():
+        imap.save(os.path.join(directory, "index-maps", shard))
+    with open(os.path.join(directory, "metadata.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+
+
+def load_game_model(directory: str) -> tuple[GameModel, dict]:
+    """Returns (model, index_maps-by-shard)."""
+    with open(os.path.join(directory, "metadata.json")) as f:
+        manifest = json.load(f)
+    index_maps: dict = {}
+    imap_root = os.path.join(directory, "index-maps")
+    if os.path.isdir(imap_root):
+        for shard in os.listdir(imap_root):
+            index_maps[shard] = IndexMap.load(os.path.join(imap_root, shard))
+
+    models: dict = {}
+    for coord in manifest["coordinates"]:
+        name = coord["name"]
+        if coord["type"] == "fixed":
+            path = os.path.join(
+                directory, "fixed-effect", name, "coefficients.avro"
+            )
+            glm, imap = load_glm_model(path, index_maps.get(coord["feature_shard"]))
+            index_maps.setdefault(coord["feature_shard"], imap)
+            models[name] = FixedEffectModel(glm, coord["feature_shard"])
+        else:
+            path = os.path.join(
+                directory, "random-effect", name, "coefficients.avro"
+            )
+            _, records = avro.read_container(path)
+            imap = index_maps[coord["feature_shard"]]
+            table = {}
+            for rec in records:
+                cols, vals = [], []
+                for e in rec["coefficients"]:
+                    idx = imap.get_index(feature_key(e["name"], e["term"]))
+                    if idx >= 0:
+                        cols.append(idx)
+                        vals.append(e["value"])
+                cols = np.asarray(cols, np.int32)
+                vals = np.asarray(vals, np.float32)
+                # Store invariant: columns ascending (coefficient_matrix_for
+                # binary-searches them).
+                order = np.argsort(cols, kind="stable")
+                table[rec["entityId"]] = (cols[order], vals[order])
+            models[name] = RandomEffectModel(
+                coefficients=table,
+                feature_shard=coord["feature_shard"],
+                entity_key=coord["entity_key"],
+                task=manifest["task"],
+                n_features=coord.get("n_features", len(imap)),
+            )
+    return GameModel(models=models, task=manifest["task"]), index_maps

@@ -102,11 +102,16 @@ class GlmOptimizationProblem:
             w0 = jnp.zeros((d,), jnp.float32)
         reg_weight = jnp.asarray(reg_weight, w0.dtype)
         # Static split coefficients (floats), dynamic weight (traced scalar).
-        l1 = cfg.regularization.l1_weight(1.0) * reg_weight
+        l1_frac = cfg.regularization.l1_weight(1.0)
+        l1 = l1_frac * reg_weight
         l2 = cfg.regularization.l2_weight(1.0) * reg_weight
         opt = cfg.optimizer
 
-        if opt.optimizer is OptimizerType.OWLQN:
+        # L1 is only representable by OWL-QN's orthant machinery; any config
+        # carrying an L1 component routes there regardless of the configured
+        # smooth optimizer (as the reference does — L-BFGS/TRON have no
+        # subgradient handling).  The check is static: l1_frac is a float.
+        if opt.optimizer is OptimizerType.OWLQN or l1_frac > 0.0:
             return owlqn_solve(
                 lambda w: obj.value_and_grad(
                     w, data, l2_weight=l2, axis_name=axis_name

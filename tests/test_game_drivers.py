@@ -1,0 +1,169 @@
+"""End-to-end GAME driver tests: train → save → load → score round trip."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.game_reader import (
+    read_game_avro,
+    write_game_avro,
+)
+from photon_ml_tpu.drivers import (
+    feature_indexing_driver,
+    game_scoring_driver,
+    game_training_driver,
+)
+from photon_ml_tpu.io.game_store import load_game_model, save_game_model
+
+
+def _make_game_rows(rng, user_effect, n_rows, uid_start=0):
+    """Synthetic MovieLens-shaped data: global features + per-user effects."""
+    rows = []
+    n_users = len(user_effect)
+    for i in range(uid_start, uid_start + n_rows):
+        u = f"u{rng.integers(n_users)}"
+        xg = rng.normal(size=3)
+        margin = 1.5 * xg[0] - 1.0 * xg[1] + user_effect[u]
+        y = float(rng.uniform() < 1 / (1 + np.exp(-margin)))
+        rows.append({
+            "uid": f"row{i}",
+            "response": y,
+            "weight": None,
+            "offset": None,
+            "ids": {"userId": u},
+            "features": {
+                "global": [
+                    {"name": f"g{j}", "term": "", "value": float(xg[j])}
+                    for j in range(3)
+                ],
+                "userFeatures": [
+                    {"name": "bias", "term": "", "value": 1.0}
+                ],
+            },
+        })
+    return rows
+
+
+@pytest.fixture(scope="module")
+def game_files(tmp_path_factory):
+    root = tmp_path_factory.mktemp("game")
+    rng = np.random.default_rng(11)
+    train = str(root / "train.avro")
+    val = str(root / "val.avro")
+    # Train and validation share ONE population of per-user effects, so the
+    # learned random effects transfer (users recur across both files).
+    user_effect = {f"u{u}": rng.normal(scale=2.0) for u in range(20)}
+    write_game_avro(train, _make_game_rows(rng, user_effect, 600))
+    write_game_avro(val, _make_game_rows(rng, user_effect, 200, uid_start=600))
+    config = {
+        "task": "logistic",
+        "iterations": 2,
+        "evaluator": "auc",
+        "coordinates": [
+            {"name": "fixed", "type": "fixed", "feature_shard": "global",
+             "optimizer": "lbfgs", "max_iters": 50, "reg_type": "l2",
+             "reg_weight": 0.5},
+            {"name": "per_user", "type": "random",
+             "feature_shard": "userFeatures", "entity_key": "userId",
+             "optimizer": "lbfgs", "max_iters": 30, "reg_type": "l2",
+             "reg_weight": 0.5},
+        ],
+    }
+    config_path = str(root / "config.json")
+    with open(config_path, "w") as f:
+        json.dump(config, f)
+    return train, val, config_path
+
+
+class TestGameReader:
+    def test_roundtrip_shapes(self, game_files):
+        train, _, _ = game_files
+        shards, ids, response, weight, offset, uids, imaps = read_game_avro(train)
+        assert shards["global"].shape == (600, 3)
+        assert shards["userFeatures"].shape == (600, 1)
+        assert set(ids) == {"userId"}
+        assert len(imaps["global"]) == 3
+        assert uids[0] == "row0"
+
+    def test_scoring_path_drops_unseen_features(self, game_files, tmp_path):
+        train, _, _ = game_files
+        _, _, _, _, _, _, imaps = read_game_avro(train)
+        extra = str(tmp_path / "extra.avro")
+        rows = [{
+            "uid": None, "response": 1.0, "weight": None, "offset": None,
+            "ids": {"userId": "u0"},
+            "features": {"global": [
+                {"name": "g0", "term": "", "value": 2.0},
+                {"name": "BRAND_NEW", "term": "", "value": 9.9},
+            ]},
+        }]
+        write_game_avro(extra, rows)
+        shards, _, _, _, _, _, _ = read_game_avro(extra, index_maps=imaps)
+        assert shards["global"].shape == (1, 3)
+        assert shards["global"].nnz == 1  # the unseen feature was dropped
+
+
+class TestGameDrivers:
+    def test_train_then_score_roundtrip(self, game_files, tmp_path):
+        train, val, config = game_files
+        out = str(tmp_path / "train_out")
+        result = game_training_driver.run([
+            "--train-data", train,
+            "--validate-data", val,
+            "--config", config,
+            "--output-dir", out,
+        ])
+        assert result["train_metric"] > 0.70
+        assert result["validation_metric"] > 0.65
+        # Random effect must help: metric after final update beats the first.
+        assert os.path.isdir(os.path.join(out, "models", "random-effect"))
+
+        # Score the validation file with the saved model.
+        score_out = str(tmp_path / "score_out")
+        sresult = game_scoring_driver.run([
+            "--data", val,
+            "--model-dir", out,
+            "--output-dir", score_out,
+            "--evaluator", "auc",
+        ])
+        assert sresult["n_rows"] == 200
+        # Scoring-path AUC equals the training driver's validation AUC.
+        assert sresult["metric"] == pytest.approx(
+            result["validation_metric"], abs=1e-6
+        )
+        from photon_ml_tpu.io import avro
+        _, scores = avro.read_container(
+            os.path.join(score_out, "scores.avro")
+        )
+        assert len(scores) == 200
+        assert scores[0]["ids"]["userId"].startswith("u")
+
+    def test_model_store_roundtrip_preserves_scores(self, game_files, tmp_path):
+        train, val, config = game_files
+        out = str(tmp_path / "rt_out")
+        game_training_driver.run([
+            "--train-data", train, "--config", config, "--output-dir", out,
+        ])
+        model, imaps = load_game_model(os.path.join(out, "models"))
+        resaved = str(tmp_path / "resaved")
+        save_game_model(model, imaps, resaved)
+        model2, _ = load_game_model(resaved)
+        from photon_ml_tpu.game.estimator import GameTransformer
+        shards, ids, *_ = read_game_avro(val, index_maps=imaps)
+        s1 = GameTransformer(model).transform(shards, ids)
+        s2 = GameTransformer(model2).transform(shards, ids)
+        np.testing.assert_allclose(s1, s2, rtol=1e-6)
+
+    def test_feature_indexing_driver(self, game_files, tmp_path):
+        train, _, _ = game_files
+        out = str(tmp_path / "maps")
+        result = feature_indexing_driver.run([
+            "--data", train, "--output-dir", out, "--binary",
+        ])
+        assert result["shards"]["global"] == 3
+        from photon_ml_tpu.data.index_map import BinaryIndexMap, IndexMap
+        imap = IndexMap.load(os.path.join(out, "global"))
+        bmap = BinaryIndexMap(os.path.join(out, "global"))
+        assert bmap.get_index("g1") == imap["g1"]

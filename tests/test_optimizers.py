@@ -174,6 +174,33 @@ class TestOWLQN:
         np.testing.assert_allclose(w[0], expected, atol=1e-2)
 
 
+class TestProblemDispatch:
+    def test_l1_routes_to_owlqn_regardless_of_optimizer(self, rng):
+        # Regression: '--reg-type l1 --optimizer lbfgs' must NOT silently
+        # train unregularized; any L1 component routes to OWL-QN.
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            GlmOptimizationProblem,
+            OptimizerConfig,
+            OptimizerType,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        X, y, data, obj = _logistic_problem(rng, n=200, d=15)
+        for opt_type in (OptimizerType.LBFGS, OptimizerType.TRON):
+            problem = GlmOptimizationProblem(
+                "logistic",
+                GlmOptimizationConfig(
+                    optimizer=OptimizerConfig(optimizer=opt_type, max_iters=200),
+                    regularization=RegularizationContext.l1(),
+                ),
+            )
+            res = problem.solve(data, reg_weight=15.0,
+                                w0=jnp.zeros(15, jnp.float64))
+            w = np.asarray(res.w)
+            assert np.sum(w == 0.0) > 0, f"{opt_type}: L1 was dropped"
+
+
 class TestTRON:
     def test_quadratic_one_newton_step(self):
         d = 10
