@@ -76,6 +76,7 @@ def parse_coordinate_config(spec: dict):
             feature_shard=spec["feature_shard"],
             optimization=opt,
             reg_weight=float(spec.get("reg_weight", 0.0)),
+            down_sampling_rate=float(spec.get("down_sampling_rate", 1.0)),
         )
     if spec["type"] == "random":
         return name, RandomEffectCoordinateConfig(
@@ -127,27 +128,83 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         list(ids),
     )
 
+    n_cd_iterations = int(config.get("iterations", 1))
+    validation = None
+    if args.validate_data:
+        validation = read_game_avro(args.validate_data, index_maps=index_maps)
+
+    result = {"task": task, "n_rows": int(len(response))}
+
+    # Optional hyperparameter tuning over per-coordinate regularization
+    # weights (the reference's BAYESIAN|RANDOM tuning mode inside
+    # GameTrainingDriver — SURVEY.md §3.5).
+    tuning = config.get("tuning")
+    if tuning:
+        if validation is None:
+            raise ValueError("hyperparameter tuning requires --validate-data")
+        import dataclasses as _dc
+
+        from photon_ml_tpu.hyperparameter.search import (
+            GaussianProcessSearch,
+            RandomSearch,
+        )
+
+        names = list(coordinate_configs)
+        lo, hi = tuning.get("range", [1e-3, 1e3])
+        v_shards, v_ids, v_resp, v_weight, v_offset, _, _ = validation
+
+        # Datasets and jitted solvers are built ONCE; each tuning point only
+        # mutates reg_weight (a traced argument) — no recompiles, no
+        # re-grouping/upload of random-effect shards.
+        tuning_est = GameEstimator(task, coordinate_configs, n_cd_iterations)
+        tuning_coords = tuning_est.build_coordinates(
+            shards, ids, response, weight, offset
+        )
+
+        def evaluate(x):
+            for coord, xi in zip(tuning_coords, x):
+                coord.reg_weight = float(xi)
+            mdl, _ = tuning_est.fit_coordinates(
+                tuning_coords, response, weight, offset, evaluator
+            )
+            scores = GameTransformer(mdl).transform(v_shards, v_ids, v_offset)
+            metric = evaluator.evaluate(scores, v_resp, v_weight)
+            logger.info("tuning: reg=%s -> %.6f", list(map(float, x)), metric)
+            return metric
+
+        search_cls = (
+            GaussianProcessSearch
+            if tuning.get("mode", "bayesian") == "bayesian"
+            else RandomSearch
+        )
+        search = search_cls([(lo, hi)] * len(names), log_scale=True, seed=0)
+        found = search.find(
+            evaluate,
+            int(tuning.get("iterations", 10)),
+            maximize=evaluator.larger_is_better,
+        )
+        coordinate_configs = {
+            nm: _dc.replace(coordinate_configs[nm], reg_weight=float(xi))
+            for nm, xi in zip(names, found.best_params)
+        }
+        result["tuning"] = {
+            "best_reg_weights": dict(zip(names, map(float, found.best_params))),
+            "best_metric": found.best_value,
+            "n_evaluations": len(found.history),
+        }
+        logger.info("tuning selected %s", result["tuning"]["best_reg_weights"])
+
     estimator = GameEstimator(
-        task,
-        coordinate_configs,
-        n_iterations=int(config.get("iterations", 1)),
-        logger=logger,
+        task, coordinate_configs, n_iterations=n_cd_iterations, logger=logger
     )
     model, history = estimator.fit(
         shards, ids, response, weight=weight, offset=offset, evaluator=evaluator
     )
+    result["history"] = history
+    result["train_metric"] = history[-1].get("train_metric") if history else None
 
-    result = {
-        "task": task,
-        "n_rows": int(len(response)),
-        "history": history,
-        "train_metric": history[-1].get("train_metric") if history else None,
-    }
-
-    if args.validate_data:
-        v_shards, v_ids, v_resp, v_weight, v_offset, _, _ = read_game_avro(
-            args.validate_data, index_maps=index_maps
-        )
+    if validation is not None:
+        v_shards, v_ids, v_resp, v_weight, v_offset, _, _ = validation
         v_scores = GameTransformer(model).transform(v_shards, v_ids, v_offset)
         result["validation_metric"] = evaluator.evaluate(
             v_scores, v_resp, v_weight

@@ -84,13 +84,14 @@ class FixedEffectCoordinate(Coordinate):
         self.feature_shard = feature_shard
         self.axis_name = axis_name
 
-        # Dataset is a jit ARGUMENT (not a closure constant): closures bake
-        # device arrays into the HLO, forcing recompiles per dataset and
-        # oversized programs.
-        def _train(data: GlmData, offsets: Array, w0: Array) -> Array:
+        # Dataset AND reg_weight are jit ARGUMENTS (not closure constants):
+        # closures bake them into the HLO, forcing recompiles per dataset /
+        # per tuning point and oversized programs.  Hyperparameter tuning
+        # mutates self.reg_weight between runs at zero recompile cost.
+        def _train(data: GlmData, offsets: Array, w0: Array, reg_weight: Array):
             data = dataclasses.replace(data, offsets=offsets)
             return self.problem.solve(
-                data, self.reg_weight, w0, axis_name=self.axis_name
+                data, reg_weight, w0, axis_name=self.axis_name
             ).w
 
         def _score(data: GlmData, w: Array) -> Array:
@@ -106,7 +107,10 @@ class FixedEffectCoordinate(Coordinate):
             if warm_state is None
             else warm_state
         )
-        return self._train_jit(self.dataset.data, offsets, w0)
+        return self._train_jit(
+            self.dataset.data, offsets, w0,
+            jnp.asarray(self.reg_weight, jnp.float32),
+        )
 
     def score(self, state: Array) -> Array:
         return self._score_jit(self.dataset.data, state)
@@ -118,17 +122,22 @@ class FixedEffectCoordinate(Coordinate):
         )
 
 
-def _make_block_solver(task: str, config: GlmOptimizationConfig, reg_weight: float):
-    """Build a jitted (block, offsets, w0) → (E, D) batched solver."""
-    loss = losses_lib.get(task)
-    l1 = config.regularization.l1_weight(reg_weight)
-    l2 = config.regularization.l2_weight(reg_weight)
-    opt = config.optimizer
-    use_owlqn = (
-        opt.optimizer is OptimizerType.OWLQN or l1 > 0.0
-    )
+def _make_block_solver(task: str, config: GlmOptimizationConfig):
+    """Build a jitted (block, offsets, w0, l1, l2) → (E, D) batched solver.
 
-    def solve_one(X, y, wts, off, w0):
+    Optimizer dispatch matches GlmOptimizationProblem.solve: any L1
+    component (static on the regularization TYPE) routes to OWL-QN; else the
+    configured smooth optimizer (L-BFGS or TRON) runs.  l1/l2 are traced
+    scalars so tuning sweeps don't recompile.
+    """
+    from photon_ml_tpu.optim.tron import TRONConfig, tron_solve
+
+    loss = losses_lib.get(task)
+    opt = config.optimizer
+    has_l1 = config.regularization.l1_weight(1.0) > 0.0
+    use_owlqn = opt.optimizer is OptimizerType.OWLQN or has_l1
+
+    def solve_one(X, y, wts, off, w0, l1, l2):
         def vg(w):
             m = X @ w + off
             val = jnp.sum(wts * loss.value(m, y)) + 0.5 * l2 * jnp.vdot(w, w)
@@ -146,6 +155,18 @@ def _make_block_solver(task: str, config: GlmOptimizationConfig, reg_weight: flo
                     history=opt.history,
                 ),
             ).w
+        if opt.optimizer is OptimizerType.TRON:
+            def hvp(w, v, aux):
+                return X.T @ (aux * (X @ v)) + l2 * v
+
+            def d2f(w):
+                return wts * loss.d2(X @ w + off, y)
+
+            return tron_solve(
+                vg, hvp, w0,
+                TRONConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
+                d2_fn=d2f,
+            ).w
         return lbfgs_solve(
             vg,
             w0,
@@ -157,10 +178,12 @@ def _make_block_solver(task: str, config: GlmOptimizationConfig, reg_weight: flo
         ).w
 
     @jax.jit
-    def solve_block(block: EntityBlock, offsets_block: Array, w0: Array) -> Array:
-        return jax.vmap(solve_one)(
-            block.X, block.labels, block.weights, offsets_block, w0
-        )
+    def solve_block(
+        block: EntityBlock, offsets_block: Array, w0: Array, l1: Array, l2: Array
+    ) -> Array:
+        return jax.vmap(
+            solve_one, in_axes=(0, 0, 0, 0, 0, None, None)
+        )(block.X, block.labels, block.weights, offsets_block, w0, l1, l2)
 
     return solve_block
 
@@ -189,7 +212,7 @@ class RandomEffectCoordinate(Coordinate):
         self.reg_weight = reg_weight
         self.feature_shard = feature_shard
         self.entity_key = entity_key or name
-        self._solver = _make_block_solver(task, config, reg_weight)
+        self._solver = _make_block_solver(task, config)
 
         @jax.jit
         def score_block(block: EntityBlock, coefs: Array) -> tuple[Array, Array]:
@@ -204,6 +227,14 @@ class RandomEffectCoordinate(Coordinate):
         return jnp.take(padded, block.row_index, axis=0)
 
     def train(self, offsets: Array, warm_state=None) -> list[Array]:
+        l1 = jnp.asarray(
+            self.config.regularization.l1_weight(1.0) * self.reg_weight,
+            jnp.float32,
+        )
+        l2 = jnp.asarray(
+            self.config.regularization.l2_weight(1.0) * self.reg_weight,
+            jnp.float32,
+        )
         state = []
         for bi, block in enumerate(self.dataset.blocks):
             off_b = self._gather_offsets(offsets, block)
@@ -212,7 +243,7 @@ class RandomEffectCoordinate(Coordinate):
                 if warm_state is not None
                 else jnp.zeros((block.n_entities, block.block_dim), jnp.float32)
             )
-            state.append(self._solver(block, off_b, w0))
+            state.append(self._solver(block, off_b, w0, l1, l2))
         return state
 
     def score(self, state: list[Array]) -> Array:

@@ -139,12 +139,18 @@ def build_random_effect_dataset(
     weights: np.ndarray,
     max_rows_per_entity: Optional[int] = None,
     dtype=jnp.float32,
+    device: bool = True,
 ) -> RandomEffectDataset:
     """Group rows by entity, project to per-entity subspaces, bucket by size.
 
     ``max_rows_per_entity`` is the reference's active-set cap: entities with
     more rows train on a uniformly-spaced subset; the remaining (passive)
     rows land in score-only ``passive_blocks``.
+
+    Entity keys are canonicalized to STRINGS — the on-disk model format
+    (Avro entityId) is string-keyed, so training with int keys and scoring
+    after reload must agree.  ``device=False`` keeps blocks as host numpy
+    arrays (pure-host scoring paths avoid the device round trip).
     """
     import scipy.sparse as sp
 
@@ -153,6 +159,17 @@ def build_random_effect_dataset(
     n_rows, d = rows_csr.shape
     entity_keys = np.asarray(entity_keys)
     assert entity_keys.shape[0] == n_rows
+    if entity_keys.dtype == object:
+        missing = sum(1 for k in entity_keys if k is None)
+        if missing:
+            raise ValueError(
+                f"{missing} of {n_rows} rows have no entity id for this "
+                "random effect (records missing the id column?)"
+            )
+    entity_keys = entity_keys.astype(str)
+    _asarray = (lambda x, dt=None: jnp.asarray(x, dt)) if device else (
+        lambda x, dt=None: np.asarray(x, dt) if dt else np.asarray(x)
+    )
 
     # Group row indices by entity.
     order = np.argsort(entity_keys, kind="stable")
@@ -160,7 +177,8 @@ def build_random_effect_dataset(
     boundaries = np.flatnonzero(
         np.concatenate([[True], sorted_keys[1:] != sorted_keys[:-1]])
     )
-    groups: list[tuple] = []  # (key, active_rows, passive_rows, active_cols)
+    # (key, active_rows, passive_rows, active_cols, active_row_slice)
+    groups: list[tuple] = []
     for gi, start in enumerate(boundaries):
         end = boundaries[gi + 1] if gi + 1 < len(boundaries) else len(order)
         ridx = order[start:end]
@@ -171,13 +189,15 @@ def build_random_effect_dataset(
             mask[keep] = True
             passive = ridx[~mask]
             ridx = ridx[mask]
+        # The CSR row slice is the dominant host cost at millions of
+        # entities; slice once and reuse it in the bucket-fill loop.
         sub = rows_csr[ridx]
         active = np.unique(sub.indices)
-        groups.append((sorted_keys[start], ridx, passive, active))
+        groups.append((sorted_keys[start], ridx, passive, active, sub))
 
     # Bucket by (padded row count, padded active-feature count).
     buckets: dict[tuple[int, int], list[int]] = {}
-    for i, (_, ridx, _passive, active) in enumerate(groups):
+    for i, (_, ridx, _passive, active, _sub) in enumerate(groups):
         key = (_round_up_pow2(len(ridx)), _round_up_pow2(len(active)))
         buckets.setdefault(key, []).append(i)
 
@@ -194,23 +214,22 @@ def build_random_effect_dataset(
         rindex = np.full((E, R), n_rows, np.int32)  # sentinel
         ids: list = []
         for lane, gi in enumerate(members):
-            key, ridx, _passive, active = groups[gi]
+            key, ridx, _passive, active, sub = groups[gi]
             ids.append(key)
             entity_to_slot[key] = (len(blocks), lane)
             cmap[lane, : len(active)] = active
             # Project this entity's rows into its active subspace.
-            sub = rows_csr[ridx][:, active].toarray()
-            X[lane, : len(ridx), : len(active)] = sub
+            X[lane, : len(ridx), : len(active)] = sub[:, active].toarray()
             lab[lane, : len(ridx)] = labels[ridx]
             wts[lane, : len(ridx)] = weights[ridx]
             rindex[lane, : len(ridx)] = ridx
         blocks.append(
             EntityBlock(
-                X=jnp.asarray(X, dtype),
-                labels=jnp.asarray(lab),
-                weights=jnp.asarray(wts),
-                col_map=jnp.asarray(cmap),
-                row_index=jnp.asarray(rindex),
+                X=_asarray(X, dtype),
+                labels=_asarray(lab),
+                weights=_asarray(wts),
+                col_map=_asarray(cmap),
+                row_index=_asarray(rindex),
                 n_entities=E,
                 rows_per_entity=R,
                 block_dim=D,
@@ -232,7 +251,7 @@ def build_random_effect_dataset(
         wtsp = np.zeros((E, Rp), np.float32)
         rindexp = np.full((E, Rp), n_rows, np.int32)
         for lane, gi in enumerate(members):
-            _key, _ridx, passive, active = groups[gi]
+            _key, _ridx, passive, active, _sub = groups[gi]
             if len(passive) == 0:
                 continue
             # Features outside the entity's ACTIVE subspace drop here, as in
@@ -245,11 +264,11 @@ def build_random_effect_dataset(
             rindexp[lane, : len(passive)] = passive
         passive_blocks.append(
             EntityBlock(
-                X=jnp.asarray(Xp, dtype),
-                labels=jnp.asarray(labp),
-                weights=jnp.asarray(wtsp),
+                X=_asarray(Xp, dtype),
+                labels=_asarray(labp),
+                weights=_asarray(wtsp),
                 col_map=blocks[-1].col_map,
-                row_index=jnp.asarray(rindexp),
+                row_index=_asarray(rindexp),
                 n_entities=E,
                 rows_per_entity=Rp,
                 block_dim=D,

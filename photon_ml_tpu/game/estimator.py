@@ -42,11 +42,17 @@ from photon_ml_tpu.optim.problem import GlmOptimizationConfig
 
 @dataclasses.dataclass(frozen=True)
 class FixedEffectCoordinateConfig:
-    """Reference: ``FixedEffectCoordinateConfiguration``."""
+    """Reference: ``FixedEffectCoordinateConfiguration`` (incl. its
+    down-sampling rate, applied to this coordinate's TRAINING loss only)."""
 
     feature_shard: str
     optimization: GlmOptimizationConfig = GlmOptimizationConfig()
     reg_weight: float = 0.0
+    #: <1.0 down-samples training rows for this coordinate (negatives only
+    #: for binary tasks, uniform otherwise), re-weighting survivors so the
+    #: objective stays unbiased.  Scoring always covers every row: dropped
+    #: rows get training weight 0, not removal, so shapes stay static.
+    down_sampling_rate: float = 1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,10 +85,16 @@ class GameEstimator:
         n_iterations: int = 1,
         logger=None,
     ):
-        self.task = task
+        self.task = losses_lib.get(task).name  # canonicalize aliases
         self.coordinate_configs = dict(coordinate_configs)
         self.n_iterations = n_iterations
         self.logger = logger
+
+    def build_coordinates(self, shards, ids, response, weight=None, offset=None):
+        """Build per-coordinate datasets + coordinate objects once.  Tuning
+        loops reuse them across evaluations (mutating ``coord.reg_weight``,
+        a traced argument — no recompilation, no dataset rebuild)."""
+        return self._build_coordinates(shards, ids, response, weight, offset)
 
     def _build_coordinates(self, shards, ids, response, weight, offset):
         n = len(response)
@@ -91,7 +103,23 @@ class GameEstimator:
         for name, cfg in self.coordinate_configs.items():
             shard = shards[cfg.feature_shard]
             if isinstance(cfg, FixedEffectCoordinateConfig):
-                data = make_glm_data(shard, response, weights=weight)
+                train_weight = weight
+                if cfg.down_sampling_rate < 1.0:
+                    from photon_ml_tpu.data.sampling import (
+                        BinaryClassificationDownSampler,
+                        DefaultDownSampler,
+                    )
+
+                    binary = self.task in ("logistic", "smoothed_hinge")
+                    sampler = (
+                        BinaryClassificationDownSampler(cfg.down_sampling_rate)
+                        if binary
+                        else DefaultDownSampler(cfg.down_sampling_rate)
+                    )
+                    idx, w_kept = sampler.downsample(response, weight)
+                    train_weight = np.zeros(n, np.float32)
+                    train_weight[idx] = w_kept
+                data = make_glm_data(shard, response, weights=train_weight)
                 coordinates.append(
                     FixedEffectCoordinate(
                         name,
@@ -137,16 +165,25 @@ class GameEstimator:
         History entries include the training-set metric after each
         coordinate update (the reference logs its validation suite there;
         validation metrics here come from scoring with GameTransformer)."""
+        coordinates = self._build_coordinates(shards, ids, response, weight, offset)
+        return self.fit_coordinates(coordinates, response, weight, offset, evaluator)
+
+    def fit_coordinates(
+        self,
+        coordinates,
+        response,
+        weight=None,
+        offset=None,
+        evaluator: Optional[Evaluator] = None,
+    ) -> tuple[GameModel, list]:
+        """Run coordinate descent over pre-built coordinates (see
+        :meth:`build_coordinates`) and finalize the GameModel."""
         n = len(response)
         response = np.asarray(response, np.float32)
         base_offsets = (
             np.zeros(n, np.float32) if offset is None else np.asarray(offset, np.float32)
         )
-        evaluator = evaluator or default_evaluator_for_task(
-            losses_lib.get(self.task).name
-        )
-        coordinates = self._build_coordinates(shards, ids, response, weight, offset)
-
+        evaluator = evaluator or default_evaluator_for_task(self.task)
         w_host = None if weight is None else np.asarray(weight, np.float32)
 
         def eval_fn(it, cname, scores):
@@ -207,18 +244,17 @@ class GameTransformer:
         without trained coefficients (or padding) contribute zero."""
         entity_col = np.asarray(ids[model.entity_key])
         n = shard.shape[0]
+        # device=False: this is a pure-host computation; uploading blocks to
+        # the accelerator just to pull them back would waste PCIe/HBM.
         dataset = build_random_effect_dataset(
-            entity_col, shard, np.zeros(n, np.float32), np.ones(n, np.float32)
+            entity_col, shard, np.zeros(n, np.float32), np.ones(n, np.float32),
+            device=False,
         )
         out = np.zeros(n + 1, np.float32)
         for block, block_ids in zip(dataset.blocks, dataset.entity_ids):
-            coefs = model.coefficient_matrix_for(
-                np.asarray(block.col_map), block_ids
-            )
-            scores = np.einsum(
-                "erd,ed->er", np.asarray(block.X), coefs, dtype=np.float32
-            )
-            np.add.at(out, np.asarray(block.row_index).ravel(), scores.ravel())
+            coefs = model.coefficient_matrix_for(block.col_map, block_ids)
+            scores = np.einsum("erd,ed->er", block.X, coefs)
+            np.add.at(out, block.row_index.ravel(), scores.ravel())
         return out[:n]
 
     def transform_with_mean(self, shards, ids, offset=None) -> np.ndarray:

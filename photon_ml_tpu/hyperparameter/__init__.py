@@ -1,0 +1,4 @@
+from photon_ml_tpu.hyperparameter.search import (  # noqa: F401
+    GaussianProcessSearch,
+    RandomSearch,
+)

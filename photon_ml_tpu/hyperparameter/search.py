@@ -1,0 +1,196 @@
+"""Hyperparameter search: random and Gaussian-process Bayesian optimization.
+
+The analogue of the reference's ``...ml.hyperparameter`` package
+(SURVEY.md §2, §3.5): ``RandomSearch`` and ``GaussianProcessSearch`` — a GP
+surrogate with a Matérn-5/2 kernel and expected-improvement acquisition —
+proposing points in a bounded box (the reference searches log-scaled
+regularization weights the same way).  ``EvaluationFunction`` is just a
+Python callable ``params -> metric`` here (the reference wraps
+GameEstimator.fit; drivers pass exactly that).
+
+Pure NumPy: the GP fits over tens of observed points, far below device
+scale.  Minimization convention — callers whose metric is
+larger-is-better pass ``maximize=True``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SearchResult:
+    best_params: np.ndarray
+    best_value: float
+    history: list  # (params, value) tuples in evaluation order
+
+
+class RandomSearch:
+    """Uniform sampling in the (optionally log-scaled) box."""
+
+    def __init__(
+        self,
+        bounds: Sequence[tuple[float, float]],
+        log_scale: bool | Sequence[bool] = False,
+        seed: int = 0,
+    ):
+        self.bounds = [(float(lo), float(hi)) for lo, hi in bounds]
+        d = len(self.bounds)
+        self.log_scale = (
+            [bool(log_scale)] * d if isinstance(log_scale, bool) else list(log_scale)
+        )
+        self.rng = np.random.default_rng(seed)
+
+    def _sample(self, n: int) -> np.ndarray:
+        d = len(self.bounds)
+        out = np.empty((n, d))
+        for j, (lo, hi) in enumerate(self.bounds):
+            if self.log_scale[j]:
+                out[:, j] = np.exp(
+                    self.rng.uniform(np.log(lo), np.log(hi), size=n)
+                )
+            else:
+                out[:, j] = self.rng.uniform(lo, hi, size=n)
+        return out
+
+    def find(
+        self,
+        evaluate: Callable[[np.ndarray], float],
+        n_iterations: int,
+        maximize: bool = False,
+    ) -> SearchResult:
+        history = []
+        for x in self._sample(n_iterations):
+            history.append((x, float(evaluate(x))))
+        sign = -1.0 if maximize else 1.0
+        best = min(history, key=lambda h: sign * h[1])
+        return SearchResult(best[0], best[1], history)
+
+
+def _matern52(X1: np.ndarray, X2: np.ndarray, length_scale: float) -> np.ndarray:
+    """Matérn-5/2 kernel, the reference's GP covariance."""
+    d = np.sqrt(
+        np.maximum(
+            np.sum(X1**2, 1)[:, None] + np.sum(X2**2, 1)[None, :]
+            - 2.0 * X1 @ X2.T,
+            0.0,
+        )
+    )
+    s = np.sqrt(5.0) * d / length_scale
+    return (1.0 + s + s**2 / 3.0) * np.exp(-s)
+
+
+class GaussianProcessModel:
+    """GP posterior over normalized inputs (the reference's
+    ``GaussianProcessModel``): zero mean, Matérn-5/2, observation noise."""
+
+    def __init__(self, length_scale: float = 0.3, noise: float = 1e-6):
+        self.length_scale = length_scale
+        self.noise = noise
+        self._X: Optional[np.ndarray] = None
+
+    def fit(self, X: np.ndarray, y: np.ndarray) -> "GaussianProcessModel":
+        self._X = np.atleast_2d(X)
+        self._y_mean = float(np.mean(y))
+        self._y_std = float(np.std(y)) or 1.0
+        self._y = (np.asarray(y, float) - self._y_mean) / self._y_std
+        K = _matern52(self._X, self._X, self.length_scale)
+        K[np.diag_indices_from(K)] += self.noise
+        self._L = np.linalg.cholesky(K)
+        self._alpha = np.linalg.solve(
+            self._L.T, np.linalg.solve(self._L, self._y)
+        )
+        return self
+
+    def predict(self, X: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Posterior mean and standard deviation at X."""
+        X = np.atleast_2d(X)
+        Ks = _matern52(X, self._X, self.length_scale)
+        mean = Ks @ self._alpha
+        v = np.linalg.solve(self._L, Ks.T)
+        var = np.maximum(
+            _matern52(X, X, self.length_scale).diagonal() - np.sum(v**2, 0),
+            1e-12,
+        )
+        return (
+            mean * self._y_std + self._y_mean,
+            np.sqrt(var) * self._y_std,
+        )
+
+
+def expected_improvement(
+    mean: np.ndarray, std: np.ndarray, best: float
+) -> np.ndarray:
+    """EI for MINIMIZATION: E[max(best - f, 0)]."""
+    from scipy.stats import norm
+
+    z = (best - mean) / std
+    return (best - mean) * norm.cdf(z) + std * norm.pdf(z)
+
+
+class GaussianProcessSearch(RandomSearch):
+    """Reference: ``GaussianProcessSearch.findWithPriors`` — seed with a few
+    random points, then repeatedly fit the GP and evaluate the EI-argmax of
+    a candidate pool (SURVEY.md §3.5)."""
+
+    def __init__(
+        self,
+        bounds: Sequence[tuple[float, float]],
+        log_scale: bool | Sequence[bool] = False,
+        seed: int = 0,
+        n_seed_points: int = 3,
+        n_candidates: int = 512,
+        length_scale: float = 0.3,
+    ):
+        super().__init__(bounds, log_scale, seed)
+        self.n_seed_points = n_seed_points
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+
+    def _normalize(self, X: np.ndarray) -> np.ndarray:
+        """Map the (possibly log-scaled) box to [0,1]^d for the GP."""
+        out = np.empty_like(X, dtype=float)
+        for j, (lo, hi) in enumerate(self.bounds):
+            if self.log_scale[j]:
+                out[:, j] = (np.log(X[:, j]) - np.log(lo)) / (
+                    np.log(hi) - np.log(lo)
+                )
+            else:
+                out[:, j] = (X[:, j] - lo) / (hi - lo)
+        return out
+
+    def find(
+        self,
+        evaluate: Callable[[np.ndarray], float],
+        n_iterations: int,
+        maximize: bool = False,
+        priors: Optional[list] = None,
+    ) -> SearchResult:
+        """``priors`` seeds the GP with already-evaluated (params, value)
+        pairs (the reference's findWithPriors — e.g. reuse the previous
+        model-selection grid)."""
+        sign = -1.0 if maximize else 1.0
+        history: list = list(priors) if priors else []
+
+        n_seed = max(0, min(self.n_seed_points - len(history), n_iterations))
+        for x in self._sample(n_seed):
+            history.append((x, float(evaluate(x))))
+
+        remaining = n_iterations - n_seed
+        for _ in range(remaining):
+            X_obs = np.array([h[0] for h in history], float)
+            y_obs = np.array([sign * h[1] for h in history], float)
+            gp = GaussianProcessModel(self.length_scale).fit(
+                self._normalize(X_obs), y_obs
+            )
+            candidates = self._sample(self.n_candidates)
+            mean, std = gp.predict(self._normalize(candidates))
+            ei = expected_improvement(mean, std, float(np.min(y_obs)))
+            x_next = candidates[int(np.argmax(ei))]
+            history.append((x_next, float(evaluate(x_next))))
+
+        best = min(history, key=lambda h: sign * h[1])
+        return SearchResult(np.asarray(best[0]), best[1], history)

@@ -1,0 +1,108 @@
+"""Auxiliary subsystem tests: down-sampling, hyperparameter search, tracker."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.sampling import (
+    BinaryClassificationDownSampler,
+    DefaultDownSampler,
+)
+from photon_ml_tpu.hyperparameter.search import (
+    GaussianProcessModel,
+    GaussianProcessSearch,
+    RandomSearch,
+    expected_improvement,
+)
+
+
+class TestDownSampling:
+    def test_default_unbiased_weight_sum(self, rng):
+        n = 20000
+        labels = (rng.uniform(size=n) < 0.5).astype(float)
+        weights = np.ones(n)
+        idx, w = DefaultDownSampler(0.25, seed=1).downsample(labels, weights)
+        # Survivor weight sum ≈ original weight sum (unbiased).
+        assert abs(w.sum() - n) / n < 0.05
+        assert len(idx) == pytest.approx(n * 0.25, rel=0.1)
+
+    def test_binary_keeps_all_positives(self, rng):
+        n = 10000
+        labels = (rng.uniform(size=n) < 0.05).astype(float)  # 5% positive
+        weights = np.ones(n)
+        idx, w = BinaryClassificationDownSampler(0.1, seed=2).downsample(
+            labels, weights
+        )
+        kept = labels[idx]
+        assert kept.sum() == labels.sum()  # every positive kept, weight 1
+        np.testing.assert_allclose(w[kept > 0], 1.0)
+        # Kept negatives re-weighted to preserve total negative mass.
+        neg_mass = w[kept == 0].sum()
+        assert abs(neg_mass - (n - labels.sum())) / n < 0.05
+
+    def test_invalid_rate_rejected(self):
+        with pytest.raises(ValueError):
+            DefaultDownSampler(0.0)
+        with pytest.raises(ValueError):
+            BinaryClassificationDownSampler(1.5)
+
+
+class TestHyperparameterSearch:
+    def test_random_search_finds_decent_point(self):
+        def f(x):
+            return float((x[0] - 3.0) ** 2 + (x[1] + 1.0) ** 2)
+
+        res = RandomSearch([(0, 5), (-3, 3)], seed=4).find(f, 60)
+        assert res.best_value < 0.5
+        assert len(res.history) == 60
+
+    def test_gp_posterior_interpolates(self):
+        X = np.array([[0.0], [0.5], [1.0]])
+        y = np.array([1.0, 0.0, 1.0])
+        gp = GaussianProcessModel().fit(X, y)
+        mean, std = gp.predict(X)
+        np.testing.assert_allclose(mean, y, atol=1e-2)
+        assert np.all(std < 0.1)
+        # Uncertainty grows away from data.
+        _, std_far = gp.predict(np.array([[0.25]]))
+        assert std_far[0] > std[0]
+
+    def test_ei_prefers_low_mean_and_high_std(self):
+        ei = expected_improvement(
+            np.array([0.0, 1.0]), np.array([0.1, 0.1]), best=0.5
+        )
+        assert ei[0] > ei[1]
+        ei2 = expected_improvement(
+            np.array([1.0, 1.0]), np.array([1.0, 0.01]), best=0.5
+        )
+        assert ei2[0] > ei2[1]
+
+    def test_gp_search_beats_random_on_smooth_objective(self):
+        def f(x):
+            return float(np.sin(3 * x[0]) + 0.3 * (x[0] - 4.0) ** 2)
+
+        budget = 18
+        gp = GaussianProcessSearch([(0.0, 8.0)], seed=5).find(f, budget)
+        assert gp.best_value < 0.1  # true min ≈ -0.04 near x≈4.5
+        assert len(gp.history) == budget
+
+    def test_gp_search_log_scale_and_priors(self):
+        # Optimum at lambda = 1e-2 on a log-scaled axis.
+        def f(x):
+            return float((np.log10(x[0]) + 2.0) ** 2)
+
+        priors = [(np.array([1.0]), f(np.array([1.0])))]
+        res = GaussianProcessSearch(
+            [(1e-4, 1e2)], log_scale=True, seed=6
+        ).find(f, 15, priors=priors)
+        assert res.best_value < 0.1
+        # History includes the prior.
+        assert len(res.history) == 16
+
+    def test_maximize_mode(self):
+        def f(x):
+            return float(-((x[0] - 2.0) ** 2))  # max at x=2
+
+        res = GaussianProcessSearch([(0.0, 5.0)], seed=7).find(
+            f, 15, maximize=True
+        )
+        assert abs(res.best_params[0] - 2.0) < 0.3

@@ -1,0 +1,114 @@
+"""Distributed GAME tests on the 8-virtual-device mesh: sharded coordinates
+must match their single-device counterparts (the reference's
+distributed-vs-single-node parity pattern, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.game.coordinates import (
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.data import FixedEffectDataset, build_random_effect_dataset
+from photon_ml_tpu.game.descent import CoordinateDescent
+from photon_ml_tpu.game.distributed import (
+    DistributedFixedEffectCoordinate,
+    EntityShardedRandomEffectCoordinate,
+)
+from photon_ml_tpu.data.dataset import make_glm_data
+from photon_ml_tpu.optim.problem import GlmOptimizationConfig, OptimizerConfig
+from photon_ml_tpu.optim.regularization import RegularizationContext
+from photon_ml_tpu.parallel.distributed import data_mesh
+
+
+@pytest.fixture
+def problem(rng):
+    n, d = 331, 9  # deliberately not divisible by 8
+    n_users = 13
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    users = np.array([f"u{rng.integers(n_users)}" for _ in range(n)])
+    ue = {f"u{k}": rng.normal(scale=1.5) for k in range(n_users)}
+    margins = X @ rng.normal(size=d) + np.array([ue[u] for u in users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+    bias = sp.csr_matrix(np.ones((n, 1), np.float32))
+    opt = GlmOptimizationConfig(
+        optimizer=OptimizerConfig(max_iters=50),
+        regularization=RegularizationContext.l2(),
+    )
+    return X, bias, users, y, opt
+
+
+class TestDistributedGame:
+    def test_fixed_effect_parity(self, problem, eight_devices):
+        X, _, _, y, opt = problem
+        mesh = data_mesh(eight_devices)
+        n = X.shape[0]
+        offsets = jnp.asarray(np.linspace(-1, 1, n), jnp.float32)
+
+        dist = DistributedFixedEffectCoordinate(
+            "fixed", X, y, mesh, "logistic", opt, reg_weight=0.7
+        )
+        w_dist = dist.train(offsets)
+        s_dist = np.asarray(dist.score(w_dist))
+
+        single = FixedEffectCoordinate(
+            "fixed",
+            FixedEffectDataset(make_glm_data(X, y), n),
+            "logistic", opt, reg_weight=0.7,
+        )
+        w_single = single.train(offsets)
+        s_single = np.asarray(single.score(w_single))
+
+        np.testing.assert_allclose(
+            np.asarray(w_dist), np.asarray(w_single), rtol=1e-3, atol=1e-4
+        )
+        np.testing.assert_allclose(s_dist, s_single, rtol=1e-3, atol=1e-4)
+
+    def test_entity_sharded_random_effect_parity(self, problem, eight_devices):
+        _, bias, users, y, opt = problem
+        mesh = data_mesh(eight_devices)
+        n = len(y)
+        offsets = jnp.zeros(n, jnp.float32)
+        ds = build_random_effect_dataset(
+            users, bias, y, np.ones(n, np.float32)
+        )
+        sharded = EntityShardedRandomEffectCoordinate(
+            "re", ds, mesh, "logistic", opt, reg_weight=0.5, entity_key="userId"
+        )
+        plain = RandomEffectCoordinate(
+            "re",
+            build_random_effect_dataset(users, bias, y, np.ones(n, np.float32)),
+            "logistic", opt, reg_weight=0.5, entity_key="userId",
+        )
+        s_sharded = np.asarray(sharded.score(sharded.train(offsets)))
+        s_plain = np.asarray(plain.score(plain.train(offsets)))
+        np.testing.assert_allclose(s_sharded, s_plain, rtol=1e-4, atol=1e-5)
+
+        # finalize drops padding lanes: entity count is exact.
+        model = sharded.finalize(sharded.train(offsets))
+        assert model.n_entities == 13
+
+    def test_full_distributed_cd_loop(self, problem, eight_devices):
+        X, bias, users, y, opt = problem
+        mesh = data_mesh(eight_devices)
+        n = X.shape[0]
+        fixed = DistributedFixedEffectCoordinate(
+            "fixed", X, y, mesh, "logistic", opt, reg_weight=0.7
+        )
+        re = EntityShardedRandomEffectCoordinate(
+            "re",
+            build_random_effect_dataset(users, bias, y, np.ones(n, np.float32)),
+            mesh, "logistic", opt, reg_weight=0.5, entity_key="userId",
+        )
+        result = CoordinateDescent([fixed, re]).run(
+            jnp.zeros(n, jnp.float32), n_iterations=2
+        )
+        total = np.asarray(result.scores["fixed"]) + np.asarray(
+            result.scores["re"]
+        )
+        from photon_ml_tpu.evaluation.evaluators import AreaUnderROCCurveEvaluator
+        auc = AreaUnderROCCurveEvaluator().evaluate(total, y)
+        assert auc > 0.8

@@ -156,6 +156,42 @@ class TestGameDrivers:
         s2 = GameTransformer(model2).transform(shards, ids)
         np.testing.assert_allclose(s1, s2, rtol=1e-6)
 
+    def test_bayesian_tuning_mode(self, game_files, tmp_path):
+        train, val, config = game_files
+        with open(config) as f:
+            cfg = json.load(f)
+        cfg["tuning"] = {"mode": "bayesian", "iterations": 5,
+                         "range": [1e-2, 1e2]}
+        cfg["iterations"] = 1
+        tuned_config = str(tmp_path / "tuned_config.json")
+        with open(tuned_config, "w") as f:
+            json.dump(cfg, f)
+        out = str(tmp_path / "tuned_out")
+        result = game_training_driver.run([
+            "--train-data", train, "--validate-data", val,
+            "--config", tuned_config, "--output-dir", out,
+        ])
+        assert result["tuning"]["n_evaluations"] == 5
+        assert set(result["tuning"]["best_reg_weights"]) == {"fixed", "per_user"}
+        # Final fit used the tuned weights and achieved the tuned metric.
+        assert result["validation_metric"] == pytest.approx(
+            result["tuning"]["best_metric"], abs=1e-6
+        )
+
+    def test_tuning_without_validation_fails_cleanly(self, game_files, tmp_path):
+        train, _, config = game_files
+        with open(config) as f:
+            cfg = json.load(f)
+        cfg["tuning"] = {"iterations": 2}
+        bad_config = str(tmp_path / "bad.json")
+        with open(bad_config, "w") as f:
+            json.dump(cfg, f)
+        with pytest.raises(ValueError, match="requires --validate-data"):
+            game_training_driver.run([
+                "--train-data", train, "--config", bad_config,
+                "--output-dir", str(tmp_path / "x"),
+            ])
+
     def test_feature_indexing_driver(self, game_files, tmp_path):
         train, _, _ = game_files
         out = str(tmp_path / "maps")
