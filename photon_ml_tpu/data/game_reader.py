@@ -11,6 +11,7 @@ per-shard feature index maps (built on the fly or supplied, the reference's
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import numpy as np
@@ -59,6 +60,7 @@ def read_game_avro(
     path: str,
     index_maps: Optional[dict] = None,
     add_intercept_shards: tuple[str, ...] = (),
+    logger=None,
 ):
     """Read GAME Avro data.
 
@@ -83,6 +85,8 @@ def read_game_avro(
         s: dict(m) for s, m in (index_maps or {}).items()
     }
 
+    dropped: dict[str, int] = {}
+
     for i, rec in enumerate(records):
         response[i] = rec["response"]
         if rec["weight"] is not None:
@@ -93,6 +97,12 @@ def read_game_avro(
         for k, v in rec["ids"].items():
             id_cols.setdefault(k, [None] * n)[i] = v
         for shard, feats in rec["features"].items():
+            if not building and shard not in forward:
+                # Scoring path: a whole feature shard absent from the
+                # supplied index maps is skipped (same policy as dropping
+                # unseen features), counted below.
+                dropped[shard] = dropped.get(shard, 0) + len(feats)
+                continue
             rows, cols, vals = shard_rows.setdefault(shard, ([], [], []))
             fwd = forward.setdefault(shard, {})
             for f in feats:
@@ -100,12 +110,23 @@ def read_game_avro(
                 idx = fwd.get(key)
                 if idx is None:
                     if not building:
+                        dropped[shard] = dropped.get(shard, 0) + 1
                         continue  # scoring path: drop unseen features
                     idx = len(fwd)
                     fwd[key] = idx
                 rows.append(i)
                 cols.append(idx)
                 vals.append(f["value"])
+
+    if dropped:
+        # Default to the module logger; drivers pass their PhotonLogger so
+        # the warning lands in the job's photon.log artifact too.
+        (logger or logging.getLogger(__name__)).warning(
+            "read_game_avro(%s): dropped features absent from supplied index "
+            "maps: %s",
+            path,
+            ", ".join(f"{s}={c}" for s, c in sorted(dropped.items())),
+        )
 
     shards: dict = {}
     out_maps: dict = {}

@@ -50,7 +50,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
     model, index_maps = load_game_model(os.path.join(args.model_dir, "models"))
     shards, ids, response, weight, offset, uids, _ = read_game_avro(
-        args.data, index_maps=index_maps
+        args.data, index_maps=index_maps, logger=logger
     )
     transformer = GameTransformer(model, logger=logger)
     scores = (

@@ -131,7 +131,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     n_cd_iterations = int(config.get("iterations", 1))
     validation = None
     if args.validate_data:
-        validation = read_game_avro(args.validate_data, index_maps=index_maps)
+        validation = read_game_avro(
+            args.validate_data, index_maps=index_maps, logger=logger
+        )
 
     result = {"task": task, "n_rows": int(len(response))}
 

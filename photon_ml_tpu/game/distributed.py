@@ -81,11 +81,16 @@ class DistributedFixedEffectCoordinate(FixedEffectCoordinate):
         self._rows_per_shard = self.dist.data.labels.shape[1]
         self._n_shards = self.dist.n_shards
 
-        def _train(dd: DistributedGlmData, offsets_blocked: Array, w0: Array):
+        def _train(
+            dd: DistributedGlmData,
+            offsets_blocked: Array,
+            w0: Array,
+            reg_weight: Array,
+        ):
             local = dd.local()
             local = dataclasses.replace(local, offsets=offsets_blocked[0])
             return self.problem.solve(
-                local, self.reg_weight, w0, axis_name=DATA_AXIS
+                local, reg_weight, w0, axis_name=DATA_AXIS
             ).w
 
         def _score(dd: DistributedGlmData, w: Array) -> Array:
@@ -95,7 +100,7 @@ class DistributedFixedEffectCoordinate(FixedEffectCoordinate):
             jax.shard_map(
                 _train,
                 mesh=mesh,
-                in_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
+                in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P()),
                 out_specs=P(),
                 check_vma=False,
             )
@@ -124,7 +129,14 @@ class DistributedFixedEffectCoordinate(FixedEffectCoordinate):
             if warm_state is None
             else warm_state
         )
-        return self._train_sm(self.dist, self._block_offsets(offsets), w0)
+        # reg_weight is traced (not closed over) so hyperparameter tuning can
+        # mutate self.reg_weight between runs without a stale compiled value.
+        return self._train_sm(
+            self.dist,
+            self._block_offsets(offsets),
+            w0,
+            jnp.asarray(self.reg_weight, jnp.float32),
+        )
 
     def score(self, state: Array) -> Array:
         blocked = self._score_sm(self.dist, state)

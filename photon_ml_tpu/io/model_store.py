@@ -84,7 +84,17 @@ def load_glm_model(
 
     keys = [feature_key(e["name"], e["term"]) for e in rec["means"]]
     if index_map is None:
-        index_map = IndexMap.build(keys)
+        # Union of means and variances keys: a coefficient sparsified out of
+        # the means (value 0) can still carry a nonzero variance, and must
+        # keep a slot or the variance is silently dropped on round trip.
+        all_keys = list(keys)
+        seen = set(keys)
+        for e in rec["variances"] or []:
+            key = feature_key(e["name"], e["term"])
+            if key not in seen:
+                seen.add(key)
+                all_keys.append(key)
+        index_map = IndexMap.build(all_keys)
     d = len(index_map)
     means = np.zeros(d, np.float32)
     for e, key in zip(rec["means"], keys):

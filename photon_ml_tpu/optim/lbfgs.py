@@ -204,24 +204,37 @@ def lbfgs_solve(
         rel_impr = jnp.abs(s.value - ls.value) / jnp.maximum(
             jnp.abs(s.value), 1e-12
         )
-        converged = jnp.logical_or(
-            g_norm <= config.tolerance * tol_scale,
-            rel_impr <= config.tolerance * 1e-2,
-        )
-        # A failed line search that also made no progress ends the run.
+        # A failed line search that also made no progress ends the run; the
+        # incumbent iterate is kept (never adopt a trial point with a higher
+        # objective than the current one).  Convergence is measured at the
+        # iterate actually returned: the gradient test at the kept point on a
+        # stalled step, the usual gradient/function-decrease tests otherwise.
         stalled = jnp.logical_and(~ls.success, ls.value >= s.value)
+        converged = jnp.where(
+            stalled,
+            jnp.linalg.norm(s.grad) <= config.tolerance * tol_scale,
+            jnp.logical_or(
+                g_norm <= config.tolerance * tol_scale,
+                rel_impr <= config.tolerance * 1e-2,
+            ),
+        )
+        w_next = jnp.where(stalled, s.w, ls.w)
+        value_next = jnp.where(stalled, s.value, ls.value)
+        grad_next = jnp.where(stalled, s.grad, ls.grad)
 
         return _LBFGSState(
-            w=ls.w,
-            value=ls.value,
-            grad=ls.grad,
+            w=w_next,
+            value=value_next,
+            grad=grad_next,
             S=S, Y=Y, rho=rho, gamma=gamma,
             k=k,
             n_pairs=n_pairs,
             done=jnp.logical_or(converged, stalled),
             converged=converged,
-            values=s.values.at[k].set(ls.value),
-            grad_norms=s.grad_norms.at[k].set(g_norm),
+            values=s.values.at[k].set(value_next),
+            grad_norms=s.grad_norms.at[k].set(
+                jnp.where(stalled, jnp.linalg.norm(s.grad), g_norm)
+            ),
         )
 
     final = lax.while_loop(cond, body, init)

@@ -134,7 +134,6 @@ def owlqn_solve(
 
         # Orthant choice: sign(w) where nonzero, else sign of the step.
         xi = jnp.where(s.w != 0, jnp.sign(s.w), jnp.sign(-pg))
-        dg = jnp.vdot(direction, pg)  # descent measure for Armijo
 
         first = s.n_pairs == 0
         t = jnp.where(
@@ -151,9 +150,15 @@ def owlqn_solve(
             return w, full_value(w, smooth), grad
 
         def ls_cond(ls):
-            t, _, value, _, n = ls
+            t, w, value, _, n = ls
+            # Armijo on the PROJECTED step (Andrew & Gao / Breeze OWLQN):
+            # the trial point is orthant-projected, so the realized step is
+            # w - s.w, not t*direction; using <pg, w - s.w> keeps the
+            # sufficient-decrease threshold correctly scaled when the
+            # projection clamps coordinates.
+            dg_proj = jnp.vdot(pg, w - s.w)
             return jnp.logical_and(
-                value > s.value + config.armijo_c1 * t * dg,
+                value > s.value + config.armijo_c1 * dg_proj,
                 n < config.max_line_search_evals,
             )
 
@@ -177,19 +182,34 @@ def owlqn_solve(
         pg_new = _pseudo_gradient(w_new, g_new, l1, mask)
         pg_norm = jnp.linalg.norm(pg_new)
         rel_impr = jnp.abs(s.value - f_new) / jnp.maximum(jnp.abs(s.value), 1e-12)
-        converged = jnp.logical_or(
-            pg_norm <= config.tolerance * tol_scale,
-            rel_impr <= config.tolerance * 1e-2,
+        # Line search made no progress: end the run and keep the incumbent
+        # iterate (never adopt a trial point with a higher objective).
+        # Convergence is measured at the iterate actually returned: the
+        # pseudo-gradient test at the kept point on a stalled step, the usual
+        # tests otherwise.
+        stalled = f_new >= s.value
+        converged = jnp.where(
+            stalled,
+            jnp.linalg.norm(pg) <= config.tolerance * tol_scale,
+            jnp.logical_or(
+                pg_norm <= config.tolerance * tol_scale,
+                rel_impr <= config.tolerance * 1e-2,
+            ),
         )
-        stalled = f_new >= s.value  # line search made no progress
+        w_keep = jnp.where(stalled, s.w, w_new)
+        f_keep = jnp.where(stalled, s.value, f_new)
+        g_keep = jnp.where(stalled, s.grad, g_new)
+        pg_norm = jnp.where(
+            stalled, jnp.linalg.norm(pg), jnp.linalg.norm(pg_new)
+        )
 
         return _OWLQNState(
-            w=w_new, value=f_new, grad=g_new,
+            w=w_keep, value=f_keep, grad=g_keep,
             S=S, Y=Y, rho=rho, gamma=gamma,
             k=k, n_pairs=n_pairs,
             done=jnp.logical_or(converged, stalled),
             converged=converged,
-            values=s.values.at[k].set(f_new),
+            values=s.values.at[k].set(f_keep),
             grad_norms=s.grad_norms.at[k].set(pg_norm),
         )
 

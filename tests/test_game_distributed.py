@@ -67,6 +67,21 @@ class TestDistributedGame:
         )
         np.testing.assert_allclose(s_dist, s_single, rtol=1e-3, atol=1e-4)
 
+    def test_fixed_effect_reg_weight_mutation(self, problem, eight_devices):
+        # Hyperparameter tuning mutates coord.reg_weight between runs;
+        # reg_weight is a traced argument, so the mutation must take effect
+        # without retracing (regression test: it used to be baked into jit).
+        X, _, _, y, opt = problem
+        mesh = data_mesh(eight_devices)
+        offsets = jnp.zeros(X.shape[0], jnp.float32)
+        dist = DistributedFixedEffectCoordinate(
+            "fixed", X, y, mesh, "logistic", opt, reg_weight=0.1
+        )
+        w_low = np.asarray(dist.train(offsets))
+        dist.reg_weight = 100.0
+        w_high = np.asarray(dist.train(offsets))
+        assert np.linalg.norm(w_high) < 0.5 * np.linalg.norm(w_low)
+
     def test_entity_sharded_random_effect_parity(self, problem, eight_devices):
         _, bias, users, y, opt = problem
         mesh = data_mesh(eight_devices)

@@ -91,6 +91,30 @@ class TestModelStore:
                                    np.asarray(variances))
         assert loaded.task == "logistic"
 
+    def test_sparsified_variance_survives_reconstructed_map(self, tmp_path):
+        # A coefficient with mean 0 but nonzero variance is sparsified out of
+        # the means entries; reconstructing the index map on load (no map
+        # supplied) must still give it a slot so its variance round-trips.
+        imap = IndexMap.build(["a", "b", "c"])
+        means = jnp.asarray(np.array([1.0, 0.0, 3.0], np.float32))
+        variances = jnp.asarray(np.array([0.1, 0.2, 0.3], np.float32))
+        model = GeneralizedLinearModel(Coefficients(means, variances), "squared")
+        path = str(tmp_path / "model.avro")
+        save_glm_model(model, imap, path, sparsify=True)
+        loaded, imap2 = load_glm_model(path)  # no index map supplied
+        assert len(imap2) == 3
+        got = {
+            imap2.index_to_name(j): (
+                float(loaded.coefficients.means[j]),
+                float(loaded.coefficients.variances[j]),
+            )
+            for j in range(3)
+        }
+        expected = {"a": (1.0, 0.1), "b": (0.0, 0.2), "c": (3.0, 0.3)}
+        assert got.keys() == expected.keys()
+        for k in expected:
+            np.testing.assert_allclose(got[k], expected[k], rtol=1e-6)
+
     def test_sparsified_save_drops_zeros(self, tmp_path):
         imap = IndexMap.build(["a", "b", "c"])
         means = jnp.asarray(np.array([1.0, 0.0, 3.0], np.float32))
