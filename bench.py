@@ -7,10 +7,17 @@ iteration in the reference (SURVEY.md §3.1) — and reports rows/second.
 Epoch time for any row count divides out: 1B rows / (rows/sec) = epoch
 seconds per objective evaluation.
 
-No reference number is recorded in BASELINE.json (``published`` is {}), so
-``vs_baseline`` is the ratio against the committed ``bench_baseline.json``
-(first measured value on this hardware, round 1); it tracks round-over-round
-progress until a real reference number exists.
+MEASUREMENT METHODOLOGY (fixed in round 2): iterations are chained inside
+ONE jitted ``fori_loop`` and the clock stops only after a small slice of the
+result is read back to host.  Round 1 timed a Python loop closed by
+``jax.block_until_ready``, which on this TPU transport returns before the
+computation finishes unless a host readback has primed the sync path — so
+round 1's number (27-29 M rows/s) measured DISPATCH rate, not compute.  The
+honest round-1 COO throughput, re-measured with this methodology, is
+~0.95 M rows/s; that is the ``real_round1_rows_per_sec`` recorded in
+bench_baseline.json.  ``vs_baseline`` continues to be reported against the
+COMMITTED round-1 number for round-over-round continuity, and is therefore
+a massive *understatement* of the real kernel speedup (~70x).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -24,7 +31,8 @@ import numpy as np
 N_ROWS = 1 << 20  # 1,048,576
 N_FEATURES = 1 << 13  # 8,192
 NNZ_PER_ROW = 32
-N_TIMED = 30
+N_CHAINED = 10  # objective evals chained inside one jit
+N_REPS = 3  # timed repetitions (min taken)
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
 
@@ -35,60 +43,61 @@ def main() -> None:
 
     from photon_ml_tpu.data.dataset import GlmData
     from photon_ml_tpu.ops import losses
-    from photon_ml_tpu.ops.sparse import SparseMatrix
     from photon_ml_tpu.optim.objective import GlmObjective
 
     rng = np.random.default_rng(0)
     nnz = N_ROWS * NNZ_PER_ROW
-    # Row-sorted COO by construction: each row holds NNZ_PER_ROW entries.
-    row_ids = np.repeat(np.arange(N_ROWS, dtype=np.int32), NNZ_PER_ROW)
-    col_ids = rng.integers(0, N_FEATURES, size=nnz, dtype=np.int32)
+    rows = np.repeat(np.arange(N_ROWS, dtype=np.int64), NNZ_PER_ROW)
+    cols = rng.integers(0, N_FEATURES, size=nnz).astype(np.int64)
     values = rng.normal(size=nnz).astype(np.float32)
     w_true = (rng.normal(size=N_FEATURES) *
               (rng.uniform(size=N_FEATURES) < 0.2)).astype(np.float32)
-
-    X = SparseMatrix(
-        row_ids=jnp.asarray(row_ids),
-        col_ids=jnp.asarray(col_ids),
-        values=jnp.asarray(values),
-        n_rows=N_ROWS,
-        n_cols=N_FEATURES,
-    )
     margins_true = np.zeros(N_ROWS, np.float32)
-    np.add.at(margins_true, row_ids, values * w_true[col_ids])
+    np.add.at(margins_true, rows, values * w_true[cols.astype(np.int64)])
     y = (rng.uniform(size=N_ROWS) < 1 / (1 + np.exp(-margins_true))).astype(
-        np.float32
-    )
-    data = GlmData(
+        np.float32)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        from photon_ml_tpu.ops.sparse_pallas import build_pallas_matrix
+
+        X = build_pallas_matrix(rows, cols, values, N_ROWS, N_FEATURES)
+    else:
+        from photon_ml_tpu.ops.sparse import from_coo
+
+        X = from_coo(rows, cols, values, N_ROWS, N_FEATURES)
+
+    data = jax.device_put(GlmData(
         features=X,
         labels=jnp.asarray(y),
         weights=jnp.ones(N_ROWS, jnp.float32),
         offsets=jnp.zeros(N_ROWS, jnp.float32),
-    )
+    ))
     obj = GlmObjective(losses.logistic)
 
     # Data is an ARGUMENT, not a closure constant: closed-over arrays get
-    # baked into the HLO as literals, which bloats the program (and overflows
-    # the axon remote-compile transport).
+    # baked into the HLO as literals (overflows the remote-compile transport).
     @jax.jit
-    def value_and_grad(w, data):
-        return obj.value_and_grad(w, data, l2_weight=1.0)
+    def chain(w, data):
+        def body(i, w):
+            val, grad = obj.value_and_grad(w, data, l2_weight=1.0)
+            return w - 1e-4 * grad
+        return jax.lax.fori_loop(0, N_CHAINED, body, w)
 
-    data = jax.device_put(data)
     w = jnp.zeros(N_FEATURES, jnp.float32)
-    # Warmup: compile + first execution.
-    val, grad = value_and_grad(w, data)
-    jax.block_until_ready(grad)
+    out = chain(w, data)
+    _ = np.asarray(out.ravel()[0:1])  # compile + prime true sync
 
-    start = time.perf_counter()
-    for _ in range(N_TIMED):
-        val, grad = value_and_grad(w, data)
-        # New iterate each call so XLA can't fold the loop away.
-        w = w - 1e-4 * grad
-    jax.block_until_ready(w)
-    elapsed = time.perf_counter() - start
+    best = np.inf
+    for i in range(N_REPS):
+        wp = jnp.full((N_FEATURES,), np.float32(1e-3 * (i + 1)))
+        _ = np.asarray(wp.ravel()[0:1])
+        t0 = time.perf_counter()
+        out = chain(wp, data)
+        _ = np.asarray(out.ravel()[0:1])  # force real completion
+        best = min(best, (time.perf_counter() - t0) / N_CHAINED)
 
-    rows_per_sec = N_ROWS * N_TIMED / elapsed
+    rows_per_sec = N_ROWS / best
 
     vs_baseline = 1.0
     if os.path.exists(BASELINE_FILE):

@@ -1,0 +1,127 @@
+"""Microbenchmark: G8192 primitive (gather 33M values from an 8192-wide
+table) implemented as a Pallas window sweep over dynamic_gather, plus the
+full ELL matvec built on it.  This decides the sparse kernel design."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 1 << 20
+K = 32
+D = 8192
+LANE = 8192
+N_BLOCKS = N // LANE  # 128
+W = 64  # number of 128-wide windows
+
+
+def matvec_kernel(cols_ref, vals_ref, w_ref, out_ref):
+    """One row-block: margins[l] = sum_k vals[k,l] * w[cols[k,l]].
+
+    cols/vals: (K, LANE); w: (1, LANE); out: (1, LANE).
+    Gather via 64-window sweep: chunk lanes in 128s, for each window t
+    dynamic-gather from that 128-slice of w and select where hi == t.
+    """
+    def chunk_body(c, _):
+        idx = cols_ref[:, pl.ds(c * 128, 128)]          # (K, 128)
+        vals = vals_ref[:, pl.ds(c * 128, 128)]         # (K, 128)
+        lo = idx & 127
+        hi = idx >> 7
+
+        def win_body(t, g):
+            tab = jnp.broadcast_to(w_ref[0, pl.ds(t * 128, 128)], (K, 128))
+            cand = jnp.take_along_axis(tab, lo, axis=1)
+            return jnp.where(hi == t, cand, g)
+
+        g = jax.lax.fori_loop(0, W, win_body, jnp.zeros((K, 128), jnp.float32))
+        m = jnp.sum(vals * g, axis=0)                   # (128,)
+        out_ref[0, 0, pl.ds(c * 128, 128)] = m
+        return 0
+
+    jax.lax.fori_loop(0, W, chunk_body, 0)
+
+
+@jax.jit
+def pallas_matvec(w, cols_T, vals_T):
+    return pl.pallas_call(
+        matvec_kernel,
+        grid=(N_BLOCKS,),
+        out_shape=jax.ShapeDtypeStruct((N_BLOCKS, 1, LANE), jnp.float32),
+        in_specs=[
+            pl.BlockSpec((K, LANE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, LANE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, LANE), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, LANE), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(cols_T, vals_T, w.reshape(1, LANE))
+
+
+def main():
+    rng = np.random.default_rng(0)
+    cols = rng.integers(0, D, size=(N, K), dtype=np.int32)
+    vals = rng.normal(size=(N, K)).astype(np.float32)
+    # Transposed ELL: (K, N); lane = row.
+    cols_T = jax.device_put(jnp.asarray(cols.T.copy()))
+    vals_T = jax.device_put(jnp.asarray(vals.T.copy()))
+    w = jnp.asarray(rng.normal(size=D).astype(np.float32))
+
+    # Correctness on a small slice first (block 0).
+    out = pallas_matvec(w, cols_T, vals_T)
+    m0 = np.asarray(out[0, 0])
+    expect = (vals[:LANE] * np.asarray(w)[cols[:LANE]]).sum(1)
+    err = np.abs(m0 - expect).max()
+    print("correctness max err:", err)
+    assert err < 1e-3
+
+    # Timing: chain T iterations, prime with readback.
+    _ = np.asarray(out.ravel()[0:1])
+
+    @jax.jit
+    def chain(w, cols_T, vals_T, reps):
+        def body(i, w):
+            m = pallas_matvec_inner(w, cols_T, vals_T)
+            return w + 1e-20 * m[0, 0, :D]
+        return jax.lax.fori_loop(0, reps, body, w)
+
+    # inline pallas in the loop (avoid jit-in-jit weirdness)
+    def pallas_matvec_inner(w, cols_T, vals_T):
+        return pl.pallas_call(
+            matvec_kernel,
+            grid=(N_BLOCKS,),
+            out_shape=jax.ShapeDtypeStruct((N_BLOCKS, 1, LANE), jnp.float32),
+            in_specs=[
+                pl.BlockSpec((K, LANE), lambda i: (0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((K, LANE), lambda i: (0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, LANE), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, 1, LANE), lambda i: (i, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)),
+        )(cols_T, vals_T, w.reshape(1, LANE))
+
+    R = 10
+    out = chain(w, cols_T, vals_T, R)
+    _ = np.asarray(out.ravel()[0:1])
+    for rep in range(2):
+        wp = w + np.float32(0.001 * (rep + 1))
+        _ = np.asarray(wp.ravel()[0:1])
+        t0 = time.perf_counter()
+        out = chain(wp, cols_T, vals_T, R)
+        _ = np.asarray(out.ravel()[0:1])
+        dt = (time.perf_counter() - t0) / R
+        print(f"pallas ELL matvec: {dt*1e3:.2f} ms/pass  "
+              f"{N/dt/1e6:.1f} Mrows/s  {N*K/dt/1e9:.2f} Gnnz/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Measure the primitive costs of the sparse value+grad hot loop on TPU.
+
+Workload mirrors bench.py: N=1M rows, K=32 nnz/row, D=8192 features.
+Times each candidate building block with min-of-k; prints a table.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 20
+K = 32
+D = 8192
+NNZ = N * K
+
+
+def timeit(fn, *args, reps=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    rows_flat = np.repeat(np.arange(N, dtype=np.int32), K)
+    cols_flat = rng.integers(0, D, size=NNZ, dtype=np.int32)
+    vals_flat = rng.normal(size=NNZ).astype(np.float32)
+
+    cols2d = jnp.asarray(cols_flat.reshape(N, K))
+    vals2d = jnp.asarray(vals_flat.reshape(N, K))
+    rows_j = jnp.asarray(rows_flat)
+    cols_j = jnp.asarray(cols_flat)
+    vals_j = jnp.asarray(vals_flat)
+    w = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    d_vec = jnp.asarray(rng.normal(size=N).astype(np.float32))
+
+    # Col-sorted copy for the rmatvec side.
+    order = np.argsort(cols_flat, kind="stable")
+    cs_rows = jnp.asarray(rows_flat[order])
+    cs_cols = jnp.asarray(cols_flat[order])
+    cs_vals = jnp.asarray(vals_flat[order])
+
+    results = {}
+
+    @jax.jit
+    def gather_w(cols2d):
+        return jnp.take(w, cols2d)
+
+    results["gather w[cols2d] (33M from 8K)"] = timeit(gather_w, cols2d)
+
+    @jax.jit
+    def gather_d(rows):
+        return jnp.take(d_vec, rows)
+
+    results["gather d[rows_flat] (33M from 1M)"] = timeit(gather_d, rows_j)
+
+    @jax.jit
+    def ell_matvec(cols2d, vals2d, w):
+        return jnp.sum(vals2d * jnp.take(w, cols2d), axis=1)
+
+    results["ELL matvec (gather+reshape-sum)"] = timeit(
+        ell_matvec, cols2d, vals2d, w)
+
+    @jax.jit
+    def coo_matvec(rows, cols, vals, w):
+        contrib = vals * jnp.take(w, cols)
+        return jax.ops.segment_sum(contrib, rows, num_segments=N,
+                                   indices_are_sorted=True)
+
+    results["COO matvec (sorted segment_sum)"] = timeit(
+        coo_matvec, rows_j, cols_j, vals_j, w)
+
+    @jax.jit
+    def coo_rmatvec(rows, cols, vals, dv):
+        contrib = vals * jnp.take(dv, rows)
+        return jax.ops.segment_sum(contrib, cols, num_segments=D)
+
+    results["COO rmatvec (unsorted segsum)"] = timeit(
+        coo_rmatvec, rows_j, cols_j, vals_j, d_vec)
+
+    @jax.jit
+    def cs_rmatvec(rows, cols, vals, dv):
+        contrib = vals * jnp.take(dv, rows)
+        return jax.ops.segment_sum(contrib, cols, num_segments=D,
+                                   indices_are_sorted=True)
+
+    results["CS rmatvec (col-sorted segsum)"] = timeit(
+        cs_rmatvec, cs_rows, cs_cols, cs_vals, d_vec)
+
+    @jax.jit
+    def seg_only_rows(vals):
+        return jax.ops.segment_sum(vals, rows_j, num_segments=N,
+                                   indices_are_sorted=True)
+
+    results["segment_sum rows only (sorted)"] = timeit(seg_only_rows, vals_j)
+
+    @jax.jit
+    def reshape_sum(vals2d):
+        return jnp.sum(vals2d, axis=1)
+
+    results["reshape-sum rows only"] = timeit(reshape_sum, vals2d)
+
+    for name, t in results.items():
+        gnnz = NNZ / t / 1e9
+        print(f"{name:42s} {t*1e3:8.3f} ms   {gnnz:8.2f} Gnnz/s "
+              f"  {N/t/1e6:8.1f} Mrows/s-equiv")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""Primitive costs with dispatch latency amortized: each op runs R times
+inside one jitted fori_loop with a data dependency between iterations.
+All large arrays are jit ARGUMENTS (closure constants overflow the axon
+remote-compile transport)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 20
+K = 32
+D = 8192
+NNZ = N * K
+R = 20
+
+
+def timeit_chained(step, carry0, data, reps=3):
+    """step(carry, data) -> carry; jitted fori_loop of R steps."""
+
+    @jax.jit
+    def run(carry, data):
+        return jax.lax.fori_loop(
+            0, R, lambda i, c: step(c, data), carry)
+
+    out = run(carry0, data)
+    jax.block_until_ready(out)
+    times = []
+    for i in range(reps):
+        # Unique carry per rep: identical invocations get cached somewhere
+        # in the axon remote-execute path and return absurdly fast.
+        carry = jax.block_until_ready(
+            carry0 + jnp.asarray(1e-12 * (i + 1), carry0.dtype))
+        t0 = time.perf_counter()
+        out = run(carry, data)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return min(times) / R
+
+
+def main():
+    rng = np.random.default_rng(0)
+    rows_flat = np.repeat(np.arange(N, dtype=np.int32), K)
+    cols_flat = rng.integers(0, D, size=NNZ, dtype=np.int32)
+    vals_flat = rng.normal(size=NNZ).astype(np.float32)
+
+    cols2d = jax.device_put(jnp.asarray(cols_flat.reshape(N, K)))
+    vals2d = jax.device_put(jnp.asarray(vals_flat.reshape(N, K)))
+    rows_j = jax.device_put(jnp.asarray(rows_flat))
+    cols_j = jax.device_put(jnp.asarray(cols_flat))
+    vals_j = jax.device_put(jnp.asarray(vals_flat))
+    w0 = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    d0 = jnp.asarray(rng.normal(size=N).astype(np.float32))
+
+    order = np.argsort(cols_flat, kind="stable")
+    cs_rows = jax.device_put(jnp.asarray(rows_flat[order]))
+    cs_cols = jax.device_put(jnp.asarray(cols_flat[order]))
+    cs_vals = jax.device_put(jnp.asarray(vals_flat[order]))
+
+    results = {}
+
+    def ell_matvec_step(w, data):
+        cols2d, vals2d = data
+        m = jnp.sum(vals2d * jnp.take(w, cols2d), axis=1)
+        return w + 1e-20 * m[:D]
+
+    results["ELL matvec (gather+row-sum)"] = (
+        timeit_chained(ell_matvec_step, w0, (cols2d, vals2d)), NNZ)
+
+    def coo_matvec_step(w, data):
+        rows_j, cols_j, vals_j = data
+        contrib = vals_j * jnp.take(w, cols_j)
+        m = jax.ops.segment_sum(contrib, rows_j, num_segments=N,
+                                indices_are_sorted=True)
+        return w + 1e-20 * m[:D]
+
+    results["COO matvec (sorted segsum)"] = (
+        timeit_chained(coo_matvec_step, w0, (rows_j, cols_j, vals_j)), NNZ)
+
+    def coo_rmatvec_step(d, data):
+        rows_j, cols_j, vals_j = data
+        contrib = vals_j * jnp.take(d, rows_j)
+        g = jax.ops.segment_sum(contrib, cols_j, num_segments=D)
+        return d + 1e-20 * jnp.tile(g, N // D)
+
+    results["COO rmatvec (unsorted segsum)"] = (
+        timeit_chained(coo_rmatvec_step, d0, (rows_j, cols_j, vals_j)), NNZ)
+
+    def cs_rmatvec_step(d, data):
+        cs_rows, cs_cols, cs_vals = data
+        contrib = cs_vals * jnp.take(d, cs_rows)
+        g = jax.ops.segment_sum(contrib, cs_cols, num_segments=D,
+                                indices_are_sorted=True)
+        return d + 1e-20 * jnp.tile(g, N // D)
+
+    results["CS rmatvec (sorted segsum)"] = (
+        timeit_chained(cs_rmatvec_step, d0, (cs_rows, cs_cols, cs_vals)), NNZ)
+
+    def rowsum_step(d, data):
+        (vals2d,) = data
+        m = jnp.sum(vals2d * d[:, None], axis=1)
+        return d + 1e-20 * m
+
+    results["rowsum ref (read 33M f32)"] = (
+        timeit_chained(rowsum_step, d0, (vals2d,)), NNZ)
+
+    def gather_w_step(w, data):
+        (cols2d,) = data
+        g = jnp.take(w, cols2d)
+        return w + 1e-20 * g[:256].reshape(-1)
+
+    results["gather w only"] = (
+        timeit_chained(gather_w_step, w0, (cols2d,)), NNZ)
+
+    def gather_d_step(d, data):
+        (rows_j,) = data
+        g = jnp.take(d, rows_j)
+        return d + 1e-20 * g[:N]
+
+    results["gather d only (sorted idx)"] = (
+        timeit_chained(gather_d_step, d0, (rows_j,)), NNZ)
+
+    A = jax.device_put(
+        jnp.asarray(rng.normal(size=(D, D)), jnp.bfloat16))
+
+    def mm_step(B, data):
+        (A,) = data
+        return jnp.dot(A, B, preferred_element_type=jnp.float32).astype(
+            jnp.bfloat16)
+
+    results["bf16 8Kx8Kx8K matmul (1.1 TFLOP)"] = (
+        timeit_chained(mm_step, A, (A,)), 2 * D**3)
+
+    for name, (t, work) in results.items():
+        print(f"{name:38s} {t*1e3:8.3f} ms   {work/t/1e9:9.2f} Gop/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Per-op costs using bench.py's proven methodology: chain N_TIMED dependent
+calls at Python level, block once at the end. This matched round-1 numbers."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 20
+K = 32
+D = 8192
+NNZ = N * K
+T = 30
+
+
+def bench(fn, carry, args, label, work=NNZ):
+    carry = jax.device_put(carry)
+    out = fn(carry, *args)
+    jax.block_until_ready(out)
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    c = carry
+    for _ in range(T):
+        c = fn(c, *args)
+    jax.block_until_ready(c)
+    np.asarray(jax.tree.leaves(c)[0]).ravel()[:1]
+    dt = (time.perf_counter() - t0) / T
+    print(f"{label:44s} {dt*1e3:8.2f} ms  {work/dt/1e9:8.2f} Gnnz/s  "
+          f"{N/dt/1e6:7.1f} Mrows/s")
+    return dt
+
+
+def main():
+    rng = np.random.default_rng(0)
+    rows_flat = np.repeat(np.arange(N, dtype=np.int32), K)
+    cols_flat = rng.integers(0, D, size=NNZ, dtype=np.int32)
+    vals_flat = rng.normal(size=NNZ).astype(np.float32)
+
+    cols2d = jax.device_put(jnp.asarray(cols_flat.reshape(N, K)))
+    vals2d = jax.device_put(jnp.asarray(vals_flat.reshape(N, K)))
+    rows_j = jax.device_put(jnp.asarray(rows_flat))
+    cols_j = jax.device_put(jnp.asarray(cols_flat))
+    vals_j = jax.device_put(jnp.asarray(vals_flat))
+    w0 = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    d0 = jnp.asarray(rng.normal(size=N).astype(np.float32))
+
+    order = np.argsort(cols_flat, kind="stable")
+    cs_rows = jax.device_put(jnp.asarray(rows_flat[order]))
+    cs_cols = jax.device_put(jnp.asarray(cols_flat[order]))
+    cs_vals = jax.device_put(jnp.asarray(vals_flat[order]))
+
+    @jax.jit
+    def ell_matvec(w, cols2d, vals2d):
+        m = jnp.sum(vals2d * jnp.take(w, cols2d), axis=1)
+        return w + 1e-20 * m[:D]
+
+    bench(ell_matvec, w0, (cols2d, vals2d), "ELL matvec (take + row-sum)")
+
+    @jax.jit
+    def coo_matvec(w, rows_j, cols_j, vals_j):
+        contrib = vals_j * jnp.take(w, cols_j)
+        m = jax.ops.segment_sum(contrib, rows_j, num_segments=N,
+                                indices_are_sorted=True)
+        return w + 1e-20 * m[:D]
+
+    bench(coo_matvec, w0, (rows_j, cols_j, vals_j), "COO matvec")
+
+    @jax.jit
+    def coo_rmatvec(d, rows_j, cols_j, vals_j):
+        contrib = vals_j * jnp.take(d, rows_j)
+        g = jax.ops.segment_sum(contrib, cols_j, num_segments=D)
+        return d + 1e-20 * jnp.tile(g, N // D)
+
+    bench(coo_rmatvec, d0, (rows_j, cols_j, vals_j), "COO rmatvec (unsorted)")
+
+    @jax.jit
+    def cs_rmatvec(d, rows, cols, vals):
+        contrib = vals * jnp.take(d, rows)
+        g = jax.ops.segment_sum(contrib, cols, num_segments=D,
+                                indices_are_sorted=True)
+        return d + 1e-20 * jnp.tile(g, N // D)
+
+    bench(cs_rmatvec, d0, (cs_rows, cs_cols, cs_vals), "CS rmatvec (col-sorted)")
+
+    @jax.jit
+    def gather_w(w, cols2d):
+        g = jnp.take(w, cols2d)
+        return w + 1e-20 * jnp.sum(g[:8, :8])
+
+    bench(gather_w, w0, (cols2d,), "gather w[cols2d] only")
+
+    @jax.jit
+    def rowsum(d, vals2d):
+        m = jnp.sum(vals2d * d[:, None], axis=1)
+        return d + 1e-20 * m
+
+    bench(rowsum, d0, (vals2d,), "rowsum ref (134MB read)")
+
+    # ELL with one-hot bf16 matmul for the gather: m = sum_k OH_k @ w
+    @jax.jit
+    def onehot_matvec(w, cols2d, vals2d):
+        wb = w.astype(jnp.bfloat16)
+        m = jnp.zeros((N,), jnp.float32)
+        iota = jnp.arange(D, dtype=jnp.int32)
+        for k in range(0, K, 8):
+            oh = (cols2d[:, k:k+8, None] == iota).astype(jnp.bfloat16)
+            mk = jax.lax.dot_general(
+                oh, wb, (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m = m + jnp.sum(vals2d[:, k:k+8] * mk, axis=1)
+        return w + 1e-20 * m[:D]
+
+    # (likely slow: materializes one-hot; measuring to confirm)
+    # bench(onehot_matvec, w0, (cols2d, vals2d), "one-hot bf16 matvec")
+
+
+if __name__ == "__main__":
+    main()

@@ -62,12 +62,19 @@ def make_glm_data(
     pad_rows: int | None = None,
     pad_nnz: int | None = None,
     dtype=jnp.float32,
+    use_pallas: bool | str = "auto",
 ) -> GlmData:
     """Build a GlmData shard from host data.
 
     ``features`` may be a numpy 2-D array (→ DenseMatrix) or a scipy sparse
-    matrix (→ SparseMatrix).  ``pad_rows`` pads the row dimension with
-    zero-weight rows up to a static budget.
+    matrix (→ SparseMatrix / PallasSparseMatrix).  ``pad_rows`` pads the row
+    dimension with zero-weight rows up to a static budget.
+
+    ``use_pallas`` selects the tiled Pallas layout for sparse features
+    (ops/sparse_pallas.py): ``"auto"`` uses it on TPU when the matrix is
+    large enough for the kernels to win (the tiled layout costs host build
+    time and ~3x slot memory, and pays off via ~70x faster value+grad);
+    ``True``/``False`` force it.
     """
     import scipy.sparse as sp
 
@@ -93,7 +100,21 @@ def make_glm_data(
             features = sp.vstack(
                 [features.tocsr(), sp.csr_matrix((pad, features.shape[1]))]
             )
-        fm: FeatureMatrix = from_scipy_csr(features, pad_nnz=pad_nnz, dtype=dtype)
+        if use_pallas == "auto":
+            from photon_ml_tpu.ops.sparse_pallas import pallas_available
+
+            use_pallas = (
+                pallas_available()
+                and features.shape[0] >= 65536
+                and features.nnz >= 1 << 20
+            )
+        if use_pallas:
+            from photon_ml_tpu.ops.sparse_pallas import from_scipy_csr_pallas
+
+            fm: FeatureMatrix = from_scipy_csr_pallas(
+                features, pad_nnz=pad_nnz, dtype=dtype)
+        else:
+            fm = from_scipy_csr(features, pad_nnz=pad_nnz, dtype=dtype)
     else:
         dense = np.asarray(features)
         if pad:

@@ -155,10 +155,13 @@ def owlqn_solve(
             # the trial point is orthant-projected, so the realized step is
             # w - s.w, not t*direction; using <pg, w - s.w> keeps the
             # sufficient-decrease threshold correctly scaled when the
-            # projection clamps coordinates.
+            # projection clamps coordinates.  The inequality is non-strict:
+            # a fully-clamped trial (w == s.w, dg_proj == 0) must keep
+            # backtracking — a smaller t clamps fewer coordinates — rather
+            # than be accepted as a zero step.
             dg_proj = jnp.vdot(pg, w - s.w)
             return jnp.logical_and(
-                value > s.value + config.armijo_c1 * dg_proj,
+                value >= s.value + config.armijo_c1 * dg_proj,
                 n < config.max_line_search_evals,
             )
 
