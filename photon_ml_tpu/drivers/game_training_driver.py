@@ -35,10 +35,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from photon_ml_tpu.data.game_reader import read_game_avro
-from photon_ml_tpu.evaluation.evaluators import (
-    default_evaluator_for_task,
-    get_evaluator,
-)
+from photon_ml_tpu.evaluation.suite import EvaluationSuite
 from photon_ml_tpu.game.estimator import (
     FixedEffectCoordinateConfig,
     GameEstimator,
@@ -55,6 +52,31 @@ from photon_ml_tpu.optim.regularization import RegularizationContext, Regulariza
 from photon_ml_tpu.ops import losses as losses_lib
 from photon_ml_tpu.utils.logging import PhotonLogger
 from photon_ml_tpu.utils.timer import Timer
+
+
+def expand_config_grid(coordinate_specs: Sequence[dict]) -> list[dict]:
+    """Expand the JSON coordinate list into the coordinate-config GRID the
+    reference's GameEstimator fits (SURVEY.md §3.2 "for each
+    coordinate-config combination"): a spec may give ``reg_weights`` (a list)
+    instead of scalar ``reg_weight``; the grid is the cross product of every
+    coordinate's variants.  Returns a list of name→config mappings."""
+    import dataclasses as _dc
+    import itertools
+
+    per_coord = []
+    for spec in coordinate_specs:
+        name, base = parse_coordinate_config(spec)
+        weights = spec.get("reg_weights")
+        variants = (
+            [_dc.replace(base, reg_weight=float(w)) for w in weights]
+            if weights
+            else [base]
+        )
+        per_coord.append((name, variants))
+    return [
+        {name: cfg for (name, _), cfg in zip(per_coord, combo)}
+        for combo in itertools.product(*[v for _, v in per_coord])
+    ]
 
 
 def parse_coordinate_config(spec: dict):
@@ -109,14 +131,17 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     with open(args.config) as f:
         config = json.load(f)
     task = config.get("task", "logistic")
-    coordinate_configs = dict(
-        parse_coordinate_config(spec) for spec in config["coordinates"]
-    )
-    evaluator = (
-        get_evaluator(config["evaluator"])
-        if "evaluator" in config
-        else default_evaluator_for_task(losses_lib.get(task).name)
-    )
+    config_grid = expand_config_grid(config["coordinates"])
+    coordinate_configs = config_grid[0]
+    # Evaluation suite (reference: EvaluationSuite / MultiEvaluator — a LIST
+    # of evaluators per run, the first driving model selection).
+    if "evaluators" in config:
+        suite = EvaluationSuite.from_specs(config["evaluators"])
+    elif "evaluator" in config:
+        suite = EvaluationSuite.from_specs([config["evaluator"]])
+    else:
+        suite = EvaluationSuite.for_task(losses_lib.get(task).name)
+    evaluator = suite.primary_evaluator
 
     shards, ids, response, weight, offset, _, index_maps = read_game_avro(
         args.train_data
@@ -189,6 +214,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             nm: _dc.replace(coordinate_configs[nm], reg_weight=float(xi))
             for nm, xi in zip(names, found.best_params)
         }
+        config_grid = [coordinate_configs]  # tuning supersedes any grid
         result["tuning"] = {
             "best_reg_weights": dict(zip(names, map(float, found.best_params))),
             "best_metric": found.best_value,
@@ -196,14 +222,49 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         }
         logger.info("tuning selected %s", result["tuning"]["best_reg_weights"])
 
+    val_tuple = None
+    if validation is not None:
+        v_shards, v_ids, v_resp, v_weight, v_offset, _, _ = validation
+        val_tuple = (v_shards, v_ids, v_resp, v_weight, v_offset)
+
     estimator = GameEstimator(
         task, coordinate_configs, n_iterations=n_cd_iterations, logger=logger
     )
-    model, history = estimator.fit(
-        shards, ids, response, weight=weight, offset=offset, evaluator=evaluator
-    )
+    if len(config_grid) > 1:
+        # Config-grid fit with validation-driven selection (SURVEY.md §3.2).
+        model, grid_results = estimator.fit_grid(
+            config_grid, shards, ids, response, weight=weight, offset=offset,
+            validation=val_tuple, suite=suite,
+        )
+        best = next(r for r in grid_results if r["best"])
+        history = best["history"]
+        result["grid"] = [
+            {
+                "grid_index": r["grid_index"],
+                "reg_weights": {
+                    nm: cfg.reg_weight for nm, cfg in r["configs"].items()
+                },
+                "metric": r["metric"],
+                "selected_by": r["selected_by"],
+                "best": r["best"],
+            }
+            for r in grid_results
+        ]
+        logger.info(
+            "config grid: %d points, best index %d (%s = %s)",
+            len(grid_results), best["grid_index"], best["selected_by"],
+            best["metric"],
+        )
+    else:
+        model, history = estimator.fit(
+            shards, ids, response, weight=weight, offset=offset,
+            validation=val_tuple, suite=suite,
+        )
     result["history"] = history
     result["train_metric"] = history[-1].get("train_metric") if history else None
+    if history and "validation" in history[-1]:
+        result["per_iteration_validation"] = True
+        result["validation_suite"] = history[-1]["validation"]
 
     if validation is not None:
         v_shards, v_ids, v_resp, v_weight, v_offset, _, _ = validation

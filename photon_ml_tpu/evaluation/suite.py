@@ -1,0 +1,92 @@
+"""Evaluation suites: many metrics per validation pass, one for selection.
+
+The analogue of the reference's ``EvaluationSuite`` / ``MultiEvaluator``
+(SURVEY.md §2, Evaluation): the reference's drivers take a LIST of evaluator
+specs, evaluate all of them per coordinate-descent iteration and per
+config-grid point, and select the best model by the FIRST evaluator in the
+list.  Here a suite is an ordered name→``Evaluator`` mapping with a
+designated primary metric that drives model selection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from photon_ml_tpu.evaluation.evaluators import (
+    Evaluator,
+    default_evaluator_for_task,
+    get_evaluator,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EvaluationSuite:
+    """Ordered collection of named evaluators; ``primary`` drives selection
+    (the reference selects by the first configured evaluator)."""
+
+    evaluators: tuple  # tuple[(name, Evaluator), ...] — ordered
+    primary: str
+
+    def __post_init__(self):
+        names = [n for n, _ in self.evaluators]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate evaluator names: {names}")
+        if self.primary not in names:
+            raise ValueError(
+                f"primary {self.primary!r} not among evaluators {names}"
+            )
+
+    @classmethod
+    def from_specs(
+        cls,
+        specs: Sequence[Union[str, Evaluator]],
+        primary: Optional[str] = None,
+    ) -> "EvaluationSuite":
+        """Build from spec strings (``"auc"``, ``"precision@5"``, ...) or
+        ``Evaluator`` instances; primary defaults to the first, as the
+        reference's driver does with its evaluator list."""
+        pairs = []
+        for spec in specs:
+            if isinstance(spec, Evaluator):
+                pairs.append((type(spec).__name__, spec))
+            else:
+                pairs.append((str(spec).strip().lower(), get_evaluator(spec)))
+        if not pairs:
+            raise ValueError("EvaluationSuite requires at least one evaluator")
+        return cls(
+            evaluators=tuple(pairs),
+            primary=primary if primary is not None else pairs[0][0],
+        )
+
+    @classmethod
+    def for_task(cls, task: str) -> "EvaluationSuite":
+        ev = default_evaluator_for_task(task)
+        return cls(evaluators=((type(ev).__name__, ev),), primary=type(ev).__name__)
+
+    @property
+    def primary_evaluator(self) -> Evaluator:
+        return dict(self.evaluators)[self.primary]
+
+    def evaluate(
+        self,
+        scores: np.ndarray,
+        labels: np.ndarray,
+        weights: Optional[np.ndarray] = None,
+        group_ids: Optional[np.ndarray] = None,
+    ) -> dict:
+        """name → metric value, every evaluator on one score pass."""
+        return {
+            name: ev.evaluate(scores, labels, weights, group_ids)
+            for name, ev in self.evaluators
+        }
+
+    def better_than(self, a: Optional[float], b: Optional[float]) -> bool:
+        """Compare two PRIMARY metric values; None/NaN always loses."""
+        if a is None or np.isnan(a):
+            return False
+        if b is None or np.isnan(b):
+            return True
+        return self.primary_evaluator.better_than(a, b)

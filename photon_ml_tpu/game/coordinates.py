@@ -22,6 +22,7 @@ mirroring the reference's ``Coordinate.trainModel`` / ``score``.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
@@ -57,6 +58,11 @@ class Coordinate:
 
     def finalize(self, state):
         """Turn device state into the host-side model object."""
+        raise NotImplementedError
+
+    def make_validation_scorer(self, shards: dict, ids: dict):
+        """Build a reusable validation scorer for this coordinate (see
+        game/validation.py) from raw validation columns."""
         raise NotImplementedError
 
 
@@ -121,14 +127,22 @@ class FixedEffectCoordinate(Coordinate):
             self.feature_shard,
         )
 
+    def make_validation_scorer(self, shards: dict, ids: dict):
+        from photon_ml_tpu.game.validation import FixedEffectValidationScorer
 
+        return FixedEffectValidationScorer(shards[self.feature_shard])
+
+
+@functools.lru_cache(maxsize=None)
 def _make_block_solver(task: str, config: GlmOptimizationConfig):
     """Build a jitted (block, offsets, w0, l1, l2) → (E, D) batched solver.
 
     Optimizer dispatch matches GlmOptimizationProblem.solve: any L1
     component (static on the regularization TYPE) routes to OWL-QN; else the
     configured smooth optimizer (L-BFGS or TRON) runs.  l1/l2 are traced
-    scalars so tuning sweeps don't recompile.
+    scalars so tuning sweeps don't recompile.  Memoized on (task, config) —
+    both hashable — so every coordinate/grid point with the same optimizer
+    setup shares ONE jit cache (one compile per block shape process-wide).
     """
     from photon_ml_tpu.optim.tron import TRONConfig, tron_solve
 
@@ -282,4 +296,11 @@ class RandomEffectCoordinate(Coordinate):
             entity_key=self.entity_key,
             task=self.task,
             n_features=self.dataset.n_features,
+        )
+
+    def make_validation_scorer(self, shards: dict, ids: dict):
+        from photon_ml_tpu.game.validation import RandomEffectValidationScorer
+
+        return RandomEffectValidationScorer(
+            self.dataset, ids[self.entity_key], shards[self.feature_shard]
         )

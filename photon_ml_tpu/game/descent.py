@@ -45,12 +45,14 @@ class CoordinateDescent:
         self,
         base_offsets: Array,
         n_iterations: int = 1,
-        eval_fn: Optional[Callable[[int, str, dict], dict]] = None,
+        eval_fn: Optional[Callable[[int, str, dict, dict], dict]] = None,
         logger=None,
     ) -> CoordinateDescentResult:
-        """``eval_fn(iteration, coordinate_name, scores_by_coordinate)`` is
-        called after each coordinate update (the reference evaluates its
-        validation suite there); its dict return is recorded in history."""
+        """``eval_fn(iteration, coordinate_name, scores_by_coordinate,
+        states_by_coordinate)`` is called after each coordinate update (the
+        reference evaluates its validation suite there — states let it score
+        a validation set against the freshly-updated coordinate); its dict
+        return is recorded in history."""
         base_offsets = jnp.asarray(base_offsets, jnp.float32)
         scores: dict[str, Array] = {
             c.name: jnp.zeros_like(base_offsets) for c in self.coordinates
@@ -74,7 +76,7 @@ class CoordinateDescent:
                     "score_norm": float(jnp.linalg.norm(new_score)),
                 }
                 if eval_fn is not None:
-                    entry.update(eval_fn(it, coord.name, scores))
+                    entry.update(eval_fn(it, coord.name, scores, states))
                 history.append(entry)
                 if logger is not None:
                     logger.info(

@@ -22,10 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.dataset import make_glm_data
-from photon_ml_tpu.evaluation.evaluators import (
-    Evaluator,
-    default_evaluator_for_task,
-)
+from photon_ml_tpu.evaluation.evaluators import Evaluator
 from photon_ml_tpu.game.coordinates import (
     FixedEffectCoordinate,
     RandomEffectCoordinate,
@@ -94,36 +91,68 @@ class GameEstimator:
         """Build per-coordinate datasets + coordinate objects once.  Tuning
         loops reuse them across evaluations (mutating ``coord.reg_weight``,
         a traced argument — no recompilation, no dataset rebuild)."""
-        return self._build_coordinates(shards, ids, response, weight, offset)
+        return self._build_coordinates(
+            self.coordinate_configs, shards, ids, response, weight, offset
+        )
 
-    def _build_coordinates(self, shards, ids, response, weight, offset):
+    @staticmethod
+    def dataset_key(cfg: "CoordinateConfig") -> tuple:
+        """Cache key identifying the DATASET a config needs — grid points
+        differing only in optimizer/regularization share built datasets (the
+        reference builds per-coordinate datasets once, outside the config
+        grid — SURVEY.md §3.2)."""
+        if isinstance(cfg, FixedEffectCoordinateConfig):
+            return ("fixed", cfg.feature_shard, cfg.down_sampling_rate)
+        return (
+            "random",
+            cfg.feature_shard,
+            cfg.entity_key,
+            cfg.max_rows_per_entity,
+        )
+
+    def _build_coordinates(
+        self,
+        coordinate_configs,
+        shards,
+        ids,
+        response,
+        weight,
+        offset,
+        dataset_cache: Optional[dict] = None,
+    ):
         n = len(response)
         weight = np.ones(n, np.float32) if weight is None else np.asarray(weight, np.float32)
+        cache = {} if dataset_cache is None else dataset_cache
         coordinates = []
-        for name, cfg in self.coordinate_configs.items():
+        for name, cfg in coordinate_configs.items():
             shard = shards[cfg.feature_shard]
+            key = self.dataset_key(cfg)
             if isinstance(cfg, FixedEffectCoordinateConfig):
-                train_weight = weight
-                if cfg.down_sampling_rate < 1.0:
-                    from photon_ml_tpu.data.sampling import (
-                        BinaryClassificationDownSampler,
-                        DefaultDownSampler,
-                    )
+                dataset = cache.get(key)
+                if dataset is None:
+                    train_weight = weight
+                    if cfg.down_sampling_rate < 1.0:
+                        from photon_ml_tpu.data.sampling import (
+                            BinaryClassificationDownSampler,
+                            DefaultDownSampler,
+                        )
 
-                    binary = self.task in ("logistic", "smoothed_hinge")
-                    sampler = (
-                        BinaryClassificationDownSampler(cfg.down_sampling_rate)
-                        if binary
-                        else DefaultDownSampler(cfg.down_sampling_rate)
-                    )
-                    idx, w_kept = sampler.downsample(response, weight)
-                    train_weight = np.zeros(n, np.float32)
-                    train_weight[idx] = w_kept
-                data = make_glm_data(shard, response, weights=train_weight)
+                        binary = self.task in ("logistic", "smoothed_hinge")
+                        sampler = (
+                            BinaryClassificationDownSampler(cfg.down_sampling_rate)
+                            if binary
+                            else DefaultDownSampler(cfg.down_sampling_rate)
+                        )
+                        idx, w_kept = sampler.downsample(response, weight)
+                        train_weight = np.zeros(n, np.float32)
+                        train_weight[idx] = w_kept
+                    data = make_glm_data(shard, response, weights=train_weight)
+                    dataset = FixedEffectDataset(data=data, n_global_rows=n)
+                    cache[key] = dataset
                 coordinates.append(
                     FixedEffectCoordinate(
                         name,
-                        FixedEffectDataset(data=data, n_global_rows=n),
+                        dataset,
                         self.task,
                         cfg.optimization,
                         cfg.reg_weight,
@@ -131,13 +160,16 @@ class GameEstimator:
                     )
                 )
             else:
-                dataset = build_random_effect_dataset(
-                    ids[cfg.entity_key],
-                    shard,
-                    np.asarray(response, np.float32),
-                    weight,
-                    max_rows_per_entity=cfg.max_rows_per_entity,
-                )
+                dataset = cache.get(key)
+                if dataset is None:
+                    dataset = build_random_effect_dataset(
+                        ids[cfg.entity_key],
+                        shard,
+                        np.asarray(response, np.float32),
+                        weight,
+                        max_rows_per_entity=cfg.max_rows_per_entity,
+                    )
+                    cache[key] = dataset
                 coordinates.append(
                     RandomEffectCoordinate(
                         name,
@@ -159,14 +191,22 @@ class GameEstimator:
         weight: Optional[np.ndarray] = None,
         offset: Optional[np.ndarray] = None,
         evaluator: Optional[Evaluator] = None,
+        validation=None,
+        suite=None,
     ) -> tuple[GameModel, list]:
         """Train; returns (model, per-coordinate-update history).
 
-        History entries include the training-set metric after each
-        coordinate update (the reference logs its validation suite there;
-        validation metrics here come from scoring with GameTransformer)."""
-        coordinates = self._build_coordinates(shards, ids, response, weight, offset)
-        return self.fit_coordinates(coordinates, response, weight, offset, evaluator)
+        ``validation`` is ``(shards, ids, response[, weight[, offset]])``;
+        with it, every history entry carries the full validation
+        ``EvaluationSuite`` after that coordinate update (the reference's
+        per-iteration validation tracking — SURVEY.md §3.2)."""
+        coordinates = self._build_coordinates(
+            self.coordinate_configs, shards, ids, response, weight, offset
+        )
+        return self.fit_coordinates(
+            coordinates, response, weight, offset, evaluator,
+            validation=validation, suite=suite,
+        )
 
     def fit_coordinates(
         self,
@@ -175,25 +215,78 @@ class GameEstimator:
         weight=None,
         offset=None,
         evaluator: Optional[Evaluator] = None,
+        validation=None,
+        suite=None,
+        validation_scorers: Optional[dict] = None,
     ) -> tuple[GameModel, list]:
         """Run coordinate descent over pre-built coordinates (see
-        :meth:`build_coordinates`) and finalize the GameModel."""
+        :meth:`build_coordinates`) and finalize the GameModel.
+
+        ``validation_scorers`` (name → scorer, see game/validation.py) lets
+        grid/tuning loops reuse scorers built once per shared dataset."""
+        from photon_ml_tpu.evaluation.suite import EvaluationSuite
+
         n = len(response)
         response = np.asarray(response, np.float32)
         base_offsets = (
             np.zeros(n, np.float32) if offset is None else np.asarray(offset, np.float32)
         )
-        evaluator = evaluator or default_evaluator_for_task(self.task)
+        if suite is None:
+            suite = (
+                EvaluationSuite.from_specs([evaluator])
+                if evaluator is not None
+                else EvaluationSuite.for_task(self.task)
+            )
+        primary = suite.primary_evaluator
         w_host = None if weight is None else np.asarray(weight, np.float32)
 
-        def eval_fn(it, cname, scores):
+        val_ctx = None
+        if validation is not None:
+            v_shards, v_ids, v_resp = validation[0], validation[1], validation[2]
+            v_weight = validation[3] if len(validation) > 3 else None
+            v_offset = validation[4] if len(validation) > 4 else None
+            scorers = validation_scorers or {
+                c.name: c.make_validation_scorer(v_shards, v_ids)
+                for c in coordinates
+            }
+            n_val = len(v_resp)
+            val_ctx = {
+                "scorers": scorers,
+                "resp": np.asarray(v_resp, np.float32),
+                "weight": None if v_weight is None else np.asarray(v_weight, np.float32),
+                "base": (
+                    np.zeros(n_val, np.float32)
+                    if v_offset is None
+                    else np.asarray(v_offset, np.float32)
+                ),
+                # Per-coordinate validation scores, refreshed incrementally:
+                # only the just-updated coordinate re-scores each step.
+                "scores": {
+                    c.name: np.zeros(n_val, np.float32) for c in coordinates
+                },
+            }
+
+        def eval_fn(it, cname, scores, states):
             total = base_offsets + np.sum(
                 [np.asarray(s) for s in scores.values()], axis=0
             )
-            return {
-                "train_metric": evaluator.evaluate(total, response, w_host),
-                "evaluator": type(evaluator).__name__,
+            entry = {
+                "train_metric": primary.evaluate(total, response, w_host),
+                "evaluator": type(primary).__name__,
             }
+            if val_ctx is not None:
+                val_ctx["scores"][cname] = np.asarray(
+                    val_ctx["scorers"][cname].score(states[cname])
+                )
+                v_total = val_ctx["base"] + np.sum(
+                    list(val_ctx["scores"].values()), axis=0
+                )
+                metrics = suite.evaluate(
+                    v_total, val_ctx["resp"], val_ctx["weight"]
+                )
+                entry["validation"] = metrics
+                entry["validation_metric"] = metrics[suite.primary]
+            return entry
 
         cd = CoordinateDescent(coordinates)
         result = cd.run(
@@ -206,6 +299,91 @@ class GameEstimator:
             c.name: c.finalize(result.states[c.name]) for c in coordinates
         }
         return GameModel(models=models, task=self.task), result.history
+
+    def fit_grid(
+        self,
+        grid_configs: Sequence[dict],
+        shards: dict,
+        ids: dict,
+        response: np.ndarray,
+        weight: Optional[np.ndarray] = None,
+        offset: Optional[np.ndarray] = None,
+        validation=None,
+        suite=None,
+    ) -> tuple[GameModel, list[dict]]:
+        """Fit EVERY coordinate-config combination, select best (SURVEY.md
+        §3.2: "for each coordinate-config combination ... select best model
+        by validation metric").
+
+        ``grid_configs`` is a list of name→config mappings (one grid point
+        each, same coordinate names).  Datasets and validation scorers are
+        built once per distinct :meth:`dataset_key` and shared across
+        points.  Selection: final validation primary metric when
+        ``validation`` is given, else final train metric.  Returns
+        ``(best_model, point_results)`` where each point result dict carries
+        ``configs / model / history / metric``.
+        """
+        from photon_ml_tpu.evaluation.suite import EvaluationSuite
+
+        if not grid_configs:
+            raise ValueError("empty coordinate-config grid")
+        if suite is None:
+            suite = EvaluationSuite.for_task(self.task)
+        dataset_cache: dict = {}
+        scorer_cache: dict = {}
+        results: list[dict] = []
+        best_idx, best_metric = None, None
+        for gi, configs in enumerate(grid_configs):
+            coordinates = self._build_coordinates(
+                configs, shards, ids, response, weight, offset,
+                dataset_cache=dataset_cache,
+            )
+            scorers = None
+            if validation is not None:
+                scorers = {}
+                for name, cfg in configs.items():
+                    # Fixed-effect scorers depend only on the feature shard
+                    # (not on down-sampling, which is train-side only).
+                    key = (
+                        ("fixed_scorer", cfg.feature_shard)
+                        if isinstance(cfg, FixedEffectCoordinateConfig)
+                        else self.dataset_key(cfg)
+                    )
+                    if key not in scorer_cache:
+                        coord = next(c for c in coordinates if c.name == name)
+                        scorer_cache[key] = coord.make_validation_scorer(
+                            validation[0], validation[1]
+                        )
+                    scorers[name] = scorer_cache[key]
+            model, history = self.fit_coordinates(
+                coordinates, response, weight, offset,
+                validation=validation, suite=suite,
+                validation_scorers=scorers,
+            )
+            metric_key = (
+                "validation_metric" if validation is not None else "train_metric"
+            )
+            metric = history[-1].get(metric_key) if history else None
+            results.append(
+                {
+                    "grid_index": gi,
+                    "configs": configs,
+                    "model": model,
+                    "history": history,
+                    "metric": metric,
+                    "selected_by": metric_key,
+                }
+            )
+            if best_idx is None or suite.better_than(metric, best_metric):
+                best_idx, best_metric = gi, metric
+            if self.logger is not None:
+                self.logger.info(
+                    "grid point %d/%d: %s = %s",
+                    gi + 1, len(grid_configs), metric_key, metric,
+                )
+        for r in results:
+            r["best"] = r["grid_index"] == best_idx
+        return results[best_idx]["model"], results
 
 
 class GameTransformer:

@@ -1,0 +1,154 @@
+"""Per-coordinate validation scoring for coordinate descent.
+
+The reference's ``CoordinateDescent`` evaluates its validation
+``EvaluationSuite`` after every coordinate update (SURVEY.md §2
+CoordinateDescent, §3.2 loop).  Doing that cheaply requires scoring the
+validation set against a coordinate's CURRENT device state without
+finalizing a host-side model each step.  These scorers are built ONCE per
+(training dataset, validation data) pair:
+
+- ``FixedEffectValidationScorer`` — the validation shard as device
+  ``GlmData``; one matvec per evaluation.
+- ``RandomEffectValidationScorer`` — the validation rows grouped into entity
+  blocks once, plus a host-precomputed STATIC gather map from every
+  (validation lane, local column) into a flattened view of the training
+  state (the per-bucket ``(E, D)`` coefficient arrays).  Each evaluation is
+  then pure device work: flatten state → one ``take`` per validation block →
+  batched einsum → scatter-add into the validation row space.  Entities
+  unseen at training time (and column misses outside a training entity's
+  active subspace) gather from a zero slot, so they score 0 exactly like the
+  reference's projector-based scoring of unseen entities/features.
+
+Both scorers are reused verbatim across a config grid when grid points share
+the underlying training dataset (the gather map depends only on the training
+dataset's entity layout, not on the coefficients).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.data import (
+    RandomEffectDataset,
+    build_random_effect_dataset,
+)
+
+Array = jax.Array
+
+
+class FixedEffectValidationScorer:
+    """score(w) = X_val @ w on device; built once per validation shard.
+
+    Holds ONLY the feature matrix (scoring never reads labels/weights, and
+    only the matvec orientation is needed — no Pallas dual-orientation
+    layout, no dummy row arrays)."""
+
+    def __init__(self, val_shard):
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.ops.sparse import DenseMatrix, from_scipy_csr
+
+        self.n_rows = val_shard.shape[0]
+        if sp.issparse(val_shard):
+            self._features = from_scipy_csr(sp.csr_matrix(val_shard))
+        else:
+            self._features = DenseMatrix(
+                jnp.asarray(np.asarray(val_shard), jnp.float32)
+            )
+        self._matvec = jax.jit(lambda f, w: f.matvec(w))
+
+    def score(self, state: Array) -> Array:
+        return self._matvec(self._features, state)
+
+
+def _flat_layout(state_shapes: Sequence[tuple[int, int]]):
+    """Bucket (E, D) shapes → per-bucket offsets into the flattened state."""
+    sizes = [e * d for e, d in state_shapes]
+    offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+    return offsets, int(offsets[-1])
+
+
+class RandomEffectValidationScorer:
+    """Static-gather scoring of validation rows against training RE state.
+
+    ``train_dataset`` fixes the entity→(bucket, lane) layout and per-lane
+    column maps; ``entity_col``/``val_shard`` are the validation rows.  The
+    expensive grouping + gather-map construction happens here, once.
+    """
+
+    def __init__(
+        self,
+        train_dataset: RandomEffectDataset,
+        entity_col,
+        val_shard,
+    ):
+        n_val = val_shard.shape[0]
+        self.n_rows = n_val
+        # Group validation rows by entity (no active-set cap: scoring covers
+        # every row).  Labels/weights are irrelevant for scoring.
+        val_ds = build_random_effect_dataset(
+            entity_col,
+            val_shard,
+            np.zeros(n_val, np.float32),
+            np.ones(n_val, np.float32),
+        )
+        state_shapes = [
+            (b.n_entities, b.block_dim) for b in train_dataset.blocks
+        ]
+        offsets, total = _flat_layout(state_shapes)
+        self._miss = total  # index of the appended zero slot
+
+        # Host copies of the training col maps (device→host once).
+        train_cmaps = [np.asarray(b.col_map) for b in train_dataset.blocks]
+
+        gather_idxs = []
+        for vb, vids in zip(val_ds.blocks, val_ds.entity_ids):
+            vcmap = np.asarray(vb.col_map)  # (E_v, D_v) global cols, -1 pad
+            gidx = np.full(vcmap.shape, self._miss, np.int64)
+            for lane, key in enumerate(vids):
+                slot = train_dataset.entity_to_slot.get(key)
+                if slot is None:
+                    continue  # unseen entity → zero slot → score 0
+                tb, tl = slot
+                tcmap = train_cmaps[tb][tl]  # sorted active cols then -1 pad
+                n_active = int(np.sum(tcmap >= 0))
+                active = tcmap[:n_active]
+                cm = vcmap[lane]
+                pos = np.searchsorted(active, cm)
+                pos_c = np.minimum(pos, max(n_active - 1, 0))
+                hit = (
+                    (cm >= 0)
+                    & (pos < n_active)
+                    & (n_active > 0)
+                )
+                hit &= np.where(hit, active[pos_c] == cm, False)
+                D_t = state_shapes[tb][1]
+                gidx[lane, hit] = (
+                    offsets[tb] + tl * D_t + pos_c[hit]
+                ).astype(np.int64)
+            gather_idxs.append(jnp.asarray(gidx))
+
+        self._val_blocks = val_ds.blocks
+        self._gather_idxs = gather_idxs
+
+        def _score(state, blocks, gidxs):
+            flat = jnp.concatenate(
+                [s.ravel() for s in state] + [jnp.zeros((1,), jnp.float32)]
+            )
+            total_scores = jnp.zeros((n_val + 1,), jnp.float32)
+            for vb, gidx in zip(blocks, gidxs):
+                coefs = jnp.take(flat, gidx, axis=0)  # (E_v, D_v)
+                s = jnp.einsum("erd,ed->er", vb.X, coefs)
+                total_scores = total_scores.at[vb.row_index.ravel()].add(
+                    s.ravel()
+                )
+            return total_scores[:n_val]
+
+        self._score_jit = jax.jit(_score)
+
+    def score(self, state: list[Array]) -> Array:
+        return self._score_jit(state, self._val_blocks, self._gather_idxs)

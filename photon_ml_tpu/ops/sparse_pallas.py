@@ -108,6 +108,13 @@ def _build_orientation(
     order = np.lexsort((lane, gwin, tile))
     t_s, g_s, l_s = tile[order], gwin[order], lane[order]
     cell = (t_s * WINS + g_s) * WIN + l_s
+    if len(cell) == 0:  # all-zero / empty matrix: one empty depth level
+        return (
+            np.zeros((nbr, nbc, WINS, WIN), np.int16),
+            np.zeros((nbr, nbc, WINS, WIN), np.float32),
+            np.empty(0, np.intp),
+            1,
+        )
     # run-length position within equal consecutive cells
     change = np.empty(len(cell), dtype=bool)
     change[0] = True

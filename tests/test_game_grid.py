@@ -1,0 +1,266 @@
+"""Config-grid fitting, per-iteration validation, and evaluation suites.
+
+Mirrors the reference's GameEstimator behavior (SURVEY.md §3.2): fit every
+coordinate-config combination, track a validation EvaluationSuite after
+every coordinate update, select the best model by the primary validation
+metric.
+"""
+
+import json
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.evaluation.evaluators import (
+    AreaUnderROCCurveEvaluator,
+    LogisticLossEvaluator,
+)
+from photon_ml_tpu.evaluation.suite import EvaluationSuite
+from photon_ml_tpu.game.estimator import (
+    FixedEffectCoordinateConfig,
+    GameEstimator,
+    GameTransformer,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.optim.problem import (
+    GlmOptimizationConfig,
+    OptimizerConfig,
+)
+from photon_ml_tpu.optim.regularization import RegularizationContext
+
+
+def _synthetic_game(rng, n_rows, n_users=15, uid_start=0):
+    """Global linear signal + per-user bias, logistic response."""
+    user_effect = rng.normal(scale=2.0, size=n_users)
+    Xg = rng.normal(size=(n_rows, 4)).astype(np.float32)
+    users = rng.integers(n_users, size=n_rows)
+    margin = 1.2 * Xg[:, 0] - 0.8 * Xg[:, 1] + user_effect[users]
+    y = (rng.uniform(size=n_rows) < 1 / (1 + np.exp(-margin))).astype(
+        np.float32
+    )
+    shards = {
+        "global": sp.csr_matrix(Xg),
+        "userFeatures": sp.csr_matrix(np.ones((n_rows, 1), np.float32)),
+    }
+    ids = {"userId": np.array([f"u{u}" for u in users])}
+    return shards, ids, y, user_effect, users
+
+
+@pytest.fixture(scope="module")
+def game_data():
+    rng = np.random.default_rng(7)
+    n_users = 15
+    user_effect = rng.normal(scale=2.0, size=n_users)
+
+    def make(n):
+        Xg = rng.normal(size=(n, 4)).astype(np.float32)
+        users = rng.integers(n_users, size=n)
+        margin = 1.2 * Xg[:, 0] - 0.8 * Xg[:, 1] + user_effect[users]
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+        shards = {
+            "global": sp.csr_matrix(Xg),
+            "userFeatures": sp.csr_matrix(np.ones((n, 1), np.float32)),
+        }
+        ids = {"userId": np.array([f"u{u}" for u in users])}
+        return shards, ids, y
+
+    return make(500), make(250)
+
+
+def _configs(reg_fixed=0.5, reg_user=0.5):
+    opt = GlmOptimizationConfig(
+        optimizer=OptimizerConfig(max_iters=40, tolerance=1e-7),
+        regularization=RegularizationContext.l2(),
+    )
+    return {
+        "fixed": FixedEffectCoordinateConfig(
+            feature_shard="global", optimization=opt, reg_weight=reg_fixed
+        ),
+        "per_user": RandomEffectCoordinateConfig(
+            feature_shard="userFeatures",
+            entity_key="userId",
+            optimization=opt,
+            reg_weight=reg_user,
+        ),
+    }
+
+
+class TestEvaluationSuite:
+    def test_from_specs_and_primary(self):
+        suite = EvaluationSuite.from_specs(["auc", "logistic_loss"])
+        assert suite.primary == "auc"
+        assert isinstance(suite.primary_evaluator, AreaUnderROCCurveEvaluator)
+        assert isinstance(dict(suite.evaluators)["logistic_loss"],
+                          LogisticLossEvaluator)
+
+    def test_evaluate_all_metrics(self):
+        suite = EvaluationSuite.from_specs(["auc", "logistic_loss", "rmse"])
+        scores = np.array([2.0, -1.0, 0.5, -0.5])
+        labels = np.array([1.0, 0.0, 1.0, 0.0])
+        out = suite.evaluate(scores, labels)
+        assert set(out) == {"auc", "logistic_loss", "rmse"}
+        assert out["auc"] == 1.0
+
+    def test_better_than_direction_and_none(self):
+        auc_suite = EvaluationSuite.from_specs(["auc"])
+        assert auc_suite.better_than(0.9, 0.8)
+        assert not auc_suite.better_than(0.8, 0.9)
+        loss_suite = EvaluationSuite.from_specs(["logistic_loss"])
+        assert loss_suite.better_than(0.3, 0.5)
+        assert auc_suite.better_than(0.5, None)
+        assert not auc_suite.better_than(None, 0.5)
+
+    def test_bad_primary_rejected(self):
+        with pytest.raises(ValueError, match="primary"):
+            EvaluationSuite.from_specs(["auc"], primary="rmse")
+
+
+class TestPerIterationValidation:
+    def test_history_carries_validation_suite(self, game_data):
+        (tr_shards, tr_ids, tr_y), (v_shards, v_ids, v_y) = game_data
+        est = GameEstimator("logistic", _configs(), n_iterations=2)
+        suite = EvaluationSuite.from_specs(["auc", "logistic_loss"])
+        model, history = est.fit(
+            tr_shards, tr_ids, tr_y,
+            validation=(v_shards, v_ids, v_y),
+            suite=suite,
+        )
+        # One entry per (iteration, coordinate) = 2 * 2.
+        assert len(history) == 4
+        for entry in history:
+            assert set(entry["validation"]) == {"auc", "logistic_loss"}
+            assert entry["validation_metric"] == entry["validation"]["auc"]
+        # Per-iteration validation must see the random effect help.
+        assert history[-1]["validation_metric"] > history[0]["validation_metric"]
+
+    def test_validation_scorer_matches_transformer(self, game_data):
+        """The incremental device-state scorer and the finalized-model
+        transformer must produce identical validation scores."""
+        (tr_shards, tr_ids, tr_y), (v_shards, v_ids, v_y) = game_data
+        est = GameEstimator("logistic", _configs(), n_iterations=2)
+        model, history = est.fit(
+            tr_shards, tr_ids, tr_y,
+            validation=(v_shards, v_ids, v_y),
+        )
+        t_scores = GameTransformer(model).transform(v_shards, v_ids)
+        ev = AreaUnderROCCurveEvaluator()
+        assert history[-1]["validation_metric"] == pytest.approx(
+            ev.evaluate(t_scores, v_y), abs=1e-5
+        )
+
+    def test_unseen_validation_entities_score_zero(self, game_data):
+        (tr_shards, tr_ids, tr_y), (v_shards, v_ids, v_y) = game_data
+        est = GameEstimator("logistic", _configs(), n_iterations=1)
+        coords = est.build_coordinates(tr_shards, tr_ids, tr_y)
+        re_coord = coords[1]
+        state = re_coord.train(np.zeros(len(tr_y), np.float32))
+        # All-new entities: every validation row must score exactly 0.
+        new_ids = {"userId": np.array(["zz%d" % i for i in range(len(v_y))])}
+        scorer = re_coord.make_validation_scorer(v_shards, new_ids)
+        np.testing.assert_array_equal(np.asarray(scorer.score(state)), 0.0)
+
+
+class TestConfigGrid:
+    def test_grid_selects_best_by_validation(self, game_data):
+        (tr_shards, tr_ids, tr_y), (v_shards, v_ids, v_y) = game_data
+        # A hugely over-regularized point must lose to a reasonable one.
+        grid = [_configs(1e6, 1e6), _configs(0.5, 0.5)]
+        est = GameEstimator("logistic", _configs(), n_iterations=2)
+        model, results = est.fit_grid(
+            grid, tr_shards, tr_ids, tr_y,
+            validation=(v_shards, v_ids, v_y),
+        )
+        assert len(results) == 2
+        assert results[1]["best"] and not results[0]["best"]
+        assert results[1]["metric"] > results[0]["metric"]
+        assert results[0]["selected_by"] == "validation_metric"
+
+    def test_grid_shares_datasets(self, game_data):
+        (tr_shards, tr_ids, tr_y), _ = game_data
+        grid = [_configs(0.1, 0.1), _configs(1.0, 1.0), _configs(10.0, 10.0)]
+        est = GameEstimator("logistic", _configs(), n_iterations=1)
+        cache: dict = {}
+        coords_a = est._build_coordinates(
+            grid[0], tr_shards, tr_ids, tr_y, None, None, dataset_cache=cache
+        )
+        coords_b = est._build_coordinates(
+            grid[1], tr_shards, tr_ids, tr_y, None, None, dataset_cache=cache
+        )
+        # Same dataset objects, different coordinate objects.
+        assert coords_a[0].dataset is coords_b[0].dataset
+        assert coords_a[1].dataset is coords_b[1].dataset
+
+    def test_grid_without_validation_selects_by_train(self, game_data):
+        (tr_shards, tr_ids, tr_y), _ = game_data
+        grid = [_configs(1e6, 1e6), _configs(0.5, 0.5)]
+        est = GameEstimator("logistic", _configs(), n_iterations=1)
+        model, results = est.fit_grid(grid, tr_shards, tr_ids, tr_y)
+        assert results[0]["selected_by"] == "train_metric"
+        assert results[1]["best"]
+
+
+class TestDriverGrid:
+    def test_driver_reg_weight_grid(self, tmp_path):
+        from photon_ml_tpu.data.game_reader import write_game_avro
+        from photon_ml_tpu.drivers import game_training_driver
+
+        rng = np.random.default_rng(3)
+        user_effect = {f"u{u}": rng.normal(scale=2.0) for u in range(12)}
+
+        def rows(n, start):
+            out = []
+            for i in range(start, start + n):
+                u = f"u{rng.integers(len(user_effect))}"
+                xg = rng.normal(size=3)
+                margin = 1.5 * xg[0] - 1.0 * xg[1] + user_effect[u]
+                y = float(rng.uniform() < 1 / (1 + np.exp(-margin)))
+                out.append({
+                    "uid": f"row{i}", "response": y, "weight": None,
+                    "offset": None, "ids": {"userId": u},
+                    "features": {
+                        "global": [
+                            {"name": f"g{j}", "term": "", "value": float(xg[j])}
+                            for j in range(3)
+                        ],
+                        "userFeatures": [
+                            {"name": "bias", "term": "", "value": 1.0}
+                        ],
+                    },
+                })
+            return out
+
+        train = str(tmp_path / "train.avro")
+        val = str(tmp_path / "val.avro")
+        write_game_avro(train, rows(400, 0))
+        write_game_avro(val, rows(150, 400))
+        config = {
+            "task": "logistic",
+            "iterations": 2,
+            "evaluators": ["auc", "logistic_loss"],
+            "coordinates": [
+                {"name": "fixed", "type": "fixed", "feature_shard": "global",
+                 "optimizer": "lbfgs", "max_iters": 40, "reg_type": "l2",
+                 "reg_weights": [1e5, 0.5]},
+                {"name": "per_user", "type": "random",
+                 "feature_shard": "userFeatures", "entity_key": "userId",
+                 "optimizer": "lbfgs", "max_iters": 30, "reg_type": "l2",
+                 "reg_weight": 0.5},
+            ],
+        }
+        config_path = str(tmp_path / "config.json")
+        with open(config_path, "w") as f:
+            json.dump(config, f)
+        out = str(tmp_path / "out")
+        result = game_training_driver.run([
+            "--train-data", train, "--validate-data", val,
+            "--config", config_path, "--output-dir", out,
+        ])
+        assert len(result["grid"]) == 2
+        best = next(g for g in result["grid"] if g["best"])
+        assert best["reg_weights"]["fixed"] == 0.5
+        assert result["per_iteration_validation"]
+        assert set(result["validation_suite"]) == {"auc", "logistic_loss"}
+        # History from the best grid point carries per-update validation.
+        assert all("validation" in h for h in result["history"])
+        assert result["validation_metric"] > 0.6

@@ -139,3 +139,26 @@ class TestPallasKernels:
         hp = obj.hvp(w, w, dp, l2_weight=0.3)
         hc = obj.hvp(w, w, dc, l2_weight=0.3)
         assert _rel(hp, hc) < 1e-5
+
+
+class TestDegenerateInputs:
+    def test_all_zero_values(self):
+        """All stored values zero → empty live set; must build, not crash."""
+        P = build_pallas_matrix(
+            np.array([0]), np.array([0]), np.array([0.0], np.float32), 10, 10
+        )
+        w = jnp.arange(10, dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(P.matvec(w)), 0.0)
+        np.testing.assert_array_equal(
+            np.asarray(P.rmatvec(jnp.ones(10, jnp.float32))), 0.0
+        )
+
+    def test_empty_entry_list(self):
+        P = build_pallas_matrix(
+            np.empty(0, np.int64), np.empty(0, np.int64),
+            np.empty(0, np.float32), 7, 5,
+        )
+        assert P.shape == (7, 5)
+        np.testing.assert_array_equal(
+            np.asarray(P.matvec(jnp.ones(5, jnp.float32))), 0.0
+        )
