@@ -119,6 +119,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--validate-data", help="GAME Avro validation file")
     p.add_argument("--config", required=True, help="coordinate config JSON")
     p.add_argument("--output-dir", required=True)
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="continue coordinate descent from the checkpoint in the "
+        "output dir (bit-exact with the uninterrupted run)",
+    )
+    p.add_argument(
+        "--initial-model",
+        help="saved GameModel directory to warm-start from (the reference's "
+        "incremental training); its index maps are used to read the data",
+    )
     return p
 
 
@@ -143,9 +154,23 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         suite = EvaluationSuite.for_task(losses_lib.get(task).name)
     evaluator = suite.primary_evaluator
 
-    shards, ids, response, weight, offset, _, index_maps = read_game_avro(
-        args.train_data
-    )
+    # Incremental training (SURVEY.md §5.4): a prior model fixes the feature
+    # index maps — the data is read through them so coefficient vectors line
+    # up column-for-column with the saved model.
+    initial_model = None
+    if args.initial_model:
+        from photon_ml_tpu.io.game_store import load_game_model
+
+        initial_model, initial_imaps = load_game_model(args.initial_model)
+        shards, ids, response, weight, offset, _, index_maps = read_game_avro(
+            args.train_data, index_maps=initial_imaps, logger=logger
+        )
+        index_maps = initial_imaps
+        logger.info("incremental training from %s", args.initial_model)
+    else:
+        shards, ids, response, weight, offset, _, index_maps = read_game_avro(
+            args.train_data
+        )
     logger.info(
         "read %d rows; shards: %s; id columns: %s",
         len(response),
@@ -227,6 +252,31 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         v_shards, v_ids, v_resp, v_weight, v_offset, _, _ = validation
         val_tuple = (v_shards, v_ids, v_resp, v_weight, v_offset)
 
+    # Per-iteration checkpointing (single-config path; a grid re-fits many
+    # configs, so resume there means re-running incomplete points).
+    checkpointer = None
+    checkpoint_enabled = bool(config.get("checkpoint", True))
+    if len(config_grid) == 1 and checkpoint_enabled:
+        from photon_ml_tpu.io.checkpoint import CoordinateDescentCheckpointer
+
+        checkpointer = CoordinateDescentCheckpointer(
+            os.path.join(args.output_dir, "checkpoints")
+        )
+        if not args.resume:
+            # A stale checkpoint from a previous job must not silently
+            # hijack a fresh run.
+            checkpointer.clear()
+    elif args.resume:
+        if len(config_grid) > 1:
+            raise ValueError(
+                "--resume requires a single coordinate config (no "
+                "reg_weights grid); grid points re-run from scratch"
+            )
+        raise ValueError(
+            '--resume requires checkpointing ("checkpoint": false is set '
+            "in the config JSON)"
+        )
+
     estimator = GameEstimator(
         task, coordinate_configs, n_iterations=n_cd_iterations, logger=logger
     )
@@ -234,7 +284,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         # Config-grid fit with validation-driven selection (SURVEY.md §3.2).
         model, grid_results = estimator.fit_grid(
             config_grid, shards, ids, response, weight=weight, offset=offset,
-            validation=val_tuple, suite=suite,
+            validation=val_tuple, suite=suite, initial_model=initial_model,
         )
         best = next(r for r in grid_results if r["best"])
         history = best["history"]
@@ -259,6 +309,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         model, history = estimator.fit(
             shards, ids, response, weight=weight, offset=offset,
             validation=val_tuple, suite=suite,
+            initial_model=initial_model, checkpointer=checkpointer,
         )
     result["history"] = history
     result["train_metric"] = history[-1].get("train_metric") if history else None

@@ -100,6 +100,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "(the reference's ModelOutputMode)",
     )
     p.add_argument("--n-features", type=int, help="fixed feature-space width")
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="continue from the λ-grid checkpoint in the output dir "
+        "(skips already-solved weights, keeps the warm-start chain)",
+    )
+    p.add_argument(
+        "--initial-model",
+        help="saved model Avro to warm-start the grid from (the reference's "
+        "incremental training)",
+    )
     return p
 
 
@@ -165,8 +176,49 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     if args.intercept and index_map.intercept_index is not None:
         l1_mask = jnp.ones((d,), jnp.float32).at[index_map.intercept_index].set(0.0)
 
-    grid = problem.run_grid(train_data, reg_weights, l1_mask=l1_mask)
+    # Checkpoint/resume + incremental training (SURVEY.md §5.3/§5.4): each
+    # solved λ is persisted; --resume skips finished λs bit-exactly;
+    # --initial-model seeds the warm-start chain from a saved model.
+    from photon_ml_tpu.io.checkpoint import GridCheckpointer
+    from photon_ml_tpu.io.model_store import load_glm_model
+
+    ckpt = GridCheckpointer(os.path.join(args.output_dir, "checkpoints"))
+    if args.resume:
+        solved = ckpt.load()
+    else:
+        # A stale checkpoint (possibly from a run on different data or
+        # normalization) must not survive into a later --resume.
+        ckpt.clear()
+        solved = {}
+    if solved:
+        logger.info(
+            "resuming: %d of %d grid points already solved",
+            len(solved), len(reg_weights),
+        )
+    solved_acc = dict(solved)
+
+    def on_solved(lam, w):
+        solved_acc[lam] = np.asarray(w)
+        ckpt.save(solved_acc)
+
+    w0 = None
+    if args.initial_model:
+        glm0, _ = load_glm_model(args.initial_model, index_map)
+        w0 = jnp.asarray(np.asarray(glm0.coefficients.means, np.float32))
+        if normalization is not None:
+            # Saved models live in the original feature space; the solver
+            # works in scaled-coefficient space.
+            w0 = normalization.original_to_model(w0)
+        logger.info("warm-starting from %s", args.initial_model)
+
+    grid = problem.run_grid(
+        train_data, reg_weights, w0=w0, l1_mask=l1_mask,
+        solved=solved, on_solved=on_solved,
+    )
     for lam, _, res in grid:
+        if res is None:
+            logger.info("lambda=%g: restored from checkpoint", lam)
+            continue
         tracker = OptimizationStatesTracker.from_solve_result(res)
         logger.info(
             "lambda=%g: value=%.8g iters=%d converged=%s",

@@ -47,12 +47,25 @@ class CoordinateDescent:
         n_iterations: int = 1,
         eval_fn: Optional[Callable[[int, str, dict, dict], dict]] = None,
         logger=None,
+        checkpointer=None,
+        initial_states: Optional[dict] = None,
     ) -> CoordinateDescentResult:
         """``eval_fn(iteration, coordinate_name, scores_by_coordinate,
         states_by_coordinate)`` is called after each coordinate update (the
         reference evaluates its validation suite there — states let it score
         a validation set against the freshly-updated coordinate); its dict
-        return is recorded in history."""
+        return is recorded in history.
+
+        ``initial_states`` (coordinate name → state) warm-starts from a
+        prior model — the reference's "incremental training" (SURVEY.md
+        §5.4): each coordinate's scores are seeded from its initial state so
+        the first update already trains against the prior model's residuals.
+
+        ``checkpointer`` (io/checkpoint.CoordinateDescentCheckpointer)
+        persists the loop state after every iteration; when it holds a saved
+        state, the run RESUMES from the last completed iteration and
+        reproduces the uninterrupted result bit-for-bit (the accumulated
+        ``total``/scores are restored, not recomputed)."""
         base_offsets = jnp.asarray(base_offsets, jnp.float32)
         scores: dict[str, Array] = {
             c.name: jnp.zeros_like(base_offsets) for c in self.coordinates
@@ -60,8 +73,44 @@ class CoordinateDescent:
         states: dict[str, object] = {c.name: None for c in self.coordinates}
         total = base_offsets
         history: list[dict] = []
+        start_it = 0
 
-        for it in range(n_iterations):
+        saved = checkpointer.load() if checkpointer is not None else None
+        if saved is not None:
+            # A checkpoint supersedes initial states entirely (it already
+            # includes any warm start the original run began from), so don't
+            # waste a full scoring pass on states about to be overwritten.
+            start_it = saved["iteration"] + 1
+            total = jnp.asarray(saved["total"])
+            for coord in self.coordinates:
+                scores[coord.name] = jnp.asarray(saved["scores"][coord.name])
+                st = saved["states"][coord.name]
+                states[coord.name] = (
+                    [jnp.asarray(a) for a in st]
+                    if isinstance(st, list)
+                    else (jnp.asarray(st) if st is not None else None)
+                )
+            history = list(saved["history"])
+            if logger is not None:
+                logger.info(
+                    "resuming coordinate descent from iteration %d", start_it
+                )
+        elif initial_states:
+            for coord in self.coordinates:
+                st = initial_states.get(coord.name)
+                if st is None:
+                    continue
+                st = (
+                    [jnp.asarray(a) for a in st]
+                    if isinstance(st, (list, tuple))
+                    else jnp.asarray(st)
+                )
+                states[coord.name] = st
+                s = coord.score(st)
+                scores[coord.name] = s
+                total = total + s
+
+        for it in range(start_it, n_iterations):
             for coord in self.coordinates:
                 offsets = total - scores[coord.name]
                 state = coord.train(offsets, warm_state=states[coord.name])
@@ -84,4 +133,6 @@ class CoordinateDescent:
                         {k: v for k, v in entry.items()
                          if k not in ("iteration", "coordinate")},
                     )
+            if checkpointer is not None:
+                checkpointer.save(it, total, scores, states, history)
         return CoordinateDescentResult(states=states, scores=scores, history=history)

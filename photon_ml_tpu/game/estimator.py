@@ -193,20 +193,79 @@ class GameEstimator:
         evaluator: Optional[Evaluator] = None,
         validation=None,
         suite=None,
+        initial_model: Optional[GameModel] = None,
+        checkpointer=None,
     ) -> tuple[GameModel, list]:
         """Train; returns (model, per-coordinate-update history).
 
         ``validation`` is ``(shards, ids, response[, weight[, offset]])``;
         with it, every history entry carries the full validation
         ``EvaluationSuite`` after that coordinate update (the reference's
-        per-iteration validation tracking — SURVEY.md §3.2)."""
+        per-iteration validation tracking — SURVEY.md §3.2).
+
+        ``initial_model`` warm-starts coordinate descent from a previously
+        trained GameModel (the reference's incremental training);
+        ``checkpointer`` enables per-iteration checkpoint + resume (see
+        game/descent.py)."""
         coordinates = self._build_coordinates(
             self.coordinate_configs, shards, ids, response, weight, offset
         )
         return self.fit_coordinates(
             coordinates, response, weight, offset, evaluator,
             validation=validation, suite=suite,
+            initial_model=initial_model, checkpointer=checkpointer,
         )
+
+    @staticmethod
+    def initial_states_from_model(
+        coordinates, model: GameModel
+    ) -> dict:
+        """Project a saved GameModel onto pre-built coordinates' state
+        layout: fixed effects take the coefficient vector directly; random
+        effects materialize each bucket's (E, D) local-space matrix from the
+        entity→sparse-coefficient table.  Coordinates absent from the model
+        start from zero (state None).
+
+        The datasets MUST have been built from data read with the saved
+        model's index maps — stored coefficients are matched by global
+        column id, so a different index map silently means different
+        features.  Width mismatches are caught; same-width re-orderings
+        cannot be (exactly as in the reference, where incremental training
+        requires the prior run's feature index maps)."""
+        states: dict = {}
+        for c in coordinates:
+            sub = model.models.get(c.name)
+            if sub is None:
+                continue
+            if isinstance(sub, FixedEffectModel):
+                w = np.asarray(sub.model.coefficients.means, np.float32)
+                if w.shape[0] != c.dataset.data.n_features:
+                    raise ValueError(
+                        f"initial model coordinate {c.name!r} has "
+                        f"{w.shape[0]} features but the dataset has "
+                        f"{c.dataset.data.n_features}; read the data with "
+                        "the initial model's index maps"
+                    )
+                states[c.name] = jnp.asarray(w)
+            elif isinstance(sub, RandomEffectModel):
+                if sub.n_features != c.dataset.n_features:
+                    raise ValueError(
+                        f"initial model coordinate {c.name!r} has "
+                        f"{sub.n_features} features but the dataset has "
+                        f"{c.dataset.n_features}; read the data with the "
+                        "initial model's index maps"
+                    )
+                states[c.name] = [
+                    jnp.asarray(
+                        sub.coefficient_matrix_for(
+                            np.asarray(block.col_map), ids
+                        )
+                    )
+                    for block, ids in zip(
+                        c.dataset.blocks, c.dataset.entity_ids
+                    )
+                ]
+        return states
 
     def fit_coordinates(
         self,
@@ -218,6 +277,8 @@ class GameEstimator:
         validation=None,
         suite=None,
         validation_scorers: Optional[dict] = None,
+        initial_model: Optional[GameModel] = None,
+        checkpointer=None,
     ) -> tuple[GameModel, list]:
         """Run coordinate descent over pre-built coordinates (see
         :meth:`build_coordinates`) and finalize the GameModel.
@@ -266,6 +327,8 @@ class GameEstimator:
                 },
             }
 
+        primed = [False]  # becomes True once every live state has scored
+
         def eval_fn(it, cname, scores, states):
             total = base_offsets + np.sum(
                 [np.asarray(s) for s in scores.values()], axis=0
@@ -275,9 +338,22 @@ class GameEstimator:
                 "evaluator": type(primary).__name__,
             }
             if val_ctx is not None:
-                val_ctx["scores"][cname] = np.asarray(
-                    val_ctx["scorers"][cname].score(states[cname])
-                )
+                if not primed[0]:
+                    # First evaluation: warm starts / resumed runs carry
+                    # live states for coordinates that haven't updated yet
+                    # this run — score them all once.
+                    for c in coordinates:
+                        if states[c.name] is not None:
+                            val_ctx["scores"][c.name] = np.asarray(
+                                val_ctx["scorers"][c.name].score(
+                                    states[c.name]
+                                )
+                            )
+                    primed[0] = True
+                else:
+                    val_ctx["scores"][cname] = np.asarray(
+                        val_ctx["scorers"][cname].score(states[cname])
+                    )
                 v_total = val_ctx["base"] + np.sum(
                     list(val_ctx["scores"].values()), axis=0
                 )
@@ -288,12 +364,19 @@ class GameEstimator:
                 entry["validation_metric"] = metrics[suite.primary]
             return entry
 
+        initial_states = (
+            self.initial_states_from_model(coordinates, initial_model)
+            if initial_model is not None
+            else None
+        )
         cd = CoordinateDescent(coordinates)
         result = cd.run(
             jnp.asarray(base_offsets),
             n_iterations=self.n_iterations,
             eval_fn=eval_fn,
             logger=self.logger,
+            checkpointer=checkpointer,
+            initial_states=initial_states,
         )
         models = {
             c.name: c.finalize(result.states[c.name]) for c in coordinates
@@ -310,6 +393,7 @@ class GameEstimator:
         offset: Optional[np.ndarray] = None,
         validation=None,
         suite=None,
+        initial_model: Optional[GameModel] = None,
     ) -> tuple[GameModel, list[dict]]:
         """Fit EVERY coordinate-config combination, select best (SURVEY.md
         §3.2: "for each coordinate-config combination ... select best model
@@ -358,7 +442,7 @@ class GameEstimator:
             model, history = self.fit_coordinates(
                 coordinates, response, weight, offset,
                 validation=validation, suite=suite,
-                validation_scorers=scorers,
+                validation_scorers=scorers, initial_model=initial_model,
             )
             metric_key = (
                 "validation_metric" if validation is not None else "train_metric"

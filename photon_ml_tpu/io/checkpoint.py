@@ -1,0 +1,175 @@
+"""Checkpoint / resume for training jobs.
+
+The reference inherits fault tolerance from Spark (task retry, lineage
+re-execution — SURVEY.md §5.3) and offers warm restarts via prior-model
+inputs ("incremental training", §5.4).  A TPU job has no lineage to replay,
+so the analogue is explicit state checkpointing:
+
+- ``CoordinateDescentCheckpointer`` — persists the full GAME coordinate-
+  descent state (per-coordinate device states, per-coordinate scores, the
+  running ``total`` offsets, the iteration counter, and the metric history)
+  to the job's output directory after every CD iteration.  A killed job
+  restarted with ``--resume`` continues from the last completed iteration
+  and reproduces the uninterrupted result bit-for-bit: the restored
+  ``total``/scores ARE the accumulated float values, not recomputations.
+- ``GridCheckpointer`` — the legacy GLM driver's λ-grid analogue: records
+  each solved (λ → coefficients) so a restart skips finished λs and
+  continues the warm-start chain from the last solution.
+
+Write protocol: ONE ``.npz`` file per checkpoint holding both the arrays
+and an embedded JSON metadata string, written to a temp path and atomically
+renamed — a kill at any instant leaves either the previous complete
+checkpoint or the new complete one, never a torn pairing of old metadata
+with new arrays.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def _atomic_savez(path: str, arrays: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+def _load_npz_with_meta(path: str) -> Optional[tuple[dict, dict]]:
+    """Returns (meta, arrays) or None if the file doesn't exist."""
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    meta = json.loads(str(arrays.pop("__meta__")))
+    return meta, arrays
+
+
+class CoordinateDescentCheckpointer:
+    """Persist / restore CoordinateDescent loop state.
+
+    Array layout inside ``cd_checkpoint.npz``:
+      ``total``                  — (N,) accumulated offsets
+      ``score__<coord>``        — (N,) that coordinate's scores
+      ``state__<coord>``        — fixed-effect coefficient vector, or
+      ``state__<coord>__<i>``   — random-effect per-bucket (E, D) arrays
+      ``__meta__``              — JSON: iteration counter, coordinate
+                                  names, list-state lengths, history
+    """
+
+    FILENAME = "cd_checkpoint.npz"
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.path = os.path.join(directory, self.FILENAME)
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def clear(self) -> None:
+        if self.exists():
+            os.remove(self.path)
+
+    def save(
+        self,
+        iteration: int,
+        total,
+        scores: dict,
+        states: dict,
+        history: list,
+    ) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        arrays = {"total": np.asarray(total)}
+        list_lens: dict[str, int] = {}
+        for name, s in scores.items():
+            arrays[f"score__{name}"] = np.asarray(s)
+        for name, st in states.items():
+            if st is None:
+                continue
+            if isinstance(st, (list, tuple)):
+                list_lens[name] = len(st)
+                for i, a in enumerate(st):
+                    arrays[f"state__{name}__{i}"] = np.asarray(a)
+            else:
+                arrays[f"state__{name}"] = np.asarray(st)
+        arrays["__meta__"] = np.asarray(
+            json.dumps(
+                {
+                    "iteration": iteration,
+                    "coordinates": list(scores),
+                    "list_states": list_lens,
+                    "history": history,
+                }
+            )
+        )
+        _atomic_savez(self.path, arrays)
+
+    def load(self) -> Optional[dict]:
+        """Returns {iteration, total, scores, states, history} or None."""
+        loaded = _load_npz_with_meta(self.path)
+        if loaded is None:
+            return None
+        meta, arrays = loaded
+        scores = {
+            name: arrays[f"score__{name}"] for name in meta["coordinates"]
+        }
+        states: dict = {}
+        for name in meta["coordinates"]:
+            if name in meta["list_states"]:
+                states[name] = [
+                    arrays[f"state__{name}__{i}"]
+                    for i in range(meta["list_states"][name])
+                ]
+            elif f"state__{name}" in arrays:
+                states[name] = arrays[f"state__{name}"]
+            else:
+                states[name] = None
+        return {
+            "iteration": int(meta["iteration"]),
+            "total": arrays["total"],
+            "scores": scores,
+            "states": states,
+            "history": meta["history"],
+        }
+
+
+class GridCheckpointer:
+    """λ-grid checkpoint for the legacy GLM driver: one coefficient vector
+    per solved regularization weight, so a restart skips finished λs and
+    keeps the warm-start chain intact."""
+
+    FILENAME = "grid_checkpoint.npz"
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.path = os.path.join(directory, self.FILENAME)
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def clear(self) -> None:
+        if self.exists():
+            os.remove(self.path)
+
+    def save(self, solved: dict) -> None:
+        """``solved``: λ (float) → coefficient vector, in solve order."""
+        os.makedirs(self.directory, exist_ok=True)
+        arrays = {
+            f"w__{i}": np.asarray(w) for i, w in enumerate(solved.values())
+        }
+        arrays["__meta__"] = np.asarray(
+            json.dumps({"lambdas": [float(lam) for lam in solved]})
+        )
+        _atomic_savez(self.path, arrays)
+
+    def load(self) -> dict:
+        """Returns λ → coefficient vector (insertion order = solve order)."""
+        loaded = _load_npz_with_meta(self.path)
+        if loaded is None:
+            return {}
+        meta, arrays = loaded
+        return {lam: arrays[f"w__{i}"] for i, lam in enumerate(meta["lambdas"])}

@@ -193,21 +193,38 @@ class GlmOptimizationProblem:
         axis_name: Optional[str] = None,
         l1_mask: Optional[Array] = None,
         warm_start: bool = True,
-    ) -> list[tuple[float, GeneralizedLinearModel, SolveResult]]:
+        solved: Optional[dict] = None,
+        on_solved=None,
+    ) -> list[tuple[float, GeneralizedLinearModel, Optional[SolveResult]]]:
         """Train one model per regularization weight, warm-starting each run
         from the previous solution (λs are sorted descending so the most
         regularized — smoothest — problem is solved first, as the reference
-        does for its warm-start chain)."""
+        does for its warm-start chain).
+
+        Checkpoint/resume: ``solved`` (λ → coefficient vector, from
+        io/checkpoint.GridCheckpointer) skips already-solved λs — their
+        entries come back with ``res=None`` and the warm-start chain
+        continues from the restored coefficients, so a resumed grid matches
+        the uninterrupted one bit-for-bit.  ``on_solved(lam, w)`` fires
+        after each fresh solve (the driver persists the checkpoint there)."""
         results = []
         w_prev = w0
+        solved = solved or {}
         for lam in sorted(reg_weights, reverse=True):
-            res = self.solve(data, lam, w_prev, axis_name, l1_mask)
+            if lam in solved:
+                w = jnp.asarray(solved[lam])
+                res = None
+            else:
+                res = self.solve(data, lam, w_prev, axis_name, l1_mask)
+                w = res.w
+                if on_solved is not None:
+                    on_solved(lam, w)
             variances = (
-                self.coefficient_variances(res.w, data, lam, axis_name)
+                self.coefficient_variances(w, data, lam, axis_name)
                 if self.config.compute_variances
                 else None
             )
-            results.append((lam, self.make_model(res.w, variances), res))
+            results.append((lam, self.make_model(w, variances), res))
             if warm_start:
-                w_prev = res.w
+                w_prev = w
         return results
