@@ -7,11 +7,19 @@ feature bags as a map shard-name → [ {name, term, value} ] — the reference's
 "feature shards"/"bags".  Reading produces per-shard CSR matrices over
 per-shard feature index maps (built on the fly or supplied, the reference's
 ``IndexMapLoader`` behaviors).
+
+Scale path: the file is STREAMED block-by-block (``io.avro.iter_blocks``) —
+no list of record dicts is ever materialized — and blocks whose schema
+matches the GAME example layout decode through a specialized flat decoder
+(direct byte-offset parsing into typed accumulators, no per-record dict /
+BytesIO / recursion).  Files with other schemas fall back to the generic
+datum decoder, record by record.
 """
 
 from __future__ import annotations
 
 import logging
+import struct
 from typing import Optional
 
 import numpy as np
@@ -56,6 +64,211 @@ def write_game_avro(path: str, rows: list[dict]) -> None:
     avro.write_container(path, GAME_EXAMPLE_SCHEMA, rows)
 
 
+def _normalize_schema(s):
+    """Canonical form for structural comparison: expand shorthand strings,
+    drop annotation-only keys (doc/aliases/namespace/default)."""
+    if isinstance(s, str):
+        return {"type": s}
+    if isinstance(s, list):
+        return [_normalize_schema(b) for b in s]
+    if isinstance(s, dict):
+        keep = {}
+        for k in ("type", "name", "fields", "items", "values", "symbols"):
+            if k in s:
+                v = s[k]
+                if k == "fields":
+                    v = [
+                        {
+                            "name": f["name"],
+                            "type": _normalize_schema(f["type"]),
+                        }
+                        for f in v
+                    ]
+                elif k in ("type", "items", "values") and not isinstance(
+                    v, str
+                ):
+                    v = _normalize_schema(v)
+                keep[k] = v
+        return keep
+    return s
+
+
+def _is_game_schema(schema) -> bool:
+    """The flat byte-offset decoder is only safe when the schema matches the
+    GAME example layout EXACTLY (field order, types, union branch order) —
+    name-only matching would misparse e.g. a non-union ``uid``."""
+    try:
+        return _normalize_schema(schema) == _normalize_schema(
+            GAME_EXAMPLE_SCHEMA
+        )
+    except (TypeError, KeyError):
+        return False
+
+
+class _Accumulator:
+    """Typed columnar sinks shared by both decode paths."""
+
+    def __init__(self, building: bool, forward: dict):
+        self.building = building
+        self.forward = forward  # shard -> {feature key -> col}
+        self.response: list[float] = []
+        self.weight: list[float] = []
+        self.offset: list[float] = []
+        self.uids: list[Optional[str]] = []
+        self.id_cols: dict[str, list] = {}
+        # shard -> (rows list, cols list, vals list)
+        self.shard_rows: dict[str, tuple[list, list, list]] = {}
+        self.dropped: dict[str, int] = {}
+        self.n = 0
+
+    def add_id(self, key: str, value: str) -> None:
+        lst = self.id_cols.get(key)
+        if lst is None:
+            lst = self.id_cols[key] = []
+        if len(lst) < self.n:  # rows before this column first appeared
+            lst.extend([None] * (self.n - len(lst)))
+        lst.append(value)
+
+    def touch_shard(self, shard: str) -> None:
+        """A shard seen in the data materializes (possibly all-zero) unless
+        the scoring path is dropping it wholesale."""
+        if shard not in self.shard_rows and (
+            self.building or shard in self.forward
+        ):
+            if self.building and shard not in self.forward:
+                self.forward[shard] = {}
+            self.shard_rows[shard] = ([], [], [])
+
+    def add_feature(self, shard: str, key: str, value: float) -> None:
+        fwd = self.forward.get(shard)
+        if fwd is None:
+            if not self.building:
+                self.dropped[shard] = self.dropped.get(shard, 0) + 1
+                return
+            fwd = self.forward[shard] = {}
+        # The shard entry must exist even if every feature is dropped:
+        # scoring data whose features all drifted out of the index map still
+        # needs an all-zero (n, d) matrix, not a missing dict key.
+        entry = self.shard_rows.get(shard)
+        if entry is None:
+            entry = self.shard_rows[shard] = ([], [], [])
+        idx = fwd.get(key)
+        if idx is None:
+            if not self.building:
+                self.dropped[shard] = self.dropped.get(shard, 0) + 1
+                return
+            idx = len(fwd)
+            fwd[key] = idx
+        entry[0].append(self.n)
+        entry[1].append(idx)
+        entry[2].append(value)
+
+    def finish_row(self) -> None:
+        self.n += 1
+
+
+def _decode_game_blocks(path: str, acc: _Accumulator) -> None:
+    """Specialized streaming decoder for GAME-schema container files."""
+    unpack_double = struct.Struct("<d").unpack_from
+    for _schema, count, payload in avro.iter_blocks(path):
+        pos = 0
+        mv = payload
+
+        def read_long():
+            nonlocal pos
+            shift = 0
+            n = 0
+            while True:
+                b = mv[pos]
+                pos += 1
+                n |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    return (n >> 1) ^ -(n & 1)
+                shift += 7
+
+        def read_str():
+            nonlocal pos
+            ln = read_long()
+            s = mv[pos : pos + ln].decode("utf-8")
+            pos += ln
+            return s
+
+        for _ in range(count):
+            acc.uids.append(read_str() if read_long() == 1 else None)
+            acc.response.append(unpack_double(mv, pos)[0])
+            pos += 8
+            if read_long() == 1:
+                acc.weight.append(unpack_double(mv, pos)[0])
+                pos += 8
+            else:
+                acc.weight.append(1.0)
+            if read_long() == 1:
+                acc.offset.append(unpack_double(mv, pos)[0])
+                pos += 8
+            else:
+                acc.offset.append(0.0)
+            # ids map
+            while True:
+                c = read_long()
+                if c == 0:
+                    break
+                if c < 0:
+                    c = -c
+                    read_long()  # skip byte-size prefix
+                for _ in range(c):
+                    k = read_str()
+                    acc.add_id(k, read_str())
+            # features map: shard -> [ {name, term, value} ]
+            while True:
+                c = read_long()
+                if c == 0:
+                    break
+                if c < 0:
+                    c = -c
+                    read_long()
+                for _ in range(c):
+                    shard = read_str()
+                    acc.touch_shard(shard)
+                    while True:
+                        fc = read_long()
+                        if fc == 0:
+                            break
+                        if fc < 0:
+                            fc = -fc
+                            read_long()
+                        for _ in range(fc):
+                            name = read_str()
+                            term = read_str()
+                            val = unpack_double(mv, pos)[0]
+                            pos += 8
+                            acc.add_feature(
+                                shard, feature_key(name, term), val
+                            )
+            acc.finish_row()
+
+
+def _decode_generic(path: str, acc: _Accumulator) -> None:
+    """Fallback: stream records through the generic datum decoder."""
+    for rec in avro.iter_container(path):
+        acc.uids.append(rec.get("uid"))
+        acc.response.append(float(rec["response"]))
+        acc.weight.append(
+            1.0 if rec.get("weight") is None else float(rec["weight"])
+        )
+        acc.offset.append(
+            0.0 if rec.get("offset") is None else float(rec["offset"])
+        )
+        for k, v in rec.get("ids", {}).items():
+            acc.add_id(k, v)
+        for shard, feats in rec.get("features", {}).items():
+            acc.touch_shard(shard)
+            for f in feats:
+                acc.add_feature(
+                    shard, feature_key(f["name"], f["term"]), f["value"]
+                )
+        acc.finish_row()
+
+
 def read_game_avro(
     path: str,
     index_maps: Optional[dict] = None,
@@ -70,71 +283,33 @@ def read_game_avro(
     them is the scoring path, where unseen features are dropped, as the
     reference's scoring driver does).
     """
-    _, records = avro.read_container(path)
-    n = len(records)
-    response = np.zeros(n, np.float32)
-    weight = np.ones(n, np.float32)
-    offset = np.zeros(n, np.float32)
-    uids: list[Optional[str]] = []
-    id_cols: dict[str, list] = {}
-    shard_rows: dict[str, tuple[list, list, list]] = {}  # rows, cols, vals
     building = index_maps is None
-    if building:
-        index_maps = {}
     forward: dict[str, dict] = {
         s: dict(m) for s, m in (index_maps or {}).items()
     }
+    acc = _Accumulator(building, forward)
+    if _is_game_schema(avro.read_schema(path)):
+        _decode_game_blocks(path, acc)
+    else:
+        _decode_generic(path, acc)
+    n = acc.n
 
-    dropped: dict[str, int] = {}
-
-    for i, rec in enumerate(records):
-        response[i] = rec["response"]
-        if rec["weight"] is not None:
-            weight[i] = rec["weight"]
-        if rec["offset"] is not None:
-            offset[i] = rec["offset"]
-        uids.append(rec["uid"])
-        for k, v in rec["ids"].items():
-            id_cols.setdefault(k, [None] * n)[i] = v
-        for shard, feats in rec["features"].items():
-            if not building and shard not in forward:
-                # Scoring path: a whole feature shard absent from the
-                # supplied index maps is skipped (same policy as dropping
-                # unseen features), counted below.
-                dropped[shard] = dropped.get(shard, 0) + len(feats)
-                continue
-            rows, cols, vals = shard_rows.setdefault(shard, ([], [], []))
-            fwd = forward.setdefault(shard, {})
-            for f in feats:
-                key = feature_key(f["name"], f["term"])
-                idx = fwd.get(key)
-                if idx is None:
-                    if not building:
-                        dropped[shard] = dropped.get(shard, 0) + 1
-                        continue  # scoring path: drop unseen features
-                    idx = len(fwd)
-                    fwd[key] = idx
-                rows.append(i)
-                cols.append(idx)
-                vals.append(f["value"])
-
-    if dropped:
+    if acc.dropped:
         # Default to the module logger; drivers pass their PhotonLogger so
         # the warning lands in the job's photon.log artifact too.
         (logger or logging.getLogger(__name__)).warning(
             "read_game_avro(%s): dropped features absent from supplied index "
             "maps: %s",
             path,
-            ", ".join(f"{s}={c}" for s, c in sorted(dropped.items())),
+            ", ".join(f"{s}={c}" for s, c in sorted(acc.dropped.items())),
         )
 
     shards: dict = {}
     out_maps: dict = {}
-    for shard, (rows, cols, vals) in shard_rows.items():
+    for shard, (rows, cols, vals) in acc.shard_rows.items():
         fwd = forward[shard]
         if building and shard in add_intercept_shards:
             fwd.setdefault(INTERCEPT_KEY, len(fwd))
-        d = len(fwd)
         imap = index_maps[shard] if not building else IndexMap.build(fwd)
         if shard in add_intercept_shards and INTERCEPT_KEY in imap:
             icol = imap[INTERCEPT_KEY]
@@ -144,9 +319,16 @@ def read_game_avro(
         shards[shard] = sp.csr_matrix(
             (np.asarray(vals, np.float32),
              (np.asarray(rows, np.int64), np.asarray(cols, np.int64))),
-            shape=(n, d),
+            shape=(n, len(fwd)),
         )
         out_maps[shard] = imap
 
-    ids = {k: np.asarray(v) for k, v in id_cols.items()}
-    return shards, ids, response, weight, offset, uids, out_maps
+    ids = {}
+    for k, lst in acc.id_cols.items():
+        if len(lst) < n:  # trailing rows missing this column
+            lst.extend([None] * (n - len(lst)))
+        ids[k] = np.asarray(lst)
+    response = np.asarray(acc.response, np.float32)
+    weight = np.asarray(acc.weight, np.float32)
+    offset = np.asarray(acc.offset, np.float32)
+    return shards, ids, response, weight, offset, acc.uids, out_maps

@@ -470,48 +470,99 @@ class GameEstimator:
         return results[best_idx]["model"], results
 
 
+@dataclasses.dataclass
+class PreparedScoringSet:
+    """Grouped block structures for scoring ONE dataset many times.
+
+    Building the per-entity block grouping is the dominant host cost of
+    random-effect scoring; ``GameTransformer.prepare`` pays it once and
+    every subsequent ``transform`` over the same data reuses it (the
+    reference persists its joined scoring RDDs the same way)."""
+
+    n_rows: int
+    re_datasets: dict  # coordinate name -> host-side RandomEffectDataset
+
+
 class GameTransformer:
     """Reference: ``GameTransformer`` — batch scoring with a GameModel
     (SURVEY.md §3.3): fixed effect = one matvec; each random effect = block
-    gather of per-entity coefficients; total = sum + offset."""
+    gather of per-entity coefficients; total = sum + offset.
+
+    Scoring is pure host compute (scipy matvec + packed-table gathers):
+    uploading scoring shards to the accelerator just to pull scores back
+    would waste PCIe/HBM.  Repeated calls on the SAME (shards, ids) objects
+    reuse the entity grouping automatically; for explicit control, call
+    :meth:`prepare` once and pass ``prepared=`` to every transform."""
 
     def __init__(self, model: GameModel, logger=None):
         self.model = model
         self.logger = logger
+        self._cache: Optional[tuple] = None  # (shards, ids, prepared)
+
+    def prepare(self, shards: dict, ids: dict) -> PreparedScoringSet:
+        """Group scoring rows by entity for every random-effect coordinate
+        (build once, score many times)."""
+        n = next(iter(shards.values())).shape[0]
+        re_datasets = {}
+        for name, sub in self.model.models.items():
+            if isinstance(sub, RandomEffectModel):
+                re_datasets[name] = build_random_effect_dataset(
+                    np.asarray(ids[sub.entity_key]),
+                    shards[sub.feature_shard],
+                    np.zeros(n, np.float32),
+                    np.ones(n, np.float32),
+                    device=False,
+                )
+        return PreparedScoringSet(n_rows=n, re_datasets=re_datasets)
+
+    def _prepared_for(self, shards: dict, ids: dict) -> PreparedScoringSet:
+        if (
+            self._cache is not None
+            and self._cache[0] is shards
+            and self._cache[1] is ids
+        ):
+            return self._cache[2]
+        prepared = self.prepare(shards, ids)
+        self._cache = (shards, ids, prepared)
+        return prepared
 
     def transform(
         self,
         shards: dict,
         ids: dict,
         offset: Optional[np.ndarray] = None,
+        prepared: Optional[PreparedScoringSet] = None,
     ) -> np.ndarray:
         some_shard = next(iter(shards.values()))
         n = some_shard.shape[0]
+        if prepared is not None and prepared.n_rows != n:
+            raise ValueError(
+                f"prepared scoring set covers {prepared.n_rows} rows but "
+                f"the shards have {n}; prepare() must be called on the same "
+                "data being transformed"
+            )
         total = (
             np.zeros(n, np.float32) if offset is None else np.asarray(offset, np.float32).copy()
         )
         for name, sub in self.model.models.items():
             if isinstance(sub, FixedEffectModel):
-                data = make_glm_data(shards[sub.feature_shard], np.zeros(n))
-                total += np.asarray(sub.model.compute_score(data))
+                w = np.asarray(sub.model.coefficients.means, np.float32)
+                total += np.asarray(
+                    shards[sub.feature_shard] @ w, np.float32
+                ).ravel()
             else:
-                total += self._score_random_effect(sub, shards[sub.feature_shard], ids)
+                if prepared is None:
+                    prepared = self._prepared_for(shards, ids)
+                total += self._score_random_effect(
+                    sub, prepared.re_datasets[name]
+                )
         return total
 
     @staticmethod
-    def _score_random_effect(
-        model: RandomEffectModel, shard, ids: dict
-    ) -> np.ndarray:
-        """Score through the same block pipeline as training; entities
-        without trained coefficients (or padding) contribute zero."""
-        entity_col = np.asarray(ids[model.entity_key])
-        n = shard.shape[0]
-        # device=False: this is a pure-host computation; uploading blocks to
-        # the accelerator just to pull them back would waste PCIe/HBM.
-        dataset = build_random_effect_dataset(
-            entity_col, shard, np.zeros(n, np.float32), np.ones(n, np.float32),
-            device=False,
-        )
+    def _score_random_effect(model: RandomEffectModel, dataset) -> np.ndarray:
+        """Score a pre-grouped dataset through the block pipeline; entities
+        without trained coefficients (and padding) contribute zero."""
+        n = dataset.n_global_rows
         out = np.zeros(n + 1, np.float32)
         for block, block_ids in zip(dataset.blocks, dataset.entity_ids):
             coefs = model.coefficient_matrix_for(block.col_map, block_ids)

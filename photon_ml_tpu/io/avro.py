@@ -271,16 +271,27 @@ def write_container(
         flush()
 
 
-def read_container(path: str) -> tuple[Any, list[Any]]:
-    """Read an Avro object container file → (schema, records)."""
+def _read_header(f: BinaryIO, path: str) -> tuple[Any, str, bytes]:
+    """Parse the container header → (schema, codec, sync marker)."""
+    if f.read(4) != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    meta = read_datum(f, _META_SCHEMA)
+    schema = json.loads(meta["avro.schema"].decode("utf-8"))
+    codec = meta.get("avro.codec", b"null").decode("utf-8")
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"unsupported codec {codec!r}")
+    sync = f.read(16)
+    return schema, codec, sync
+
+
+def iter_blocks(path: str) -> Iterator[tuple[Any, int, bytes]]:
+    """Stream a container file block-by-block WITHOUT materializing records:
+    yields (schema, record_count, decompressed_block_payload).  This is the
+    scale path — a multi-GB file is processed one ~records_per_block chunk
+    at a time (the reference streams Avro through Spark partitions the same
+    way; SURVEY.md §7 hard-part "host→device ingest")."""
     with open(path, "rb") as f:
-        if f.read(4) != MAGIC:
-            raise ValueError(f"{path}: not an Avro container file")
-        meta = read_datum(f, _META_SCHEMA)
-        schema = json.loads(meta["avro.schema"].decode("utf-8"))
-        codec = meta.get("avro.codec", b"null").decode("utf-8")
-        sync = f.read(16)
-        records: list[Any] = []
+        schema, codec, sync = _read_header(f, path)
         while True:
             head = f.read(1)
             if not head:
@@ -290,16 +301,35 @@ def read_container(path: str) -> tuple[Any, list[Any]]:
             payload = read_bytes(f)
             if codec == "deflate":
                 payload = zlib.decompress(payload, -15)
-            elif codec != "null":
-                raise ValueError(f"unsupported codec {codec!r}")
-            body = _io.BytesIO(payload)
-            for _ in range(count):
-                records.append(read_datum(body, schema))
             if f.read(16) != sync:
                 raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
-        return schema, records
+            yield schema, count, payload
 
 
 def iter_container(path: str) -> Iterator[Any]:
-    _, records = read_container(path)
-    yield from records
+    """Yield records one at a time, holding at most one block in memory."""
+    for schema, count, payload in iter_blocks(path):
+        body = _io.BytesIO(payload)
+        for _ in range(count):
+            yield read_datum(body, schema)
+
+
+def read_schema(path: str) -> Any:
+    with open(path, "rb") as f:
+        schema, _, _ = _read_header(f, path)
+    return schema
+
+
+def read_container(path: str) -> tuple[Any, list[Any]]:
+    """Read an Avro object container file → (schema, records).  Convenience
+    for small files; use :func:`iter_container` / :func:`iter_blocks` for
+    anything large."""
+    records: list[Any] = []
+    schema = None
+    for schema, count, payload in iter_blocks(path):
+        body = _io.BytesIO(payload)
+        for _ in range(count):
+            records.append(read_datum(body, schema))
+    if schema is None:
+        schema = read_schema(path)
+    return schema, records
