@@ -497,7 +497,10 @@ class GameTransformer:
     def __init__(self, model: GameModel, logger=None):
         self.model = model
         self.logger = logger
-        self._cache: Optional[tuple] = None  # (shards, ids, prepared)
+        # (value-identity key, [weakrefs to source arrays], prepared); the
+        # weakref callbacks clear the slot when any source array dies, so a
+        # long-lived transformer never pins a dead scoring set's blocks.
+        self._cache: Optional[tuple] = None
 
     def prepare(self, shards: dict, ids: dict) -> PreparedScoringSet:
         """Group scoring rows by entity for every random-effect coordinate
@@ -515,15 +518,35 @@ class GameTransformer:
                 )
         return PreparedScoringSet(n_rows=n, re_datasets=re_datasets)
 
+    @staticmethod
+    def _cache_key(shards: dict, ids: dict) -> tuple:
+        """Identity of the VALUE objects (not the dicts): replacing a matrix
+        or id column inside the same dict objects must miss the cache."""
+        return (
+            tuple(sorted((name, id(m)) for name, m in shards.items())),
+            tuple(sorted((name, id(a)) for name, a in ids.items())),
+        )
+
     def _prepared_for(self, shards: dict, ids: dict) -> PreparedScoringSet:
-        if (
-            self._cache is not None
-            and self._cache[0] is shards
-            and self._cache[1] is ids
-        ):
+        import weakref
+
+        key = self._cache_key(shards, ids)
+        if self._cache is not None and self._cache[0] == key:
             return self._cache[2]
         prepared = self.prepare(shards, ids)
-        self._cache = (shards, ids, prepared)
+
+        def _clear(_ref, _self=weakref.ref(self)):
+            t = _self()
+            if t is not None:
+                t._cache = None
+
+        refs = []
+        for obj in list(shards.values()) + list(ids.values()):
+            try:
+                refs.append(weakref.ref(obj, _clear))
+            except TypeError:
+                pass  # un-weakref-able value: fall back to identity check
+        self._cache = (key, refs, prepared)
         return prepared
 
     def transform(

@@ -29,6 +29,8 @@ from jax import lax
 from photon_ml_tpu.optim.linesearch import (
     LineSearchConfig,
     ValueAndGrad,
+    pnorm,
+    pvdot,
     wolfe_line_search,
 )
 
@@ -78,7 +80,7 @@ class _LBFGSState(NamedTuple):
 
 
 def _two_loop(grad: Array, S: Array, Y: Array, rho: Array, gamma: Array,
-              k_pairs: Array) -> Array:
+              k_pairs: Array, w_axis: str | None = None) -> Array:
     """Two-loop recursion over the circular (S, Y) history.
 
     Slots with index >= k_pairs (never written) or rho == 0 (curvature-skipped)
@@ -93,7 +95,7 @@ def _two_loop(grad: Array, S: Array, Y: Array, rho: Array, gamma: Array,
 
     def first_loop(q, i_and_valid):
         i, is_valid = i_and_valid
-        alpha = rho[i] * jnp.vdot(S[i], q)
+        alpha = rho[i] * pvdot(S[i], q, w_axis)
         alpha = jnp.where(jnp.logical_and(is_valid, rho[i] > 0), alpha, 0.0)
         return q - alpha * Y[i], alpha
 
@@ -103,7 +105,7 @@ def _two_loop(grad: Array, S: Array, Y: Array, rho: Array, gamma: Array,
 
     def second_loop(r, scan_in):
         i, is_valid, alpha = scan_in
-        beta = rho[i] * jnp.vdot(Y[i], r)
+        beta = rho[i] * pvdot(Y[i], r, w_axis)
         corr = jnp.where(jnp.logical_and(is_valid, rho[i] > 0),
                          alpha - beta, 0.0)
         return r + corr * S[i], None
@@ -117,19 +119,19 @@ def _two_loop(grad: Array, S: Array, Y: Array, rho: Array, gamma: Array,
 
 def update_history(
     S: Array, Y: Array, rho: Array, gamma: Array, n_pairs: Array,
-    s_vec: Array, y_vec: Array,
+    s_vec: Array, y_vec: Array, w_axis: str | None = None,
 ) -> tuple[Array, Array, Array, Array, Array]:
     """Insert a curvature pair into the circular history, skipping it when
     <s, y> is not safely positive (standard safeguard).  Shared by L-BFGS
     and OWL-QN so the history rules cannot drift apart."""
     m = S.shape[0]
-    sy = jnp.vdot(s_vec, y_vec)
-    good = sy > 1e-10 * jnp.linalg.norm(s_vec) * jnp.linalg.norm(y_vec)
+    sy = pvdot(s_vec, y_vec, w_axis)
+    good = sy > 1e-10 * pnorm(s_vec, w_axis) * pnorm(y_vec, w_axis)
     slot = n_pairs % m
     S = jnp.where(good, S.at[slot].set(s_vec), S)
     Y = jnp.where(good, Y.at[slot].set(y_vec), Y)
     rho = jnp.where(good, rho.at[slot].set(1.0 / sy), rho)
-    gamma = jnp.where(good, sy / jnp.vdot(y_vec, y_vec), gamma)
+    gamma = jnp.where(good, sy / pvdot(y_vec, y_vec, w_axis), gamma)
     n_pairs = jnp.where(good, n_pairs + 1, n_pairs)
     return S, Y, rho, gamma, n_pairs
 
@@ -138,16 +140,24 @@ def lbfgs_solve(
     value_and_grad: ValueAndGrad,
     w0: Array,
     config: LBFGSConfig = LBFGSConfig(),
+    w_axis: str | None = None,
 ) -> SolveResult:
     """Minimize via L-BFGS.  Pure function of (w0, closure data); safe to wrap
     in ``jit`` / ``vmap`` (the vmap'd form is what batched per-entity
-    random-effect solves use) / ``shard_map`` (distributed objectives)."""
+    random-effect solves use) / ``shard_map`` (distributed objectives).
+
+    ``w_axis``: mesh axis name when ``w0`` (and the objective's gradient) are
+    feature-dim SHARDS of a wide coefficient vector (tensor parallelism —
+    SURVEY.md §5.7 scale axis (b)).  Every w-space inner product and norm in
+    the two-loop recursion, history update, and line search then reduces
+    over that axis, so the solver runs an exact replica of the single-device
+    iteration on sharded state."""
     m = config.history
     d = w0.shape[0]
     dtype = w0.dtype
 
     f0, g0 = value_and_grad(w0)
-    g0_norm = jnp.linalg.norm(g0)
+    g0_norm = pnorm(g0, w_axis)
     tol_scale = jnp.maximum(1.0, g0_norm)
 
     n_track = config.max_iters + 1
@@ -174,8 +184,10 @@ def lbfgs_solve(
         return jnp.logical_and(~s.done, s.k < config.max_iters)
 
     def body(s: _LBFGSState):
-        direction = -_two_loop(s.grad, s.S, s.Y, s.rho, s.gamma, s.n_pairs)
-        dg = jnp.vdot(direction, s.grad)
+        direction = -_two_loop(
+            s.grad, s.S, s.Y, s.rho, s.gamma, s.n_pairs, w_axis
+        )
+        dg = pvdot(direction, s.grad, w_axis)
         # Fall back to steepest descent if the history produced a
         # non-descent direction (can happen after skipped updates).
         bad = dg >= 0.0
@@ -185,20 +197,21 @@ def lbfgs_solve(
         # (1 / ||g||, capped at 1) so the unit quasi-Newton step is sane later.
         first = s.n_pairs == 0
         init_step = jnp.where(
-            first, jnp.minimum(1.0, 1.0 / jnp.linalg.norm(s.grad)), 1.0
+            first, jnp.minimum(1.0, 1.0 / pnorm(s.grad, w_axis)), 1.0
         )
 
         ls = wolfe_line_search(
             value_and_grad, s.w, s.value, s.grad, direction,
-            initial_step=init_step, config=config.line_search,
+            initial_step=init_step, config=config.line_search, w_axis=w_axis,
         )
 
         S, Y, rho, gamma, n_pairs = update_history(
-            s.S, s.Y, s.rho, s.gamma, s.n_pairs, ls.w - s.w, ls.grad - s.grad
+            s.S, s.Y, s.rho, s.gamma, s.n_pairs, ls.w - s.w, ls.grad - s.grad,
+            w_axis,
         )
 
         k = s.k + 1
-        g_norm = jnp.linalg.norm(ls.grad)
+        g_norm = pnorm(ls.grad, w_axis)
         # Converged when the gradient is small (relative, Breeze-style) or the
         # objective stops moving (relative function decrease).
         rel_impr = jnp.abs(s.value - ls.value) / jnp.maximum(
@@ -212,7 +225,7 @@ def lbfgs_solve(
         stalled = jnp.logical_and(~ls.success, ls.value >= s.value)
         converged = jnp.where(
             stalled,
-            jnp.linalg.norm(s.grad) <= config.tolerance * tol_scale,
+            pnorm(s.grad, w_axis) <= config.tolerance * tol_scale,
             jnp.logical_or(
                 g_norm <= config.tolerance * tol_scale,
                 rel_impr <= config.tolerance * 1e-2,
@@ -233,7 +246,7 @@ def lbfgs_solve(
             converged=converged,
             values=s.values.at[k].set(value_next),
             grad_norms=s.grad_norms.at[k].set(
-                jnp.where(stalled, jnp.linalg.norm(s.grad), g_norm)
+                jnp.where(stalled, pnorm(s.grad, w_axis), g_norm)
             ),
         )
 

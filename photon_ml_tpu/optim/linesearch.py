@@ -28,6 +28,22 @@ Array = jax.Array
 ValueAndGrad = Callable[[Array], tuple[Array, Array]]
 
 
+def pvdot(a: Array, b: Array, w_axis: str | None = None) -> Array:
+    """w-space inner product; with ``w_axis`` the vectors are SHARDS of a
+    coefficient vector sharded over that mesh axis (feature-dim / tensor
+    parallelism — SURVEY.md §2 parallelism table, TP row) and the partial
+    dot is psum'd so every device sees the global value."""
+    r = jnp.vdot(a, b)
+    return lax.psum(r, w_axis) if w_axis is not None else r
+
+
+def pnorm(a: Array, w_axis: str | None = None) -> Array:
+    """w-space 2-norm, global under w-sharding (see :func:`pvdot`)."""
+    if w_axis is None:
+        return jnp.linalg.norm(a)
+    return jnp.sqrt(pvdot(a, a, w_axis))
+
+
 @dataclasses.dataclass(frozen=True)
 class LineSearchConfig:
     c1: float = 1e-4  # Armijo (sufficient decrease) constant
@@ -66,6 +82,7 @@ def wolfe_line_search(
     direction: Array,
     initial_step: Array | float = 1.0,
     config: LineSearchConfig = LineSearchConfig(),
+    w_axis: str | None = None,
 ) -> LineSearchResult:
     """Find t satisfying the weak Wolfe conditions along ``direction``.
 
@@ -74,14 +91,17 @@ def wolfe_line_search(
     while unbracketed).  Always returns the last evaluated point; ``success``
     reports whether the Wolfe conditions actually held (callers fall back to
     steepest descent / skip the curvature pair when it is False).
+
+    ``w_axis``: mesh axis name when w/grad/direction are feature-dim shards
+    (directional derivatives are then psum'd globals).
     """
-    dg0 = jnp.vdot(direction, g0)
+    dg0 = pvdot(direction, g0, w_axis)
     t0 = jnp.asarray(initial_step, dtype=f0.dtype)
 
     def evaluate(t):
         w = w0 + t * direction
         value, grad = value_and_grad(w)
-        return w, value, grad, jnp.vdot(direction, grad)
+        return w, value, grad, pvdot(direction, grad, w_axis)
 
     def cond(s: _SearchState):
         return jnp.logical_and(~s.done, s.n_evals < config.max_evals)

@@ -1,0 +1,257 @@
+"""Feature-dim (tensor) parallelism for wide fixed-effect GLMs.
+
+The reference has no TP — its model is one weight vector small enough to
+broadcast (SURVEY.md §2 parallelism table, TP row: "optional feature-dim
+sharding for very wide models"; §5.7 scale axis (b): feature spaces up to
+very wide sparse widths).  At 10⁸+ features, a replicated ``w`` (plus the
+L-BFGS ``(m, d)`` history buffers — 10× ``w``!) no longer fits per-device
+alongside the data, so here both are sharded over a second mesh axis:
+
+- mesh: 2-D ``(data, feature)`` — rows sharded over ``data`` as in
+  parallel/distributed.py, columns of X and entries of ``w`` sharded over
+  ``feature``;
+- each device holds ONE (row-block × column-slice) tile of X with local
+  column ids, its slice of ``w``, and its slice of every history vector;
+- margins: local tile matvec then ``psum`` over the FEATURE axis (each
+  data-rank's row margins need every column's contribution);
+- gradient: loss derivatives are replicated within a feature group (they
+  depend only on margins), so the local ``rmatvec`` then ``psum`` over the
+  DATA axis yields the gradient SLICE for the local columns — the gradient
+  is born sharded exactly like ``w``, no all-gather anywhere;
+- the whole L-BFGS loop runs on sharded state inside ``shard_map``: every
+  w-space inner product / norm reduces over the feature axis
+  (``optim.lbfgs`` ``w_axis``), so the iteration is an exact replica of the
+  single-device one.
+
+Per objective evaluation the wire cost is one (rows/dp)-length psum over
+``feature`` + one fused scalar/slice psum over ``data`` — both ride ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.ops import losses as losses_lib
+from photon_ml_tpu.ops.sparse import DenseMatrix, SparseMatrix, from_coo
+from photon_ml_tpu.optim.lbfgs import LBFGSConfig, SolveResult, lbfgs_solve
+from photon_ml_tpu.parallel.distributed import DATA_AXIS
+
+Array = jax.Array
+
+FEATURE_AXIS = "feature"
+
+
+def dp_tp_mesh(
+    dp: int, tp: int, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """A (data=dp, feature=tp) mesh.  Convention: the FEATURE axis is the
+    minor (fastest-varying) one so a feature group's devices are ICI
+    neighbors — the per-evaluation margin psum rides the shortest links."""
+    devices = jax.devices() if devices is None else list(devices)
+    if len(devices) < dp * tp:
+        raise ValueError(f"need {dp * tp} devices, have {len(devices)}")
+    return Mesh(
+        np.asarray(devices[: dp * tp]).reshape(dp, tp),
+        (DATA_AXIS, FEATURE_AXIS),
+    )
+
+
+def _ceil_to(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k
+
+
+def shard_glm_data_dp_tp(
+    X_host,
+    labels: np.ndarray,
+    mesh: Mesh,
+    weights: Optional[np.ndarray] = None,
+    offsets: Optional[np.ndarray] = None,
+    dtype=jnp.float32,
+):
+    """Tile host data over the (data, feature) mesh.
+
+    Rows pad (weight 0) to a multiple of dp; columns pad (all-zero) to a
+    multiple of tp.  Returns ``(features, labels, weights, offsets, d)``
+    where ``features`` arrays carry leading (dp, tp) tile axes, the row
+    arrays carry a leading (dp,) axis (replicated over feature by their
+    sharding), and ``d`` is the ORIGINAL feature count (strip padding from
+    the solution with ``w[:d]``).
+    """
+    import scipy.sparse as sp
+
+    dp, tp = (mesh.shape[DATA_AXIS], mesh.shape[FEATURE_AXIS])
+    n, d = X_host.shape
+    rows_per = _ceil_to(n, dp) // dp
+    cols_per = _ceil_to(d, tp) // tp
+
+    labels = np.asarray(labels, np.float32)
+    weights = (
+        np.ones(n, np.float32) if weights is None
+        else np.asarray(weights, np.float32)
+    )
+    offsets = (
+        np.zeros(n, np.float32) if offsets is None
+        else np.asarray(offsets, np.float32)
+    )
+    pad = dp * rows_per - n
+    labels = np.concatenate([labels, np.zeros(pad, np.float32)])
+    weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+    offsets = np.concatenate([offsets, np.zeros(pad, np.float32)])
+
+    if sp.issparse(X_host):
+        csr = X_host.tocsr()
+        csr.sum_duplicates()
+        tiles = []
+        budget = 1
+        for i in range(dp):
+            row_block = csr[min(i * rows_per, n): min((i + 1) * rows_per, n)]
+            row_tiles = []
+            for j in range(tp):
+                tile = row_block[:, j * cols_per: min((j + 1) * cols_per, d)]
+                coo = tile.tocoo()
+                row_tiles.append((coo.row, coo.col, coo.data))
+                budget = max(budget, coo.nnz)
+            tiles.append(row_tiles)
+        mats = [
+            [
+                from_coo(r, c, v, rows_per, cols_per, budget, dtype)
+                for (r, c, v) in row_tiles
+            ]
+            for row_tiles in tiles
+        ]
+        features = SparseMatrix(
+            row_ids=jnp.stack(
+                [jnp.stack([m.row_ids for m in row]) for row in mats]
+            ),
+            col_ids=jnp.stack(
+                [jnp.stack([m.col_ids for m in row]) for row in mats]
+            ),
+            values=jnp.stack(
+                [jnp.stack([m.values for m in row]) for row in mats]
+            ),
+            n_rows=rows_per,
+            n_cols=cols_per,
+        )
+    else:
+        dense = np.asarray(X_host, np.float32)
+        dense = np.pad(
+            dense, ((0, dp * rows_per - n), (0, tp * cols_per - d))
+        )
+        features = DenseMatrix(
+            jnp.asarray(
+                dense.reshape(dp, rows_per, tp, cols_per).transpose(
+                    0, 2, 1, 3
+                ),
+                dtype,
+            )
+        )
+
+    feat_sharding = NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS))
+    row_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    features = jax.tree.map(
+        lambda x: jax.device_put(x, feat_sharding), features
+    )
+    put_rows = lambda a: jax.device_put(
+        jnp.asarray(a.reshape(dp, rows_per)), row_sharding
+    )
+    return (
+        features,
+        put_rows(labels),
+        put_rows(weights),
+        put_rows(offsets),
+        d,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _make_tp_solver(task: str, mesh: Mesh, config: LBFGSConfig):
+    """ONE jitted shard_map program per (task, mesh, config) — reused across
+    calls, so a λ sweep or repeated fits pay a single compile per data shape
+    (``reg_weight`` and the data are traced arguments)."""
+    loss = losses_lib.get(task)
+
+    def spmd(feat, lab, wts, off, w0_local, lam):
+        local = jax.tree.map(lambda x: x[0, 0], feat)
+        lab, wts, off = lab[0], wts[0], off[0]
+
+        def vg(wl):
+            # Margins: every feature-rank contributes its column slice.
+            m = lax.psum(local.matvec(wl), FEATURE_AXIS) + off
+            val = lax.psum(
+                jnp.sum(wts * loss.value(m, lab)), DATA_AXIS
+            )
+            u = wts * loss.d1(m, lab)
+            # Gradient slice for the local columns — born sharded like w.
+            g = lax.psum(local.rmatvec(u), DATA_AXIS)
+            val = val + 0.5 * lam * lax.psum(
+                jnp.vdot(wl, wl), FEATURE_AXIS
+            )
+            return val, g + lam * wl
+
+        return lbfgs_solve(vg, w0_local, config, w_axis=FEATURE_AXIS)
+
+    out_specs = SolveResult(
+        w=P(FEATURE_AXIS),
+        value=P(),
+        grad=P(FEATURE_AXIS),
+        iterations=P(),
+        converged=P(),
+        values=P(),
+        grad_norms=P(),
+    )
+    return jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(
+                P(DATA_AXIS, FEATURE_AXIS),
+                P(DATA_AXIS),
+                P(DATA_AXIS),
+                P(DATA_AXIS),
+                P(FEATURE_AXIS),
+                P(),
+            ),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+
+
+def tp_lbfgs_solve(
+    task: str,
+    features,
+    labels: Array,
+    weights: Array,
+    offsets: Array,
+    mesh: Mesh,
+    reg_weight: Array | float = 0.0,
+    w0: Optional[Array] = None,
+    config: LBFGSConfig = LBFGSConfig(),
+) -> SolveResult:
+    """Fit an L2 GLM with rows sharded over DATA and features over FEATURE.
+
+    ``features``/``labels``... come from :func:`shard_glm_data_dp_tp`.
+    Returns a replicated :class:`SolveResult` whose ``w`` is the full
+    (column-padded) coefficient vector — slice ``w[:d]``.  ``reg_weight``
+    is a traced scalar and the compiled program is memoized per
+    (task, mesh, config): λ sweeps reuse one compile.
+    """
+    tp = mesh.shape[FEATURE_AXIS]
+    if isinstance(features, SparseMatrix):
+        d_padded = features.n_cols * tp  # n_cols is the per-tile width
+    else:
+        d_padded = features.data.shape[1] * features.data.shape[3]
+    if w0 is None:
+        w0 = jnp.zeros((d_padded,), jnp.float32)
+    fn = _make_tp_solver(losses_lib.get(task).name, mesh, config)
+    return fn(
+        features, labels, weights, offsets, w0,
+        jnp.asarray(reg_weight, jnp.float32),
+    )

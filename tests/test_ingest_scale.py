@@ -304,3 +304,49 @@ class TestReviewRegressions:
         prep = t.prepare(shards_a, ids_a)
         with pytest.raises(ValueError, match="prepared scoring set"):
             t.transform(shards_b, ids_b, prepared=prep)
+
+
+class TestTransformerCacheStaleness:
+    def test_replaced_dict_values_miss_cache(self, monkeypatch):
+        """Mutating the VALUES inside the same shards/ids dicts must rebuild
+        the grouping (identity of the arrays, not the dicts, is the key)."""
+        import photon_ml_tpu.game.estimator as est_mod
+        from photon_ml_tpu.game.estimator import GameTransformer
+        from photon_ml_tpu.game.model import GameModel
+
+        nf = 6
+        table = {"u0": (np.array([0], np.int32), np.array([5.0], np.float32)),
+                 "u1": (np.array([0], np.int32), np.array([-3.0], np.float32))}
+        model = GameModel(models={"re": RandomEffectModel(
+            table, "s", "userId", "logistic", nf)}, task="logistic")
+
+        shards = {"s": sp.csr_matrix(np.ones((4, nf), np.float32))}
+        ids = {"userId": np.array(["u0", "u0", "u1", "u1"])}
+        t = GameTransformer(model)
+        s1 = t.transform(shards, ids)
+        np.testing.assert_array_equal(s1, [5.0, 5.0, -3.0, -3.0])
+
+        # Same dict objects, swapped values (same shapes): batch 2.
+        shards["s"] = sp.csr_matrix(np.ones((4, nf), np.float32))
+        ids["userId"] = np.array(["u1", "u1", "u0", "u0"])
+        s2 = t.transform(shards, ids)
+        np.testing.assert_array_equal(s2, [-3.0, -3.0, 5.0, 5.0])
+
+    def test_cache_cleared_when_source_dies(self):
+        import gc
+
+        from photon_ml_tpu.game.estimator import GameTransformer
+        from photon_ml_tpu.game.model import GameModel
+
+        nf = 3
+        model = GameModel(models={"re": RandomEffectModel(
+            {"u0": (np.array([0], np.int32), np.array([1.0], np.float32))},
+            "s", "userId", "logistic", nf)}, task="logistic")
+        t = GameTransformer(model)
+        shards = {"s": sp.csr_matrix(np.ones((2, nf), np.float32))}
+        ids = {"userId": np.array(["u0", "u0"])}
+        t.transform(shards, ids)
+        assert t._cache is not None
+        del shards, ids
+        gc.collect()
+        assert t._cache is None  # weakref callbacks released the blocks
