@@ -1,11 +1,16 @@
-"""Headline benchmark: logistic-GLM epoch throughput on one chip.
+"""Headline benchmarks — all three BASELINE.json metrics.
 
-Measures the hot loop of BASELINE.json's headline metric ("1B-row logistic
-GLM epoch time"): fused value+gradient evaluations of a sparse logistic
-objective — the exact op Spark's ``treeAggregate`` performs per L-BFGS
-iteration in the reference (SURVEY.md §3.1) — and reports rows/second.
-Epoch time for any row count divides out: 1B rows / (rows/sec) = epoch
-seconds per objective evaluation.
+1. ``logistic_glm_rows_per_sec`` (primary): fused value+gradient throughput
+   of the sparse logistic objective — the hot op behind BASELINE's "1B-row
+   logistic GLM epoch time" (epoch seconds = 1e9 / rows_per_sec per
+   objective evaluation; SURVEY.md §3.1 hot loop).
+2. ``game_cd_iters_per_sec``: full GAME coordinate-descent iterations
+   (fixed effect + long-tailed per-user random effect) per second on a
+   MovieLens-shaped synthetic — 10⁵ entities, zipf-tailed row counts
+   (BASELINE metric "GAME coord-descent iters/sec").
+3. ``glm_driver_wall_seconds``: end-to-end legacy GLM driver wall-clock
+   (read → index → summarize → train λ grid → validate → select → write) on
+   an a1a-shaped dataset (BASELINE config 1).
 
 MEASUREMENT METHODOLOGY (fixed in round 2): iterations are chained inside
 ONE jitted ``fori_loop`` and the clock stops only after a small slice of the
@@ -19,25 +24,58 @@ bench_baseline.json.  ``vs_baseline`` continues to be reported against the
 COMMITTED round-1 number for round-over-round continuity, and is therefore
 a massive *understatement* of the real kernel speedup (~70x).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"} —
+the primary metric in the required fields, the other two under "extra" with
+their own vs_baseline ratios.
+
+Env knobs: BENCH_SMALL=1 shrinks every workload (CI/smoke); BENCH_ONLY=
+glm|game|driver runs a single section.
 """
 
 import json
 import os
+import sys
+import tempfile
 import time
 
 import numpy as np
 
-N_ROWS = 1 << 20  # 1,048,576
-N_FEATURES = 1 << 13  # 8,192
+
+def _log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+SMALL = os.environ.get("BENCH_SMALL") == "1"
+ONLY = os.environ.get("BENCH_ONLY", "")
+
+N_ROWS = 1 << (16 if SMALL else 20)
+N_FEATURES = 1 << 13
 NNZ_PER_ROW = 32
 N_CHAINED = 10  # objective evals chained inside one jit
 N_REPS = 3  # timed repetitions (min taken)
+
+GAME_ENTITIES = 2_000 if SMALL else 100_000
+GAME_FIXED_FEATURES = 512
+GAME_FIXED_NNZ = 8
+GAME_RE_DIM = 8
+GAME_TIMED_ITERS = 1
+GAME_BUCKET_GROWTH = 4.0  # consolidate the zipf tail: ~5 compiled shapes
+GAME_ROW_CAP = 128
+
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
 
 
-def main() -> None:
+def _read_sync(x) -> None:
+    """Force true completion: read one element back to host."""
+    np.asarray(x.ravel()[0:1])
+
+
+def bench_glm_throughput() -> float:
+    """rows/s of the fused sparse logistic value+grad (primary metric)."""
     import jax
     import jax.numpy as jnp
 
@@ -57,8 +95,7 @@ def main() -> None:
     y = (rng.uniform(size=N_ROWS) < 1 / (1 + np.exp(-margins_true))).astype(
         np.float32)
 
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
+    if jax.default_backend() == "tpu":
         from photon_ml_tpu.ops.sparse_pallas import build_pallas_matrix
 
         X = build_pallas_matrix(rows, cols, values, N_ROWS, N_FEATURES)
@@ -84,34 +121,184 @@ def main() -> None:
             return w - 1e-4 * grad
         return jax.lax.fori_loop(0, N_CHAINED, body, w)
 
+    _log("glm: compiling throughput chain...")
     w = jnp.zeros(N_FEATURES, jnp.float32)
     out = chain(w, data)
-    _ = np.asarray(out.ravel()[0:1])  # compile + prime true sync
+    _read_sync(out)  # compile + prime true sync
 
     best = np.inf
     for i in range(N_REPS):
         wp = jnp.full((N_FEATURES,), np.float32(1e-3 * (i + 1)))
-        _ = np.asarray(wp.ravel()[0:1])
+        _read_sync(wp)
         t0 = time.perf_counter()
         out = chain(wp, data)
-        _ = np.asarray(out.ravel()[0:1])  # force real completion
+        _read_sync(out)  # force real completion
         best = min(best, (time.perf_counter() - t0) / N_CHAINED)
 
-    rows_per_sec = N_ROWS / best
+    return N_ROWS / best
 
-    vs_baseline = 1.0
+
+def bench_game_cd() -> float:
+    """Full coordinate-descent iterations per second on a MovieLens-shaped
+    synthetic: one fixed effect over sparse global features + one per-user
+    random effect with a zipf long tail of rows per user."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.game.coordinates import (
+        FixedEffectCoordinate,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.game.data import (
+        FixedEffectDataset,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.game.descent import CoordinateDescent
+    from photon_ml_tpu.data.dataset import make_glm_data
+    from photon_ml_tpu.optim.problem import (
+        GlmOptimizationConfig,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.optim.regularization import RegularizationContext
+
+    rng = np.random.default_rng(1)
+    # Long-tailed rows per entity (MovieLens-like): zipf, capped so bucket
+    # count (= compile count) stays bounded.
+    sizes = np.minimum(rng.zipf(1.8, GAME_ENTITIES), GAME_ROW_CAP)
+    n = int(sizes.sum())
+    users = np.repeat(
+        np.array([f"u{i}" for i in range(GAME_ENTITIES)], dtype=object),
+        sizes,
+    )
+    perm = rng.permutation(n)
+    users = users[perm]
+
+    nnzf = n * GAME_FIXED_NNZ
+    Xg = sp.csr_matrix(
+        (rng.normal(size=nnzf).astype(np.float32),
+         (np.repeat(np.arange(n, dtype=np.int64), GAME_FIXED_NNZ),
+          rng.integers(0, GAME_FIXED_FEATURES, size=nnzf))),
+        shape=(n, GAME_FIXED_FEATURES),
+    )
+    Xu = sp.csr_matrix(rng.normal(size=(n, GAME_RE_DIM)).astype(np.float32))
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    weights = np.ones(n, np.float32)
+
+    opt = GlmOptimizationConfig(
+        optimizer=OptimizerConfig(max_iters=10, tolerance=1e-6),
+        regularization=RegularizationContext.l2(),
+    )
+    fixed = FixedEffectCoordinate(
+        "fixed",
+        FixedEffectDataset(data=make_glm_data(Xg, y), n_global_rows=n),
+        "logistic", opt, reg_weight=1.0,
+    )
+    _log(f"game: {n} rows, {GAME_ENTITIES} entities; grouping...")
+    re_ds = build_random_effect_dataset(
+        users, Xu, y, weights, bucket_growth=GAME_BUCKET_GROWTH
+    )
+    _log(f"game: {len(re_ds.blocks)} buckets "
+         f"{[(b.n_entities, b.rows_per_entity) for b in re_ds.blocks]}")
+    re = RandomEffectCoordinate(
+        "per_user", re_ds,
+        "logistic", opt, reg_weight=1.0, entity_key="userId",
+    )
+    cd = CoordinateDescent([fixed, re])
+
+    import jax.numpy as jnp
+
+    base = jnp.zeros(n, jnp.float32)
+    _log("game: warmup iteration (compiles every bucket shape)...")
+    warm = cd.run(base, n_iterations=1)  # warmup: compiles every bucket shape
+    # The CD loop's per-update float(score_norm) already forces readbacks,
+    # but sync explicitly anyway — same discipline as the GLM bench.
+    _read_sync(warm.scores["per_user"])
+    _log("game: warmup done; timing...")
+
+    t0 = time.perf_counter()
+    result = cd.run(base, n_iterations=GAME_TIMED_ITERS)
+    _read_sync(result.scores["per_user"])
+    dt = time.perf_counter() - t0
+    _log(f"game: {GAME_TIMED_ITERS} iters in {dt:.2f}s")
+    return GAME_TIMED_ITERS / dt
+
+
+def bench_glm_driver() -> float:
+    """Wall-clock of the full legacy GLM driver on an a1a-shaped dataset
+    (1605 train / 2000 validate rows, 123 binary features, 3-point λ grid)."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data import libsvm
+    from photon_ml_tpu.drivers import glm_driver
+
+    rng = np.random.default_rng(2)
+    n_train, n_val, d = (400, 200, 123) if SMALL else (1605, 2000, 123)
+    X = sp.random(
+        n_train + n_val, d, density=0.11, random_state=4, format="csr"
+    )
+    X.data[:] = 1.0
+    w_true = rng.normal(size=d) * (rng.uniform(size=d) < 0.3)
+    logits = X @ w_true - 0.5
+    y = np.where(
+        rng.uniform(size=n_train + n_val) < 1 / (1 + np.exp(-logits)),
+        1.0, -1.0,
+    )
+    with tempfile.TemporaryDirectory() as td:
+        train = os.path.join(td, "a1a_shaped.libsvm")
+        val = os.path.join(td, "a1a_shaped.t.libsvm")
+        libsvm.write_libsvm(train, X[:n_train], y[:n_train])
+        libsvm.write_libsvm(val, X[n_train:], y[n_train:])
+        _log("driver: running glm_driver end to end...")
+        t0 = time.perf_counter()
+        glm_driver.run([
+            "--train-data", train,
+            "--validate-data", val,
+            "--output-dir", os.path.join(td, "out"),
+            "--task", "logistic",
+            "--reg-type", "l2",
+            "--reg-weights", "0.1,1.0,10.0",
+            "--n-features", str(d),
+        ])
+        return time.perf_counter() - t0
+
+
+def main() -> None:
+    baseline = {}
     if os.path.exists(BASELINE_FILE):
         with open(BASELINE_FILE) as f:
-            base = json.load(f).get("logistic_glm_rows_per_sec")
-        if base:
-            vs_baseline = rows_per_sec / base
+            baseline = json.load(f)
 
-    print(json.dumps({
+    def ratio(value, key, smaller_is_better=False):
+        base = baseline.get(key)
+        if not base:
+            return 1.0
+        return round(base / value if smaller_is_better else value / base, 4)
+
+    extra = {}
+    if ONLY in ("", "game"):
+        v = bench_game_cd()
+        extra["game_cd_iters_per_sec"] = round(v, 3)
+        extra["game_cd_vs_baseline"] = ratio(v, "game_cd_iters_per_sec")
+    if ONLY in ("", "driver"):
+        v = bench_glm_driver()
+        extra["glm_driver_wall_seconds"] = round(v, 2)
+        extra["glm_driver_vs_baseline"] = ratio(
+            v, "glm_driver_wall_seconds", smaller_is_better=True
+        )
+    out = {
         "metric": "logistic_glm_rows_per_sec",
-        "value": round(rows_per_sec, 1),
         "unit": "rows/s",
-        "vs_baseline": round(vs_baseline, 4),
-    }))
+        "extra": extra,
+    }
+    if ONLY in ("", "glm"):
+        rows_per_sec = bench_glm_throughput()
+        out["value"] = round(rows_per_sec, 1)
+        out["vs_baseline"] = ratio(rows_per_sec, "logistic_glm_rows_per_sec")
+    else:
+        # Debug-only partial run: never report a fake 0.0 regression.
+        out["value"] = None
+        out["vs_baseline"] = None
+        out["note"] = f"primary metric skipped (BENCH_ONLY={ONLY})"
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -247,6 +247,109 @@ def _decode_game_blocks(path: str, acc: _Accumulator) -> None:
             acc.finish_row()
 
 
+def _decode_game_blocks_native(path: str, acc: _Accumulator) -> bool:
+    """Decode through the C++ session (photon_ml_tpu/native): the whole
+    per-feature hot path — varint parsing AND the feature-key→column hash
+    lookups — runs in native code; only columnar arrays cross back.
+    Returns False (leaving ``acc`` untouched) when the native library is
+    unavailable, True on success.  Raises ValueError on malformed input,
+    like the Python decoders."""
+    import ctypes
+
+    from photon_ml_tpu.native import load_game_decoder
+
+    lib = load_game_decoder()
+    if lib is None:
+        return False
+    h = lib.gd_new(1 if acc.building else 0)
+    try:
+        if not acc.building:
+            for shard, fwd in acc.forward.items():
+                keys = [k for k, _ in sorted(fwd.items(), key=lambda kv: kv[1])]
+                arr = (ctypes.c_char_p * len(keys))(
+                    *[k.encode("utf-8") for k in keys]
+                )
+                lib.gd_preload_shard(h, shard.encode("utf-8"), arr, len(keys))
+        for _schema, count, payload in avro.iter_blocks(path):
+            rc = lib.gd_decode_block(h, payload, len(payload), count)
+            if rc != 0:
+                raise ValueError(
+                    f"{path}: {lib.gd_error(h).decode()} (native decoder)"
+                )
+        n = lib.gd_n_rows(h)
+        acc.n = int(n)
+
+        resp = np.empty(n, np.float64)
+        wt = np.empty(n, np.float64)
+        off = np.empty(n, np.float64)
+        as_d = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        as_i = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        as_f = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        if n:
+            lib.gd_copy_row_data(h, as_d(resp), as_d(wt), as_d(off))
+        acc.response = resp
+        acc.weight = wt
+        acc.offset = off
+
+        def _strings(blob_len, copy_fn):
+            blob = ctypes.create_string_buffer(max(int(blob_len), 1))
+            start = np.empty(n, np.int64)
+            end = np.empty(n, np.int64)
+            if n:
+                copy_fn(blob, as_i(start), as_i(end))
+            raw = blob.raw
+            return [
+                raw[s:e].decode("utf-8") if s >= 0 else None
+                for s, e in zip(start, end)
+            ]
+
+        acc.uids = _strings(
+            lib.gd_uid_blob_len(h),
+            lambda b, s, e: lib.gd_copy_uids(h, b, s, e),
+        )
+        for i in range(lib.gd_n_id_cols(h)):
+            name = lib.gd_id_col_name(h, i).decode("utf-8")
+            acc.id_cols[name] = _strings(
+                lib.gd_id_col_blob_len(h, i),
+                lambda b, s, e, i=i: lib.gd_copy_id_col(h, i, b, s, e),
+            )
+
+        for i in range(lib.gd_n_shards(h)):
+            shard = lib.gd_shard_name(h, i).decode("utf-8")
+            dropped = int(lib.gd_shard_dropped(h, i))
+            if dropped:
+                acc.dropped[shard] = dropped
+            if lib.gd_shard_unknown(h, i) or not lib.gd_shard_seen(h, i):
+                # Unknown shard (scoring) → excluded; preloaded shard never
+                # seen in the data → excluded (matches the Python paths).
+                continue
+            nnz = int(lib.gd_shard_nnz(h, i))
+            rows = np.empty(nnz, np.int64)
+            cols = np.empty(nnz, np.int64)
+            vals = np.empty(nnz, np.float32)
+            if nnz:
+                lib.gd_copy_shard_coo(h, i, as_i(rows), as_i(cols), as_f(vals))
+            acc.shard_rows[shard] = (rows, cols, vals)
+            if acc.building:
+                nkeys = int(lib.gd_shard_nkeys(h, i))
+                blob = ctypes.create_string_buffer(
+                    max(int(lib.gd_shard_keys_blob_len(h, i)), 1)
+                )
+                offsets = np.empty(nkeys, np.int64)
+                if nkeys:
+                    lib.gd_copy_shard_keys(h, i, blob, as_i(offsets))
+                raw = blob.raw
+                keys = []
+                pos = 0
+                for koff in offsets:
+                    keys.append(raw[pos:koff].decode("utf-8"))
+                    pos = int(koff)
+                acc.forward[shard] = {k: j for j, k in enumerate(keys)}
+        return True
+    finally:
+        lib.gd_free(h)
+
+
 def _decode_generic(path: str, acc: _Accumulator) -> None:
     """Fallback: stream records through the generic datum decoder."""
     for rec in avro.iter_container(path):
@@ -289,7 +392,8 @@ def read_game_avro(
     }
     acc = _Accumulator(building, forward)
     if _is_game_schema(avro.read_schema(path)):
-        _decode_game_blocks(path, acc)
+        if not _decode_game_blocks_native(path, acc):
+            _decode_game_blocks(path, acc)
     else:
         _decode_generic(path, acc)
     n = acc.n
@@ -311,15 +415,16 @@ def read_game_avro(
         if building and shard in add_intercept_shards:
             fwd.setdefault(INTERCEPT_KEY, len(fwd))
         imap = index_maps[shard] if not building else IndexMap.build(fwd)
+        rows = np.asarray(rows, np.int64)
+        cols = np.asarray(cols, np.int64)
+        vals = np.asarray(vals, np.float32)
         if shard in add_intercept_shards and INTERCEPT_KEY in imap:
             icol = imap[INTERCEPT_KEY]
-            rows = rows + list(range(n))
-            cols = cols + [icol] * n
-            vals = vals + [1.0] * n
+            rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+            cols = np.concatenate([cols, np.full(n, icol, np.int64)])
+            vals = np.concatenate([vals, np.ones(n, np.float32)])
         shards[shard] = sp.csr_matrix(
-            (np.asarray(vals, np.float32),
-             (np.asarray(rows, np.int64), np.asarray(cols, np.int64))),
-            shape=(n, len(fwd)),
+            (vals, (rows, cols)), shape=(n, len(fwd)),
         )
         out_maps[shard] = imap
 

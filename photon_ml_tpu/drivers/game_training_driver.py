@@ -107,6 +107,7 @@ def parse_coordinate_config(spec: dict):
             optimization=opt,
             reg_weight=float(spec.get("reg_weight", 0.0)),
             max_rows_per_entity=spec.get("max_rows_per_entity"),
+            bucket_growth=float(spec.get("bucket_growth", 2.0)),
         )
     raise ValueError(f"unknown coordinate type {spec['type']!r}")
 

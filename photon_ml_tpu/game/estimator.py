@@ -63,6 +63,9 @@ class RandomEffectCoordinateConfig:
     optimization: GlmOptimizationConfig = GlmOptimizationConfig()
     reg_weight: float = 0.0
     max_rows_per_entity: Optional[int] = None
+    #: geometric bucket grid for per-entity size bucketing (2.0 = pow2);
+    #: larger values consolidate long tails into fewer compiled programs.
+    bucket_growth: float = 2.0
 
 
 CoordinateConfig = FixedEffectCoordinateConfig | RandomEffectCoordinateConfig
@@ -108,6 +111,7 @@ class GameEstimator:
             cfg.feature_shard,
             cfg.entity_key,
             cfg.max_rows_per_entity,
+            cfg.bucket_growth,
         )
 
     def _build_coordinates(
@@ -168,6 +172,7 @@ class GameEstimator:
                         np.asarray(response, np.float32),
                         weight,
                         max_rows_per_entity=cfg.max_rows_per_entity,
+                        bucket_growth=cfg.bucket_growth,
                     )
                     cache[key] = dataset
                 coordinates.append(

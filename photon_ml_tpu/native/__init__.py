@@ -1,0 +1,127 @@
+"""Native (C++) ingest components, loaded via ctypes.
+
+The shared library builds lazily from the checked-in source with the
+system ``g++`` the first time it is needed (no pybind11 in this
+environment; the C ABI + ctypes needs no Python headers).  The build is
+cached next to the source and invalidated on source change.  Everything
+here degrades gracefully: ``load_game_decoder()`` returns None when a
+compiler is unavailable or the build fails, and callers fall back to the
+pure-Python decoders.
+
+Set ``PHOTON_NO_NATIVE=1`` to force the Python paths (used by parity
+tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "game_decoder.cpp")
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+logger = logging.getLogger(__name__)
+
+
+def _source_tag() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def _build() -> Optional[str]:
+    tag = _source_tag()
+    so_path = os.path.join(_DIR, f"_game_decoder_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = f"{so_path}.build.{os.getpid()}"  # unique per builder: no
+    # interleaved writes; the os.replace below is the atomic install
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", tmp, _SRC,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=240
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        detail = getattr(e, "stderr", b"") or b""
+        logger.warning(
+            "native game decoder build failed (%s): %s — using the Python "
+            "decoder", e, detail.decode(errors="replace")[:500],
+        )
+        return None
+    os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+    return so_path
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_void = ctypes.c_void_p
+    c_i64 = ctypes.c_int64
+    c_char_p = ctypes.c_char_p
+    sig = {
+        "gd_new": ([ctypes.c_int], c_void),
+        "gd_free": ([c_void], None),
+        "gd_preload_shard": (
+            [c_void, c_char_p, ctypes.POINTER(c_char_p), c_i64], None),
+        "gd_decode_block": ([c_void, ctypes.c_char_p, c_i64, c_i64], c_i64),
+        "gd_error": ([c_void], c_char_p),
+        "gd_n_rows": ([c_void], c_i64),
+        "gd_copy_row_data": (
+            [c_void, ctypes.POINTER(ctypes.c_double),
+             ctypes.POINTER(ctypes.c_double),
+             ctypes.POINTER(ctypes.c_double)], None),
+        "gd_uid_blob_len": ([c_void], c_i64),
+        "gd_copy_uids": (
+            [c_void, ctypes.c_char_p, ctypes.POINTER(c_i64),
+             ctypes.POINTER(c_i64)], None),
+        "gd_n_id_cols": ([c_void], c_i64),
+        "gd_id_col_name": ([c_void, c_i64], c_char_p),
+        "gd_id_col_blob_len": ([c_void, c_i64], c_i64),
+        "gd_copy_id_col": (
+            [c_void, c_i64, ctypes.c_char_p, ctypes.POINTER(c_i64),
+             ctypes.POINTER(c_i64)], None),
+        "gd_n_shards": ([c_void], c_i64),
+        "gd_shard_name": ([c_void, c_i64], c_char_p),
+        "gd_shard_nnz": ([c_void, c_i64], c_i64),
+        "gd_shard_dropped": ([c_void, c_i64], c_i64),
+        "gd_shard_unknown": ([c_void, c_i64], c_i64),
+        "gd_shard_seen": ([c_void, c_i64], c_i64),
+        "gd_copy_shard_coo": (
+            [c_void, c_i64, ctypes.POINTER(c_i64), ctypes.POINTER(c_i64),
+             ctypes.POINTER(ctypes.c_float)], None),
+        "gd_shard_nkeys": ([c_void, c_i64], c_i64),
+        "gd_shard_keys_blob_len": ([c_void, c_i64], c_i64),
+        "gd_copy_shard_keys": (
+            [c_void, c_i64, ctypes.c_char_p, ctypes.POINTER(c_i64)], None),
+    }
+    for name, (argtypes, restype) in sig.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    return lib
+
+
+def load_game_decoder() -> Optional[ctypes.CDLL]:
+    """The bound shared library, building it if needed; None on failure or
+    when ``PHOTON_NO_NATIVE=1``."""
+    if os.environ.get("PHOTON_NO_NATIVE") == "1":
+        return None
+    with _LOCK:
+        if "lib" in _CACHE:
+            return _CACHE["lib"]
+        so_path = _build()
+        lib = None
+        if so_path is not None:
+            try:
+                lib = _bind(ctypes.CDLL(so_path))
+            except OSError as e:
+                logger.warning("native game decoder load failed: %s", e)
+        _CACHE["lib"] = lib
+        return lib

@@ -80,6 +80,29 @@ class GlmOptimizationProblem:
         self.config = config
         self.objective = GlmObjective(losses_lib.get(task), normalization)
         self.normalization = normalization
+        # One compiled program serves every single-device solve: data,
+        # reg_weight, w0, and l1_mask are traced arguments, so a λ grid or
+        # repeated fits never re-trace (the GAME coordinates already did
+        # this; the legacy-driver path goes through here).
+        self._solve_jit = jax.jit(
+            lambda data, reg_weight, w0, l1_mask: self.solve(
+                data, reg_weight, w0, None, l1_mask
+            )
+        )
+
+    def solve_single_device(
+        self,
+        data: GlmData,
+        reg_weight: Array | float = 0.0,
+        w0: Optional[Array] = None,
+        l1_mask: Optional[Array] = None,
+    ) -> SolveResult:
+        """Jit-cached single-device :meth:`solve` (axis_name=None)."""
+        if w0 is None:
+            w0 = jnp.zeros((data.n_features,), jnp.float32)
+        return self._solve_jit(
+            data, jnp.asarray(reg_weight, jnp.float32), w0, l1_mask
+        )
 
     # -- core solve (jit/shard_map-safe) -----------------------------------
     def solve(
@@ -215,7 +238,11 @@ class GlmOptimizationProblem:
                 w = jnp.asarray(solved[lam])
                 res = None
             else:
-                res = self.solve(data, lam, w_prev, axis_name, l1_mask)
+                res = (
+                    self.solve_single_device(data, lam, w_prev, l1_mask)
+                    if axis_name is None
+                    else self.solve(data, lam, w_prev, axis_name, l1_mask)
+                )
                 w = res.w
                 if on_solved is not None:
                     on_solved(lam, w)

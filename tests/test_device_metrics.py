@@ -1,0 +1,99 @@
+"""Device-side metrics match the host evaluators (VERDICT weak #8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from photon_ml_tpu.evaluation.device import (
+    device_auc,
+    device_pointwise_metric,
+)
+from photon_ml_tpu.evaluation.evaluators import (
+    AreaUnderROCCurveEvaluator,
+    LogisticLossEvaluator,
+    PoissonLossEvaluator,
+    RMSEEvaluator,
+    SquaredLossEvaluator,
+)
+
+
+@pytest.fixture
+def arrays(rng):
+    n = 5000
+    scores = rng.normal(size=n).astype(np.float32)
+    scores = np.round(scores, 1)  # many exact ties → tie-averaging path
+    labels = (rng.uniform(size=n) < 0.4).astype(np.float32)
+    weights = rng.uniform(0.0, 2.0, size=n).astype(np.float32)
+    weights[rng.uniform(size=n) < 0.1] = 0.0  # padding rows
+    return scores, labels, weights
+
+
+class TestPointwiseParity:
+    @pytest.mark.parametrize(
+        "kind,host",
+        [
+            ("logistic_loss", LogisticLossEvaluator()),
+            ("poisson_loss", PoissonLossEvaluator()),
+            ("squared_loss", SquaredLossEvaluator()),
+            ("rmse", RMSEEvaluator()),
+        ],
+    )
+    def test_matches_host(self, arrays, kind, host):
+        scores, labels, weights = arrays
+        got = float(
+            device_pointwise_metric(
+                jnp.asarray(scores), jnp.asarray(labels),
+                jnp.asarray(weights), kind=kind,
+            )
+        )
+        want = host.evaluate(scores, labels, weights)
+        assert got == pytest.approx(want, rel=2e-5)
+
+    def test_psum_over_mesh(self, arrays):
+        """Row-sharded metric inside shard_map == whole-array metric."""
+        scores, labels, weights = arrays
+        n_dev = len(jax.devices())
+        n = (len(scores) // n_dev) * n_dev
+        scores, labels, weights = scores[:n], labels[:n], weights[:n]
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+
+        def spmd(s, y, w):
+            return device_pointwise_metric(
+                s, y, w, kind="logistic_loss", axis_name="data"
+            )
+
+        sharded = jax.jit(
+            jax.shard_map(
+                spmd, mesh=mesh,
+                in_specs=(P("data"), P("data"), P("data")),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )(jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights))
+        whole = device_pointwise_metric(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights),
+            kind="logistic_loss",
+        )
+        assert float(sharded) == pytest.approx(float(whole), rel=1e-5)
+
+
+class TestAucParity:
+    def test_matches_host_with_ties_and_weights(self, arrays):
+        scores, labels, weights = arrays
+        got = float(device_auc(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights)
+        ))
+        want = AreaUnderROCCurveEvaluator().evaluate(scores, labels, weights)
+        assert got == pytest.approx(want, abs=1e-6)
+
+    def test_single_class_nan(self):
+        scores = jnp.asarray(np.random.default_rng(0).normal(size=10))
+        ones = jnp.ones(10)
+        assert np.isnan(float(device_auc(scores, ones)))
+
+    def test_perfect_separation(self):
+        scores = jnp.asarray([3.0, 2.0, -1.0, -2.0])
+        labels = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+        assert float(device_auc(scores, labels)) == 1.0

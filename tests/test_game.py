@@ -363,3 +363,54 @@ class TestGameTraining:
         # Every trained user has some nonzero coefficients.
         nonzero = sum(1 for c, v in re.coefficients.values() if len(v))
         assert nonzero == 8
+
+
+class TestBucketConsolidation:
+    def test_growth_reduces_buckets_same_model(self, rng):
+        """bucket_growth=4 consolidates the long tail into fewer blocks and
+        trains per-entity models identical to the pow2 grid (padding rows
+        carry weight 0, so bucket shape never changes the math)."""
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+        from photon_ml_tpu.game.data import build_random_effect_dataset
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        sizes = np.minimum(rng.zipf(1.7, 300), 64)
+        n = int(sizes.sum())
+        users = np.repeat(
+            np.array([f"u{i}" for i in range(300)], dtype=object), sizes
+        )
+        X = sp.csr_matrix(rng.normal(size=(n, 5)).astype(np.float32))
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        w = np.ones(n, np.float32)
+
+        ds2 = build_random_effect_dataset(users, X, y, w)
+        ds4 = build_random_effect_dataset(users, X, y, w, bucket_growth=4.0)
+        assert len(ds4.blocks) < len(ds2.blocks)
+
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=25),
+            regularization=RegularizationContext.l2(),
+        )
+        import jax.numpy as jnp
+
+        offs = jnp.zeros(n, jnp.float32)
+        models = []
+        for ds in (ds2, ds4):
+            coord = RandomEffectCoordinate(
+                "re", ds, "logistic", opt, reg_weight=0.5,
+                entity_key="userId",
+            )
+            models.append(coord.finalize(coord.train(offs)))
+        t2, t4 = models[0].coefficients, models[1].coefficients
+        assert set(t2) == set(t4)
+        for k in t2:
+            np.testing.assert_array_equal(t2[k][0], t4[k][0])
+            # Padded shapes change f32 reduction order inside the iterative
+            # solver; solutions agree to optimization tolerance, not ulps.
+            np.testing.assert_allclose(t2[k][1], t4[k][1], atol=2e-3)

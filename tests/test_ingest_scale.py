@@ -75,6 +75,9 @@ class TestFastGameDecoder:
         rng = np.random.default_rng(2)
         write_game_avro(path, _rows(rng, 300))
 
+        # Pin the PYTHON flat decoder (native parity is TestNativeDecoder's
+        # job) against the generic datum decoder.
+        monkeypatch.setenv("PHOTON_NO_NATIVE", "1")
         fast = read_game_avro(path)
         monkeypatch.setattr(gr, "_is_game_schema", lambda s: False)
         slow = read_game_avro(path)
@@ -350,3 +353,92 @@ class TestTransformerCacheStaleness:
         del shards, ids
         gc.collect()
         assert t._cache is None  # weakref callbacks released the blocks
+
+
+class TestNativeDecoder:
+    """C++ session decoder parity with the Python paths (the native
+    component replacing the reference's JVM Avro ingest)."""
+
+    @pytest.fixture()
+    def native_lib(self):
+        from photon_ml_tpu.native import load_game_decoder
+
+        lib = load_game_decoder()
+        if lib is None:
+            pytest.skip("native decoder unavailable (no g++ or build failed)")
+        return lib
+
+    def test_native_matches_python(self, tmp_path, monkeypatch, native_lib):
+        import photon_ml_tpu.data.game_reader as gr
+
+        path = str(tmp_path / "n.avro")
+        rng = np.random.default_rng(21)
+        write_game_avro(path, _rows(rng, 400))
+
+        native = read_game_avro(path)
+        monkeypatch.setenv("PHOTON_NO_NATIVE", "1")
+        python = read_game_avro(path)
+
+        n_shards, n_ids, n_resp, n_w, n_off, n_uids, n_maps = native
+        p_shards, p_ids, p_resp, p_w, p_off, p_uids, p_maps = python
+        assert n_uids == p_uids
+        np.testing.assert_array_equal(n_resp, p_resp)
+        np.testing.assert_array_equal(n_w, p_w)
+        np.testing.assert_array_equal(n_off, p_off)
+        assert set(n_shards) == set(p_shards)
+        for k in n_shards:
+            assert (n_shards[k] != p_shards[k]).nnz == 0
+            assert dict(n_maps[k]) == dict(p_maps[k])
+        for k in n_ids:
+            np.testing.assert_array_equal(n_ids[k], p_ids[k])
+
+    def test_native_scoring_drops_match(self, tmp_path, monkeypatch,
+                                        native_lib):
+        path = str(tmp_path / "t.avro")
+        rng = np.random.default_rng(22)
+        write_game_avro(path, _rows(rng, 60))
+        *_, imaps = read_game_avro(path)
+
+        path2 = str(tmp_path / "s.avro")
+        rows2 = _rows(rng, 25)
+        rows2[0]["features"]["global"].append(
+            {"name": "NEW", "term": "x", "value": 1.5}
+        )
+        rows2[1]["features"]["mysteryShard"] = [
+            {"name": "m", "term": "", "value": 2.0}
+        ]
+        for f in rows2[2]["features"]["global"]:
+            f["name"] = "GONE_" + f["name"]
+        write_game_avro(path2, rows2)
+
+        n = read_game_avro(path2, index_maps=imaps)
+        monkeypatch.setenv("PHOTON_NO_NATIVE", "1")
+        p = read_game_avro(path2, index_maps=imaps)
+        assert set(n[0]) == set(p[0])
+        for k in n[0]:
+            assert (n[0][k] != p[0][k]).nnz == 0
+        assert "mysteryShard" not in n[0]
+
+    def test_native_malformed_raises(self, tmp_path, native_lib):
+        """Truncated payload must raise, not crash or hang."""
+        import photon_ml_tpu.data.game_reader as gr
+
+        path = str(tmp_path / "m.avro")
+        rng = np.random.default_rng(23)
+        write_game_avro(path, _rows(rng, 10))
+        acc = gr._Accumulator(True, {})
+        import photon_ml_tpu.io.avro as avro_mod
+
+        blocks = list(avro_mod.iter_blocks(path))
+        schema, count, payload = blocks[0]
+
+        from photon_ml_tpu.native import load_game_decoder
+        lib = load_game_decoder()
+        h = lib.gd_new(1)
+        try:
+            rc = lib.gd_decode_block(h, payload[: len(payload) // 2],
+                                     len(payload) // 2, count)
+            assert rc == -1
+            assert b"malformed" in lib.gd_error(h)
+        finally:
+            lib.gd_free(h)

@@ -1,0 +1,140 @@
+"""Named BASELINE workloads: real a1a and MovieLens-20M when staged.
+
+These are the reference's actual benchmark configs (BASELINE.json configs
+1 and 3; SURVEY.md §4 resource datasets).  They run against the REAL files
+when staged under ``datasets/`` (see its README for curl commands) and skip
+with a loud reason otherwise — synthetic stand-ins live in other test files
+and never masquerade as these.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.datasets import resolve_dataset, skip_reason
+
+
+def _require(name: str) -> str:
+    path = resolve_dataset(name)
+    if path is None:
+        pytest.skip(skip_reason(name))
+    return path
+
+
+class TestA1a:
+    def test_a1a_l2_logistic_auc_floor(self, tmp_path):
+        """BASELINE config 1: L2 logistic regression on a1a.  The
+        liblinear-class result is ~0.90 validation AUC; assert a 0.88
+        floor so numerical drift fails loudly without being flaky."""
+        train = _require("a1a")
+        test = _require("a1a.t")
+        from photon_ml_tpu.drivers import glm_driver
+
+        result = glm_driver.run([
+            "--train-data", train,
+            "--validate-data", test,
+            "--output-dir", str(tmp_path / "out"),
+            "--task", "logistic",
+            "--reg-type", "l2",
+            "--reg-weights", "0.01,0.1,1.0,10.0",
+            "--n-features", "123",
+        ])
+        best_auc = result["metrics"][str(result["best_lambda"])]
+        assert best_auc >= 0.88, f"a1a AUC regressed: {best_auc}"
+
+
+class TestMovieLens:
+    MAX_ROWS = 200_000  # subsample cap: keep the integration test minutes-fast
+
+    def test_movielens_per_user_random_effect(self, tmp_path):
+        """BASELINE config 3 shape: fixed effect + per-user random effect on
+        MovieLens ratings.  The per-user effect must improve validation RMSE
+        over the fixed effect alone."""
+        path = _require("ml-20m-ratings.csv")
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.evaluation.evaluators import RMSEEvaluator
+        from photon_ml_tpu.game.estimator import (
+            FixedEffectCoordinateConfig,
+            GameEstimator,
+            GameTransformer,
+            RandomEffectCoordinateConfig,
+        )
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        users, movies, ratings = [], [], []
+        with open(path) as f:
+            header = f.readline()
+            assert header.strip().startswith("userId")
+            for i, line in enumerate(f):
+                if i >= self.MAX_ROWS:
+                    break
+                u, m, r, _ = line.rstrip("\n").split(",")
+                users.append(u)
+                movies.append(int(m))
+                ratings.append(float(r))
+        n = len(ratings)
+        users = np.asarray(users)
+        ratings = np.asarray(ratings, np.float32)
+
+        # Global shard: bias + one-hot of the most-rated movies.
+        movies = np.asarray(movies)
+        top, counts = np.unique(movies, return_counts=True)
+        top = top[np.argsort(-counts)][:500]
+        movie_col = {m: j + 1 for j, m in enumerate(top)}
+        rows_i, cols_i = [], []
+        for i, m in enumerate(movies):
+            rows_i.append(i)
+            cols_i.append(0)  # bias
+            j = movie_col.get(m)
+            if j is not None:
+                rows_i.append(i)
+                cols_i.append(j)
+        Xg = sp.csr_matrix(
+            (np.ones(len(rows_i), np.float32), (rows_i, cols_i)),
+            shape=(n, len(top) + 1),
+        )
+        Xu = sp.csr_matrix(np.ones((n, 1), np.float32))  # per-user bias
+
+        rng = np.random.default_rng(0)
+        val_mask = rng.uniform(size=n) < 0.2
+        tr, va = ~val_mask, val_mask
+        shards_tr = {"global": Xg[tr], "userFeatures": Xu[tr]}
+        ids_tr = {"userId": users[tr]}
+        shards_va = {"global": Xg[va], "userFeatures": Xu[va]}
+        ids_va = {"userId": users[va]}
+
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=40),
+            regularization=RegularizationContext.l2(),
+        )
+        rmse = RMSEEvaluator()
+
+        fixed_only = GameEstimator("squared", {
+            "fixed": FixedEffectCoordinateConfig("global", opt, 1.0),
+        }, n_iterations=1)
+        m0, _ = fixed_only.fit(shards_tr, ids_tr, ratings[tr])
+        rmse0 = rmse.evaluate(
+            GameTransformer(m0).transform(shards_va, ids_va), ratings[va]
+        )
+
+        game = GameEstimator("squared", {
+            "fixed": FixedEffectCoordinateConfig("global", opt, 1.0),
+            "per_user": RandomEffectCoordinateConfig(
+                "userFeatures", "userId", opt, 5.0,
+                max_rows_per_entity=256,
+            ),
+        }, n_iterations=2)
+        m1, _ = game.fit(shards_tr, ids_tr, ratings[tr])
+        rmse1 = rmse.evaluate(
+            GameTransformer(m1).transform(shards_va, ids_va), ratings[va]
+        )
+        assert rmse1 < rmse0, (
+            f"per-user random effect must improve RMSE: {rmse1} vs {rmse0}"
+        )
+        assert rmse1 < 1.0  # MovieLens per-user models land well under 1.0
