@@ -57,10 +57,19 @@ from photon_ml_tpu.ops.sparse import SparseMatrix, from_coo
 
 Array = jax.Array
 
-TILE_R = 2048
-TILE_C = 2048
+# Tile edge: experimentally tunable (PHOTON_PALLAS_TILE); the per-tile
+# output sweep costs WINS = TILE/128 masked passes over the slot grid, so
+# smaller tiles trade DMA granularity for sweep work.  2048 measured best
+# on v5e for the bench workload; see ops/README.md.
+TILE_R = int(os.environ.get("PHOTON_PALLAS_TILE", "2048"))
+if TILE_R < 128 or TILE_R % 128:
+    raise ValueError(
+        f"PHOTON_PALLAS_TILE must be a positive multiple of 128 (lane "
+        f"width), got {TILE_R}"
+    )
+TILE_C = TILE_R
 WIN = 128           # window width = lanes per vreg
-WINS = TILE_R // WIN  # 16 windows per tile side
+WINS = TILE_R // WIN  # windows per tile side
 
 
 def _interpret() -> bool:
@@ -85,6 +94,7 @@ def _build_orientation(
     nbr: int,
     nbc: int,
     depth_cap: int,
+    spill_cost_ratio: float = 1024.0,
 ):
     """Place entries into the (tile, sublane, lane) slot grid.
 
@@ -95,6 +105,17 @@ def _build_orientation(
     lo   (NT, A, 128) int32 — gather-side low 7 bits (index into the table)
     val  (NT, A, 128) f32   — entry values (0 in empty slots)
     ohi  (NT, A, 128) int32 — output window id within the tile, in [0, 16)
+
+    Depth selection is COST-based, not worst-cell-based: each depth level
+    costs one full (tiles × WINS × 128) kernel sweep, while each spilled
+    entry costs ~``spill_cost_ratio`` slot-equivalents on the XLA
+    gather/segment_sum path (measured ~1000x per entry on v5e: ~60 ns
+    per spilled entry vs ~0.06 ns per kernel slot).  The
+    chosen depth minimizes the modeled total, so a lone overloaded cell
+    spills instead of inflating every tile to the cap, while near-full
+    occupancy keeps everything tiled (spilling 0.5% to shave a few depth
+    levels is a measured net LOSS).  ``spill_cost_ratio=inf`` forces full
+    coverage (used for the post-spill rebuild).
     """
     tr = rows // TILE_R
     tc = cols // TILE_C
@@ -123,8 +144,26 @@ def _build_orientation(
     run_ids = np.cumsum(change) - 1
     depth_pos = np.arange(len(cell)) - run_starts[run_ids]
 
-    needed = int(depth_pos.max()) + 1 if len(depth_pos) else 1
-    depth = min(needed, depth_cap)
+    # Cost model over candidate depths d (covering depth_pos < d):
+    #   cost(d) = d · (tiles · WINS · WIN)  +  spill_cost_ratio · spilled(d)
+    hist = np.bincount(depth_pos)
+    cum = np.cumsum(hist)
+    spilled_at = len(depth_pos) - cum  # spilled(d) for d = 1..len(hist)
+    if np.isinf(spill_cost_ratio):
+        needed = len(hist)
+    else:
+        level_cost = float(nbr * nbc * WINS * WIN)
+        # Any nonzero spill also pays a FIXED cost (the XLA scatter's
+        # latency floor, measured ~milliseconds — worth ~16 depth levels):
+        # spilling a handful of entries to shave one or two levels always
+        # loses; spilling to avoid a 100-deep pathological cell wins.
+        cost = (
+            np.arange(1, len(hist) + 1, dtype=np.float64) * level_cost
+            + spill_cost_ratio * spilled_at
+            + 16.0 * level_cost * (spilled_at > 0)
+        )
+        needed = int(np.argmin(cost)) + 1
+    depth = min(max(needed, 1), depth_cap)
     keep = depth_pos < depth
 
     nt = nbr * nbc
@@ -263,20 +302,33 @@ def _tiled_apply(code, val, vec_padded, *, depth, nbo, nbg, square):
         "f_code", "f_val",
         "b_code", "b_val",
         "spill",
+        "dense_cols", "dense_col_ids",
+        "dense_rows", "dense_row_ids",
     ],
-    meta_fields=["n_rows", "n_cols", "nbr", "nbc", "depth_f", "depth_b"],
+    meta_fields=[
+        "n_rows", "n_cols", "nbr", "nbc", "depth_f", "depth_b",
+        "has_dense_cols", "has_dense_rows",
+    ],
 )
 @dataclasses.dataclass
 class PallasSparseMatrix:
     """Sparse feature matrix backed by the tiled Pallas layout.
 
     Drop-in for :class:`photon_ml_tpu.ops.sparse.SparseMatrix` in the GLM
-    hot loop (matvec / rmatvec / squared variants).  Statistics and other
-    cold paths delegate to the COO ``spill`` matrix, which holds ALL entries
-    (the tiled arrays are a redundant, fast representation of the non-spilled
-    majority; ``spill`` doubles as the full COO copy for cold ops and as the
-    overflow path for entries beyond the depth cap — its ``hot_mask`` splits
-    the two roles).
+    hot loop (matvec / rmatvec / squared variants).  Three complementary
+    storage classes, split at build time:
+
+    - **tiled slot grids** — the bulk of the entries, Pallas-kernel fast;
+    - **dense stripes** — ultra-dense columns/rows (an explicit bias column,
+      a few very popular features) extracted into small dense blocks that
+      ride plain MXU matmuls: they would otherwise overload their slot
+      cells and drag the whole layout's depth up;
+    - **compact spill** — the residual overflow past the occupancy-chosen
+      depth, a COO matrix holding ONLY the spilled entries (cost scales
+      with spill size, not total nnz).
+
+    Statistics and other cold paths delegate to the full COO copy inside
+    ``spill``.
     """
 
     # orientation F (matvec): lane = row%128, tables = w windows
@@ -285,14 +337,23 @@ class PallasSparseMatrix:
     # orientation B (rmatvec): lane = col%128, tables = u windows
     b_code: Array
     b_val: Array
-    # full COO copy (cold paths) + spill bookkeeping
+    # full COO copy (cold paths) + compact spill matrix (hot-path overflow)
     spill: "SpillData"
+    # ultra-dense stripes (minor dim = the long axis, so XLA's physical
+    # tiling pads 8 sublanes, not 128 lanes per stripe; placeholder arrays
+    # when absent — see has_* flags)
+    dense_cols: Array      # (kc, n_rows) f32 — TRANSPOSED stripe storage
+    dense_col_ids: Array   # (kc,) int32 — global column of each stripe
+    dense_rows: Array      # (kr, n_cols) f32
+    dense_row_ids: Array   # (kr,) int32 — global row of each stripe
     n_rows: int
     n_cols: int
     nbr: int
     nbc: int
     depth_f: int
     depth_b: int
+    has_dense_cols: bool
+    has_dense_rows: bool
 
     # -- shape protocol ----------------------------------------------------
     @property
@@ -317,28 +378,56 @@ class PallasSparseMatrix:
             self.f_code, self.f_val, self._pad_cols(w),
             depth=self.depth_f, nbo=self.nbr, nbg=self.nbc, square=False,
         )[: self.n_rows]
-        return out + self.spill.matvec(w)
+        out = out + self.spill.matvec(w)
+        if self.has_dense_cols:
+            out = out + jnp.einsum(
+                "k,kn->n", w[self.dense_col_ids], self.dense_cols)
+        if self.has_dense_rows:
+            out = out.at[self.dense_row_ids].add(self.dense_rows @ w)
+        return out
 
     def rmatvec(self, u: Array) -> Array:
         out = _tiled_apply(
             self.b_code, self.b_val, self._pad_rows(u),
             depth=self.depth_b, nbo=self.nbc, nbg=self.nbr, square=False,
         )[: self.n_cols]
-        return out + self.spill.rmatvec(u)
+        out = out + self.spill.rmatvec(u)
+        if self.has_dense_cols:
+            out = out.at[self.dense_col_ids].add(self.dense_cols @ u)
+        if self.has_dense_rows:
+            out = out + jnp.einsum(
+                "k,kn->n", u[self.dense_row_ids], self.dense_rows)
+        return out
 
     def row_sq_matvec(self, v: Array) -> Array:
         out = _tiled_apply(
             self.f_code, self.f_val, self._pad_cols(v),
             depth=self.depth_f, nbo=self.nbr, nbg=self.nbc, square=True,
         )[: self.n_rows]
-        return out + self.spill.row_sq_matvec(v)
+        out = out + self.spill.row_sq_matvec(v)
+        if self.has_dense_cols:
+            out = out + jnp.einsum(
+                "k,kn->n", v[self.dense_col_ids],
+                self.dense_cols * self.dense_cols)
+        if self.has_dense_rows:
+            out = out.at[self.dense_row_ids].add(
+                (self.dense_rows * self.dense_rows) @ v)
+        return out
 
     def sq_rmatvec(self, u: Array) -> Array:
         out = _tiled_apply(
             self.b_code, self.b_val, self._pad_rows(u),
             depth=self.depth_b, nbo=self.nbc, nbg=self.nbr, square=True,
         )[: self.n_cols]
-        return out + self.spill.sq_rmatvec(u)
+        out = out + self.spill.sq_rmatvec(u)
+        if self.has_dense_cols:
+            out = out.at[self.dense_col_ids].add(
+                (self.dense_cols * self.dense_cols) @ u)
+        if self.has_dense_rows:
+            out = out + jnp.einsum(
+                "k,kn->n", u[self.dense_row_ids],
+                self.dense_rows * self.dense_rows)
+        return out
 
     # -- cold paths: delegate to the full COO copy -------------------------
     def col_nnz(self, row_mask=None) -> Array:
@@ -353,47 +442,53 @@ class PallasSparseMatrix:
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=["coo", "hot_mask"],
+    data_fields=["coo", "spill_coo"],
     meta_fields=["has_spill"],
 )
 @dataclasses.dataclass
 class SpillData:
-    """Full COO copy + mask of entries NOT covered by the tiled layout.
+    """Full COO copy (cold paths) + COMPACT spill matrix (hot paths).
 
-    ``hot_mask`` is 0.0 for entries the tiles already handle and 1.0 for
-    depth-overflow entries; hot-path contributions are scaled by it so the
-    spilled minority goes through the (slow) XLA path without being counted
-    twice.  When nothing spilled (the common case) the whole XLA branch is
-    skipped at trace time via the static ``has_spill`` flag.
+    ``spill_coo`` holds ONLY the depth-overflow entries (pow2-padded), so
+    the XLA gather/segment_sum cost of a spill scales with the spilled
+    minority, never with the total nnz.  When nothing spilled (the common
+    case) the whole XLA branch is skipped at trace time via the static
+    ``has_spill`` flag (``spill_coo`` is then an empty 1-entry placeholder).
     """
 
-    coo: SparseMatrix
-    hot_mask: Array  # (nnz,) f32: 1.0 where entry spilled past the depth cap
+    coo: SparseMatrix       # ALL entries — cold paths only
+    spill_coo: SparseMatrix  # spilled entries only
     has_spill: bool
-
-    def _masked(self) -> SparseMatrix:
-        return dataclasses.replace(
-            self.coo, values=self.coo.values * self.hot_mask)
 
     def matvec(self, w):
         if not self.has_spill:
             return jnp.zeros((), jnp.float32)
-        return self._masked().matvec(w)
+        return self.spill_coo.matvec(w)
 
     def rmatvec(self, u):
         if not self.has_spill:
             return jnp.zeros((), jnp.float32)
-        return self._masked().rmatvec(u)
+        return self.spill_coo.rmatvec(u)
 
     def row_sq_matvec(self, v):
         if not self.has_spill:
             return jnp.zeros((), jnp.float32)
-        return self._masked().row_sq_matvec(v)
+        return self.spill_coo.row_sq_matvec(v)
 
     def sq_rmatvec(self, u):
         if not self.has_spill:
             return jnp.zeros((), jnp.float32)
-        return self._masked().sq_rmatvec(u)
+        return self.spill_coo.sq_rmatvec(u)
+
+
+def _extract_dense(counts, threshold, max_stripes):
+    """Pick up to ``max_stripes`` indices whose entry count ≥ threshold,
+    densest first."""
+    cand = np.flatnonzero(counts >= threshold)
+    if cand.size > max_stripes:
+        cand = cand[np.argsort(-counts[cand], kind="stable")[:max_stripes]]
+        cand = np.sort(cand)
+    return cand.astype(np.int64)
 
 
 def build_pallas_matrix(
@@ -405,13 +500,20 @@ def build_pallas_matrix(
     depth_cap: int = 128,
     pad_nnz: Optional[int] = None,
     dtype=jnp.float32,
+    dense_frac: float = 1.0 / 32.0,
+    max_dense: int = 8,
 ) -> PallasSparseMatrix:
     """Build the tiled layout from host COO triples.
 
-    ``depth_cap`` bounds slot-grid depth; denser (tile, window, lane) cells
-    spill to the XLA COO path.  The default cap covers a per-cell load far
-    beyond uniform sparsity; pathological columns (e.g. an explicit bias
-    column) land in the spill tail instead of exploding the layout.
+    Storage-class split (see :class:`PallasSparseMatrix`):
+
+    1. columns with ≥ ``max(256, n_rows·dense_frac)`` entries (then rows
+       with ≥ ``max(256, n_cols·dense_frac)``, from what remains) become
+       dense MXU stripes, at most ``max_dense`` each — an explicit bias
+       column would otherwise drive every tile's slot depth to the cap;
+    2. the rest lands in the tiled slot grids, at the cost-model depth
+       (see ``_build_orientation``; ≤ ``depth_cap``);
+    3. the residual overflow becomes a COMPACT spill COO (cost ∝ spill).
     """
     coo = from_coo(rows, cols, vals, n_rows, n_cols, pad_nnz=pad_nnz,
                    dtype=dtype)
@@ -425,6 +527,36 @@ def build_pallas_matrix(
     live = np.flatnonzero(v_all != 0)
     r, c, v = r_all[live], c_all[live], v_all[live]
 
+    # --- dense stripe extraction (columns first, rows from the rest) ------
+    dense_col_ids = _extract_dense(
+        np.bincount(c, minlength=n_cols),
+        max(256, int(n_rows * dense_frac)), max_dense,
+    )
+    in_dc = (
+        np.isin(c, dense_col_ids) if dense_col_ids.size else
+        np.zeros(len(c), bool)
+    )
+    # Zero-SIZE placeholder when absent (never read; has_dense_cols gates).
+    dense_cols = np.zeros((len(dense_col_ids), n_rows), np.float32)
+    if dense_col_ids.size:
+        pos = np.searchsorted(dense_col_ids, c[in_dc])
+        dense_cols[pos, r[in_dc]] = v[in_dc]
+        r, c, v = r[~in_dc], c[~in_dc], v[~in_dc]
+
+    dense_row_ids = _extract_dense(
+        np.bincount(r, minlength=n_rows),
+        max(256, int(n_cols * dense_frac)), max_dense,
+    )
+    in_dr = (
+        np.isin(r, dense_row_ids) if dense_row_ids.size else
+        np.zeros(len(r), bool)
+    )
+    dense_rows = np.zeros((len(dense_row_ids), n_cols), np.float32)
+    if dense_row_ids.size:
+        pos = np.searchsorted(dense_row_ids, r[in_dr])
+        dense_rows[pos, c[in_dr]] = v[in_dr]
+        r, c, v = r[~in_dr], c[~in_dr], v[~in_dr]
+
     nbr = max(1, -(-n_rows // TILE_R))
     nbc = max(1, -(-n_cols // TILE_C))
 
@@ -435,28 +567,42 @@ def build_pallas_matrix(
 
     # Entries spilled from EITHER orientation go through the COO path for
     # BOTH directions (keeps matvec and rmatvec consistent with one X).
-    # hot_mask indexes the FULL (padded) COO entry list.
-    hot = np.zeros(r_all.shape[0], np.float32)
     spilled = np.union1d(f_spill, b_spill)
     if spilled.size:
-        hot[live[spilled]] = 1.0
+        spill_coo = from_coo(
+            r[spilled], c[spilled], v[spilled], n_rows, n_cols, dtype=dtype,
+        )
         # Rebuild both orientations without the spilled entries so neither
         # tiled layout double-counts them (host-side, one extra pass).
         keep = np.ones(r.shape[0], bool)
         keep[spilled] = False
         f_code, f_val, fs2, depth_f = _build_orientation(
-            r[keep], c[keep], v[keep], nbr, nbc, depth_cap)
+            r[keep], c[keep], v[keep], nbr, nbc, depth_cap,
+            spill_cost_ratio=np.inf)
         b_code, b_val, bs2, depth_b = _build_orientation(
-            c[keep], r[keep], v[keep], nbc, nbr, depth_cap)
-        assert fs2.size == 0 and bs2.size == 0
+            c[keep], r[keep], v[keep], nbc, nbr, depth_cap,
+            spill_cost_ratio=np.inf)
+        assert fs2.size == 0 and bs2.size == 0, "re-spill after rebuild"
+    else:
+        spill_coo = from_coo(
+            np.zeros(1, np.int64), np.zeros(1, np.int64),
+            np.zeros(1, np.float32), n_rows, n_cols, dtype=dtype,
+        )
 
     return PallasSparseMatrix(
         f_code=jnp.asarray(f_code), f_val=jnp.asarray(f_val),
         b_code=jnp.asarray(b_code), b_val=jnp.asarray(b_val),
-        spill=SpillData(coo=coo, hot_mask=jnp.asarray(hot),
-                        has_spill=bool(spilled.size)),
+        spill=SpillData(
+            coo=coo, spill_coo=spill_coo, has_spill=bool(spilled.size),
+        ),
+        dense_cols=jnp.asarray(dense_cols),
+        dense_col_ids=jnp.asarray(dense_col_ids, jnp.int32),
+        dense_rows=jnp.asarray(dense_rows),
+        dense_row_ids=jnp.asarray(dense_row_ids, jnp.int32),
         n_rows=int(n_rows), n_cols=int(n_cols),
         nbr=nbr, nbc=nbc, depth_f=depth_f, depth_b=depth_b,
+        has_dense_cols=bool(dense_col_ids.size),
+        has_dense_rows=bool(dense_row_ids.size),
     )
 
 

@@ -162,3 +162,55 @@ class TestDegenerateInputs:
         np.testing.assert_array_equal(
             np.asarray(P.matvec(jnp.ones(5, jnp.float32))), 0.0
         )
+
+
+class TestStorageClasses:
+    """Depth inflation fix: dense stripes + occupancy depth + compact spill."""
+
+    def test_bias_column_becomes_dense_stripe(self, rng):
+        """A bias column touched by every row must not inflate the slot
+        depth (it previously drove depth_b to the cap, ~12x memory)."""
+        n, d, nnz = 70000, 3000, 8 * 70000
+        rows = rng.integers(0, n, size=nnz).astype(np.int64)
+        cols = rng.integers(1, d, size=nnz).astype(np.int64)
+        vals = rng.normal(size=nnz).astype(np.float32)
+        # bias column 0 on every row
+        rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+        cols = np.concatenate([cols, np.zeros(n, np.int64)])
+        vals = np.concatenate([vals, np.ones(n, np.float32)])
+        P = build_pallas_matrix(rows, cols, vals, n, d)
+        assert P.has_dense_cols
+        assert 0 in np.asarray(P.dense_col_ids)
+        # Without extraction the bias column forces depth_b to the 128 cap
+        # (its cells hold one entry per window row); the background tail
+        # alone needs far less.
+        assert P.depth_b <= 32, f"depth_b inflated to {P.depth_b}"
+        C = from_coo(rows, cols, vals, n, d)
+        w = rng.normal(size=d).astype(np.float32)
+        u = rng.normal(size=n).astype(np.float32)
+        assert _rel(P.matvec(jnp.asarray(w)), C.matvec(jnp.asarray(w))) < 1e-5
+        assert _rel(P.rmatvec(jnp.asarray(u)), C.rmatvec(jnp.asarray(u))) < 1e-5
+
+    def test_compact_spill_scales_with_overflow(self, rng):
+        """Spill matrix holds only the overflow, not a full masked copy."""
+        n, d = 4096, 4096
+        # A hot 64-entry cell (same row-window, same lane pattern) on top of
+        # a sparse background, with a tiny depth cap to force spill.
+        rows = rng.integers(0, n, size=20000).astype(np.int64)
+        cols = rng.integers(0, d, size=20000).astype(np.int64)
+        vals = rng.normal(size=20000).astype(np.float32)
+        hot_rows = np.full(64, 7, np.int64)          # one row
+        hot_cols = (np.arange(64, dtype=np.int64) * 128) % 2048  # same lane
+        hot_vals = np.ones(64, np.float32)
+        rows = np.concatenate([rows, hot_rows])
+        cols = np.concatenate([cols, hot_cols])
+        vals = np.concatenate([vals, hot_vals])
+        P = build_pallas_matrix(rows, cols, vals, n, d, depth_cap=8)
+        if P.spill.has_spill:
+            assert P.spill.spill_coo.nnz < 2048  # compact, not ~20k
+        C = from_coo(rows, cols, vals, n, d)
+        w = rng.normal(size=d).astype(np.float32)
+        assert _rel(P.matvec(jnp.asarray(w)), C.matvec(jnp.asarray(w))) < 1e-5
+        u = rng.normal(size=n).astype(np.float32)
+        assert _rel(P.sq_rmatvec(jnp.asarray(u)),
+                    C.sq_rmatvec(jnp.asarray(u))) < 1e-5
