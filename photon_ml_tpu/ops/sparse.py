@@ -203,17 +203,18 @@ def from_scipy_csr(csr, pad_nnz: int | None = None, dtype=jnp.float32) -> Sparse
     )
 
 
-def from_coo(
+def canonicalize_coo(
     rows: np.ndarray,
     cols: np.ndarray,
     vals: np.ndarray,
     n_rows: int,
     n_cols: int,
     pad_nnz: int | None = None,
-    dtype=jnp.float32,
-) -> SparseMatrix:
-    """Build a SparseMatrix from host COO triples (dedups duplicate (row, col)
-    entries by summing, sorts by row, pads nnz)."""
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side COO canonicalization shared by the device and Pallas
+    builders: dedup duplicate (row, col) entries by summing, sort by row,
+    pad nnz to the requested budget.  Returns numpy (rows i32, cols i32,
+    vals)."""
     rows = np.asarray(rows)
     cols = np.asarray(cols)
     vals = np.asarray(vals)
@@ -240,6 +241,23 @@ def from_coo(
         rows = np.concatenate([rows, np.full(pad, pad_row, np.int32)])
         cols = np.concatenate([cols, np.zeros(pad, np.int32)])
         vals = np.concatenate([vals, np.zeros(pad, vals.dtype)])
+    return rows, cols, vals
+
+
+def from_coo(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    n_cols: int,
+    pad_nnz: int | None = None,
+    dtype=jnp.float32,
+) -> SparseMatrix:
+    """Build a SparseMatrix from host COO triples (dedups duplicate (row, col)
+    entries by summing, sorts by row, pads nnz)."""
+    rows, cols, vals = canonicalize_coo(
+        rows, cols, vals, n_rows, n_cols, pad_nnz
+    )
     return SparseMatrix(
         row_ids=jnp.asarray(rows),
         col_ids=jnp.asarray(cols),

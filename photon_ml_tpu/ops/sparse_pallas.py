@@ -53,7 +53,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.ops.sparse import SparseMatrix, from_coo
+from photon_ml_tpu.ops.sparse import (
+    DenseMatrix,
+    SparseMatrix,
+    canonicalize_coo,
+    from_coo,
+)
 
 Array = jax.Array
 
@@ -62,10 +67,12 @@ Array = jax.Array
 # smaller tiles trade DMA granularity for sweep work.  2048 measured best
 # on v5e for the bench workload; see ops/README.md.
 TILE_R = int(os.environ.get("PHOTON_PALLAS_TILE", "2048"))
-if TILE_R < 128 or TILE_R % 128:
+if TILE_R < 128 or TILE_R % 128 or TILE_R > 32768:
+    # Upper bound: the packed per-slot code ohi*128 + lo spans [0, TILE_R)
+    # and must fit int16.
     raise ValueError(
-        f"PHOTON_PALLAS_TILE must be a positive multiple of 128 (lane "
-        f"width), got {TILE_R}"
+        f"PHOTON_PALLAS_TILE must be a multiple of 128 in [128, 32768] "
+        f"(packed int16 slot codes), got {TILE_R}"
     )
 TILE_C = TILE_R
 WIN = 128           # window width = lanes per vreg
@@ -125,10 +132,15 @@ def _build_orientation(
     glo = cols % WIN                    # index into that window's table
     ohi = (rows % TILE_R) // WIN        # output window within tile [0,16)
 
-    # Depth position within each (tile, gather-window, lane) cell.
-    order = np.lexsort((lane, gwin, tile))
-    t_s, g_s, l_s = tile[order], gwin[order], lane[order]
-    cell = (t_s * WINS + g_s) * WIN + l_s
+    # Depth position within each (tile, gather-window, lane) cell.  One
+    # combined int64 sort key (≈2-3x faster than a 3-key lexsort at 33M
+    # entries); tile/gwin/lane recover from the key by div/mod.
+    key = (tile * np.int64(WINS) + gwin) * np.int64(WIN) + lane
+    order = np.argsort(key)
+    cell = key[order]
+    t_s = cell // (WINS * WIN)
+    g_s = (cell // WIN) % WINS
+    l_s = cell % WIN
     if len(cell) == 0:  # all-zero / empty matrix: one empty depth level
         return (
             np.zeros((nbr, nbc, WINS, WIN), np.int16),
@@ -296,6 +308,94 @@ def _tiled_apply(code, val, vec_padded, *, depth, nbo, nbg, square):
 # ---------------------------------------------------------------------------
 
 
+class HostCoo:
+    """Host-side canonical COO triples for COLD paths (stats, min/max,
+    densify) — one-shot per job, so they run in numpy on the host instead of
+    keeping a full device COO copy alive (at 33M nnz that copy cost ~670 MB
+    of HBM and ~14 s of transfer over this transport for ops the hot loop
+    never touches).
+
+    Lives in a pytree META field, never traced, never transferred.
+    Equality/hash use the (n_rows, n_cols, nnz) shape class — NOT content —
+    so rebuilding a same-shaped matrix (tuning / down-sampling loops) keeps
+    hitting existing jit caches exactly as the all-int metadata did.  Two
+    consequences, both documented invariants:
+
+    - cold ops must be called EAGERLY (outside jit), as the drivers do —
+      under tracing their results would be baked as constants keyed by the
+      shape class, which is wrong across different matrices (the main
+      consumer, stats.summarize, passes a row_mask whose np.asarray raises
+      on tracers, failing loudly);
+    - a jit cache entry for a given shape class keeps that first holder's
+      host arrays alive until the compiled function is dropped (bounded by
+      distinct shape classes, not by rebuild count).
+    """
+
+    __slots__ = ("rows", "cols", "vals", "n_rows", "n_cols")
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, HostCoo)
+            and self.n_rows == other.n_rows
+            and self.n_cols == other.n_cols
+            and self.nnz == other.nnz
+        )
+
+    def __hash__(self):
+        return hash((self.n_rows, self.n_cols, self.nnz))
+
+    def __init__(self, rows, cols, vals, n_rows, n_cols):
+        self.rows = rows
+        self.cols = cols
+        self.vals = vals
+        self.n_rows = n_rows
+        self.n_cols = n_cols
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    def _live(self, row_mask):
+        live = self.vals != 0
+        if row_mask is not None:
+            live &= np.asarray(row_mask)[self.rows]
+        return live
+
+    def col_nnz(self, row_mask=None):
+        live = self._live(row_mask)
+        return jnp.asarray(
+            np.bincount(
+                self.cols[live], minlength=self.n_cols
+            ).astype(np.int32)
+        )
+
+    def col_min_max(self, row_mask=None):
+        """Per-feature (min, max) over stored entries of live rows, folded
+        with the implicit zeros of unstored entries — same semantics as
+        SparseMatrix.col_min_max."""
+        live = self._live(row_mask)
+        c = self.cols[live]
+        v = self.vals[live]
+        mins = np.full(self.n_cols, np.inf, np.float32)
+        maxs = np.full(self.n_cols, -np.inf, np.float32)
+        np.minimum.at(mins, c, v)
+        np.maximum.at(maxs, c, v)
+        nnz = np.bincount(c, minlength=self.n_cols)
+        n_live_rows = (
+            self.n_rows if row_mask is None
+            else int(np.sum(np.asarray(row_mask)))
+        )
+        has_zero = nnz < n_live_rows
+        mins = np.where(has_zero, np.minimum(mins, 0.0), mins)
+        maxs = np.where(has_zero, np.maximum(maxs, 0.0), maxs)
+        return jnp.asarray(mins), jnp.asarray(maxs)
+
+    def to_dense(self):
+        dense = np.zeros((self.n_rows, self.n_cols), np.float32)
+        np.add.at(dense, (self.rows, self.cols), self.vals)
+        return DenseMatrix(jnp.asarray(dense))
+
+
 @functools.partial(
     jax.tree_util.register_dataclass,
     data_fields=[
@@ -306,6 +406,7 @@ def _tiled_apply(code, val, vec_padded, *, depth, nbo, nbg, square):
         "dense_rows", "dense_row_ids",
     ],
     meta_fields=[
+        "host_coo",
         "n_rows", "n_cols", "nbr", "nbc", "depth_f", "depth_b",
         "has_dense_cols", "has_dense_rows",
     ],
@@ -323,12 +424,13 @@ class PallasSparseMatrix:
       a few very popular features) extracted into small dense blocks that
       ride plain MXU matmuls: they would otherwise overload their slot
       cells and drag the whole layout's depth up;
-    - **compact spill** — the residual overflow past the occupancy-chosen
-      depth, a COO matrix holding ONLY the spilled entries (cost scales
-      with spill size, not total nnz).
+    - **compact spill** — the residual overflow past the cost-model depth,
+      a COO matrix holding ONLY the spilled entries (cost scales with
+      spill size, not total nnz).
 
-    Statistics and other cold paths delegate to the full COO copy inside
-    ``spill``.
+    Statistics and other cold paths run host-side over ``host_coo`` (the
+    canonical triples; a META field — see its docstring for the eager-only
+    contract).
     """
 
     # orientation F (matvec): lane = row%128, tables = w windows
@@ -337,7 +439,7 @@ class PallasSparseMatrix:
     # orientation B (rmatvec): lane = col%128, tables = u windows
     b_code: Array
     b_val: Array
-    # full COO copy (cold paths) + compact spill matrix (hot-path overflow)
+    # compact spill matrix (hot-path overflow past the chosen depth)
     spill: "SpillData"
     # ultra-dense stripes (minor dim = the long axis, so XLA's physical
     # tiling pads 8 sublanes, not 128 lanes per stripe; placeholder arrays
@@ -346,6 +448,7 @@ class PallasSparseMatrix:
     dense_col_ids: Array   # (kc,) int32 — global column of each stripe
     dense_rows: Array      # (kr, n_cols) f32
     dense_row_ids: Array   # (kr,) int32 — global row of each stripe
+    host_coo: HostCoo      # META: host triples for cold paths (never traced)
     n_rows: int
     n_cols: int
     nbr: int
@@ -362,7 +465,7 @@ class PallasSparseMatrix:
 
     @property
     def nnz(self) -> int:
-        return self.spill.coo.nnz
+        return self.host_coo.nnz
 
     def _pad_cols(self, w: Array) -> Array:
         target = self.nbc * TILE_C
@@ -429,34 +532,33 @@ class PallasSparseMatrix:
                 self.dense_rows * self.dense_rows)
         return out
 
-    # -- cold paths: delegate to the full COO copy -------------------------
+    # -- cold paths: host-side over the canonical triples ------------------
     def col_nnz(self, row_mask=None) -> Array:
-        return self.spill.coo.col_nnz(row_mask)
+        return self.host_coo.col_nnz(row_mask)
 
     def col_min_max(self, row_mask=None):
-        return self.spill.coo.col_min_max(row_mask)
+        return self.host_coo.col_min_max(row_mask)
 
     def to_dense(self):
-        return self.spill.coo.to_dense()
+        return self.host_coo.to_dense()
 
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=["coo", "spill_coo"],
+    data_fields=["spill_coo"],
     meta_fields=["has_spill"],
 )
 @dataclasses.dataclass
 class SpillData:
-    """Full COO copy (cold paths) + COMPACT spill matrix (hot paths).
+    """COMPACT spill matrix for hot-path depth overflow.
 
-    ``spill_coo`` holds ONLY the depth-overflow entries (pow2-padded), so
-    the XLA gather/segment_sum cost of a spill scales with the spilled
-    minority, never with the total nnz.  When nothing spilled (the common
-    case) the whole XLA branch is skipped at trace time via the static
-    ``has_spill`` flag (``spill_coo`` is then an empty 1-entry placeholder).
+    ``spill_coo`` holds ONLY the depth-overflow entries, so the XLA
+    gather/segment_sum cost of a spill scales with the spilled minority,
+    never with the total nnz.  When nothing spilled (the common case) the
+    whole XLA branch is skipped at trace time via the static ``has_spill``
+    flag (``spill_coo`` is then an empty 1-entry placeholder).
     """
 
-    coo: SparseMatrix       # ALL entries — cold paths only
     spill_coo: SparseMatrix  # spilled entries only
     has_spill: bool
 
@@ -515,15 +617,17 @@ def build_pallas_matrix(
        (see ``_build_orientation``; ≤ ``depth_cap``);
     3. the residual overflow becomes a COMPACT spill COO (cost ∝ spill).
     """
-    coo = from_coo(rows, cols, vals, n_rows, n_cols, pad_nnz=pad_nnz,
-                   dtype=dtype)
-    # Use the DEDUPED, SORTED entries actually stored in the COO matrix so
-    # the tiled layout and the COO copy agree entry-for-entry.  Zero-valued
-    # entries (nnz padding) contribute nothing; excluding them keeps the
-    # padding pile-up at (last_row, col 0) from faking a dense cell.
-    r_all = np.asarray(coo.row_ids)
-    c_all = np.asarray(coo.col_ids)
-    v_all = np.asarray(coo.values)
+    # Canonicalize ON HOST (dedup + sort + nnz-budget pad/validation) —
+    # the old path built a full device COO first and read it straight
+    # back, paying two transfers of the entire entry set for nothing.
+    # Padding entries carry value 0, so the tiled build excludes them via
+    # the live filter below; P.nnz still reports the padded budget.
+    r_all, c_all, v_all = canonicalize_coo(
+        rows, cols, vals, n_rows, n_cols, pad_nnz
+    )
+    host_coo = HostCoo(r_all, c_all, v_all, int(n_rows), int(n_cols))
+    # Zero-valued entries contribute nothing; excluding them keeps explicit
+    # zeros from faking a dense cell.
     live = np.flatnonzero(v_all != 0)
     r, c, v = r_all[live], c_all[live], v_all[live]
 
@@ -593,12 +697,13 @@ def build_pallas_matrix(
         f_code=jnp.asarray(f_code), f_val=jnp.asarray(f_val),
         b_code=jnp.asarray(b_code), b_val=jnp.asarray(b_val),
         spill=SpillData(
-            coo=coo, spill_coo=spill_coo, has_spill=bool(spilled.size),
+            spill_coo=spill_coo, has_spill=bool(spilled.size),
         ),
         dense_cols=jnp.asarray(dense_cols),
         dense_col_ids=jnp.asarray(dense_col_ids, jnp.int32),
         dense_rows=jnp.asarray(dense_rows),
         dense_row_ids=jnp.asarray(dense_row_ids, jnp.int32),
+        host_coo=host_coo,
         n_rows=int(n_rows), n_cols=int(n_cols),
         nbr=nbr, nbc=nbc, depth_f=depth_f, depth_b=depth_b,
         has_dense_cols=bool(dense_col_ids.size),

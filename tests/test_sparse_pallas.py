@@ -199,15 +199,19 @@ class TestStorageClasses:
         rows = rng.integers(0, n, size=20000).astype(np.int64)
         cols = rng.integers(0, d, size=20000).astype(np.int64)
         vals = rng.normal(size=20000).astype(np.float32)
-        hot_rows = np.full(64, 7, np.int64)          # one row
-        hot_cols = (np.arange(64, dtype=np.int64) * 128) % 2048  # same lane
+        # One row, 64 DISTINCT columns inside one 128-wide window: all 64
+        # entries share the (tile, gwin, lane) cell in orientation F, far
+        # past depth_cap=8 — spill is forced (the cap binds, regardless of
+        # the cost model).
+        hot_rows = np.full(64, 7, np.int64)
+        hot_cols = np.arange(64, dtype=np.int64)
         hot_vals = np.ones(64, np.float32)
         rows = np.concatenate([rows, hot_rows])
         cols = np.concatenate([cols, hot_cols])
         vals = np.concatenate([vals, hot_vals])
         P = build_pallas_matrix(rows, cols, vals, n, d, depth_cap=8)
-        if P.spill.has_spill:
-            assert P.spill.spill_coo.nnz < 2048  # compact, not ~20k
+        assert P.spill.has_spill
+        assert P.spill.spill_coo.nnz < 2048  # compact, not ~20k
         C = from_coo(rows, cols, vals, n, d)
         w = rng.normal(size=d).astype(np.float32)
         assert _rel(P.matvec(jnp.asarray(w)), C.matvec(jnp.asarray(w))) < 1e-5
