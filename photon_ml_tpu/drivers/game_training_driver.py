@@ -91,6 +91,7 @@ def parse_coordinate_config(spec: dict):
             RegularizationType(spec.get("reg_type", "none")),
             float(spec.get("elastic_net_alpha", 0.5)),
         ),
+        compute_variances=bool(spec.get("compute_variances", False)),
     )
     name = spec["name"]
     if spec["type"] == "fixed":
@@ -131,6 +132,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="saved GameModel directory to warm-start from (the reference's "
         "incremental training); its index maps are used to read the data",
     )
+    p.add_argument(
+        "--data-parallel",
+        choices=["off", "auto"],
+        default="off",
+        help="auto: with >1 device, shard rows (fixed effects) and the "
+        "entity axis (random effects) over a mesh of all devices — the "
+        "reference's Spark-cluster layout on ICI",
+    )
     return p
 
 
@@ -147,12 +156,21 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     coordinate_configs = config_grid[0]
     # Evaluation suite (reference: EvaluationSuite / MultiEvaluator — a LIST
     # of evaluators per run, the first driving model selection).
+    group_column = config.get("evaluator_group_column")
     if "evaluators" in config:
-        suite = EvaluationSuite.from_specs(config["evaluators"])
+        suite = EvaluationSuite.from_specs(
+            config["evaluators"], group_column=group_column
+        )
     elif "evaluator" in config:
-        suite = EvaluationSuite.from_specs([config["evaluator"]])
+        suite = EvaluationSuite.from_specs(
+            [config["evaluator"]], group_column=group_column
+        )
     else:
         suite = EvaluationSuite.for_task(losses_lib.get(task).name)
+        if group_column is not None:
+            import dataclasses as _dc
+
+            suite = _dc.replace(suite, group_column=group_column)
     evaluator = suite.primary_evaluator
 
     # Incremental training (SURVEY.md §5.4): a prior model fixes the feature
@@ -191,6 +209,19 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     # Optional hyperparameter tuning over per-coordinate regularization
     # weights (the reference's BAYESIAN|RANDOM tuning mode inside
     # GameTrainingDriver — SURVEY.md §3.5).
+    mesh = None
+    if args.data_parallel == "auto":
+        import jax
+
+        if len(jax.devices()) > 1:
+            from photon_ml_tpu.parallel.distributed import data_mesh
+
+            mesh = data_mesh()
+            logger.info(
+                "data-parallel: %d-device mesh (rows + entity axis sharded)",
+                len(jax.devices()),
+            )
+
     tuning = config.get("tuning")
     if tuning:
         if validation is None:
@@ -209,9 +240,17 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         # Datasets and jitted solvers are built ONCE; each tuning point only
         # mutates reg_weight (a traced argument) — no recompiles, no
         # re-grouping/upload of random-effect shards.
-        tuning_est = GameEstimator(task, coordinate_configs, n_cd_iterations)
+        tuning_est = GameEstimator(
+            task, coordinate_configs, n_cd_iterations, mesh=mesh
+        )
         tuning_coords = tuning_est.build_coordinates(
             shards, ids, response, weight, offset
+        )
+
+        v_groups = (
+            np.asarray(v_ids[suite.group_column])
+            if suite.group_column is not None
+            else None
         )
 
         def evaluate(x):
@@ -221,7 +260,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 tuning_coords, response, weight, offset, evaluator
             )
             scores = GameTransformer(mdl).transform(v_shards, v_ids, v_offset)
-            metric = evaluator.evaluate(scores, v_resp, v_weight)
+            metric = evaluator.evaluate(
+                scores, v_resp, v_weight, group_ids=v_groups
+            )
             logger.info("tuning: reg=%s -> %.6f", list(map(float, x)), metric)
             return metric
 
@@ -279,7 +320,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         )
 
     estimator = GameEstimator(
-        task, coordinate_configs, n_iterations=n_cd_iterations, logger=logger
+        task, coordinate_configs, n_iterations=n_cd_iterations, logger=logger,
+        mesh=mesh,
     )
     if len(config_grid) > 1:
         # Config-grid fit with validation-driven selection (SURVEY.md §3.2).
@@ -321,8 +363,13 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     if validation is not None:
         v_shards, v_ids, v_resp, v_weight, v_offset, _, _ = validation
         v_scores = GameTransformer(model).transform(v_shards, v_ids, v_offset)
+        v_groups = (
+            np.asarray(v_ids[suite.group_column])
+            if suite.group_column is not None
+            else None
+        )
         result["validation_metric"] = evaluator.evaluate(
-            v_scores, v_resp, v_weight
+            v_scores, v_resp, v_weight, group_ids=v_groups
         )
         logger.info(
             "validation %s = %.6f",

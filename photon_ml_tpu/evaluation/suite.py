@@ -29,6 +29,10 @@ class EvaluationSuite:
 
     evaluators: tuple  # tuple[(name, Evaluator), ...] — ordered
     primary: str
+    #: id column whose values group rows for per-group evaluators (the
+    #: reference's per-query AUC / precision@k "sharded" evaluators); None
+    #: evaluates globally.
+    group_column: Optional[str] = None
 
     def __post_init__(self):
         names = [n for n, _ in self.evaluators]
@@ -44,6 +48,7 @@ class EvaluationSuite:
         cls,
         specs: Sequence[Union[str, Evaluator]],
         primary: Optional[str] = None,
+        group_column: Optional[str] = None,
     ) -> "EvaluationSuite":
         """Build from spec strings (``"auc"``, ``"precision@5"``, ...) or
         ``Evaluator`` instances; primary defaults to the first, as the
@@ -59,6 +64,7 @@ class EvaluationSuite:
         return cls(
             evaluators=tuple(pairs),
             primary=primary if primary is not None else pairs[0][0],
+            group_column=group_column,
         )
 
     @classmethod

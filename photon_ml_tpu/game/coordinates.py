@@ -56,8 +56,13 @@ class Coordinate:
     def score(self, state) -> Array:
         raise NotImplementedError
 
-    def finalize(self, state):
-        """Turn device state into the host-side model object."""
+    def finalize(self, state, offsets=None):
+        """Turn device state into the host-side model object.
+
+        ``offsets`` are this coordinate's final residual offsets (base +
+        the other coordinates' scores) — required for coefficient-variance
+        computation, whose Hessian must be evaluated at the full final
+        margins, not this coordinate's margins alone."""
         raise NotImplementedError
 
     def make_validation_scorer(self, shards: dict, ids: dict):
@@ -121,9 +126,17 @@ class FixedEffectCoordinate(Coordinate):
     def score(self, state: Array) -> Array:
         return self._score_jit(self.dataset.data, state)
 
-    def finalize(self, state: Array) -> FixedEffectModel:
+    def finalize(self, state: Array, offsets=None) -> FixedEffectModel:
+        variances = None
+        if self.problem.config.compute_variances and offsets is not None:
+            data = dataclasses.replace(
+                self.dataset.data, offsets=jnp.asarray(offsets, jnp.float32)
+            )
+            variances = self.problem.coefficient_variances(
+                state, data, self.reg_weight
+            )
         return FixedEffectModel(
-            GeneralizedLinearModel(Coefficients(state), self.task),
+            GeneralizedLinearModel(Coefficients(state, variances), self.task),
             self.feature_shard,
         )
 
@@ -277,25 +290,53 @@ class RandomEffectCoordinate(Coordinate):
                 total = total.at[idx_p].add(vals_p)
         return total[:n]
 
-    def finalize(self, state: list[Array]) -> RandomEffectModel:
+    def _block_variances(self, block: EntityBlock, coefs: Array,
+                         offsets: Array) -> np.ndarray:
+        """Per-entity diagonal-inverse-Hessian variances (the reference's
+        SIMPLE variance type, per entity): 1 / (Σ_r w·d2(m)·X² + λ₂),
+        evaluated at the FULL final margins (residual offsets included)."""
+        loss = losses_lib.get(self.task)
+        l2 = jnp.asarray(
+            self.config.regularization.l2_weight(1.0) * self.reg_weight,
+            jnp.float32,
+        )
+        off_b = self._gather_offsets(jnp.asarray(offsets, jnp.float32), block)
+        m = jnp.einsum("erd,ed->er", block.X, coefs) + off_b
+        d2w = block.weights * loss.d2(m, block.labels)
+        diag = jnp.einsum("er,erd->ed", d2w, block.X * block.X) + l2
+        return np.asarray(1.0 / jnp.maximum(diag, 1e-12))
+
+    def finalize(self, state: list[Array], offsets=None) -> RandomEffectModel:
+        compute_var = (
+            self.config.compute_variances and offsets is not None
+        )
         table: dict = {}
+        var_table: dict = {} if compute_var else None
         for block, ids, coefs in zip(
             self.dataset.blocks, self.dataset.entity_ids, state
         ):
             cmap = np.asarray(block.col_map)
             w = np.asarray(coefs)
+            var = (
+                self._block_variances(block, coefs, offsets)
+                if compute_var
+                else None
+            )
             for lane, key in enumerate(ids):
                 keep = cmap[lane] >= 0
                 cols = cmap[lane][keep]
                 vals = w[lane][keep]
                 nz = vals != 0
                 table[key] = (cols[nz].astype(np.int32), vals[nz].astype(np.float32))
+                if var is not None:
+                    var_table[key] = var[lane][keep][nz].astype(np.float32)
         return RandomEffectModel(
             coefficients=table,
             feature_shard=self.feature_shard,
             entity_key=self.entity_key,
             task=self.task,
             n_features=self.dataset.n_features,
+            variances=var_table,
         )
 
     def make_validation_scorer(self, shards: dict, ids: dict):

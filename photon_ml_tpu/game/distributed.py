@@ -142,7 +142,16 @@ class DistributedFixedEffectCoordinate(FixedEffectCoordinate):
         blocked = self._score_sm(self.dist, state)
         return blocked.reshape(-1)[: self.n_rows]
 
-    def finalize(self, state: Array) -> FixedEffectModel:
+    def finalize(self, state: Array, offsets=None) -> FixedEffectModel:
+        if self.problem.config.compute_variances:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "coordinate %s: compute_variances is not implemented on the "
+                "row-sharded (mesh) fixed-effect path yet — the saved model "
+                "will carry no variances; run single-device to get them",
+                self.name,
+            )
         return FixedEffectModel(
             GeneralizedLinearModel(Coefficients(state), self.task),
             self.feature_shard,
@@ -209,8 +218,8 @@ class EntityShardedRandomEffectCoordinate(RandomEffectCoordinate):
         )
         self.mesh = mesh
 
-    def finalize(self, state):
+    def finalize(self, state, offsets=None):
         # Drop padding lanes (entity_ids lists are shorter than padded E);
         # the base implementation iterates entity_ids, so padding lanes are
         # skipped naturally.
-        return super().finalize(state)
+        return super().finalize(state, offsets=offsets)

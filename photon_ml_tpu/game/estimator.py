@@ -84,11 +84,18 @@ class GameEstimator:
         coordinate_configs: dict[str, CoordinateConfig],
         n_iterations: int = 1,
         logger=None,
+        mesh=None,
     ):
+        """``mesh``: a ``jax.sharding.Mesh`` with a ``"data"`` axis enables
+        the multi-chip path — rows sharded for fixed effects (whole solver
+        inside shard_map, one fused psum per objective evaluation) and the
+        entity axis sharded for random effects (the reference's Spark
+        executor-parallel layout — SURVEY.md §2 parallelism table)."""
         self.task = losses_lib.get(task).name  # canonicalize aliases
         self.coordinate_configs = dict(coordinate_configs)
         self.n_iterations = n_iterations
         self.logger = logger
+        self.mesh = mesh
 
     def build_coordinates(self, shards, ids, response, weight=None, offset=None):
         """Build per-coordinate datasets + coordinate objects once.  Tuning
@@ -132,25 +139,40 @@ class GameEstimator:
             shard = shards[cfg.feature_shard]
             key = self.dataset_key(cfg)
             if isinstance(cfg, FixedEffectCoordinateConfig):
+                def train_weight(cfg=cfg):
+                    # Down-sampling runs ONLY on cache miss — grid/tuning
+                    # points hitting the cache never pay the O(n) pass.
+                    if cfg.down_sampling_rate >= 1.0:
+                        return weight
+                    from photon_ml_tpu.data.sampling import (
+                        BinaryClassificationDownSampler,
+                        DefaultDownSampler,
+                    )
+
+                    binary = self.task in ("logistic", "smoothed_hinge")
+                    sampler = (
+                        BinaryClassificationDownSampler(cfg.down_sampling_rate)
+                        if binary
+                        else DefaultDownSampler(cfg.down_sampling_rate)
+                    )
+                    idx, w_kept = sampler.downsample(response, weight)
+                    tw = np.zeros(n, np.float32)
+                    tw[idx] = w_kept
+                    return tw
+
+                if self.mesh is not None:
+                    coordinates.append(
+                        self._distributed_fixed(
+                            name, cfg, shard, response, train_weight,
+                            cache, key,
+                        )
+                    )
+                    continue
                 dataset = cache.get(key)
                 if dataset is None:
-                    train_weight = weight
-                    if cfg.down_sampling_rate < 1.0:
-                        from photon_ml_tpu.data.sampling import (
-                            BinaryClassificationDownSampler,
-                            DefaultDownSampler,
-                        )
-
-                        binary = self.task in ("logistic", "smoothed_hinge")
-                        sampler = (
-                            BinaryClassificationDownSampler(cfg.down_sampling_rate)
-                            if binary
-                            else DefaultDownSampler(cfg.down_sampling_rate)
-                        )
-                        idx, w_kept = sampler.downsample(response, weight)
-                        train_weight = np.zeros(n, np.float32)
-                        train_weight[idx] = w_kept
-                    data = make_glm_data(shard, response, weights=train_weight)
+                    data = make_glm_data(
+                        shard, response, weights=train_weight(),
+                    )
                     dataset = FixedEffectDataset(data=data, n_global_rows=n)
                     cache[key] = dataset
                 coordinates.append(
@@ -164,6 +186,14 @@ class GameEstimator:
                     )
                 )
             else:
+                if self.mesh is not None:
+                    coordinates.append(
+                        self._distributed_random(
+                            name, cfg, shard, ids, response, weight,
+                            cache, key,
+                        )
+                    )
+                    continue
                 dataset = cache.get(key)
                 if dataset is None:
                     dataset = build_random_effect_dataset(
@@ -187,6 +217,75 @@ class GameEstimator:
                     )
                 )
         return coordinates
+
+    def _distributed_fixed(
+        self, name, cfg, shard, response, train_weight_fn, cache, key
+    ):
+        """Row-sharded fixed effect (mesh path).  Grid points sharing the
+        dataset AND optimizer config reuse the sharded data and compiled
+        shard_map programs via a shallow copy (reg_weight is traced)."""
+        import copy
+
+        from photon_ml_tpu.game.distributed import (
+            DistributedFixedEffectCoordinate,
+        )
+
+        cache_key = ("dist",) + key
+        cached = cache.get(cache_key)
+        if cached is not None and cached[0] == cfg.optimization:
+            coord = copy.copy(cached[1])
+            coord.name = name
+            coord.reg_weight = cfg.reg_weight
+            return coord
+        coord = DistributedFixedEffectCoordinate(
+            name, shard, np.asarray(response, np.float32), self.mesh,
+            self.task, cfg.optimization, cfg.reg_weight,
+            feature_shard=cfg.feature_shard, weights=train_weight_fn(),
+        )
+        cache[cache_key] = (cfg.optimization, coord)
+        return coord
+
+    def _distributed_random(
+        self, name, cfg, shard, ids, response, weight, cache, key
+    ):
+        """Entity-sharded random effect (mesh path); same reuse rules as
+        :meth:`_distributed_fixed`."""
+        import copy
+
+        from photon_ml_tpu.game.distributed import (
+            EntityShardedRandomEffectCoordinate,
+        )
+
+        cache_key = ("dist",) + key
+        cached = cache.get(cache_key)
+        if cached is not None and cached[0] == cfg.optimization:
+            coord = copy.copy(cached[1])
+            coord.name = name
+            coord.reg_weight = cfg.reg_weight
+            return coord
+        # The expensive entity re-grouping is cached independently of the
+        # optimizer config; a config change only re-places blocks on the
+        # mesh.
+        ds_key = ("dist_ds",) + key
+        dataset = cache.get(ds_key)
+        if dataset is None:
+            dataset = build_random_effect_dataset(
+                ids[cfg.entity_key],
+                shard,
+                np.asarray(response, np.float32),
+                np.asarray(weight, np.float32),
+                max_rows_per_entity=cfg.max_rows_per_entity,
+                bucket_growth=cfg.bucket_growth,
+                device=False,  # EntitySharded places blocks on the mesh
+            )
+            cache[ds_key] = dataset
+        coord = EntityShardedRandomEffectCoordinate(
+            name, dataset, self.mesh, self.task, cfg.optimization,
+            cfg.reg_weight, feature_shard=cfg.feature_shard,
+            entity_key=cfg.entity_key,
+        )
+        cache[cache_key] = (cfg.optimization, coord)
+        return coord
 
     def fit(
         self,
@@ -215,10 +314,14 @@ class GameEstimator:
         coordinates = self._build_coordinates(
             self.coordinate_configs, shards, ids, response, weight, offset
         )
+        train_groups = None
+        if suite is not None and suite.group_column is not None:
+            train_groups = np.asarray(ids[suite.group_column])
         return self.fit_coordinates(
             coordinates, response, weight, offset, evaluator,
             validation=validation, suite=suite,
             initial_model=initial_model, checkpointer=checkpointer,
+            train_group_ids=train_groups,
         )
 
     @staticmethod
@@ -284,6 +387,7 @@ class GameEstimator:
         validation_scorers: Optional[dict] = None,
         initial_model: Optional[GameModel] = None,
         checkpointer=None,
+        train_group_ids=None,
     ) -> tuple[GameModel, list]:
         """Run coordinate descent over pre-built coordinates (see
         :meth:`build_coordinates`) and finalize the GameModel.
@@ -316,9 +420,15 @@ class GameEstimator:
                 for c in coordinates
             }
             n_val = len(v_resp)
+            # Per-group evaluation (per-query AUC / precision@k): the
+            # suite's group column names an id column of the validation set.
+            v_groups = None
+            if suite.group_column is not None:
+                v_groups = np.asarray(v_ids[suite.group_column])
             val_ctx = {
                 "scorers": scorers,
                 "resp": np.asarray(v_resp, np.float32),
+                "groups": v_groups,
                 "weight": None if v_weight is None else np.asarray(v_weight, np.float32),
                 "base": (
                     np.zeros(n_val, np.float32)
@@ -338,8 +448,18 @@ class GameEstimator:
             total = base_offsets + np.sum(
                 [np.asarray(s) for s in scores.values()], axis=0
             )
+            # With a grouped suite, the train metric is grouped too (else
+            # history entries would mix global and per-group semantics); a
+            # per-group-only primary without train group ids records None
+            # rather than crashing training.
+            if suite.group_column is not None and train_group_ids is None:
+                train_metric = None
+            else:
+                train_metric = primary.evaluate(
+                    total, response, w_host, group_ids=train_group_ids
+                )
             entry = {
-                "train_metric": primary.evaluate(total, response, w_host),
+                "train_metric": train_metric,
                 "evaluator": type(primary).__name__,
             }
             if val_ctx is not None:
@@ -363,7 +483,8 @@ class GameEstimator:
                     list(val_ctx["scores"].values()), axis=0
                 )
                 metrics = suite.evaluate(
-                    v_total, val_ctx["resp"], val_ctx["weight"]
+                    v_total, val_ctx["resp"], val_ctx["weight"],
+                    group_ids=val_ctx["groups"],
                 )
                 entry["validation"] = metrics
                 entry["validation_metric"] = metrics[suite.primary]
@@ -383,9 +504,30 @@ class GameEstimator:
             checkpointer=checkpointer,
             initial_states=initial_states,
         )
-        models = {
-            c.name: c.finalize(result.states[c.name]) for c in coordinates
-        }
+        # Finalize with each coordinate's residual offsets (base + the
+        # OTHER coordinates' scores) so coefficient variances — when a
+        # coordinate's config asks for them — are evaluated at the full
+        # final margins.  Skipped entirely (no device readbacks) when no
+        # coordinate wants variances.
+        def wants_variances(c):
+            cfg = getattr(c, "config", None) or getattr(
+                getattr(c, "problem", None), "config", None
+            )
+            return bool(cfg is not None and cfg.compute_variances)
+
+        total_np = None
+        if any(wants_variances(c) for c in coordinates):
+            total_np = base_offsets + np.sum(
+                [np.asarray(s) for s in result.scores.values()], axis=0
+            )
+        models = {}
+        for c in coordinates:
+            off_c = (
+                total_np - np.asarray(result.scores[c.name])
+                if total_np is not None
+                else None
+            )
+            models[c.name] = c.finalize(result.states[c.name], offsets=off_c)
         return GameModel(models=models, task=self.task), result.history
 
     def fit_grid(
@@ -444,10 +586,14 @@ class GameEstimator:
                             validation[0], validation[1]
                         )
                     scorers[name] = scorer_cache[key]
+            train_groups = None
+            if suite.group_column is not None:
+                train_groups = np.asarray(ids[suite.group_column])
             model, history = self.fit_coordinates(
                 coordinates, response, weight, offset,
                 validation=validation, suite=suite,
                 validation_scorers=scorers, initial_model=initial_model,
+                train_group_ids=train_groups,
             )
             metric_key = (
                 "validation_metric" if validation is not None else "train_metric"

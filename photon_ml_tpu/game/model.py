@@ -44,6 +44,9 @@ class RandomEffectModel:
     entity_key: str
     task: str
     n_features: int
+    #: optional per-entity coefficient variances (reference: Bayesian model
+    #: output) — entity key → float32[] aligned with that entity's ``cols``.
+    variances: Optional[dict] = None
     #: lazily-built packed view for vectorized lookup; the coefficient table
     #: is immutable after training/load, so this never needs invalidation.
     _packed: object = dataclasses.field(

@@ -37,6 +37,9 @@ RANDOM_EFFECT_MODEL_SCHEMA = {
                         {"name": "name", "type": "string"},
                         {"name": "term", "type": "string"},
                         {"name": "value", "type": "double"},
+                        # Optional per-coefficient variance (the reference's
+                        # BayesianLinearModelAvro carries variances too).
+                        {"name": "variance", "type": ["null", "double"]},
                     ],
                 },
             },
@@ -70,10 +73,23 @@ def save_game_model(
             imap = index_maps[sub.feature_shard]
             records = []
             for entity, (cols, vals) in sub.coefficients.items():
+                variances = (
+                    sub.variances.get(entity)
+                    if sub.variances is not None
+                    else None
+                )
                 coefs = []
-                for c, v in zip(cols, vals):
+                for j, (c, v) in enumerate(zip(cols, vals)):
                     fname, _, term = imap.index_to_name(int(c)).partition("\x01")
-                    coefs.append({"name": fname, "term": term, "value": float(v)})
+                    coefs.append({
+                        "name": fname,
+                        "term": term,
+                        "value": float(v),
+                        "variance": (
+                            float(variances[j]) if variances is not None
+                            else None
+                        ),
+                    })
                 records.append({"entityId": str(entity), "coefficients": coefs})
             avro.write_container(
                 os.path.join(sub_dir, "coefficients.avro"),
@@ -120,24 +136,34 @@ def load_game_model(directory: str) -> tuple[GameModel, dict]:
             _, records = avro.read_container(path)
             imap = index_maps[coord["feature_shard"]]
             table = {}
+            var_table: dict = {}
             for rec in records:
-                cols, vals = [], []
+                cols, vals, variances = [], [], []
                 for e in rec["coefficients"]:
                     idx = imap.get_index(feature_key(e["name"], e["term"]))
                     if idx >= 0:
                         cols.append(idx)
                         vals.append(e["value"])
+                        # Older files lack the variance field entirely.
+                        variances.append(e.get("variance"))
                 cols = np.asarray(cols, np.int32)
                 vals = np.asarray(vals, np.float32)
                 # Store invariant: columns ascending (coefficient_matrix_for
                 # binary-searches them).
                 order = np.argsort(cols, kind="stable")
                 table[rec["entityId"]] = (cols[order], vals[order])
+                if any(v is not None for v in variances):
+                    var = np.asarray(
+                        [0.0 if v is None else v for v in variances],
+                        np.float32,
+                    )
+                    var_table[rec["entityId"]] = var[order]
             models[name] = RandomEffectModel(
                 coefficients=table,
                 feature_shard=coord["feature_shard"],
                 entity_key=coord["entity_key"],
                 task=manifest["task"],
                 n_features=coord.get("n_features", len(imap)),
+                variances=var_table or None,
             )
     return GameModel(models=models, task=manifest["task"]), index_maps

@@ -127,3 +127,102 @@ class TestDistributedGame:
         from photon_ml_tpu.evaluation.evaluators import AreaUnderROCCurveEvaluator
         auc = AreaUnderROCCurveEvaluator().evaluate(total, y)
         assert auc > 0.8
+
+
+class TestEstimatorMeshPath:
+    def test_estimator_mesh_parity_and_driver_flag(self, tmp_path):
+        """GameEstimator(mesh=...) trains the same model as single-device,
+        and the driver's --data-parallel auto flag engages it."""
+        import json
+
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.game.estimator import (
+            FixedEffectCoordinateConfig,
+            GameEstimator,
+            GameTransformer,
+            RandomEffectCoordinateConfig,
+        )
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+        from photon_ml_tpu.parallel.distributed import data_mesh
+
+        rng = np.random.default_rng(17)
+        n, n_users = 400, 12
+        ue = rng.normal(scale=2.0, size=n_users)
+        Xg = rng.normal(size=(n, 4)).astype(np.float32)
+        users = rng.integers(n_users, size=n)
+        margin = 1.2 * Xg[:, 0] - 0.8 * Xg[:, 1] + ue[users]
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(
+            np.float32
+        )
+        shards = {
+            "global": sp.csr_matrix(Xg),
+            "userFeatures": sp.csr_matrix(np.ones((n, 1), np.float32)),
+        }
+        ids = {"userId": np.array([f"u{u}" for u in users])}
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=30),
+            regularization=RegularizationContext.l2(),
+        )
+        configs = {
+            "fixed": FixedEffectCoordinateConfig("global", opt, 0.5),
+            "per_user": RandomEffectCoordinateConfig(
+                "userFeatures", "userId", opt, 0.5
+            ),
+        }
+
+        single = GameEstimator("logistic", configs, n_iterations=2)
+        m1, _ = single.fit(shards, ids, y)
+        dist = GameEstimator(
+            "logistic", configs, n_iterations=2, mesh=data_mesh()
+        )
+        m2, _ = dist.fit(shards, ids, y)
+
+        s1 = GameTransformer(m1).transform(shards, ids)
+        s2 = GameTransformer(m2).transform(shards, ids)
+        np.testing.assert_allclose(s1, s2, atol=2e-3)
+
+        # Driver flag smoke: --data-parallel auto on the 8-device CPU mesh.
+        from photon_ml_tpu.data.game_reader import write_game_avro
+        from photon_ml_tpu.drivers import game_training_driver
+
+        rows = []
+        for i in range(n):
+            rows.append({
+                "uid": f"r{i}", "response": float(y[i]), "weight": None,
+                "offset": None, "ids": {"userId": ids["userId"][i]},
+                "features": {
+                    "global": [
+                        {"name": f"g{j}", "term": "", "value": float(Xg[i, j])}
+                        for j in range(4)
+                    ],
+                    "userFeatures": [{"name": "b", "term": "", "value": 1.0}],
+                },
+            })
+        train = str(tmp_path / "t.avro")
+        write_game_avro(train, rows)
+        cfg = {
+            "task": "logistic", "iterations": 1,
+            "coordinates": [
+                {"name": "fixed", "type": "fixed", "feature_shard": "global",
+                 "optimizer": "lbfgs", "max_iters": 25, "reg_type": "l2",
+                 "reg_weight": 0.5},
+                {"name": "per_user", "type": "random",
+                 "feature_shard": "userFeatures", "entity_key": "userId",
+                 "optimizer": "lbfgs", "max_iters": 20, "reg_type": "l2",
+                 "reg_weight": 0.5},
+            ],
+        }
+        cfgp = str(tmp_path / "c.json")
+        with open(cfgp, "w") as f:
+            json.dump(cfg, f)
+        result = game_training_driver.run([
+            "--train-data", train, "--config", cfgp,
+            "--output-dir", str(tmp_path / "out"),
+            "--data-parallel", "auto",
+        ])
+        assert result["train_metric"] > 0.7

@@ -1,0 +1,174 @@
+"""GAME coefficient variances + per-group evaluation plumbing.
+
+The reference computes optional coefficient variances for fixed AND
+per-entity random effects (Bayesian model output) and evaluates per-query
+("sharded") metrics via an id column; these tests cover the TPU analogues.
+"""
+
+import json
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.evaluation.suite import EvaluationSuite
+from photon_ml_tpu.game.estimator import (
+    FixedEffectCoordinateConfig,
+    GameEstimator,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.optim.problem import (
+    GlmOptimizationConfig,
+    OptimizerConfig,
+)
+from photon_ml_tpu.optim.regularization import RegularizationContext
+
+
+def _data(rng, n=300, n_users=10):
+    ue = rng.normal(scale=1.5, size=n_users)
+    Xg = rng.normal(size=(n, 3)).astype(np.float32)
+    users = rng.integers(n_users, size=n)
+    margin = 1.1 * Xg[:, 0] - 0.6 * Xg[:, 1] + ue[users]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+    shards = {
+        "global": sp.csr_matrix(Xg),
+        "userFeatures": sp.csr_matrix(np.ones((n, 1), np.float32)),
+    }
+    ids = {"userId": np.array([f"u{u}" for u in users])}
+    return shards, ids, y, users, Xg
+
+
+def _configs(compute_variances=True):
+    opt = GlmOptimizationConfig(
+        optimizer=OptimizerConfig(max_iters=40),
+        regularization=RegularizationContext.l2(),
+        compute_variances=compute_variances,
+    )
+    return {
+        "fixed": FixedEffectCoordinateConfig("global", opt, 0.5),
+        "per_user": RandomEffectCoordinateConfig(
+            "userFeatures", "userId", opt, 0.5
+        ),
+    }
+
+
+class TestGameVariances:
+    def test_variances_present_and_match_closed_form(self, rng):
+        shards, ids, y, users, Xg = _data(rng)
+        est = GameEstimator("logistic", _configs(), n_iterations=2)
+        model, _ = est.fit(shards, ids, y)
+
+        fe = model["fixed"].model.coefficients
+        assert fe.variances is not None
+        assert np.all(np.asarray(fe.variances) > 0)
+
+        re = model["per_user"]
+        assert re.variances is not None
+        # Closed form for one entity: its feature is the constant 1, so
+        # H = sum over its rows of sigmoid'(m) + l2, variance = 1/H, with
+        # m the FULL margin (fixed-effect score + its own bias).
+        w_fe = np.asarray(fe.means)
+        key = "u3"
+        rows = np.flatnonzero(ids["userId"] == key)
+        bias = re.coefficients[key][1][0]
+        m = Xg[rows] @ w_fe + bias
+        p = 1 / (1 + np.exp(-m))
+        H = np.sum(p * (1 - p)) + 0.5  # l2 = reg_weight
+        assert re.variances[key][0] == pytest.approx(1.0 / H, rel=1e-3)
+
+    def test_variances_off_by_default(self, rng):
+        shards, ids, y, *_ = _data(rng)
+        est = GameEstimator(
+            "logistic", _configs(compute_variances=False), n_iterations=1
+        )
+        model, _ = est.fit(shards, ids, y)
+        assert model["fixed"].model.coefficients.variances is None
+        assert model["per_user"].variances is None
+
+    def test_store_round_trip_preserves_variances(self, rng, tmp_path):
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.io.game_store import (
+            load_game_model,
+            save_game_model,
+        )
+
+        shards, ids, y, *_ = _data(rng)
+        est = GameEstimator("logistic", _configs(), n_iterations=1)
+        model, _ = est.fit(shards, ids, y)
+        imaps = {
+            "global": IndexMap.build([f"g{j}" for j in range(3)]),
+            "userFeatures": IndexMap.build(["bias"]),
+        }
+        out = str(tmp_path / "m")
+        save_game_model(model, imaps, out)
+        loaded, _ = load_game_model(out)
+        orig = model["per_user"].variances
+        got = loaded["per_user"].variances
+        assert got is not None and set(got) == set(orig)
+        for k in orig:
+            np.testing.assert_allclose(got[k], orig[k], rtol=1e-6)
+
+
+class TestGroupedEvaluation:
+    def test_per_query_metric_in_history_and_driver(self, rng, tmp_path):
+        from photon_ml_tpu.data.game_reader import write_game_avro
+        from photon_ml_tpu.drivers import game_training_driver
+
+        shards, ids, y, users, Xg = _data(rng, n=400)
+        # Query column: few rows per query.
+        queries = np.array([f"q{i % 40}" for i in range(400)])
+        ids = dict(ids, queryId=queries)
+
+        suite = EvaluationSuite.from_specs(
+            ["auc", "precision@2"], group_column="queryId"
+        )
+        est = GameEstimator("logistic", _configs(False), n_iterations=1)
+        model, history = est.fit(
+            shards, ids, y, validation=(shards, ids, y), suite=suite,
+        )
+        # Grouped AUC (mean of per-query AUCs) and precision@2 both present.
+        assert set(history[-1]["validation"]) == {"auc", "precision@2"}
+        assert 0 <= history[-1]["validation"]["precision@2"] <= 1
+
+        # Driver-level: evaluator_group_column in the JSON config.
+        rows = []
+        for i in range(400):
+            rows.append({
+                "uid": f"r{i}", "response": float(y[i]), "weight": None,
+                "offset": None,
+                "ids": {"userId": ids["userId"][i], "queryId": queries[i]},
+                "features": {
+                    "global": [
+                        {"name": f"g{j}", "term": "", "value": float(Xg[i, j])}
+                        for j in range(3)
+                    ],
+                    "userFeatures": [{"name": "b", "term": "", "value": 1.0}],
+                },
+            })
+        train = str(tmp_path / "t.avro")
+        val = str(tmp_path / "v.avro")
+        write_game_avro(train, rows[:300])
+        write_game_avro(val, rows[300:])
+        cfg = {
+            "task": "logistic", "iterations": 1,
+            "evaluators": ["auc"],
+            "evaluator_group_column": "queryId",
+            "coordinates": [
+                {"name": "fixed", "type": "fixed", "feature_shard": "global",
+                 "optimizer": "lbfgs", "max_iters": 25, "reg_type": "l2",
+                 "reg_weight": 0.5, "compute_variances": True},
+                {"name": "per_user", "type": "random",
+                 "feature_shard": "userFeatures", "entity_key": "userId",
+                 "optimizer": "lbfgs", "max_iters": 20, "reg_type": "l2",
+                 "reg_weight": 0.5},
+            ],
+        }
+        cfgp = str(tmp_path / "c.json")
+        with open(cfgp, "w") as f:
+            json.dump(cfg, f)
+        result = game_training_driver.run([
+            "--train-data", train, "--validate-data", val,
+            "--config", cfgp, "--output-dir", str(tmp_path / "out"),
+        ])
+        # Per-query mean AUC is a valid number in (0, 1].
+        assert 0 < result["validation_metric"] <= 1
