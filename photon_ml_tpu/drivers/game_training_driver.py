@@ -240,8 +240,19 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         # Datasets and jitted solvers are built ONCE; each tuning point only
         # mutates reg_weight (a traced argument) — no recompiles, no
         # re-grouping/upload of random-effect shards.
+        # Tuning evaluates by score metric only — never pay the
+        # coefficient-variance finalize cost per tuning point.
+        tuning_configs = {
+            nm: _dc.replace(
+                cfg,
+                optimization=_dc.replace(
+                    cfg.optimization, compute_variances=False
+                ),
+            )
+            for nm, cfg in coordinate_configs.items()
+        }
         tuning_est = GameEstimator(
-            task, coordinate_configs, n_cd_iterations, mesh=mesh
+            task, tuning_configs, n_cd_iterations, mesh=mesh
         )
         tuning_coords = tuning_est.build_coordinates(
             shards, ids, response, weight, offset

@@ -111,6 +111,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="saved model Avro to warm-start the grid from (the reference's "
         "incremental training)",
     )
+    p.add_argument(
+        "--data-parallel",
+        choices=["off", "auto"],
+        default="off",
+        help="auto: with >1 device, shard rows over a mesh and run the "
+        "whole λ grid with one fused psum per objective evaluation (the "
+        "reference's treeAggregate loop on ICI)",
+    )
     return p
 
 
@@ -211,10 +219,26 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             w0 = normalization.original_to_model(w0)
         logger.info("warm-starting from %s", args.initial_model)
 
-    grid = problem.run_grid(
-        train_data, reg_weights, w0=w0, l1_mask=l1_mask,
-        solved=solved, on_solved=on_solved,
-    )
+    mesh = None
+    if args.data_parallel == "auto" and len(jax.devices()) > 1:
+        from photon_ml_tpu.parallel.distributed import (
+            data_mesh,
+            run_grid_distributed,
+            shard_glm_data,
+        )
+
+        mesh = data_mesh()
+        logger.info("data-parallel: %d-device mesh", len(jax.devices()))
+        dist = shard_glm_data(X_train, y_train, mesh)
+        grid = run_grid_distributed(
+            problem, dist, mesh, reg_weights, w0=w0, l1_mask=l1_mask,
+            solved=solved, on_solved=on_solved,
+        )
+    else:
+        grid = problem.run_grid(
+            train_data, reg_weights, w0=w0, l1_mask=l1_mask,
+            solved=solved, on_solved=on_solved,
+        )
     for lam, _, res in grid:
         if res is None:
             logger.info("lambda=%g: restored from checkpoint", lam)

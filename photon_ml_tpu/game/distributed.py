@@ -64,6 +64,7 @@ class DistributedFixedEffectCoordinate(FixedEffectCoordinate):
         reg_weight: float = 0.0,
         feature_shard: str = "global",
         weights: Optional[np.ndarray] = None,
+        dist: Optional[DistributedGlmData] = None,
     ):
         from photon_ml_tpu.optim.problem import GlmOptimizationProblem
 
@@ -77,7 +78,13 @@ class DistributedFixedEffectCoordinate(FixedEffectCoordinate):
         self.mesh = mesh
         self.n_rows = X_host.shape[0]
         self.n_features = X_host.shape[1]
-        self.dist = shard_glm_data(X_host, labels, mesh, weights=weights)
+        # A prebuilt sharded dataset (grid points differing only in the
+        # optimizer config reuse it — re-sharding/re-uploading the training
+        # matrix per point is the expensive part).
+        self.dist = (
+            dist if dist is not None
+            else shard_glm_data(X_host, labels, mesh, weights=weights)
+        )
         self._rows_per_shard = self.dist.data.labels.shape[1]
         self._n_shards = self.dist.n_shards
 

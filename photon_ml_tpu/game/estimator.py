@@ -237,11 +237,17 @@ class GameEstimator:
             coord.name = name
             coord.reg_weight = cfg.reg_weight
             return coord
+        # The sharded dataset is cached independently of the optimizer
+        # config (same pattern as _distributed_random): a config change
+        # re-jits but never re-shards/re-uploads the matrix.
+        ds_key = ("dist_ds",) + key
         coord = DistributedFixedEffectCoordinate(
             name, shard, np.asarray(response, np.float32), self.mesh,
             self.task, cfg.optimization, cfg.reg_weight,
             feature_shard=cfg.feature_shard, weights=train_weight_fn(),
+            dist=cache.get(ds_key),
         )
+        cache[ds_key] = coord.dist
         cache[cache_key] = (cfg.optimization, coord)
         return coord
 
@@ -347,12 +353,19 @@ class GameEstimator:
                 continue
             if isinstance(sub, FixedEffectModel):
                 w = np.asarray(sub.model.coefficients.means, np.float32)
-                if w.shape[0] != c.dataset.data.n_features:
+                # Distributed fixed coordinates have no .dataset; both
+                # expose the feature width.
+                width = (
+                    c.n_features
+                    if hasattr(c, "n_features")
+                    else c.dataset.data.n_features
+                )
+                if w.shape[0] != width:
                     raise ValueError(
                         f"initial model coordinate {c.name!r} has "
                         f"{w.shape[0]} features but the dataset has "
-                        f"{c.dataset.data.n_features}; read the data with "
-                        "the initial model's index maps"
+                        f"{width}; read the data with the initial model's "
+                        "index maps"
                     )
                 states[c.name] = jnp.asarray(w)
             elif isinstance(sub, RandomEffectModel):
@@ -363,16 +376,17 @@ class GameEstimator:
                         f"{c.dataset.n_features}; read the data with the "
                         "initial model's index maps"
                     )
-                states[c.name] = [
-                    jnp.asarray(
-                        sub.coefficient_matrix_for(
-                            np.asarray(block.col_map), ids
-                        )
+                blocks_states = []
+                for block, ids in zip(c.dataset.blocks, c.dataset.entity_ids):
+                    cmap = np.asarray(block.col_map)
+                    # Entity-sharded blocks are mesh-padded beyond the real
+                    # lanes; padding lanes warm-start at zero.
+                    mat = np.zeros(cmap.shape, np.float32)
+                    mat[: len(ids)] = sub.coefficient_matrix_for(
+                        cmap[: len(ids)], ids
                     )
-                    for block, ids in zip(
-                        c.dataset.blocks, c.dataset.entity_ids
-                    )
-                ]
+                    blocks_states.append(jnp.asarray(mat))
+                states[c.name] = blocks_states
         return states
 
     def fit_coordinates(

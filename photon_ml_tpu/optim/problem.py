@@ -208,6 +208,46 @@ class GlmOptimizationProblem:
         return GeneralizedLinearModel(Coefficients(w, variances), self.task)
 
     # -- grid sweep with warm start (the reference's ModelTraining loop) ----
+    def grid_loop(
+        self,
+        solve_fn,
+        reg_weights: Sequence[float],
+        w0: Optional[Array] = None,
+        warm_start: bool = True,
+        solved: Optional[dict] = None,
+        on_solved=None,
+        variance_fn=None,
+    ) -> list[tuple[float, GeneralizedLinearModel, Optional[SolveResult]]]:
+        """The warm-started λ chain shared by the single-device and
+        distributed grids; ``solve_fn(lam, w_prev) → SolveResult`` is the
+        only thing that differs between them.
+
+        Checkpoint/resume: ``solved`` (λ → coefficient vector, from
+        io/checkpoint.GridCheckpointer) skips already-solved λs — their
+        entries come back with ``res=None`` and the warm-start chain
+        continues from the restored coefficients, so a resumed grid matches
+        the uninterrupted one bit-for-bit.  ``on_solved(lam, w)`` fires
+        after each fresh solve (the driver persists the checkpoint there).
+        ``variance_fn(w, lam)`` runs for EVERY grid point (including
+        restored ones) when coefficient variances are requested."""
+        results = []
+        w_prev = w0
+        solved = solved or {}
+        for lam in sorted(reg_weights, reverse=True):
+            if lam in solved:
+                w = jnp.asarray(solved[lam])
+                res = None
+            else:
+                res = solve_fn(lam, w_prev)
+                w = res.w
+                if on_solved is not None:
+                    on_solved(lam, w)
+            variances = variance_fn(w, lam) if variance_fn is not None else None
+            results.append((lam, self.make_model(w, variances), res))
+            if warm_start:
+                w_prev = w
+        return results
+
     def run_grid(
         self,
         data: GlmData,
@@ -219,39 +259,22 @@ class GlmOptimizationProblem:
         solved: Optional[dict] = None,
         on_solved=None,
     ) -> list[tuple[float, GeneralizedLinearModel, Optional[SolveResult]]]:
-        """Train one model per regularization weight, warm-starting each run
-        from the previous solution (λs are sorted descending so the most
-        regularized — smoothest — problem is solved first, as the reference
-        does for its warm-start chain).
+        """Train one model per regularization weight (see :meth:`grid_loop`
+        for the warm-start/checkpoint semantics)."""
 
-        Checkpoint/resume: ``solved`` (λ → coefficient vector, from
-        io/checkpoint.GridCheckpointer) skips already-solved λs — their
-        entries come back with ``res=None`` and the warm-start chain
-        continues from the restored coefficients, so a resumed grid matches
-        the uninterrupted one bit-for-bit.  ``on_solved(lam, w)`` fires
-        after each fresh solve (the driver persists the checkpoint there)."""
-        results = []
-        w_prev = w0
-        solved = solved or {}
-        for lam in sorted(reg_weights, reverse=True):
-            if lam in solved:
-                w = jnp.asarray(solved[lam])
-                res = None
-            else:
-                res = (
-                    self.solve_single_device(data, lam, w_prev, l1_mask)
-                    if axis_name is None
-                    else self.solve(data, lam, w_prev, axis_name, l1_mask)
-                )
-                w = res.w
-                if on_solved is not None:
-                    on_solved(lam, w)
-            variances = (
-                self.coefficient_variances(w, data, lam, axis_name)
-                if self.config.compute_variances
-                else None
+        def solve_fn(lam, w_prev):
+            return (
+                self.solve_single_device(data, lam, w_prev, l1_mask)
+                if axis_name is None
+                else self.solve(data, lam, w_prev, axis_name, l1_mask)
             )
-            results.append((lam, self.make_model(w, variances), res))
-            if warm_start:
-                w_prev = w
-        return results
+
+        variance_fn = None
+        if self.config.compute_variances:
+            variance_fn = lambda w, lam: self.coefficient_variances(
+                w, data, lam, axis_name
+            )
+        return self.grid_loop(
+            solve_fn, reg_weights, w0, warm_start, solved, on_solved,
+            variance_fn,
+        )

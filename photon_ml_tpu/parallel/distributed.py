@@ -147,6 +147,78 @@ def shard_glm_data(
     return DistributedGlmData(data=stacked, n_shards=n_shards)
 
 
+def run_grid_distributed(
+    problem,
+    dist_data: DistributedGlmData,
+    mesh: Mesh,
+    reg_weights,
+    w0: Optional[Array] = None,
+    l1_mask: Optional[Array] = None,
+    warm_start: bool = True,
+    solved: Optional[dict] = None,
+    on_solved=None,
+):
+    """The λ-grid warm-start chain (optim.problem.grid_loop) on a
+    row-sharded mesh: ONE jitted shard_map program serves every λ
+    (reg_weight and the warm start are traced), each objective evaluation
+    is one fused psum — the reference's per-λ ``treeAggregate`` loop
+    collapsed onto ICI.  Coefficient variances, when configured, run as a
+    second shard_map program (one psum'd squared-column reduction per λ)."""
+    import jax.numpy as jnp
+
+    d = dist_data.data.features.shape[-1]
+    if w0 is None:
+        w0 = jnp.zeros((d,), jnp.float32)
+    mask = (
+        jnp.ones((d,), jnp.float32) if l1_mask is None
+        else jnp.asarray(l1_mask, jnp.float32)
+    )
+
+    def spmd(dd: DistributedGlmData, w_start: Array, lam: Array, m: Array):
+        return problem.solve(
+            dd.local(), lam, w_start, axis_name=DATA_AXIS, l1_mask=m
+        )
+
+    solve_sm = jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+    def solve_fn(lam, w_prev):
+        return solve_sm(
+            dist_data, w_prev, jnp.asarray(lam, jnp.float32), mask
+        )
+
+    variance_fn = None
+    if problem.config.compute_variances:
+        def var_spmd(dd: DistributedGlmData, w: Array, lam: Array):
+            return problem.coefficient_variances(
+                w, dd.local(), lam, axis_name=DATA_AXIS
+            )
+
+        var_sm = jax.jit(
+            jax.shard_map(
+                var_spmd,
+                mesh=mesh,
+                in_specs=(P(DATA_AXIS), P(), P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        variance_fn = lambda w, lam: var_sm(
+            dist_data, w, jnp.asarray(lam, jnp.float32)
+        )
+
+    return problem.grid_loop(
+        solve_fn, reg_weights, w0, warm_start, solved, on_solved, variance_fn
+    )
+
+
 def distributed_solve(
     solve_fn: Callable[[GlmData, Array], object],
     dist_data: DistributedGlmData,

@@ -9,6 +9,7 @@ the sharded objective and solver must agree with the single-device ones.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import scipy.sparse as sp
 
 from photon_ml_tpu.data.dataset import make_glm_data
@@ -126,3 +127,74 @@ class TestDistributedSolve:
         np.testing.assert_allclose(
             np.asarray(res.w), np.asarray(res_1.w), rtol=1e-3, atol=1e-4
         )
+
+
+class TestDistributedGrid:
+    def test_run_grid_distributed_matches_single_device(self, rng):
+        """The sharded λ-grid warm-start chain reproduces the single-device
+        grid (same λs, same coefficients to solver tolerance)."""
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.data.dataset import make_glm_data
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            GlmOptimizationProblem,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+        from photon_ml_tpu.parallel.distributed import (
+            data_mesh,
+            run_grid_distributed,
+            shard_glm_data,
+        )
+
+        n, d = 400, 30
+        X = sp.random(n, d, density=0.3, random_state=2, format="csr")
+        w_true = rng.normal(size=d)
+        y = (np.asarray(X @ w_true).ravel() > 0).astype(np.float32)
+        problem = GlmOptimizationProblem(
+            "logistic",
+            GlmOptimizationConfig(
+                optimizer=OptimizerConfig(max_iters=60),
+                regularization=RegularizationContext.l2(),
+            ),
+        )
+        lams = [5.0, 0.5]
+        single = problem.run_grid(make_glm_data(X, y), lams)
+        mesh = data_mesh()
+        dist = shard_glm_data(X, y, mesh)
+        multi = run_grid_distributed(problem, dist, mesh, lams)
+        for (l1_, m1, _), (l2_, m2, _) in zip(single, multi):
+            assert l1_ == l2_
+            np.testing.assert_allclose(
+                np.asarray(m1.coefficients.means),
+                np.asarray(m2.coefficients.means),
+                atol=2e-3,
+            )
+
+    def test_glm_driver_data_parallel_flag(self, rng, tmp_path):
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.data import libsvm
+        from photon_ml_tpu.drivers import glm_driver
+
+        n, d = 300, 25
+        X = sp.random(n, d, density=0.25, random_state=3, format="csr")
+        w_true = rng.normal(size=d)
+        y = np.where(np.asarray(X @ w_true).ravel() > 0, 1.0, -1.0)
+        train = str(tmp_path / "t.libsvm")
+        libsvm.write_libsvm(train, X, y)
+        args = [
+            "--train-data", train, "--task", "logistic", "--reg-type", "l2",
+            "--reg-weights", "0.5,5.0", "--n-features", str(d),
+            "--max-iters", "40", "--output-dir",
+        ]
+        r_dp = glm_driver.run(
+            args + [str(tmp_path / "dp"), "--data-parallel", "auto"]
+        )
+        r_sd = glm_driver.run(args + [str(tmp_path / "sd")])
+        assert r_dp["best_lambda"] == r_sd["best_lambda"]
+        for k in r_sd["metrics"]:
+            assert r_dp["metrics"][k] == pytest.approx(
+                r_sd["metrics"][k], abs=1e-3
+            )

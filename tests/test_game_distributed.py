@@ -226,3 +226,96 @@ class TestEstimatorMeshPath:
             "--data-parallel", "auto",
         ])
         assert result["train_metric"] > 0.7
+
+
+class TestMeshWarmStartAndVariances:
+    def test_initial_model_on_mesh_path(self, rng):
+        """Incremental training works with --data-parallel: a model trained
+        single-device warm-starts a mesh fit (previously crashed on the
+        distributed coordinates' layout)."""
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.game.estimator import (
+            FixedEffectCoordinateConfig,
+            GameEstimator,
+            RandomEffectCoordinateConfig,
+        )
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+        from photon_ml_tpu.parallel.distributed import data_mesh
+
+        n, n_users = 250, 9
+        ue = rng.normal(scale=1.5, size=n_users)
+        Xg = rng.normal(size=(n, 3)).astype(np.float32)
+        users = rng.integers(n_users, size=n)
+        y = (rng.uniform(size=n) <
+             1 / (1 + np.exp(-(Xg[:, 0] + ue[users])))).astype(np.float32)
+        shards = {
+            "global": sp.csr_matrix(Xg),
+            "userFeatures": sp.csr_matrix(np.ones((n, 1), np.float32)),
+        }
+        ids = {"userId": np.array([f"u{u}" for u in users])}
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=25),
+            regularization=RegularizationContext.l2(),
+        )
+        configs = {
+            "fixed": FixedEffectCoordinateConfig("global", opt, 0.5),
+            "per_user": RandomEffectCoordinateConfig(
+                "userFeatures", "userId", opt, 0.5
+            ),
+        }
+        single = GameEstimator("logistic", configs, n_iterations=1)
+        prior, _ = single.fit(shards, ids, y)
+        dist = GameEstimator(
+            "logistic", configs, n_iterations=1, mesh=data_mesh()
+        )
+        model, history = dist.fit(shards, ids, y, initial_model=prior)
+        cold = GameEstimator(
+            "logistic", configs, n_iterations=1, mesh=data_mesh()
+        )
+        _, hist_cold = cold.fit(shards, ids, y)
+        # Warm start includes the prior random effect from update one.
+        assert history[0]["train_metric"] > hist_cold[0]["train_metric"]
+
+    def test_distributed_grid_variances_match_single_device(self, rng):
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.data.dataset import make_glm_data
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            GlmOptimizationProblem,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+        from photon_ml_tpu.parallel.distributed import (
+            data_mesh,
+            run_grid_distributed,
+            shard_glm_data,
+        )
+
+        n, d = 320, 20
+        X = sp.random(n, d, density=0.4, random_state=4, format="csr")
+        y = (np.asarray(X @ rng.normal(size=d)).ravel() > 0).astype(
+            np.float32
+        )
+        problem = GlmOptimizationProblem(
+            "logistic",
+            GlmOptimizationConfig(
+                optimizer=OptimizerConfig(max_iters=50),
+                regularization=RegularizationContext.l2(),
+                compute_variances=True,
+            ),
+        )
+        single = problem.run_grid(make_glm_data(X, y), [1.0])
+        mesh = data_mesh()
+        multi = run_grid_distributed(
+            problem, shard_glm_data(X, y, mesh), mesh, [1.0]
+        )
+        v1 = np.asarray(single[0][1].coefficients.variances)
+        v2 = np.asarray(multi[0][1].coefficients.variances)
+        assert v2 is not None
+        np.testing.assert_allclose(v2, v1, rtol=1e-3)
