@@ -64,3 +64,57 @@ def summarize(data: GlmData, axis_name: str | None = None) -> BasicStatisticalSu
     return BasicStatisticalSummary(
         mean=mean, variance=variance, min=mins, max=maxs, nnz=nnz, count=w_sum
     )
+
+
+def summarize_host(X, weights=None) -> BasicStatisticalSummary:
+    """Host-side (numpy/scipy) summary of a raw feature matrix — the GAME
+    driver summarizes each feature shard without a device upload.  Same
+    semantics as :func:`summarize`: weighted moments over all rows,
+    nnz/min/max over live (weight > 0) rows folded with implicit zeros."""
+    import numpy as np
+    import scipy.sparse as sp
+
+    n, d = X.shape
+    w = (
+        np.ones(n, np.float64) if weights is None
+        else np.asarray(weights, np.float64)
+    )
+    w_sum = float(w.sum())
+    if sp.issparse(X):
+        csr = X.tocsr()
+        csr.sum_duplicates()
+        coo = csr.tocoo()
+        rows, cols, vals = coo.row, coo.col, coo.data.astype(np.float64)
+        wv = w[rows] * vals
+        s1 = np.bincount(cols, weights=wv, minlength=d)
+        s2 = np.bincount(cols, weights=wv * vals, minlength=d)
+        live = (vals != 0) & (w[rows] > 0)
+        c, v = cols[live], vals[live]
+        nnz = np.bincount(c, minlength=d)
+        mins = np.full(d, np.inf)
+        maxs = np.full(d, -np.inf)
+        np.minimum.at(mins, c, v)
+        np.maximum.at(maxs, c, v)
+        n_live = int(np.sum(w > 0))
+        has_zero = nnz < n_live
+        mins = np.where(has_zero, np.minimum(mins, 0.0), mins)
+        maxs = np.where(has_zero, np.maximum(maxs, 0.0), maxs)
+    else:
+        dense = np.asarray(X, np.float64)
+        s1 = w @ dense
+        s2 = w @ (dense * dense)
+        live_rows = w > 0
+        live = dense[live_rows]
+        nnz = np.count_nonzero(live, axis=0)
+        mins = live.min(axis=0) if live.shape[0] else np.zeros(d)
+        maxs = live.max(axis=0) if live.shape[0] else np.zeros(d)
+    mean = s1 / max(w_sum, 1e-12)
+    variance = np.maximum(s2 / max(w_sum, 1e-12) - mean * mean, 0.0)
+    return BasicStatisticalSummary(
+        mean=mean.astype(np.float64),
+        variance=variance,
+        min=np.asarray(mins, np.float64),
+        max=np.asarray(maxs, np.float64),
+        nnz=np.asarray(nnz, np.int32),
+        count=np.float64(w_sum),
+    )

@@ -197,6 +197,24 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         list(ids),
     )
 
+    # Optional per-shard feature summaries (the reference writes feature
+    # summary Avro artifacts — SURVEY.md §5.5).
+    if config.get("feature_summaries", False):
+        from photon_ml_tpu.data.stats import summarize_host
+        from photon_ml_tpu.io.summary_store import save_feature_summary
+
+        summary_dir = os.path.join(args.output_dir, "feature-summaries")
+        os.makedirs(summary_dir, exist_ok=True)
+        for shard_name, shard_matrix in shards.items():
+            save_feature_summary(
+                summarize_host(shard_matrix, weight),
+                index_maps[shard_name],
+                os.path.join(summary_dir, f"{shard_name}.avro"),
+            )
+        logger.info(
+            "wrote feature summaries for %s", sorted(shards)
+        )
+
     n_cd_iterations = int(config.get("iterations", 1))
     validation = None
     if args.validate_data:

@@ -144,8 +144,17 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     index_map = IndexMap.build(names, add_intercept=args.intercept)
 
     # Stage 2: summarize + normalization ------------------------------------
-    train_data = make_glm_data(X_train, y_train)
-    summary = summarize(train_data)
+    data_parallel = args.data_parallel == "auto" and len(jax.devices()) > 1
+    if data_parallel:
+        # The sharded path uploads the matrix across the mesh; a second
+        # full single-device copy just for summarization would double HBM.
+        from photon_ml_tpu.data.stats import summarize_host
+
+        train_data = None
+        summary = summarize_host(X_train)
+    else:
+        train_data = make_glm_data(X_train, y_train)
+        summary = summarize(train_data)
     norm_type = NormalizationType(args.normalization)
     normalization = (
         None
@@ -162,6 +171,13 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     }
     with open(os.path.join(args.output_dir, "feature_summary.json"), "w") as f:
         json.dump(summary_out, f)
+    # Avro artifact too, as the reference writes (SURVEY.md §5.5).
+    from photon_ml_tpu.io.summary_store import save_feature_summary
+
+    save_feature_summary(
+        summary, index_map,
+        os.path.join(args.output_dir, "feature_summary.avro"),
+    )
 
     # Stage 3: train over the λ grid ----------------------------------------
     problem = GlmOptimizationProblem(
@@ -220,7 +236,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         logger.info("warm-starting from %s", args.initial_model)
 
     mesh = None
-    if args.data_parallel == "auto" and len(jax.devices()) > 1:
+    if data_parallel:
         from photon_ml_tpu.parallel.distributed import (
             data_mesh,
             run_grid_distributed,
@@ -263,17 +279,27 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             # not abort the job after all training compute is spent.
             drop_out_of_range=True,
         )
-        val_data = make_glm_data(X_val, y_val)
     else:
-        val_data = train_data
-        y_val = y_train
+        X_val, y_val = X_train, y_train
+    val_data = None if data_parallel else (
+        make_glm_data(X_val, y_val) if args.validate_data else train_data
+    )
 
     metrics = {}
     best: tuple[float, GeneralizedLinearModel] | None = None
     best_metric = None
     for lam, model, _ in grid:
-        scores = np.asarray(model.compute_score(val_data))
-        m = evaluator.evaluate(scores, y_val, np.asarray(val_data.weights))
+        if data_parallel:
+            # Host scipy matvec: validation never needs a device round trip
+            # of a full unsharded copy.
+            scores = np.asarray(
+                X_val @ np.asarray(model.coefficients.means, np.float32)
+            ).ravel()
+            val_weights = None
+        else:
+            scores = np.asarray(model.compute_score(val_data))
+            val_weights = np.asarray(val_data.weights)
+        m = evaluator.evaluate(scores, y_val, val_weights)
         metrics[lam] = m
         logger.info("lambda=%g: %s=%.6f", lam, type(evaluator).__name__, m)
         if best_metric is None or evaluator.better_than(m, best_metric):

@@ -241,11 +241,15 @@ class GameEstimator:
         # config (same pattern as _distributed_random): a config change
         # re-jits but never re-shards/re-uploads the matrix.
         ds_key = ("dist_ds",) + key
+        dist = cache.get(ds_key)
         coord = DistributedFixedEffectCoordinate(
             name, shard, np.asarray(response, np.float32), self.mesh,
             self.task, cfg.optimization, cfg.reg_weight,
-            feature_shard=cfg.feature_shard, weights=train_weight_fn(),
-            dist=cache.get(ds_key),
+            feature_shard=cfg.feature_shard,
+            # weights (incl. the O(n) down-sampling pass) only matter when
+            # the sharded dataset is actually (re)built.
+            weights=None if dist is not None else train_weight_fn(),
+            dist=dist,
         )
         cache[ds_key] = coord.dist
         cache[cache_key] = (cfg.optimization, coord)

@@ -83,3 +83,18 @@ SCORING_RESULT = {
         {"name": "ids", "type": {"type": "map", "values": "string"}},
     ],
 }
+
+FEATURE_SUMMARY = {
+    "type": "record",
+    "name": "FeatureSummaryAvro",
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string"},
+        {"name": "mean", "type": "double"},
+        {"name": "variance", "type": "double"},
+        {"name": "min", "type": "double"},
+        {"name": "max", "type": "double"},
+        {"name": "nonzeroCount", "type": "long"},
+        {"name": "totalWeight", "type": "double"},
+    ],
+}

@@ -1,0 +1,47 @@
+"""Per-feature summary persistence (Avro).
+
+The reference writes feature summaries as Avro artifacts (SURVEY.md §5.5
+"feature summary output (per-feature stats as Avro)") — one record per
+feature with the name/term split, weighted moments, range, and nonzero
+count.  Mirrors the BasicStatisticalSummary produced by data/stats.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.io import avro
+from photon_ml_tpu.io.schemas import FEATURE_SUMMARY
+
+
+def save_feature_summary(summary, index_map: IndexMap, path: str) -> None:
+    """``summary``: a data/stats.BasicStatisticalSummary (device or host)."""
+    mean = np.asarray(summary.mean, np.float64)
+    var = np.asarray(summary.variance, np.float64)
+    mins = np.asarray(summary.min, np.float64)
+    maxs = np.asarray(summary.max, np.float64)
+    nnz = np.asarray(summary.nnz, np.int64)
+    count = float(np.asarray(summary.count))
+
+    def records():
+        for j in range(len(mean)):
+            fname, _, term = index_map.index_to_name(j).partition("\x01")
+            yield {
+                "name": fname,
+                "term": term,
+                "mean": float(mean[j]),
+                "variance": float(var[j]),
+                "min": float(mins[j]),
+                "max": float(maxs[j]),
+                "nonzeroCount": int(nnz[j]),
+                "totalWeight": count,
+            }
+
+    avro.write_container(path, FEATURE_SUMMARY, records())
+
+
+def load_feature_summary(path: str) -> list[dict]:
+    """Summary records in column order as written."""
+    _, recs = avro.read_container(path)
+    return recs

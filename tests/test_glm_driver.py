@@ -74,7 +74,10 @@ class TestGlmDriver:
             "--n-features", str(d),
             "--output-mode", "all",
         ])
-        files = [f for f in os.listdir(out) if f.endswith(".avro")]
+        files = [
+            f for f in os.listdir(out)
+            if f.startswith("model_lambda_") and f.endswith(".avro")
+        ]
         assert len(files) == 2
         # Stronger L1 ⇒ sparser model file (zero coefficients not written).
         from photon_ml_tpu.io import avro

@@ -442,3 +442,81 @@ class TestNativeDecoder:
             assert b"malformed" in lib.gd_error(h)
         finally:
             lib.gd_free(h)
+
+
+class TestFeatureSummaryStore:
+    def test_host_summary_matches_device_and_round_trips(self, tmp_path):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.data.dataset import make_glm_data
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.data.stats import summarize, summarize_host
+        from photon_ml_tpu.io.summary_store import (
+            load_feature_summary,
+            save_feature_summary,
+        )
+
+        rng = np.random.default_rng(31)
+        n, d = 200, 12
+        X = sp.random(n, d, density=0.3, random_state=6, format="csr")
+        w = rng.uniform(0.0, 2.0, size=n).astype(np.float32)
+        w[rng.uniform(size=n) < 0.1] = 0.0
+        y = np.zeros(n, np.float32)
+
+        dev = summarize(make_glm_data(X, y, weights=w))
+        host = summarize_host(X, w)
+        np.testing.assert_allclose(
+            np.asarray(host.mean), np.asarray(dev.mean), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(host.variance), np.asarray(dev.variance),
+            rtol=1e-4, atol=1e-7,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(host.nnz), np.asarray(dev.nnz)
+        )
+        np.testing.assert_allclose(
+            np.asarray(host.min), np.asarray(dev.min), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(host.max), np.asarray(dev.max), rtol=1e-6
+        )
+
+        imap = IndexMap.build([f"f{j}" for j in range(d)])
+        path = str(tmp_path / "summary.avro")
+        save_feature_summary(host, imap, path)
+        recs = load_feature_summary(path)
+        assert len(recs) == d
+        assert recs[3]["name"] == "f3"
+        assert recs[3]["mean"] == pytest.approx(float(host.mean[3]))
+        assert recs[3]["nonzeroCount"] == int(host.nnz[3])
+
+    def test_game_driver_writes_shard_summaries(self, tmp_path):
+        import json
+        import os
+
+        from photon_ml_tpu.drivers import game_training_driver
+        from photon_ml_tpu.io.summary_store import load_feature_summary
+
+        rng = np.random.default_rng(32)
+        write_game_avro(str(tmp_path / "t.avro"), _rows(rng, 80))
+        cfg = {
+            "task": "logistic", "iterations": 1, "feature_summaries": True,
+            "coordinates": [
+                {"name": "fixed", "type": "fixed", "feature_shard": "global",
+                 "optimizer": "lbfgs", "max_iters": 10, "reg_type": "l2",
+                 "reg_weight": 0.5},
+            ],
+        }
+        cfgp = str(tmp_path / "c.json")
+        with open(cfgp, "w") as f:
+            json.dump(cfg, f)
+        game_training_driver.run([
+            "--train-data", str(tmp_path / "t.avro"),
+            "--config", cfgp, "--output-dir", str(tmp_path / "out"),
+        ])
+        path = os.path.join(
+            str(tmp_path / "out"), "feature-summaries", "global.avro"
+        )
+        recs = load_feature_summary(path)
+        assert len(recs) > 0 and all("mean" in r for r in recs)
