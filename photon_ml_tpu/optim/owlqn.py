@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from photon_ml_tpu.optim.lbfgs import SolveResult, _two_loop, update_history
-from photon_ml_tpu.optim.linesearch import ValueAndGrad
+from photon_ml_tpu.optim.linesearch import ValueAndGrad, pnorm, pvdot
 
 Array = jax.Array
 
@@ -76,12 +76,20 @@ def owlqn_solve(
     l1_weight: Array | float,
     config: OWLQNConfig = OWLQNConfig(),
     l1_mask: Optional[Array] = None,
+    w_axis: Optional[str] = None,
 ) -> SolveResult:
     """Minimize ``f(w) + l1_weight·Σ_i mask_i·|w_i|``.
 
     ``value_and_grad`` evaluates only the smooth part f.  Returned
     ``SolveResult.grad`` is the final *pseudo-gradient* (its norm is the
     convergence quantity, matching Breeze's OWLQN ``adjustedGradient``).
+
+    ``w_axis``: mesh axis name when ``w0`` (and f's gradient) are
+    feature-dim SHARDS of a wide coefficient vector (tensor parallelism);
+    every w-space reduction — the L1 term, pseudo-gradient norms, the
+    two-loop recursion, history update, Armijo products — then reduces over
+    that axis, so the sharded iteration replicates the single-device one
+    (the orthant machinery itself is elementwise).
     """
     m = config.history
     d = w0.shape[0]
@@ -92,12 +100,15 @@ def owlqn_solve(
     )
 
     def full_value(w, smooth_value):
-        return smooth_value + l1 * jnp.sum(mask * jnp.abs(w))
+        l1_term = jnp.sum(mask * jnp.abs(w))
+        if w_axis is not None:
+            l1_term = lax.psum(l1_term, w_axis)
+        return smooth_value + l1 * l1_term
 
     f0_smooth, g0 = value_and_grad(w0)
     f0 = full_value(w0, f0_smooth)
     pg0 = _pseudo_gradient(w0, g0, l1, mask)
-    pg0_norm = jnp.linalg.norm(pg0)
+    pg0_norm = pnorm(pg0, w_axis)
     tol_scale = jnp.maximum(1.0, pg0_norm)
 
     n_track = config.max_iters + 1
@@ -124,12 +135,14 @@ def owlqn_solve(
     def body(s: _OWLQNState):
         pg = _pseudo_gradient(s.w, s.grad, l1, mask)
 
-        direction = -_two_loop(pg, s.S, s.Y, s.rho, s.gamma, s.n_pairs)
+        direction = -_two_loop(
+            pg, s.S, s.Y, s.rho, s.gamma, s.n_pairs, w_axis
+        )
         # Project the direction onto the descent orthant of -pg: zero any
         # coordinate whose sign disagrees (Andrew & Gao §3.2 "alignment").
         direction = jnp.where(direction * (-pg) > 0, direction, 0.0)
         # Degenerate (all-zero) direction → steepest descent on pg.
-        deg = jnp.vdot(direction, direction) == 0.0
+        deg = pvdot(direction, direction, w_axis) == 0.0
         direction = jnp.where(deg, -pg, direction)
 
         # Orthant choice: sign(w) where nonzero, else sign of the step.
@@ -137,7 +150,7 @@ def owlqn_solve(
 
         first = s.n_pairs == 0
         t = jnp.where(
-            first, jnp.minimum(1.0, 1.0 / jnp.linalg.norm(pg)), 1.0
+            first, jnp.minimum(1.0, 1.0 / pnorm(pg, w_axis)), 1.0
         )
 
         def project(w):
@@ -159,7 +172,7 @@ def owlqn_solve(
             # a fully-clamped trial (w == s.w, dg_proj == 0) must keep
             # backtracking — a smaller t clamps fewer coordinates — rather
             # than be accepted as a zero step.
-            dg_proj = jnp.vdot(pg, w - s.w)
+            dg_proj = pvdot(pg, w - s.w, w_axis)
             return jnp.logical_and(
                 value >= s.value + config.armijo_c1 * dg_proj,
                 n < config.max_line_search_evals,
@@ -178,12 +191,13 @@ def owlqn_solve(
 
         # History pairs use the SMOOTH gradient (standard OWL-QN).
         S, Y, rho, gamma, n_pairs = update_history(
-            s.S, s.Y, s.rho, s.gamma, s.n_pairs, w_new - s.w, g_new - s.grad
+            s.S, s.Y, s.rho, s.gamma, s.n_pairs, w_new - s.w, g_new - s.grad,
+            w_axis,
         )
 
         k = s.k + 1
         pg_new = _pseudo_gradient(w_new, g_new, l1, mask)
-        pg_norm = jnp.linalg.norm(pg_new)
+        pg_norm = pnorm(pg_new, w_axis)
         rel_impr = jnp.abs(s.value - f_new) / jnp.maximum(jnp.abs(s.value), 1e-12)
         # Line search made no progress: end the run and keep the incumbent
         # iterate (never adopt a trial point with a higher objective).
@@ -193,7 +207,7 @@ def owlqn_solve(
         stalled = f_new >= s.value
         converged = jnp.where(
             stalled,
-            jnp.linalg.norm(pg) <= config.tolerance * tol_scale,
+            pnorm(pg, w_axis) <= config.tolerance * tol_scale,
             jnp.logical_or(
                 pg_norm <= config.tolerance * tol_scale,
                 rel_impr <= config.tolerance * 1e-2,
@@ -203,7 +217,7 @@ def owlqn_solve(
         f_keep = jnp.where(stalled, s.value, f_new)
         g_keep = jnp.where(stalled, s.grad, g_new)
         pg_norm = jnp.where(
-            stalled, jnp.linalg.norm(pg), jnp.linalg.norm(pg_new)
+            stalled, pnorm(pg, w_axis), pnorm(pg_new, w_axis)
         )
 
         return _OWLQNState(

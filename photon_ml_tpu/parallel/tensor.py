@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from photon_ml_tpu.ops import losses as losses_lib
 from photon_ml_tpu.ops.sparse import DenseMatrix, SparseMatrix, from_coo
 from photon_ml_tpu.optim.lbfgs import LBFGSConfig, SolveResult, lbfgs_solve
+from photon_ml_tpu.optim.owlqn import OWLQNConfig, owlqn_solve
 from photon_ml_tpu.parallel.distributed import DATA_AXIS
 
 Array = jax.Array
@@ -179,23 +180,10 @@ def _make_tp_solver(task: str, mesh: Mesh, config: LBFGSConfig):
 
     def spmd(feat, lab, wts, off, w0_local, lam):
         local = jax.tree.map(lambda x: x[0, 0], feat)
-        lab, wts, off = lab[0], wts[0], off[0]
-
-        def vg(wl):
-            # Margins: every feature-rank contributes its column slice.
-            m = lax.psum(local.matvec(wl), FEATURE_AXIS) + off
-            val = lax.psum(
-                jnp.sum(wts * loss.value(m, lab)), DATA_AXIS
-            )
-            u = wts * loss.d1(m, lab)
-            # Gradient slice for the local columns — born sharded like w.
-            g = lax.psum(local.rmatvec(u), DATA_AXIS)
-            val = val + 0.5 * lam * lax.psum(
-                jnp.vdot(wl, wl), FEATURE_AXIS
-            )
-            return val, g + lam * wl
-
-        return lbfgs_solve(vg, w0_local, config, w_axis=FEATURE_AXIS)
+        vg = _smooth_vg(loss, local, lab[0], wts[0], off[0])
+        return lbfgs_solve(
+            lambda wl: vg(wl, lam), w0_local, config, w_axis=FEATURE_AXIS
+        )
 
     out_specs = SolveResult(
         w=P(FEATURE_AXIS),
@@ -224,6 +212,108 @@ def _make_tp_solver(task: str, mesh: Mesh, config: LBFGSConfig):
     )
 
 
+def _smooth_vg(loss, local, lab, wts, off):
+    """The sharded smooth GLM objective shared by every TP solver: margins
+    psum over FEATURE, weighted loss + gradient psum over DATA, L2 term via
+    a feature-axis psum'd dot.  Returns vg(wl, l2) -> (value, grad_slice)."""
+
+    def vg(wl, l2):
+        m = lax.psum(local.matvec(wl), FEATURE_AXIS) + off
+        val = lax.psum(jnp.sum(wts * loss.value(m, lab)), DATA_AXIS)
+        u = wts * loss.d1(m, lab)
+        g = lax.psum(local.rmatvec(u), DATA_AXIS)
+        val = val + 0.5 * l2 * lax.psum(jnp.vdot(wl, wl), FEATURE_AXIS)
+        return val, g + l2 * wl
+
+    return vg
+
+
+def _padded_width(features, mesh) -> int:
+    tp = mesh.shape[FEATURE_AXIS]
+    if isinstance(features, SparseMatrix):
+        return features.n_cols * tp  # n_cols is the per-tile width
+    return features.data.shape[1] * features.data.shape[3]
+
+
+@functools.lru_cache(maxsize=None)
+def _make_tp_owlqn_solver(task: str, mesh: Mesh, config: OWLQNConfig):
+    """ONE jitted shard_map OWL-QN program per (task, mesh, config) — the
+    L1/elastic-net counterpart of :func:`_make_tp_solver`.  The smooth part
+    (value/grad + L2) reduces exactly as in the L-BFGS solver; the L1 term,
+    pseudo-gradient norms, and orthant machinery run on w shards with
+    feature-axis psums (``owlqn_solve`` w_axis)."""
+    loss = losses_lib.get(task)
+
+    def spmd(feat, lab, wts, off, w0_local, l1, l2, mask_local):
+        local = jax.tree.map(lambda x: x[0, 0], feat)
+        vg = _smooth_vg(loss, local, lab[0], wts[0], off[0])
+        return owlqn_solve(
+            lambda wl: vg(wl, l2), w0_local, l1, config,
+            l1_mask=mask_local, w_axis=FEATURE_AXIS,
+        )
+
+    out_specs = SolveResult(
+        w=P(FEATURE_AXIS),
+        value=P(),
+        grad=P(FEATURE_AXIS),
+        iterations=P(),
+        converged=P(),
+        values=P(),
+        grad_norms=P(),
+    )
+    return jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(
+                P(DATA_AXIS, FEATURE_AXIS),
+                P(DATA_AXIS),
+                P(DATA_AXIS),
+                P(DATA_AXIS),
+                P(FEATURE_AXIS),
+                P(),
+                P(),
+                P(FEATURE_AXIS),
+            ),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+
+
+def tp_owlqn_solve(
+    task: str,
+    features,
+    labels: Array,
+    weights: Array,
+    offsets: Array,
+    mesh: Mesh,
+    l1_weight: Array | float,
+    l2_weight: Array | float = 0.0,
+    w0: Optional[Array] = None,
+    config: OWLQNConfig = OWLQNConfig(),
+    l1_mask: Optional[Array] = None,
+) -> SolveResult:
+    """L1/elastic-net fit with rows sharded over DATA and features over
+    FEATURE — very wide sparse models keep w, the L-BFGS history, AND the
+    orthant state sharded.  ``l1_mask`` (global, column-padded width) exempts
+    columns (e.g. the intercept) from the penalty."""
+    d_padded = _padded_width(features, mesh)
+    if w0 is None:
+        w0 = jnp.zeros((d_padded,), jnp.float32)
+    mask = (
+        jnp.ones((d_padded,), jnp.float32) if l1_mask is None
+        else jnp.asarray(l1_mask, jnp.float32)
+    )
+    fn = _make_tp_owlqn_solver(losses_lib.get(task).name, mesh, config)
+    return fn(
+        features, labels, weights, offsets, w0,
+        jnp.asarray(l1_weight, jnp.float32),
+        jnp.asarray(l2_weight, jnp.float32),
+        mask,
+    )
+
+
 def tp_lbfgs_solve(
     task: str,
     features,
@@ -243,11 +333,7 @@ def tp_lbfgs_solve(
     is a traced scalar and the compiled program is memoized per
     (task, mesh, config): λ sweeps reuse one compile.
     """
-    tp = mesh.shape[FEATURE_AXIS]
-    if isinstance(features, SparseMatrix):
-        d_padded = features.n_cols * tp  # n_cols is the per-tile width
-    else:
-        d_padded = features.data.shape[1] * features.data.shape[3]
+    d_padded = _padded_width(features, mesh)
     if w0 is None:
         w0 = jnp.zeros((d_padded,), jnp.float32)
     fn = _make_tp_solver(losses_lib.get(task).name, mesh, config)

@@ -152,3 +152,74 @@ class TestTensorParallel:
         assert np.linalg.norm(np.asarray(r2.w)) < np.linalg.norm(
             np.asarray(r1.w)
         )
+
+
+class TestTensorParallelOwlqn:
+    def test_l1_parity_and_sparsity(self, rng):
+        """Sharded OWL-QN reproduces the single-device L1 fit, including the
+        exact sparsity pattern (zeros land on the same coordinates)."""
+        from photon_ml_tpu.optim.owlqn import OWLQNConfig
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+        from photon_ml_tpu.parallel.tensor import tp_owlqn_solve
+
+        X, y = _wide_problem(rng, n=500, d=400)
+        lam = 2.0
+        problem = GlmOptimizationProblem(
+            "logistic",
+            GlmOptimizationConfig(
+                optimizer=OptimizerConfig(max_iters=80),
+                regularization=RegularizationContext.l1(),
+            ),
+        )
+        ref = problem.solve(make_glm_data(X, y), lam)
+        w_ref = np.asarray(ref.w)
+        assert np.sum(w_ref == 0) > 100  # the L1 fit is genuinely sparse
+
+        mesh = dp_tp_mesh(2, 4)
+        feats, lab, wts, off, d = shard_glm_data_dp_tp(X, y, mesh)
+        res = tp_owlqn_solve(
+            "logistic", feats, lab, wts, off, mesh, l1_weight=lam,
+            config=OWLQNConfig(max_iters=80),
+        )
+        w = np.asarray(res.w)[:d]
+        np.testing.assert_array_equal(np.asarray(res.w)[d:], 0.0)
+        assert float(res.value) == pytest.approx(float(ref.value), rel=1e-4)
+        np.testing.assert_allclose(w, w_ref, atol=3e-3)
+        # Sparsity pattern agreement (allow a few borderline coords).
+        disagree = np.sum((w == 0) != (w_ref == 0))
+        assert disagree <= max(2, int(0.01 * d))
+
+    def test_elastic_net_with_mask(self, rng):
+        """Elastic net + an intercept-exempt l1_mask on the sharded path."""
+        from photon_ml_tpu.optim.owlqn import OWLQNConfig
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+        from photon_ml_tpu.parallel.tensor import tp_owlqn_solve
+
+        X, y = _wide_problem(rng, n=300, d=200)
+        lam, alpha = 1.5, 0.5
+        problem = GlmOptimizationProblem(
+            "logistic",
+            GlmOptimizationConfig(
+                optimizer=OptimizerConfig(max_iters=60),
+                regularization=RegularizationContext.elastic_net(alpha),
+            ),
+        )
+        import jax.numpy as jnp
+
+        mask_ref = jnp.ones((200,), jnp.float32).at[0].set(0.0)
+        ref = problem.solve(make_glm_data(X, y), lam, l1_mask=mask_ref)
+
+        mesh = dp_tp_mesh(4, 2)
+        feats, lab, wts, off, d = shard_glm_data_dp_tp(X, y, mesh)
+        d_padded = feats.n_cols * 2
+        mask = np.ones(d_padded, np.float32)
+        mask[0] = 0.0
+        res = tp_owlqn_solve(
+            "logistic", feats, lab, wts, off, mesh,
+            l1_weight=alpha * lam, l2_weight=(1 - alpha) * lam,
+            config=OWLQNConfig(max_iters=60), l1_mask=mask,
+        )
+        assert float(res.value) == pytest.approx(float(ref.value), rel=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(res.w)[:d], np.asarray(ref.w), atol=3e-3
+        )
