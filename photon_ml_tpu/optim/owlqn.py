@@ -100,10 +100,7 @@ def owlqn_solve(
     )
 
     def full_value(w, smooth_value):
-        l1_term = jnp.sum(mask * jnp.abs(w))
-        if w_axis is not None:
-            l1_term = lax.psum(l1_term, w_axis)
-        return smooth_value + l1 * l1_term
+        return smooth_value + l1 * pvdot(mask, jnp.abs(w), w_axis)
 
     f0_smooth, g0 = value_and_grad(w0)
     f0 = full_value(w0, f0_smooth)

@@ -162,7 +162,9 @@ class TestTensorParallelOwlqn:
         from photon_ml_tpu.optim.regularization import RegularizationContext
         from photon_ml_tpu.parallel.tensor import tp_owlqn_solve
 
-        X, y = _wide_problem(rng, n=500, d=400)
+        # d deliberately NOT a multiple of tp: the padded-columns-stay-zero
+        # assertion below must check a non-empty slice.
+        X, y = _wide_problem(rng, n=500, d=397)
         lam = 2.0
         problem = GlmOptimizationProblem(
             "logistic",
