@@ -214,12 +214,14 @@ def bench_game_cd() -> float:
     _read_sync(warm.scores["per_user"])
     _log("game: warmup done; timing...")
 
-    t0 = time.perf_counter()
-    result = cd.run(base, n_iterations=GAME_TIMED_ITERS)
-    _read_sync(result.scores["per_user"])
-    dt = time.perf_counter() - t0
-    _log(f"game: {GAME_TIMED_ITERS} iters in {dt:.2f}s")
-    return GAME_TIMED_ITERS / dt
+    best = np.inf
+    for _ in range(2):  # best-of-2 post-warmup: damp chip/run variance
+        t0 = time.perf_counter()
+        result = cd.run(base, n_iterations=GAME_TIMED_ITERS)
+        _read_sync(result.scores["per_user"])
+        best = min(best, time.perf_counter() - t0)
+    _log(f"game: {GAME_TIMED_ITERS} iters in {best:.2f}s (best of 2)")
+    return GAME_TIMED_ITERS / best
 
 
 def bench_glm_driver() -> float:

@@ -77,6 +77,14 @@ if TILE_R < 128 or TILE_R % 128 or TILE_R > 32768:
 TILE_C = TILE_R
 WIN = 128           # window width = lanes per vreg
 WINS = TILE_R // WIN  # windows per tile side
+# Per-grid-step DMA budget for the tile kernel (bytes); 4 MiB measured best
+# on v5e (2/8/16 MiB all slower — see ops/README.md).
+DMA_BUDGET = int(os.environ.get("PHOTON_PALLAS_BUDGET", 4 << 20))
+if DMA_BUDGET <= 0:
+    raise ValueError(
+        f"PHOTON_PALLAS_BUDGET must be a positive byte count, got "
+        f"{DMA_BUDGET}"
+    )
 
 
 def _interpret() -> bool:
@@ -250,8 +258,10 @@ def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, depth, square,
 
 
 def _pick_rect(nbo: int, nbg: int, a: int,
-               budget: int = 4 << 20) -> tuple[int, int]:
+               budget: int = None) -> tuple[int, int]:
     """(batch, chunk) tiles per grid step fitting ~``budget`` input bytes."""
+    if budget is None:
+        budget = DMA_BUDGET
     per_tile = a * WIN * 6  # int16 code + f32 val
     cap = max(1, budget // per_tile)
 
