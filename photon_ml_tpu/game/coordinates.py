@@ -164,55 +164,114 @@ def _make_block_solver(task: str, config: GlmOptimizationConfig):
     has_l1 = config.regularization.l1_weight(1.0) > 0.0
     use_owlqn = opt.optimizer is OptimizerType.OWLQN or has_l1
 
-    def solve_one(X, y, wts, off, w0, l1, l2):
-        def vg(w):
-            m = X @ w + off
-            val = jnp.sum(wts * loss.value(m, y)) + 0.5 * l2 * jnp.vdot(w, w)
-            g = X.T @ (wts * loss.d1(m, y)) + l2 * w
-            return val, g
+    def rank1_newton(block, offsets_block, w0, l2):
+        """Single-row entities (R == 1 — the LARGEST bucket class in
+        long-tailed data) have a closed structure: the stationarity
+        condition ℓ'(m)·x + λw = 0 forces w ∝ x, so the whole per-entity
+        GLM collapses to a 1-D problem in α (w = α·x).  A few damped Newton
+        steps replace the full vmapped L-BFGS machinery — ~30 sequential
+        device ops instead of hundreds (the while_loop step count, not
+        FLOPs, dominates these buckets).  Smooth objectives only (L1 breaks
+        the proportionality)."""
+        X = block.X[:, 0, :]                       # (E, D)
+        y = block.labels[:, 0]
+        wt = block.weights[:, 0]
+        off = offsets_block[:, 0].astype(X.dtype)  # robust under x64 callers
+        s = jnp.sum(X * X, axis=1)                 # (E,) = ‖x‖²
+        safe_s = jnp.maximum(s, 1e-12)
+        alpha = jnp.sum(w0 * X, axis=1) / safe_s   # warm start projection
+        # Margin-change clamp: Δmargin = Δα·s, so |Δα| ≤ 20/s bounds each
+        # step's margin movement at 20 — keeps the undamped Newton step sane
+        # when the curvature flattens (λ = 0, saturated logistic / large
+        # Poisson counts) without capping total movement (12 × 20 margins).
+        clip = 20.0 / safe_s
 
-        if use_owlqn:
-            return owlqn_solve(
+        def body(_, alpha):
+            m = alpha * s + off
+            g1 = s * (wt * loss.d1(m, y) + l2 * alpha)
+            g2 = wt * loss.d2(m, y) * s * s + l2 * s
+            step = g1 / jnp.maximum(g2, 1e-12)
+            step = jnp.clip(step, -clip, clip)
+            return alpha - jnp.where(s > 0, step, 0.0)
+
+        # 30 damped steps: exp-family losses can overshoot to the clamp
+        # ceiling then crawl back ~1 margin-unit per Newton step (e.g. a
+        # huge Poisson count), so 12 was not always enough; converged lanes
+        # take zero-steps, and 30 sequential ops is still ~10x fewer than
+        # the generic vmapped L-BFGS machinery.
+        alpha = jax.lax.fori_loop(0, 30, body, alpha)
+        return alpha[:, None] * X
+
+    def make_solve_one(history: int):
+        def solve_one(X, y, wts, off, w0, l1, l2):
+            def vg(w):
+                m = X @ w + off
+                val = jnp.sum(wts * loss.value(m, y)) + 0.5 * l2 * jnp.vdot(w, w)
+                g = X.T @ (wts * loss.d1(m, y)) + l2 * w
+                return val, g
+
+            if use_owlqn:
+                return owlqn_solve(
+                    vg,
+                    w0,
+                    l1,
+                    OWLQNConfig(
+                        max_iters=opt.max_iters,
+                        tolerance=opt.tolerance,
+                        history=history,
+                    ),
+                ).w
+            if opt.optimizer is OptimizerType.TRON:
+                def hvp(w, v, aux):
+                    return X.T @ (aux * (X @ v)) + l2 * v
+
+                def d2f(w):
+                    return wts * loss.d2(X @ w + off, y)
+
+                return tron_solve(
+                    vg, hvp, w0,
+                    TRONConfig(
+                        max_iters=opt.max_iters, tolerance=opt.tolerance
+                    ),
+                    d2_fn=d2f,
+                ).w
+            return lbfgs_solve(
                 vg,
                 w0,
-                l1,
-                OWLQNConfig(
+                LBFGSConfig(
                     max_iters=opt.max_iters,
                     tolerance=opt.tolerance,
-                    history=opt.history,
+                    history=history,
                 ),
             ).w
-        if opt.optimizer is OptimizerType.TRON:
-            def hvp(w, v, aux):
-                return X.T @ (aux * (X @ v)) + l2 * v
 
-            def d2f(w):
-                return wts * loss.d2(X @ w + off, y)
-
-            return tron_solve(
-                vg, hvp, w0,
-                TRONConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
-                d2_fn=d2f,
-            ).w
-        return lbfgs_solve(
-            vg,
-            w0,
-            LBFGSConfig(
-                max_iters=opt.max_iters,
-                tolerance=opt.tolerance,
-                history=opt.history,
-            ),
-        ).w
+        return solve_one
 
     @jax.jit
     def solve_block(
         block: EntityBlock, offsets_block: Array, w0: Array, l1: Array, l2: Array
     ) -> Array:
+        # Static shape dispatch (trace-time): single-row buckets take the
+        # rank-1 Newton path for smooth objectives.
+        if block.rows_per_entity == 1 and not use_owlqn:
+            return rank1_newton(block, offsets_block, w0, l2)
+        # History beyond the LOCAL problem dimension buys nothing (L-BFGS
+        # with m >= d already behaves Newton-like) but every extra pair
+    # adds two scan steps per iteration — sequential step count is what
+        # dominates these small batched solves.
+        solve_one = make_solve_one(min(opt.history, block.block_dim))
         return jax.vmap(
             solve_one, in_axes=(0, 0, 0, 0, 0, None, None)
         )(block.X, block.labels, block.weights, offsets_block, w0, l1, l2)
 
     return solve_block
+
+
+def _gather_block_offsets(offsets: Array, block: EntityBlock) -> Array:
+    """Per-row offsets for one entity block; padding rows (sentinel index)
+    read the appended zero slot."""
+    padded = jnp.concatenate([offsets, jnp.zeros((1,), offsets.dtype)])
+    return jnp.take(padded, block.row_index, axis=0)
 
 
 class RandomEffectCoordinate(Coordinate):
@@ -241,17 +300,43 @@ class RandomEffectCoordinate(Coordinate):
         self.entity_key = entity_key or name
         self._solver = _make_block_solver(task, config)
 
-        @jax.jit
-        def score_block(block: EntityBlock, coefs: Array) -> tuple[Array, Array]:
-            scores = jnp.einsum("erd,ed->er", block.X, coefs)
-            # Padding rows (sentinel index) scatter into the trailing slot.
-            return block.row_index.ravel(), scores.ravel()
+        # ONE jitted program for ALL buckets (and one for scoring): per-
+        # bucket dispatches each pay a host→device round trip, which on a
+        # tunneled chip (~0.1-0.2 s each) dominated the whole coordinate
+        # update for long-tailed datasets with many buckets.  Bucket shapes
+        # differ but are static, so a single trace inlines every bucket's
+        # solver into one HLO.
+        solver = self._solver
 
-        self._score_block = score_block
+        def _train_all(blocks, offsets, w0s, l1, l2):
+            return [
+                solver(b, _gather_block_offsets(offsets, b), w0, l1, l2)
+                for b, w0 in zip(blocks, w0s)
+            ]
 
-    def _gather_offsets(self, offsets: Array, block: EntityBlock) -> Array:
-        padded = jnp.concatenate([offsets, jnp.zeros((1,), offsets.dtype)])
-        return jnp.take(padded, block.row_index, axis=0)
+        n_rows = dataset.n_global_rows
+
+        def _score_all(blocks, passive_blocks, coefs_list):
+            total = jnp.zeros((n_rows + 1,), jnp.float32)
+            passive = passive_blocks or [None] * len(blocks)
+            for block, passive_block, coefs in zip(blocks, passive, coefs_list):
+                s = jnp.einsum("erd,ed->er", block.X, coefs)
+                # Padding rows (sentinel index) scatter into the trailing slot.
+                total = total.at[block.row_index.ravel()].add(s.ravel())
+                if passive_block is not None:
+                    # Active/passive split: capped-out rows are never trained
+                    # on but MUST be scored, or other coordinates would see
+                    # offsets missing this coordinate for those rows.
+                    sp_ = jnp.einsum(
+                        "erd,ed->er", passive_block.X, coefs
+                    )
+                    total = total.at[passive_block.row_index.ravel()].add(
+                        sp_.ravel()
+                    )
+            return total[:n_rows]
+
+        self._train_all_jit = jax.jit(_train_all)
+        self._score_all_jit = jax.jit(_score_all)
 
     def train(self, offsets: Array, warm_state=None) -> list[Array]:
         l1 = jnp.asarray(
@@ -262,33 +347,25 @@ class RandomEffectCoordinate(Coordinate):
             self.config.regularization.l2_weight(1.0) * self.reg_weight,
             jnp.float32,
         )
-        state = []
-        for bi, block in enumerate(self.dataset.blocks):
-            off_b = self._gather_offsets(offsets, block)
-            w0 = (
+        w0s = [
+            (
                 warm_state[bi]
                 if warm_state is not None
-                else jnp.zeros((block.n_entities, block.block_dim), jnp.float32)
+                else jnp.zeros(
+                    (block.n_entities, block.block_dim), jnp.float32
+                )
             )
-            state.append(self._solver(block, off_b, w0, l1, l2))
-        return state
+            for bi, block in enumerate(self.dataset.blocks)
+        ]
+        return self._train_all_jit(
+            self.dataset.blocks, jnp.asarray(offsets, jnp.float32), w0s,
+            l1, l2,
+        )
 
     def score(self, state: list[Array]) -> Array:
-        n = self.dataset.n_global_rows
-        total = jnp.zeros((n + 1,), jnp.float32)
-        passive = self.dataset.passive_blocks or [None] * len(self.dataset.blocks)
-        for block, passive_block, coefs in zip(
-            self.dataset.blocks, passive, state
-        ):
-            idx, vals = self._score_block(block, coefs)
-            total = total.at[idx].add(vals)
-            if passive_block is not None:
-                # Active/passive split: capped-out rows are never trained on
-                # but MUST be scored, or other coordinates would see offsets
-                # missing this coordinate for those rows.
-                idx_p, vals_p = self._score_block(passive_block, coefs)
-                total = total.at[idx_p].add(vals_p)
-        return total[:n]
+        return self._score_all_jit(
+            self.dataset.blocks, self.dataset.passive_blocks, state
+        )
 
     def _block_variances(self, block: EntityBlock, coefs: Array,
                          offsets: Array) -> np.ndarray:
@@ -300,7 +377,7 @@ class RandomEffectCoordinate(Coordinate):
             self.config.regularization.l2_weight(1.0) * self.reg_weight,
             jnp.float32,
         )
-        off_b = self._gather_offsets(jnp.asarray(offsets, jnp.float32), block)
+        off_b = _gather_block_offsets(jnp.asarray(offsets, jnp.float32), block)
         m = jnp.einsum("erd,ed->er", block.X, coefs) + off_b
         d2w = block.weights * loss.d2(m, block.labels)
         diag = jnp.einsum("er,erd->ed", d2w, block.X * block.X) + l2
