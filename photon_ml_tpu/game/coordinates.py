@@ -252,7 +252,10 @@ def _make_block_solver(task: str, config: GlmOptimizationConfig):
         block: EntityBlock, offsets_block: Array, w0: Array, l1: Array, l2: Array
     ) -> Array:
         # Static shape dispatch (trace-time): single-row buckets take the
-        # rank-1 Newton path for smooth objectives.
+        # rank-1 Newton path for smooth objectives.  (A gram-space dual
+        # Newton for 2 <= R <= 16 was tried and measured 4.5x SLOWER than
+        # the vmapped L-BFGS: batched small jnp.linalg.solve lowers to
+        # scalar-heavy LU loops on TPU.)
         if block.rows_per_entity == 1 and not use_owlqn:
             return rank1_newton(block, offsets_block, w0, l2)
         # History beyond the LOCAL problem dimension buys nothing (L-BFGS
