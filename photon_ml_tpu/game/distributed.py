@@ -122,6 +122,28 @@ class DistributedFixedEffectCoordinate(FixedEffectCoordinate):
             )
         )
 
+        def _variances(
+            dd: DistributedGlmData,
+            offsets_blocked: Array,
+            w: Array,
+            reg_weight: Array,
+        ):
+            local = dd.local()
+            local = dataclasses.replace(local, offsets=offsets_blocked[0])
+            return self.problem.coefficient_variances(
+                w, local, reg_weight, axis_name=DATA_AXIS
+            )
+
+        self._var_sm = jax.jit(
+            jax.shard_map(
+                _variances,
+                mesh=mesh,
+                in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+
     def _block_offsets(self, offsets: Array) -> Array:
         total = self._n_shards * self._rows_per_shard
         padded = jnp.concatenate(
@@ -150,17 +172,28 @@ class DistributedFixedEffectCoordinate(FixedEffectCoordinate):
         return blocked.reshape(-1)[: self.n_rows]
 
     def finalize(self, state: Array, offsets=None) -> FixedEffectModel:
-        if self.problem.config.compute_variances:
+        variances = None
+        if self.problem.config.compute_variances and offsets is None:
             import logging
 
             logging.getLogger(__name__).warning(
-                "coordinate %s: compute_variances is not implemented on the "
-                "row-sharded (mesh) fixed-effect path yet — the saved model "
-                "will carry no variances; run single-device to get them",
+                "coordinate %s: compute_variances requires finalize(...,"
+                " offsets=...) (the estimator passes residual offsets); "
+                "the model will carry no variances",
                 self.name,
             )
+        if self.problem.config.compute_variances and offsets is not None:
+            # One psum'd squared-column reduction over the mesh, with the
+            # Hessian evaluated at the full final margins (residual offsets
+            # included) — same semantics as the single-device path.
+            variances = self._var_sm(
+                self.dist,
+                self._block_offsets(jnp.asarray(offsets, jnp.float32)),
+                state,
+                jnp.asarray(self.reg_weight, jnp.float32),
+            )
         return FixedEffectModel(
-            GeneralizedLinearModel(Coefficients(state), self.task),
+            GeneralizedLinearModel(Coefficients(state, variances), self.task),
             self.feature_shard,
         )
 

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from photon_ml_tpu.optim.lbfgs import SolveResult
-from photon_ml_tpu.optim.linesearch import ValueAndGrad
+from photon_ml_tpu.optim.linesearch import ValueAndGrad, pnorm, pvdot
 
 Array = jax.Array
 
@@ -71,7 +71,8 @@ def _steihaug_cg(
     delta: Array,
     max_iters: int,
     tol: Array,
-) -> tuple[Array, Array]:
+    w_axis: Optional[str] = None,
+) -> tuple[Array, Array, Array]:
     """Approximately minimize g·s + ½ sᵀHs subject to ‖s‖ ≤ delta.
 
     Returns (s, r, hit_boundary) with r = -g - H·s the final residual
@@ -84,9 +85,9 @@ def _steihaug_cg(
 
     def boundary_tau(s, p):
         # Solve ‖s + τ p‖ = delta for τ ≥ 0.
-        pp = jnp.vdot(p, p)
-        sp = jnp.vdot(s, p)
-        ss = jnp.vdot(s, s)
+        pp = pvdot(p, p, w_axis)
+        sp = pvdot(s, p, w_axis)
+        ss = pvdot(s, s, w_axis)
         disc = jnp.maximum(sp * sp + pp * (delta * delta - ss), 0.0)
         return (-sp + jnp.sqrt(disc)) / jnp.maximum(pp, 1e-30)
 
@@ -94,9 +95,9 @@ def _steihaug_cg(
         s=jnp.zeros((d,), dtype),
         r=-g,
         p=-g,
-        rr=jnp.vdot(g, g),
+        rr=pvdot(g, g, w_axis),
         i=jnp.asarray(0, jnp.int32),
-        done=jnp.sqrt(jnp.vdot(g, g)) <= tol,
+        done=pnorm(g, w_axis) <= tol,
         hit_boundary=jnp.asarray(False),
     )
 
@@ -105,14 +106,14 @@ def _steihaug_cg(
 
     def body(c: _CGState):
         Hp = hvp(c.p)
-        pHp = jnp.vdot(c.p, Hp)
+        pHp = pvdot(c.p, Hp, w_axis)
 
         # Negative curvature → go to the boundary along p.
         neg_curv = pHp <= 0.0
 
         alpha = c.rr / jnp.where(pHp > 0, pHp, 1.0)
         s_next = c.s + alpha * c.p
-        crosses = jnp.linalg.norm(s_next) >= delta
+        crosses = pnorm(s_next, w_axis) >= delta
 
         take_boundary = jnp.logical_or(neg_curv, crosses)
         tau = boundary_tau(c.s, c.p)
@@ -123,7 +124,7 @@ def _steihaug_cg(
         # extra Hessian-vector product.
         r_new = c.r - step_len * Hp
 
-        rr_new = jnp.vdot(r_new, r_new)
+        rr_new = pvdot(r_new, r_new, w_axis)
         small = jnp.sqrt(rr_new) <= tol
         beta = rr_new / jnp.maximum(c.rr, 1e-30)
         p_new = r_new + beta * c.p
@@ -162,18 +163,23 @@ def tron_solve(
     w0: Array,
     config: TRONConfig = TRONConfig(),
     d2_fn: Optional[D2Fn] = None,
+    w_axis: Optional[str] = None,
 ) -> SolveResult:
     """Minimize via trust-region Newton-CG.
 
     ``hvp_fn(w, v, aux)`` must return the (regularized) Hessian-vector
     product; ``d2_fn(w)`` produces the reusable per-iterate cache passed as
     ``aux`` (pass None to recompute inside hvp_fn each call).
+
+    ``w_axis``: mesh axis name when ``w0``/gradients/HVPs are feature-dim
+    SHARDS (tensor parallelism) — every w-space inner product and norm in
+    the outer loop and the Steihaug CG then reduces over that axis.
     """
     dtype = w0.dtype
     make_aux = d2_fn if d2_fn is not None else (lambda w: jnp.zeros((0,), dtype))
 
     f0, g0 = value_and_grad(w0)
-    g0_norm = jnp.linalg.norm(g0)
+    g0_norm = pnorm(g0, w_axis)
     tol_scale = jnp.maximum(1.0, g0_norm)
 
     n_track = config.max_iters + 1
@@ -197,22 +203,23 @@ def tron_solve(
         return jnp.logical_and(~s.done, s.k < config.max_iters)
 
     def body(s: _TRONState):
-        cg_tol = config.cg_tol * jnp.linalg.norm(s.grad)
+        cg_tol = config.cg_tol * pnorm(s.grad, w_axis)
         step, residual, _ = _steihaug_cg(
             lambda v: hvp_fn(s.w, v, s.aux),
             s.grad,
             s.delta,
             config.max_cg_iters,
             cg_tol,
+            w_axis,
         )
 
         w_try = s.w + step
         f_try, g_try = value_and_grad(w_try)
 
-        gs = jnp.vdot(s.grad, step)
+        gs = pvdot(s.grad, step, w_axis)
         # r = -g - H·s  ⇒  sᵀHs = -s·r - s·g; saves one HVP (and its psum
         # round when distributed) per outer iteration, as LIBLINEAR does.
-        sHs = -jnp.vdot(step, residual) - gs
+        sHs = -pvdot(step, residual, w_axis) - gs
         pred = -(gs + 0.5 * sHs)
         ared = s.value - f_try
         rho = ared / jnp.where(pred > 0, pred, 1e-30)
@@ -226,7 +233,7 @@ def tron_solve(
         )
 
         # Radius update (LIBLINEAR-style).
-        snorm = jnp.linalg.norm(step)
+        snorm = pnorm(step, w_axis)
         delta = jnp.where(
             rho < config.eta1,
             jnp.maximum(config.sigma1 * snorm, config.sigma2 * s.delta)
@@ -240,7 +247,7 @@ def tron_solve(
         delta = jnp.maximum(delta, 1e-20)
 
         k = s.k + 1
-        g_norm = jnp.linalg.norm(g_new)
+        g_norm = pnorm(g_new, w_axis)
         rel_impr = jnp.where(
             accept,
             jnp.abs(ared) / jnp.maximum(jnp.abs(s.value), 1e-12),

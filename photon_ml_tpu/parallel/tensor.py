@@ -42,6 +42,7 @@ from photon_ml_tpu.ops import losses as losses_lib
 from photon_ml_tpu.ops.sparse import DenseMatrix, SparseMatrix, from_coo
 from photon_ml_tpu.optim.lbfgs import LBFGSConfig, SolveResult, lbfgs_solve
 from photon_ml_tpu.optim.owlqn import OWLQNConfig, owlqn_solve
+from photon_ml_tpu.optim.tron import TRONConfig, tron_solve
 from photon_ml_tpu.parallel.distributed import DATA_AXIS
 
 Array = jax.Array
@@ -171,6 +172,28 @@ def shard_glm_data_dp_tp(
     )
 
 
+# shard_map spec layout shared by every TP solver: the six data args
+# (features tiles, three row arrays, the w0 shard, the traced scalar) and a
+# replicated SolveResult with w/grad staying feature-sharded.
+_TP_IN_SPECS = (
+    P(DATA_AXIS, FEATURE_AXIS),
+    P(DATA_AXIS),
+    P(DATA_AXIS),
+    P(DATA_AXIS),
+    P(FEATURE_AXIS),
+    P(),
+)
+_TP_OUT_SPECS = SolveResult(
+    w=P(FEATURE_AXIS),
+    value=P(),
+    grad=P(FEATURE_AXIS),
+    iterations=P(),
+    converged=P(),
+    values=P(),
+    grad_norms=P(),
+)
+
+
 @functools.lru_cache(maxsize=None)
 def _make_tp_solver(task: str, mesh: Mesh, config: LBFGSConfig):
     """ONE jitted shard_map program per (task, mesh, config) — reused across
@@ -185,28 +208,12 @@ def _make_tp_solver(task: str, mesh: Mesh, config: LBFGSConfig):
             lambda wl: vg(wl, lam), w0_local, config, w_axis=FEATURE_AXIS
         )
 
-    out_specs = SolveResult(
-        w=P(FEATURE_AXIS),
-        value=P(),
-        grad=P(FEATURE_AXIS),
-        iterations=P(),
-        converged=P(),
-        values=P(),
-        grad_norms=P(),
-    )
     return jax.jit(
         jax.shard_map(
             spmd,
             mesh=mesh,
-            in_specs=(
-                P(DATA_AXIS, FEATURE_AXIS),
-                P(DATA_AXIS),
-                P(DATA_AXIS),
-                P(DATA_AXIS),
-                P(FEATURE_AXIS),
-                P(),
-            ),
-            out_specs=out_specs,
+            in_specs=_TP_IN_SPECS,
+            out_specs=_TP_OUT_SPECS,
             check_vma=False,
         )
     )
@@ -252,30 +259,12 @@ def _make_tp_owlqn_solver(task: str, mesh: Mesh, config: OWLQNConfig):
             l1_mask=mask_local, w_axis=FEATURE_AXIS,
         )
 
-    out_specs = SolveResult(
-        w=P(FEATURE_AXIS),
-        value=P(),
-        grad=P(FEATURE_AXIS),
-        iterations=P(),
-        converged=P(),
-        values=P(),
-        grad_norms=P(),
-    )
     return jax.jit(
         jax.shard_map(
             spmd,
             mesh=mesh,
-            in_specs=(
-                P(DATA_AXIS, FEATURE_AXIS),
-                P(DATA_AXIS),
-                P(DATA_AXIS),
-                P(DATA_AXIS),
-                P(FEATURE_AXIS),
-                P(),
-                P(),
-                P(FEATURE_AXIS),
-            ),
-            out_specs=out_specs,
+            in_specs=_TP_IN_SPECS[:5] + (P(), P(), P(FEATURE_AXIS)),
+            out_specs=_TP_OUT_SPECS,
             check_vma=False,
         )
     )
@@ -311,6 +300,68 @@ def tp_owlqn_solve(
         jnp.asarray(l1_weight, jnp.float32),
         jnp.asarray(l2_weight, jnp.float32),
         mask,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _make_tp_tron_solver(task: str, mesh: Mesh, config: TRONConfig):
+    """ONE jitted shard_map TRON program per (task, mesh, config): the
+    trust-region Newton-CG outer/inner loops run on w shards with
+    feature-axis psums (``tron_solve`` w_axis); each CG step's HVP is one
+    (margin psum over FEATURE) + (gradient-side psum over DATA) pair — the
+    reference's per-CG-step ``HessianVectorAggregator`` treeAggregate
+    collapsed onto ICI."""
+    loss = losses_lib.get(task)
+
+    def spmd(feat, lab, wts, off, w0_local, lam):
+        local = jax.tree.map(lambda x: x[0, 0], feat)
+        lab_l, wts_l, off_l = lab[0], wts[0], off[0]
+        vg = _smooth_vg(loss, local, lab_l, wts_l, off_l)
+
+        def d2f(wl):
+            m = lax.psum(local.matvec(wl), FEATURE_AXIS) + off_l
+            return wts_l * loss.d2(m, lab_l)
+
+        def hvp(wl, v, aux):
+            dm = lax.psum(local.matvec(v), FEATURE_AXIS)
+            return lax.psum(local.rmatvec(aux * dm), DATA_AXIS) + lam * v
+
+        return tron_solve(
+            lambda wl: vg(wl, lam), hvp, w0_local, config, d2_fn=d2f,
+            w_axis=FEATURE_AXIS,
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=_TP_IN_SPECS,
+            out_specs=_TP_OUT_SPECS,
+            check_vma=False,
+        )
+    )
+
+
+def tp_tron_solve(
+    task: str,
+    features,
+    labels: Array,
+    weights: Array,
+    offsets: Array,
+    mesh: Mesh,
+    reg_weight: Array | float = 0.0,
+    w0: Optional[Array] = None,
+    config: TRONConfig = TRONConfig(),
+) -> SolveResult:
+    """Trust-region Newton fit with rows sharded over DATA and features
+    over FEATURE (L2 only, like the single-device TRON)."""
+    d_padded = _padded_width(features, mesh)
+    if w0 is None:
+        w0 = jnp.zeros((d_padded,), jnp.float32)
+    fn = _make_tp_tron_solver(losses_lib.get(task).name, mesh, config)
+    return fn(
+        features, labels, weights, offsets, w0,
+        jnp.asarray(reg_weight, jnp.float32),
     )
 
 

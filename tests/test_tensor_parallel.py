@@ -225,3 +225,70 @@ class TestTensorParallelOwlqn:
         np.testing.assert_allclose(
             np.asarray(res.w)[:d], np.asarray(ref.w), atol=3e-3
         )
+
+
+class TestTensorParallelTron:
+    def test_tron_parity(self, rng):
+        """Sharded trust-region Newton reproduces the single-device TRON."""
+        from photon_ml_tpu.optim.problem import OptimizerType
+        from photon_ml_tpu.optim.tron import TRONConfig
+        from photon_ml_tpu.parallel.tensor import tp_tron_solve
+
+        X, y = _wide_problem(rng, n=500, d=350)
+        lam = 0.8
+        problem = GlmOptimizationProblem(
+            "logistic",
+            GlmOptimizationConfig(
+                optimizer=OptimizerConfig(
+                    optimizer=OptimizerType.TRON, max_iters=50
+                ),
+                regularization=RegularizationContext.l2(),
+            ),
+        )
+        ref = problem.solve(make_glm_data(X, y), lam)
+        mesh = dp_tp_mesh(2, 4)
+        feats, lab, wts, off, d = shard_glm_data_dp_tp(X, y, mesh)
+        res = tp_tron_solve(
+            "logistic", feats, lab, wts, off, mesh, reg_weight=lam,
+            config=TRONConfig(max_iters=50),
+        )
+        assert float(res.value) == pytest.approx(float(ref.value), rel=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(res.w)[:d], np.asarray(ref.w), atol=2e-3
+        )
+
+
+class TestMeshVariancesFixedEffect:
+    def test_distributed_game_fixed_variances_match(self, rng):
+        """Row-sharded GAME fixed effects now compute variances; they must
+        match the single-device path."""
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.game.estimator import (
+            FixedEffectCoordinateConfig,
+            GameEstimator,
+        )
+        from photon_ml_tpu.parallel.distributed import data_mesh
+
+        n = 320
+        Xg = rng.normal(size=(n, 5)).astype(np.float32)
+        y = (rng.uniform(size=n) <
+             1 / (1 + np.exp(-Xg[:, 0]))).astype(np.float32)
+        shards = {"global": sp.csr_matrix(Xg)}
+        ids = {}
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=40),
+            regularization=RegularizationContext.l2(),
+            compute_variances=True,
+        )
+        configs = {"fixed": FixedEffectCoordinateConfig("global", opt, 0.6)}
+        m_single, _ = GameEstimator("logistic", configs, 1).fit(
+            shards, ids, y
+        )
+        m_dist, _ = GameEstimator(
+            "logistic", configs, 1, mesh=data_mesh()
+        ).fit(shards, ids, y)
+        v1 = np.asarray(m_single["fixed"].model.coefficients.variances)
+        v2 = np.asarray(m_dist["fixed"].model.coefficients.variances)
+        assert v2 is not None
+        np.testing.assert_allclose(v2, v1, rtol=1e-3)
