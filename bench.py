@@ -74,6 +74,37 @@ def _read_sync(x) -> None:
     np.asarray(x.ravel()[0:1])
 
 
+def bench_chip_stream() -> float:
+    """Chip calibration: GB/s of a plain XLA elementwise reduce over ~256 MB.
+
+    The tunneled TPU's effective streaming rate varies ~2x between
+    sessions (measured 47 vs ~90 GB/s on different days for the SAME
+    committed code).  This number lets rows/s results be normalized
+    across sessions; the sparse kernels are bandwidth-bound, so rows/s
+    scales ~linearly with it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((64 << 20,), jnp.float32)  # 256 MB
+
+    @jax.jit
+    def chain(x):
+        def body(i, acc):
+            return acc + jnp.sum(x * (1.0 + 1e-12 * acc))
+        return jax.lax.fori_loop(0, 10, body, jnp.zeros((), jnp.float32))
+
+    r = chain(x)
+    _read_sync(r)
+    best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = chain(x)
+        _read_sync(r)
+        best = min(best, (time.perf_counter() - t0) / 10)
+    return x.nbytes / best / 1e9
+
+
 def bench_glm_throughput() -> float:
     """rows/s of the fused sparse logistic value+grad (primary metric)."""
     import jax
@@ -276,6 +307,10 @@ def main() -> None:
         return round(base / value if smaller_is_better else value / base, 4)
 
     extra = {}
+    try:
+        extra["chip_stream_gbps"] = round(bench_chip_stream(), 1)
+    except Exception as e:  # calibration must never sink the bench
+        extra["chip_stream_gbps"] = f"failed: {e}"
     if ONLY in ("", "game"):
         v = bench_game_cd()
         extra["game_cd_iters_per_sec"] = round(v, 3)

@@ -15,20 +15,29 @@ metric dies there.  Mosaic's only fast data-movement primitive is
 The kernel design exploits exactly that:
 
 - The matrix is cut into ``TILE_R x TILE_C = 2048 x 2048`` tiles; each tile's
-  entries are placed, ON HOST at build time, into a dense slot grid
+  entries are placed, ON HOST at build time, into a window-PACKED slot grid
   ``(A, 128)`` where
 
   * ``lane  = row % 128``                      (matvec orientation "F")
-  * ``sublane group = (col % 2048) // 128``    — the entry's 128-wide
-    column *window*, so every sublane needs ONE 128-wide slice of ``w``
-    as its gather table;
-  * ``depth`` slots absorb collisions; overflow spills to a tiny COO tail.
+  * an entry's *window* ``(col % 2048) // 128`` decides which sublanes can
+    hold it: each (tile, window) owns a contiguous run of
+    ``min(max-lane-load, depth)`` sublanes (bin-packed per tile), and every
+    sublane needs ONE 128-wide slice of ``w`` as its gather table;
+  * extra sublanes per window absorb (window, lane) collisions; overflow
+    past the cost-model depth spills to a tiny COO tail.
 
-- matvec per tile: ONE ``dynamic_gather`` of the whole ``(A, 128)`` block
-  against per-sublane tables built with ``pltpu.repeat`` from the 16 column
-  windows, then a 16-step masked sweep accumulates rows into the
-  ``(16, 128)`` margin block (``rhi = (row % 2048) // 128`` selects the
-  output sublane).  No scatter anywhere.
+  Packing beats the older uniform ``depth × WINS`` grid ~1.4x on slot
+  padding: A = Σ over windows of that window's own worst lane, instead of
+  ``WINS ×`` the worst cell anywhere in the matrix.
+
+- matvec per tile: per-sublane gather tables are built by a 16-step masked
+  SELECT over the windows (from each sublane's packed window id — exact,
+  and non-finites stay localized to their own window), then ONE
+  ``dynamic_gather`` of the whole ``(A, 128)`` block, then a 16-step
+  masked sweep accumulates rows into the ``(16, 128)`` margin block
+  (``ohi = (row % 2048) // 128``, packed per slot, selects the output
+  sublane).  No scatter anywhere; the selects and sweep overlap the
+  slot-stream DMA (measured: the kernel is bandwidth-bound).
 
 - rmatvec (the gradient side, Xᵀu) is the SAME kernel with roles mirrored
   (orientation "B": lane = col % 128, tables = 128-wide windows of ``u``,
@@ -38,8 +47,9 @@ The kernel design exploits exactly that:
 Measured on one v5e chip (1M rows x 8192 features, 32 nnz/row): ~40x the
 pure-XLA COO path for the fused objective; see bench.py / ops/README.md.
 
-Precision: everything is f32 on the VPU — bit-comparable to the COO path
-(only summation ORDER differs).  No bf16 shortcuts in the value path.
+Precision: everything is f32 — bit-comparable to the COO path (only
+summation ORDER differs).  Table construction is pure selection (no
+arithmetic).  No bf16 shortcuts in the value path.
 """
 
 from __future__ import annotations
@@ -68,15 +78,29 @@ Array = jax.Array
 # on v5e for the bench workload; see ops/README.md.
 TILE_R = int(os.environ.get("PHOTON_PALLAS_TILE", "2048"))
 if TILE_R < 128 or TILE_R % 128 or TILE_R > 32768:
-    # Upper bound: the packed per-slot code ohi*128 + lo spans [0, TILE_R)
-    # and must fit int16.
+    # The packed slot code (win | ohi | lo) switches to int32 automatically
+    # past TILE 2048 (CODE_DTYPE below); 32768 is a sanity bound.
     raise ValueError(
-        f"PHOTON_PALLAS_TILE must be a multiple of 128 in [128, 32768] "
-        f"(packed int16 slot codes), got {TILE_R}"
+        f"PHOTON_PALLAS_TILE must be a multiple of 128 in [128, 32768], "
+        f"got {TILE_R}"
     )
 TILE_C = TILE_R
 WIN = 128           # window width = lanes per vreg
 WINS = TILE_R // WIN  # windows per tile side
+# Packed per-slot code layout: | win | ohi | lo |, low bits first.
+#   lo  (7 bits)      — gather index into the sublane's 128-wide table
+#   ohi (OBITS bits)  — output window within the tile
+#   win (OBITS bits)  — the SUBLANE's gather window (same value in all 128
+#                       slots of a sublane; the kernel reads lane 0)
+# int16 when it fits (TILE ≤ 2048 — halves index DMA), else int32.
+OBITS = max(1, (WINS - 1).bit_length())
+WIN_SHIFT = 7 + OBITS
+_CODE_BITS = 7 + 2 * OBITS
+CODE_DTYPE = np.int16 if _CODE_BITS <= 15 else np.int32
+CODE_BYTES = 2 if _CODE_BITS <= 15 else 4
+# Sublane-count granularity: the int16 slot arrays tile as (16, 128) on TPU,
+# so A is padded to a multiple of 16 (8 would re-pad internally).
+SUBPAD = 16
 # Per-grid-step DMA budget for the tile kernel (bytes); 4 MiB measured best
 # on v5e (2/8/16 MiB all slower — see ops/README.md).
 DMA_BUDGET = int(os.environ.get("PHOTON_PALLAS_BUDGET", 4 << 20))
@@ -111,26 +135,33 @@ def _build_orientation(
     depth_cap: int,
     spill_cost_ratio: float = 1024.0,
 ):
-    """Place entries into the (tile, sublane, lane) slot grid.
+    """Place entries into the window-PACKED (tile, sublane, lane) slot grid.
 
     Orientation F (matvec): ``rows`` are the lane/output side, ``cols`` the
     gather side.  Call with rows/cols swapped (and nbr/nbc swapped) for
-    orientation B.  Returns (lo, val, ohi, spill_mask, depth).
+    orientation B.  Returns (code, val, spill_idx, a, depth) where
 
-    lo   (NT, A, 128) int32 — gather-side low 7 bits (index into the table)
-    val  (NT, A, 128) f32   — entry values (0 in empty slots)
-    ohi  (NT, A, 128) int32 — output window id within the tile, in [0, 16)
+    code (NBR, NBC, A, 128) — packed ``win<<WIN_SHIFT | ohi<<7 | lo``:
+         ``lo`` indexes the sublane's 128-wide gather table, ``ohi`` is the
+         output window, ``win`` the SUBLANE's gather window (present in
+         every slot, empty or not — the kernel reads lane 0's copy)
+    val  (NBR, NBC, A, 128) f32 — entry values (0 in empty slots)
 
-    Depth selection is COST-based, not worst-cell-based: each depth level
-    costs one full (tiles × WINS × 128) kernel sweep, while each spilled
-    entry costs ~``spill_cost_ratio`` slot-equivalents on the XLA
-    gather/segment_sum path (measured ~1000x per entry on v5e: ~60 ns
-    per spilled entry vs ~0.06 ns per kernel slot).  The
-    chosen depth minimizes the modeled total, so a lone overloaded cell
-    spills instead of inflating every tile to the cap, while near-full
-    occupancy keeps everything tiled (spilling 0.5% to shave a few depth
-    levels is a measured net LOSS).  ``spill_cost_ratio=inf`` forces full
-    coverage (used for the post-spill rebuild).
+    Packing: each (tile, window) pair owns a CONTIGUOUS run of
+    ``need = min(max-lane-load, depth)`` sublanes, bin-packed per tile, so
+    A = max over tiles of Σ_w need — instead of the old uniform
+    ``WINS × global-max-depth`` grid.  On Poisson-spread data this cuts slot
+    padding ~1.5×: the old grid paid ``WINS ×`` the WORST cell anywhere,
+    the packed layout pays each window's own worst lane, summed.
+
+    Depth (the per-cell slot cap) is still COST-based: covering one more
+    collision level costs real slots only where windows actually need it
+    (Σ over windows of the increment to ``min(M, d)``, maxed over tiles),
+    while each spilled entry costs ~``spill_cost_ratio`` slot-equivalents
+    on the XLA gather/segment_sum path (measured ~1000x per entry on v5e),
+    plus a FIXED penalty for any nonzero spill (the XLA scatter's latency
+    floor, worth ~16 uniform depth levels).  ``spill_cost_ratio=inf``
+    forces full coverage (used for the post-spill rebuild).
     """
     tr = rows // TILE_R
     tc = cols // TILE_C
@@ -139,6 +170,16 @@ def _build_orientation(
     gwin = (cols % TILE_C) // WIN       # gather window within tile [0,16)
     glo = cols % WIN                    # index into that window's table
     ohi = (rows % TILE_R) // WIN        # output window within tile [0,16)
+    nt = nbr * nbc
+
+    if len(rows) == 0:  # all-zero / empty matrix: one empty sublane group
+        return (
+            np.zeros((nbr, nbc, SUBPAD, WIN), CODE_DTYPE),
+            np.zeros((nbr, nbc, SUBPAD, WIN), np.float32),
+            np.empty(0, np.intp),
+            SUBPAD,
+            1,
+        )
 
     # Depth position within each (tile, gather-window, lane) cell.  One
     # combined int64 sort key (≈2-3x faster than a 3-key lexsort at 33M
@@ -146,16 +187,6 @@ def _build_orientation(
     key = (tile * np.int64(WINS) + gwin) * np.int64(WIN) + lane
     order = np.argsort(key)
     cell = key[order]
-    t_s = cell // (WINS * WIN)
-    g_s = (cell // WIN) % WINS
-    l_s = cell % WIN
-    if len(cell) == 0:  # all-zero / empty matrix: one empty depth level
-        return (
-            np.zeros((nbr, nbc, WINS, WIN), np.int16),
-            np.zeros((nbr, nbc, WINS, WIN), np.float32),
-            np.empty(0, np.intp),
-            1,
-        )
     # run-length position within equal consecutive cells
     change = np.empty(len(cell), dtype=bool)
     change[0] = True
@@ -164,47 +195,68 @@ def _build_orientation(
     run_ids = np.cumsum(change) - 1
     depth_pos = np.arange(len(cell)) - run_starts[run_ids]
 
-    # Cost model over candidate depths d (covering depth_pos < d):
-    #   cost(d) = d · (tiles · WINS · WIN)  +  spill_cost_ratio · spilled(d)
+    # Per-(tile, window) max lane load M — the sublanes window w needs at
+    # depth cap d is min(M[t, w], d) (max of min = min of max per lane).
+    counts = np.diff(np.append(run_starts, len(cell)))
+    cell_tw = (cell[run_starts] // WIN).astype(np.int64)  # tile*WINS + gwin
+    M = np.zeros(nt * WINS, np.int64)
+    np.maximum.at(M, cell_tw, counts)
+    M = M.reshape(nt, WINS)
+
     hist = np.bincount(depth_pos)
     cum = np.cumsum(hist)
     spilled_at = len(depth_pos) - cum  # spilled(d) for d = 1..len(hist)
     if np.isinf(spill_cost_ratio):
-        needed = len(hist)
+        depth = len(hist)
     else:
-        level_cost = float(nbr * nbc * WINS * WIN)
-        # Any nonzero spill also pays a FIXED cost (the XLA scatter's
-        # latency floor, measured ~milliseconds — worth ~16 depth levels):
-        # spilling a handful of entries to shave one or two levels always
-        # loses; spilling to avoid a 100-deep pathological cell wins.
-        cost = (
-            np.arange(1, len(hist) + 1, dtype=np.float64) * level_cost
-            + spill_cost_ratio * spilled_at
-            + 16.0 * level_cost * (spilled_at > 0)
+        max_d = min(len(hist), depth_cap)
+        # cost(d) = slots(d) + ratio·spilled(d) + fixed·(spilled(d) > 0)
+        a_at = np.array(
+            [np.minimum(M, d).sum(axis=1).max() for d in range(1, max_d + 1)],
+            np.float64,
         )
-        needed = int(np.argmin(cost)) + 1
-    depth = min(max(needed, 1), depth_cap)
+        cost = (
+            a_at * float(nt * WIN)
+            + spill_cost_ratio * spilled_at[:max_d]
+            + 16.0 * float(nt * WINS * WIN) * (spilled_at[:max_d] > 0)
+        )
+        depth = int(np.argmin(cost)) + 1
+    depth = min(max(depth, 1), depth_cap)
     keep = depth_pos < depth
 
-    nt = nbr * nbc
-    a = WINS * depth
-    # Packed per-slot code: ohi*128 + lo (11 bits) -> int16 halves the DMA
-    # for index data relative to two int32 planes.
-    code = np.zeros((nt, a, WIN), np.int16)
+    # Bin-pack: window w of tile t owns sublanes [base[t,w], base[t,w]+need).
+    need = np.minimum(M, depth)             # (nt, WINS)
+    base = np.cumsum(need, axis=1) - need   # exclusive per-tile cumsum
+    a_t = need.sum(axis=1)
+    a = max(SUBPAD, int(-(-a_t.max() // SUBPAD) * SUBPAD))
+
+    # Every slot of a sublane carries the sublane's window id in its high
+    # bits (so empty slots still tell the kernel which table to build).
+    winid = np.zeros((nt, a), CODE_DTYPE)
+    total = int(a_t.sum())
+    tile_of = np.repeat(np.arange(nt), a_t)
+    pos = np.arange(total) - np.repeat(np.cumsum(a_t) - a_t, a_t)
+    winid[tile_of, pos] = np.repeat(
+        np.tile(np.arange(WINS, dtype=CODE_DTYPE), nt), need.ravel()
+    )
+    code = np.empty((nt, a, WIN), CODE_DTYPE)
+    code[:] = (winid << np.array(WIN_SHIFT, CODE_DTYPE))[:, :, None]
     val = np.zeros((nt, a, WIN), np.float32)
 
-    # sublane = depth * WINS + gwin  (tile-repeat table order: the in-kernel
-    # pltpu.repeat produces tables [w0..w15, w0..w15, ...])
-    sub = depth_pos[keep] * WINS + g_s[keep]
+    t_s = cell // (WINS * WIN)
+    g_s = (cell // WIN) % WINS
+    l_s = cell % WIN
     kt = t_s[keep]
     kl = l_s[keep]
-    code[kt, sub, kl] = (ohi[order][keep] * WIN + glo[order][keep]).astype(
-        np.int16)
+    sub = base[kt, g_s[keep]] + depth_pos[keep]
+    code[kt, sub, kl] |= (
+        (ohi[order][keep] << 7) | glo[order][keep]
+    ).astype(CODE_DTYPE)
     val[kt, sub, kl] = vals[order][keep]
 
     spill_idx = order[~keep]            # indices into original entry arrays
     return (code.reshape(nbr, nbc, a, WIN), val.reshape(nbr, nbc, a, WIN),
-            spill_idx, depth)
+            spill_idx, a, depth)
 
 
 # ---------------------------------------------------------------------------
@@ -212,7 +264,7 @@ def _build_orientation(
 # ---------------------------------------------------------------------------
 
 
-def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, depth, square,
+def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, square,
                  batch, chunk):
     """A (batch x chunk) rectangle of tiles per grid step.
 
@@ -220,13 +272,18 @@ def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, depth, square,
     so the stream stays bandwidth-bound instead of per-step-overhead-bound
     (measured: 2048 one-tile steps cost ~5 us each — more than the data).
 
-    code: (batch, chunk, A, 128) int16 packed (ohi*128 + lo)
+    code: (batch, chunk, A, 128) packed (win<<WIN_SHIFT | ohi<<7 | lo)
     val:  (batch, chunk, A, 128) f32
     tab:  (chunk, WINS, 128) gather-side vector windows for this chunk
     out:  (batch, WINS, 128), accumulated across the chunked grid dim
+
+    Gather tables are built per tile with a one-hot MXU matmul
+    (A×WINS @ WINS×128) from each sublane's packed window id — the packed
+    layout has no fixed depth→window structure for ``pltpu.repeat`` to
+    exploit, and the matmul is exact for one-hot selectors at HIGHEST
+    precision.
     """
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     @pl.when(pl.program_id(1) == 0)
     def _():
@@ -237,14 +294,35 @@ def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, depth, square,
         j = t % chunk
         code = code_ref[b, j].astype(jnp.int32)
         lo = code & (WIN - 1)
-        ohi = code >> 7
-        tables = pltpu.repeat(tab_ref[j], depth, axis=0)      # (A, 128)
+        ohi = (code >> 7) & (WINS - 1)
+        win = code[:, 0:1] >> WIN_SHIFT                       # (A, 1)
+        a = code.shape[0]
+
+        # Per-sublane tables by masked selection over the WINS windows —
+        # EXACT (pure selects, no arithmetic), and a non-finite vector
+        # entry stays localized to sublanes whose window actually holds
+        # it (a one-hot matmul would leak it everywhere via 0*inf=NaN).
+        # The selects overlap the slot-stream DMA; measured free.
+        def w_body(wi, acc):
+            row = tab_ref[j, pl.ds(wi, 1), :]                 # (1, 128)
+            return jnp.where(
+                win == wi, jnp.broadcast_to(row, (a, WIN)), acc
+            )
+
+        tables = jax.lax.fori_loop(
+            0, WINS, w_body, jnp.zeros((a, WIN), jnp.float32)
+        )                                                     # (A, 128)
         g = jnp.take_along_axis(tables, lo, axis=1)           # (A, 128)
         v = val_ref[b, j]
         if square:
             contrib = v * v * g
         else:
             contrib = v * g
+        # Empty slots (v == 0; zero-valued entries are excluded at build
+        # time) must contribute EXACT zero even when their placeholder
+        # gather (lo = 0) hits a non-finite vector entry — 0 * inf = NaN
+        # would otherwise leak into output window 0 of unrelated rows.
+        contrib = jnp.where(v != 0.0, contrib, 0.0)
 
         def h_body(h, _):
             part = jnp.sum(jnp.where(ohi == h, contrib, 0.0), axis=0)
@@ -262,7 +340,7 @@ def _pick_rect(nbo: int, nbg: int, a: int,
     """(batch, chunk) tiles per grid step fitting ~``budget`` input bytes."""
     if budget is None:
         budget = DMA_BUDGET
-    per_tile = a * WIN * 6  # int16 code + f32 val
+    per_tile = a * WIN * (CODE_BYTES + 4)  # packed code + f32 val
     cap = max(1, budget // per_tile)
 
     def largest_divisor_leq(n, m):
@@ -276,20 +354,21 @@ def _pick_rect(nbo: int, nbg: int, a: int,
     return batch, chunk
 
 
-@functools.partial(jax.jit, static_argnames=("depth", "nbo", "nbg", "square"))
-def _tiled_apply(code, val, vec_padded, *, depth, nbo, nbg, square):
+@functools.partial(jax.jit, static_argnames=("nbo", "nbg", "square"))
+def _tiled_apply(code, val, vec_padded, *, nbo, nbg, square):
     """out[i] = sum over entries (i, j, v) of v * vec[j] (+ optional v²).
 
     ``code``/``val``: (nbo, nbg, A, 128); ``vec_padded``: (nbg * TILE_C,).
-    Returns (nbo * TILE_R,) output.
+    Returns (nbo * TILE_R,) output.  The packed sublane count A comes from
+    the array shape (jit already specializes on it).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    a = WINS * depth
+    a = code.shape[2]
     batch, chunk = _pick_rect(nbo, nbg, a)
     tab = vec_padded.reshape(nbg, WINS, WIN)
-    kernel = functools.partial(_tile_kernel, depth=depth, square=square,
+    kernel = functools.partial(_tile_kernel, square=square,
                                batch=batch, chunk=chunk)
     out = pl.pallas_call(
         kernel,
@@ -417,7 +496,7 @@ class HostCoo:
     ],
     meta_fields=[
         "host_coo",
-        "n_rows", "n_cols", "nbr", "nbc", "depth_f", "depth_b",
+        "n_rows", "n_cols", "nbr", "nbc", "a_f", "a_b", "depth_f", "depth_b",
         "has_dense_cols", "has_dense_rows",
     ],
 )
@@ -463,7 +542,9 @@ class PallasSparseMatrix:
     n_cols: int
     nbr: int
     nbc: int
-    depth_f: int
+    a_f: int               # packed sublane count per tile, orientation F
+    a_b: int               # packed sublane count per tile, orientation B
+    depth_f: int           # per-cell collision cap chosen by the cost model
     depth_b: int
     has_dense_cols: bool
     has_dense_rows: bool
@@ -489,7 +570,7 @@ class PallasSparseMatrix:
     def matvec(self, w: Array) -> Array:
         out = _tiled_apply(
             self.f_code, self.f_val, self._pad_cols(w),
-            depth=self.depth_f, nbo=self.nbr, nbg=self.nbc, square=False,
+            nbo=self.nbr, nbg=self.nbc, square=False,
         )[: self.n_rows]
         out = out + self.spill.matvec(w)
         if self.has_dense_cols:
@@ -502,7 +583,7 @@ class PallasSparseMatrix:
     def rmatvec(self, u: Array) -> Array:
         out = _tiled_apply(
             self.b_code, self.b_val, self._pad_rows(u),
-            depth=self.depth_b, nbo=self.nbc, nbg=self.nbr, square=False,
+            nbo=self.nbc, nbg=self.nbr, square=False,
         )[: self.n_cols]
         out = out + self.spill.rmatvec(u)
         if self.has_dense_cols:
@@ -515,7 +596,7 @@ class PallasSparseMatrix:
     def row_sq_matvec(self, v: Array) -> Array:
         out = _tiled_apply(
             self.f_code, self.f_val, self._pad_cols(v),
-            depth=self.depth_f, nbo=self.nbr, nbg=self.nbc, square=True,
+            nbo=self.nbr, nbg=self.nbc, square=True,
         )[: self.n_rows]
         out = out + self.spill.row_sq_matvec(v)
         if self.has_dense_cols:
@@ -530,7 +611,7 @@ class PallasSparseMatrix:
     def sq_rmatvec(self, u: Array) -> Array:
         out = _tiled_apply(
             self.b_code, self.b_val, self._pad_rows(u),
-            depth=self.depth_b, nbo=self.nbc, nbg=self.nbr, square=True,
+            nbo=self.nbc, nbg=self.nbr, square=True,
         )[: self.n_cols]
         out = out + self.spill.sq_rmatvec(u)
         if self.has_dense_cols:
@@ -674,9 +755,9 @@ def build_pallas_matrix(
     nbr = max(1, -(-n_rows // TILE_R))
     nbc = max(1, -(-n_cols // TILE_C))
 
-    f_code, f_val, f_spill, depth_f = _build_orientation(
+    f_code, f_val, f_spill, a_f, depth_f = _build_orientation(
         r, c, v, nbr, nbc, depth_cap)
-    b_code, b_val, b_spill, depth_b = _build_orientation(
+    b_code, b_val, b_spill, a_b, depth_b = _build_orientation(
         c, r, v, nbc, nbr, depth_cap)
 
     # Entries spilled from EITHER orientation go through the COO path for
@@ -690,10 +771,10 @@ def build_pallas_matrix(
         # tiled layout double-counts them (host-side, one extra pass).
         keep = np.ones(r.shape[0], bool)
         keep[spilled] = False
-        f_code, f_val, fs2, depth_f = _build_orientation(
+        f_code, f_val, fs2, a_f, depth_f = _build_orientation(
             r[keep], c[keep], v[keep], nbr, nbc, depth_cap,
             spill_cost_ratio=np.inf)
-        b_code, b_val, bs2, depth_b = _build_orientation(
+        b_code, b_val, bs2, a_b, depth_b = _build_orientation(
             c[keep], r[keep], v[keep], nbc, nbr, depth_cap,
             spill_cost_ratio=np.inf)
         assert fs2.size == 0 and bs2.size == 0, "re-spill after rebuild"
@@ -715,7 +796,8 @@ def build_pallas_matrix(
         dense_row_ids=jnp.asarray(dense_row_ids, jnp.int32),
         host_coo=host_coo,
         n_rows=int(n_rows), n_cols=int(n_cols),
-        nbr=nbr, nbc=nbc, depth_f=depth_f, depth_b=depth_b,
+        nbr=nbr, nbc=nbc, a_f=a_f, a_b=a_b,
+        depth_f=depth_f, depth_b=depth_b,
         has_dense_cols=bool(dense_col_ids.size),
         has_dense_rows=bool(dense_row_ids.size),
     )

@@ -116,6 +116,41 @@ class TestPallasKernels:
         w = jnp.asarray(rng.normal(size=200).astype(np.float32))
         assert _rel(data.features.matvec(w), dense.features.matvec(w)) < 1e-5
 
+    def test_nonfinite_vector_entries_stay_localized(self, rng):
+        """A non-finite w entry must affect ONLY rows whose stored entries
+        touch that column — matching COO/dense semantics.  (A one-hot
+        matmul table build would leak it tile-wide via 0*inf = NaN.)"""
+        n, d = 300, 2048
+        rows = np.array([0, 1, 2], np.int64)
+        # col 128 sits at OFFSET 0 of its window: empty slots' placeholder
+        # lo=0 gathers exactly w[128], the hardest leak case (0*inf=NaN
+        # would hit every lane of the window's sublanes).
+        cols = np.array([0, 128, 72], np.int64)
+        vals = np.ones(3, np.float32)
+        P = build_pallas_matrix(rows, cols, vals, n, d)
+        w = np.zeros(d, np.float32)
+        w[128] = np.inf
+        w[72] = 5.0
+        w[0] = 1.0
+        out = np.asarray(P.matvec(jnp.asarray(w)))
+        assert out[0] == 1.0
+        assert np.isinf(out[1])
+        assert out[2] == 5.0, f"row 2 contaminated: {out[2]}"
+        assert np.all(out[3:] == 0.0)
+        # also an inf at a window-interior offset
+        w2 = np.zeros(d, np.float32)
+        w2[72] = np.inf
+        out2 = np.asarray(P.matvec(jnp.asarray(w2)))
+        assert np.isinf(out2[2]) and out2[0] == 0.0 and np.all(out2[3:] == 0)
+        # rmatvec side: a non-finite residual in one row
+        u = np.zeros(n, np.float32)
+        u[1] = np.nan
+        u[2] = 2.0
+        ru = np.asarray(P.rmatvec(jnp.asarray(u)))
+        assert np.isnan(ru[128])
+        assert ru[72] == 2.0
+        assert ru[0] == 0.0
+
     def test_objective_parity(self, rng):
         """Full fused value+grad through GlmObjective matches the COO path."""
         import scipy.sparse as sp
