@@ -290,6 +290,11 @@ def bench_glm_driver() -> float:
             "--reg-type", "l2",
             "--reg-weights", "0.1,1.0,10.0",
             "--n-features", str(d),
+            # Measure a COLD run: the persistent compilation cache (driver
+            # default 'auto') would make repeat bench runs on one machine
+            # incomparable with earlier rounds' cold numbers.  (Cache
+            # impact, measured on v5e: 149 s cold -> 9.1 s warm.)
+            "--compile-cache", "off",
         ])
         return time.perf_counter() - t0
 

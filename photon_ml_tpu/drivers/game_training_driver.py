@@ -50,6 +50,7 @@ from photon_ml_tpu.optim.problem import (
 )
 from photon_ml_tpu.optim.regularization import RegularizationContext, RegularizationType
 from photon_ml_tpu.ops import losses as losses_lib
+from photon_ml_tpu.utils.compile_cache import enable_compile_cache
 from photon_ml_tpu.utils.logging import PhotonLogger
 from photon_ml_tpu.utils.timer import Timer
 
@@ -140,6 +141,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "entity axis (random effects) over a mesh of all devices — the "
         "reference's Spark-cluster layout on ICI",
     )
+    p.add_argument(
+        "--compile-cache",
+        default="auto",
+        help="persistent XLA compilation-cache dir; 'auto' = "
+        "$PHOTON_COMPILE_CACHE or ~/.cache/photon_ml_tpu/jax_cache, "
+        "'off' disables",
+    )
     return p
 
 
@@ -148,6 +156,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     os.makedirs(args.output_dir, exist_ok=True)
     logger = PhotonLogger(args.output_dir)
     timer = Timer().start()
+    cache_dir = enable_compile_cache(args.compile_cache)
+    if cache_dir:
+        logger.info(f"compilation cache: {cache_dir}")
 
     with open(args.config) as f:
         config = json.load(f)

@@ -197,10 +197,16 @@ def _build_orientation(
 
     # Per-(tile, window) max lane load M — the sublanes window w needs at
     # depth cap d is min(M[t, w], d) (max of min = min of max per lane).
+    # cell ids are sorted, so grouped reduceat beats the ufunc.at path
+    # (~10x at 33M entries).
     counts = np.diff(np.append(run_starts, len(cell)))
     cell_tw = (cell[run_starts] // WIN).astype(np.int64)  # tile*WINS + gwin
+    tw_change = np.empty(len(cell_tw), dtype=bool)
+    tw_change[0] = True
+    np.not_equal(cell_tw[1:], cell_tw[:-1], out=tw_change[1:])
+    tw_starts = np.flatnonzero(tw_change)
     M = np.zeros(nt * WINS, np.int64)
-    np.maximum.at(M, cell_tw, counts)
+    M[cell_tw[tw_starts]] = np.maximum.reduceat(counts, tw_starts)
     M = M.reshape(nt, WINS)
 
     hist = np.bincount(depth_pos)

@@ -1,0 +1,85 @@
+"""Persistent XLA compilation cache for driver runs.
+
+The reference pays JVM+Spark startup per job but compiles nothing; this
+framework's cost shape is inverted — jit compilation dominates short driver
+runs (~30 s of a 38 s a1a-grid job on one v5e).  JAX's persistent
+compilation cache removes that cost for every repeat invocation with the
+same program shapes (λ re-grids, scoring reruns, resumed jobs), including
+across processes.
+
+Verified to work through the axon remote-compile transport: a cached
+single-op program loads in ~0.2 s vs a ~2.5 s cold compile.
+
+Opt-out rather than opt-in at the DRIVER layer (``--compile-cache off``);
+library users call :func:`enable_compile_cache` themselves.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_DEFAULT_ENV = "PHOTON_COMPILE_CACHE"
+
+
+def default_cache_dir() -> str:
+    """``$PHOTON_COMPILE_CACHE``, else ``~/.cache/photon_ml_tpu/jax_cache``."""
+    env = os.environ.get(_DEFAULT_ENV)
+    if env:
+        return env
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "photon_ml_tpu", "jax_cache"
+    )
+
+
+def enable_compile_cache(
+    path: Optional[str] = None, min_compile_secs: float = 0.5
+) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``path`` and return it.
+
+    ``path`` may be ``"off"`` (returns None, cache untouched) or ``"auto"``/
+    None (use :func:`default_cache_dir`).  Compilations faster than
+    ``min_compile_secs`` are not persisted (they'd bloat the cache for no
+    win).  Failures are non-fatal: a read-only home dir degrades to an
+    uncached run, never a crashed job.
+    """
+    if path == "off":
+        # Actively disable: a previously enabled cache in this process must
+        # not keep serving/persisting (bench cold-run measurement relies on
+        # this when a prior in-process run enabled it).
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", None)
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:
+                pass
+        except Exception:
+            pass
+        return None
+    if path in (None, "auto"):
+        path = default_cache_dir()
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_secs
+        )
+        # JAX latches the file-cache handle on first use; without a reset a
+        # later redirect (tests, multi-job processes) keeps writing to the
+        # OLD dir.  Best-effort — the API is private and absent versions
+        # just keep the latch semantics.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+    except Exception:
+        return None
+    return path

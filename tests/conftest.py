@@ -8,9 +8,16 @@ sharding semantics without TPU hardware.
 """
 
 import os
+import tempfile
 
 # Must be set before jax initializes any backend.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Drivers enable the persistent compilation cache by default ('auto');
+# keep test-shaped executables out of the real ~/.cache.
+os.environ.setdefault(
+    "PHOTON_COMPILE_CACHE", tempfile.mkdtemp(prefix="photon_test_jax_cache_")
+)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,5 +1,7 @@
 """Auxiliary subsystem tests: down-sampling, hyperparameter search, tracker."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -106,3 +108,73 @@ class TestHyperparameterSearch:
             f, 15, maximize=True
         )
         assert abs(res.best_params[0] - 2.0) < 0.3
+
+
+class TestCompileCache:
+    """Persistent XLA compilation cache plumbing (utils/compile_cache.py)."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_jax_cache_config(self):
+        """These tests mutate process-global JAX config; restore it so
+        later tests don't persist every trivial compile (min secs 0.0) or
+        write into this class's tmp dirs."""
+        import jax
+
+        prev_dir = jax.config.jax_compilation_cache_dir
+        prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        yield
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_min
+        )
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+
+    def test_enable_returns_and_creates_dir(self, tmp_path):
+        import jax
+
+        from photon_ml_tpu.utils.compile_cache import enable_compile_cache
+
+        target = str(tmp_path / "cache")
+        got = enable_compile_cache(target, min_compile_secs=0.0)
+        assert got == target
+        assert os.path.isdir(target)
+        assert jax.config.jax_compilation_cache_dir == target
+        # A jitted computation should land an entry in the cache dir.  The
+        # baked-in constant makes the HLO unique so an in-memory executable
+        # from an earlier test can't satisfy it without a fresh compile.
+        const = float(np.random.default_rng().uniform(1.0, 2.0))
+        jax.jit(lambda x: x * 2.0 + const)(
+            jax.numpy.ones((8, 8))
+        ).block_until_ready()
+        assert len(os.listdir(target)) >= 1
+
+    def test_off_and_failure_are_non_fatal(self, tmp_path):
+        import jax
+
+        from photon_ml_tpu.utils import compile_cache
+
+        # 'off' must actively disable a previously enabled cache (bench
+        # relies on this for honest cold-run driver timing).
+        compile_cache.enable_compile_cache(str(tmp_path / "on"))
+        assert compile_cache.enable_compile_cache("off") is None
+        assert jax.config.jax_compilation_cache_dir is None
+        # unwritable parent: degrade to None, never raise
+        blocked = tmp_path / "ro"
+        blocked.mkdir()
+        blocked.chmod(0o500)
+        try:
+            got = compile_cache.enable_compile_cache(str(blocked / "sub"))
+            assert got is None or os.path.isdir(got)  # root can still write
+        finally:
+            blocked.chmod(0o700)
+
+    def test_default_dir_env_override(self, monkeypatch, tmp_path):
+        from photon_ml_tpu.utils import compile_cache
+
+        monkeypatch.setenv("PHOTON_COMPILE_CACHE", str(tmp_path / "envcache"))
+        assert compile_cache.default_cache_dir() == str(tmp_path / "envcache")
