@@ -23,7 +23,10 @@ from photon_ml_tpu.game.estimator import GameTransformer
 from photon_ml_tpu.io import avro
 from photon_ml_tpu.io.game_store import load_game_model
 from photon_ml_tpu.io.schemas import SCORING_RESULT
-from photon_ml_tpu.utils.compile_cache import enable_compile_cache
+from photon_ml_tpu.utils.compile_cache import (
+    add_compile_cache_arg,
+    enable_from_args,
+)
 from photon_ml_tpu.utils.logging import PhotonLogger
 from photon_ml_tpu.utils.timer import Timer
 
@@ -40,13 +43,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="emit mean responses (inverse link) instead of raw margins",
     )
     p.add_argument("--evaluator", help="also compute a metric if labels present")
-    p.add_argument(
-        "--compile-cache",
-        default="auto",
-        help="persistent XLA compilation-cache dir; 'auto' = "
-        "$PHOTON_COMPILE_CACHE or ~/.cache/photon_ml_tpu/jax_cache, "
-        "'off' disables",
-    )
+    add_compile_cache_arg(p)
     return p
 
 
@@ -55,9 +52,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     os.makedirs(args.output_dir, exist_ok=True)
     logger = PhotonLogger(args.output_dir)
     timer = Timer().start()
-    cache_dir = enable_compile_cache(args.compile_cache)
-    if cache_dir:
-        logger.info(f"compilation cache: {cache_dir}")
+    enable_from_args(args, logger)
 
     model, index_maps = load_game_model(os.path.join(args.model_dir, "models"))
     shards, ids, response, weight, offset, uids, _ = read_game_avro(

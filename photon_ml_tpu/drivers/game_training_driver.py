@@ -37,6 +37,7 @@ import numpy as np
 from photon_ml_tpu.data.game_reader import read_game_avro
 from photon_ml_tpu.evaluation.suite import EvaluationSuite
 from photon_ml_tpu.game.estimator import (
+    FactoredRandomEffectCoordinateConfig,
     FixedEffectCoordinateConfig,
     GameEstimator,
     GameTransformer,
@@ -50,7 +51,10 @@ from photon_ml_tpu.optim.problem import (
 )
 from photon_ml_tpu.optim.regularization import RegularizationContext, RegularizationType
 from photon_ml_tpu.ops import losses as losses_lib
-from photon_ml_tpu.utils.compile_cache import enable_compile_cache
+from photon_ml_tpu.utils.compile_cache import (
+    add_compile_cache_arg,
+    enable_from_args,
+)
 from photon_ml_tpu.utils.logging import PhotonLogger
 from photon_ml_tpu.utils.timer import Timer
 
@@ -111,6 +115,21 @@ def parse_coordinate_config(spec: dict):
             max_rows_per_entity=spec.get("max_rows_per_entity"),
             bucket_growth=float(spec.get("bucket_growth", 2.0)),
         )
+    if spec["type"] in ("factored_random", "factored"):
+        proj_rw = spec.get("projection_reg_weight")
+        return name, FactoredRandomEffectCoordinateConfig(
+            feature_shard=spec["feature_shard"],
+            entity_key=spec["entity_key"],
+            rank=int(spec["rank"]),
+            optimization=opt,
+            reg_weight=float(spec.get("reg_weight", 0.0)),
+            projection_reg_weight=(
+                None if proj_rw is None else float(proj_rw)
+            ),
+            alternations=int(spec.get("alternations", 2)),
+            max_rows_per_entity=spec.get("max_rows_per_entity"),
+            bucket_growth=float(spec.get("bucket_growth", 2.0)),
+        )
     raise ValueError(f"unknown coordinate type {spec['type']!r}")
 
 
@@ -141,13 +160,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "entity axis (random effects) over a mesh of all devices — the "
         "reference's Spark-cluster layout on ICI",
     )
-    p.add_argument(
-        "--compile-cache",
-        default="auto",
-        help="persistent XLA compilation-cache dir; 'auto' = "
-        "$PHOTON_COMPILE_CACHE or ~/.cache/photon_ml_tpu/jax_cache, "
-        "'off' disables",
-    )
+    add_compile_cache_arg(p)
     return p
 
 
@@ -156,9 +169,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     os.makedirs(args.output_dir, exist_ok=True)
     logger = PhotonLogger(args.output_dir)
     timer = Timer().start()
-    cache_dir = enable_compile_cache(args.compile_cache)
-    if cache_dir:
-        logger.info(f"compilation cache: {cache_dir}")
+    enable_from_args(args, logger)
 
     with open(args.config) as f:
         config = json.load(f)

@@ -52,7 +52,10 @@ from photon_ml_tpu.optim.problem import (
     OptimizerType,
 )
 from photon_ml_tpu.optim.regularization import RegularizationContext, RegularizationType
-from photon_ml_tpu.utils.compile_cache import enable_compile_cache
+from photon_ml_tpu.utils.compile_cache import (
+    add_compile_cache_arg,
+    enable_from_args,
+)
 from photon_ml_tpu.utils.logging import PhotonLogger
 from photon_ml_tpu.utils.timer import Timer
 from photon_ml_tpu.utils.tracker import OptimizationStatesTracker
@@ -120,13 +123,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "whole λ grid with one fused psum per objective evaluation (the "
         "reference's treeAggregate loop on ICI)",
     )
-    p.add_argument(
-        "--compile-cache",
-        default="auto",
-        help="persistent XLA compilation-cache dir; 'auto' = "
-        "$PHOTON_COMPILE_CACHE or ~/.cache/photon_ml_tpu/jax_cache, "
-        "'off' disables (repeat runs recompile from scratch)",
-    )
+    add_compile_cache_arg(p)
     return p
 
 
@@ -135,9 +132,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     os.makedirs(args.output_dir, exist_ok=True)
     logger = PhotonLogger(args.output_dir)
     timer = Timer().start()
-    cache_dir = enable_compile_cache(args.compile_cache)
-    if cache_dir:
-        logger.info(f"compilation cache: {cache_dir}")
+    enable_from_args(args, logger)
 
     # Stage 1: read ---------------------------------------------------------
     X_train, y_train = libsvm.read_libsvm(

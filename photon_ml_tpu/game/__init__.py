@@ -15,5 +15,8 @@ from photon_ml_tpu.game.coordinates import (  # noqa: F401
     FixedEffectCoordinate,
     RandomEffectCoordinate,
 )
+from photon_ml_tpu.game.factored import (  # noqa: F401
+    FactoredRandomEffectCoordinate,
+)
 from photon_ml_tpu.game.descent import CoordinateDescent  # noqa: F401
 from photon_ml_tpu.game.estimator import GameEstimator, GameTransformer  # noqa: F401

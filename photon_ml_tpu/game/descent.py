@@ -23,6 +23,16 @@ import numpy as np
 
 from photon_ml_tpu.game.coordinates import Coordinate
 
+
+def _state_to_device(st):
+    """Recursively move a coordinate state (array, list of arrays, or
+    nested — e.g. the factored (u_list, V)) onto the device."""
+    if st is None:
+        return None
+    if isinstance(st, (list, tuple)):
+        return [_state_to_device(s) for s in st]
+    return jnp.asarray(st)
+
 Array = jax.Array
 
 
@@ -84,11 +94,8 @@ class CoordinateDescent:
             total = jnp.asarray(saved["total"])
             for coord in self.coordinates:
                 scores[coord.name] = jnp.asarray(saved["scores"][coord.name])
-                st = saved["states"][coord.name]
-                states[coord.name] = (
-                    [jnp.asarray(a) for a in st]
-                    if isinstance(st, list)
-                    else (jnp.asarray(st) if st is not None else None)
+                states[coord.name] = _state_to_device(
+                    saved["states"][coord.name]
                 )
             history = list(saved["history"])
             if logger is not None:
@@ -100,11 +107,7 @@ class CoordinateDescent:
                 st = initial_states.get(coord.name)
                 if st is None:
                     continue
-                st = (
-                    [jnp.asarray(a) for a in st]
-                    if isinstance(st, (list, tuple))
-                    else jnp.asarray(st)
-                )
+                st = _state_to_device(st)
                 states[coord.name] = st
                 s = coord.score(st)
                 scores[coord.name] = s

@@ -68,7 +68,29 @@ class RandomEffectCoordinateConfig:
     bucket_growth: float = 2.0
 
 
-CoordinateConfig = FixedEffectCoordinateConfig | RandomEffectCoordinateConfig
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectCoordinateConfig:
+    """Reference: ``FactoredRandomEffectCoordinateConfiguration`` — random
+    effects constrained to a shared rank-``rank`` projection (w_e = V u_e,
+    see game/factored.py).  Dataset shape is identical to a plain random
+    effect, so grid points share built datasets with it."""
+
+    feature_shard: str
+    entity_key: str
+    rank: int
+    optimization: GlmOptimizationConfig = GlmOptimizationConfig()
+    reg_weight: float = 0.0
+    projection_reg_weight: Optional[float] = None
+    alternations: int = 2
+    max_rows_per_entity: Optional[int] = None
+    bucket_growth: float = 2.0
+
+
+CoordinateConfig = (
+    FixedEffectCoordinateConfig
+    | RandomEffectCoordinateConfig
+    | FactoredRandomEffectCoordinateConfig
+)
 
 
 class GameEstimator:
@@ -113,6 +135,8 @@ class GameEstimator:
         grid — SURVEY.md §3.2)."""
         if isinstance(cfg, FixedEffectCoordinateConfig):
             return ("fixed", cfg.feature_shard, cfg.down_sampling_rate)
+        # Plain and factored random effects need the SAME dataset shape,
+        # so they share cache entries deliberately.
         return (
             "random",
             cfg.feature_shard,
@@ -186,7 +210,8 @@ class GameEstimator:
                     )
                 )
             else:
-                if self.mesh is not None:
+                factored = isinstance(cfg, FactoredRandomEffectCoordinateConfig)
+                if self.mesh is not None and not factored:
                     coordinates.append(
                         self._distributed_random(
                             name, cfg, shard, ids, response, weight,
@@ -205,6 +230,30 @@ class GameEstimator:
                         bucket_growth=cfg.bucket_growth,
                     )
                     cache[key] = dataset
+                if factored:
+                    # No entity-sharded variant yet: the shared projection V
+                    # would need a psum'd fit across shards.  The single-
+                    # device coordinate composes fine with distributed
+                    # coordinates in one descent (scores are global arrays).
+                    from photon_ml_tpu.game.factored import (
+                        FactoredRandomEffectCoordinate,
+                    )
+
+                    coordinates.append(
+                        FactoredRandomEffectCoordinate(
+                            name,
+                            dataset,
+                            self.task,
+                            cfg.optimization,
+                            rank=cfg.rank,
+                            reg_weight=cfg.reg_weight,
+                            projection_reg_weight=cfg.projection_reg_weight,
+                            alternations=cfg.alternations,
+                            feature_shard=cfg.feature_shard,
+                            entity_key=cfg.entity_key,
+                        )
+                    )
+                    continue
                 coordinates.append(
                     RandomEffectCoordinate(
                         name,
@@ -373,6 +422,18 @@ class GameEstimator:
                     )
                 states[c.name] = jnp.asarray(w)
             elif isinstance(sub, RandomEffectModel):
+                from photon_ml_tpu.game.factored import (
+                    FactoredRandomEffectCoordinate,
+                )
+
+                if isinstance(c, FactoredRandomEffectCoordinate):
+                    # A factored coordinate's state is (u_list, V); the
+                    # saved model stores only the materialized w_e = V u_e,
+                    # and the factorization is not recoverable from it.
+                    # Start this coordinate cold (the reference's factored
+                    # coordinates likewise don't warm-start from plain
+                    # random-effect models).
+                    continue
                 if sub.n_features != c.dataset.n_features:
                     raise ValueError(
                         f"initial model coordinate {c.name!r} has "
@@ -593,10 +654,13 @@ class GameEstimator:
                 for name, cfg in configs.items():
                     # Fixed-effect scorers depend only on the feature shard
                     # (not on down-sampling, which is train-side only).
+                    # Random-effect scorer keys carry the config TYPE:
+                    # factored and plain share dataset_key (same dataset)
+                    # but their scorers consume different state shapes.
                     key = (
                         ("fixed_scorer", cfg.feature_shard)
                         if isinstance(cfg, FixedEffectCoordinateConfig)
-                        else self.dataset_key(cfg)
+                        else (type(cfg).__name__,) + self.dataset_key(cfg)
                     )
                     if key not in scorer_cache:
                         coord = next(c for c in coordinates if c.name == name)

@@ -39,6 +39,33 @@ def _atomic_savez(path: str, arrays: dict) -> None:
     os.replace(tmp, path)
 
 
+def _flatten_state(prefix: str, st, arrays: dict):
+    """Flatten a (possibly nested) coordinate state into ``arrays``.
+    Returns a JSON-able structure spec: "array", a list of child specs
+    (lists AND tuples both load back as lists — coordinates accept either),
+    or None."""
+    if st is None:
+        return None
+    if isinstance(st, (list, tuple)):
+        return [
+            _flatten_state(f"{prefix}__{i}", child, arrays)
+            for i, child in enumerate(st)
+        ]
+    arrays[prefix] = np.asarray(st)
+    return "array"
+
+
+def _unflatten_state(prefix: str, spec, arrays: dict):
+    if spec is None:
+        return None
+    if spec == "array":
+        return arrays[prefix]
+    return [
+        _unflatten_state(f"{prefix}__{i}", child, arrays)
+        for i, child in enumerate(spec)
+    ]
+
+
 def _load_npz_with_meta(path: str) -> Optional[tuple[dict, dict]]:
     """Returns (meta, arrays) or None if the file doesn't exist."""
     if not os.path.exists(path):
@@ -55,10 +82,15 @@ class CoordinateDescentCheckpointer:
     Array layout inside ``cd_checkpoint.npz``:
       ``total``                  — (N,) accumulated offsets
       ``score__<coord>``        — (N,) that coordinate's scores
-      ``state__<coord>``        — fixed-effect coefficient vector, or
-      ``state__<coord>__<i>``   — random-effect per-bucket (E, D) arrays
+      ``state__<coord>...``     — that coordinate's state arrays: a bare
+                                  vector (fixed effects), per-bucket
+                                  ``__<i>`` arrays (random effects), or
+                                  arbitrarily nested ``__<i>__<j>...``
+                                  (factored random effects: (u_list, V))
       ``__meta__``              — JSON: iteration counter, coordinate
-                                  names, list-state lengths, history
+                                  names, per-coordinate state STRUCTURE
+                                  specs ("array" | [specs...] | null),
+                                  history
     """
 
     FILENAME = "cd_checkpoint.npz"
@@ -84,24 +116,17 @@ class CoordinateDescentCheckpointer:
     ) -> None:
         os.makedirs(self.directory, exist_ok=True)
         arrays = {"total": np.asarray(total)}
-        list_lens: dict[str, int] = {}
         for name, s in scores.items():
             arrays[f"score__{name}"] = np.asarray(s)
+        specs: dict = {}
         for name, st in states.items():
-            if st is None:
-                continue
-            if isinstance(st, (list, tuple)):
-                list_lens[name] = len(st)
-                for i, a in enumerate(st):
-                    arrays[f"state__{name}__{i}"] = np.asarray(a)
-            else:
-                arrays[f"state__{name}"] = np.asarray(st)
+            specs[name] = _flatten_state(f"state__{name}", st, arrays)
         arrays["__meta__"] = np.asarray(
             json.dumps(
                 {
                     "iteration": iteration,
                     "coordinates": list(scores),
-                    "list_states": list_lens,
+                    "state_specs": specs,
                     "history": history,
                 }
             )
@@ -117,17 +142,22 @@ class CoordinateDescentCheckpointer:
         scores = {
             name: arrays[f"score__{name}"] for name in meta["coordinates"]
         }
-        states: dict = {}
-        for name in meta["coordinates"]:
-            if name in meta["list_states"]:
-                states[name] = [
-                    arrays[f"state__{name}__{i}"]
-                    for i in range(meta["list_states"][name])
-                ]
-            elif f"state__{name}" in arrays:
-                states[name] = arrays[f"state__{name}"]
-            else:
-                states[name] = None
+        specs = meta.get("state_specs")
+        if specs is None:
+            # Pre-nesting checkpoint format: "list_states" held only the
+            # per-coordinate list lengths (flat lists or bare arrays).
+            specs = {}
+            for name in meta["coordinates"]:
+                if name in meta.get("list_states", {}):
+                    specs[name] = ["array"] * meta["list_states"][name]
+                elif f"state__{name}" in arrays:
+                    specs[name] = "array"
+                else:
+                    specs[name] = None
+        states = {
+            name: _unflatten_state(f"state__{name}", specs.get(name), arrays)
+            for name in meta["coordinates"]
+        }
         return {
             "iteration": int(meta["iteration"]),
             "total": arrays["total"],

@@ -22,6 +22,25 @@ from typing import Optional
 _DEFAULT_ENV = "PHOTON_COMPILE_CACHE"
 
 
+def add_compile_cache_arg(parser) -> None:
+    """The shared ``--compile-cache`` driver flag (one help text for all)."""
+    parser.add_argument(
+        "--compile-cache",
+        default="auto",
+        help="persistent XLA compilation-cache dir; 'auto' = "
+        "$PHOTON_COMPILE_CACHE or ~/.cache/photon_ml_tpu/jax_cache, "
+        "'off' disables (repeat runs recompile from scratch)",
+    )
+
+
+def enable_from_args(args, logger=None) -> Optional[str]:
+    """Driver preamble: enable per ``args.compile_cache`` and log the dir."""
+    cache_dir = enable_compile_cache(args.compile_cache)
+    if cache_dir and logger is not None:
+        logger.info(f"compilation cache: {cache_dir}")
+    return cache_dir
+
+
 def default_cache_dir() -> str:
     """``$PHOTON_COMPILE_CACHE``, else ``~/.cache/photon_ml_tpu/jax_cache``."""
     env = os.environ.get(_DEFAULT_ENV)

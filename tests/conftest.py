@@ -14,10 +14,16 @@ import tempfile
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 # Drivers enable the persistent compilation cache by default ('auto');
-# keep test-shaped executables out of the real ~/.cache.
-os.environ.setdefault(
-    "PHOTON_COMPILE_CACHE", tempfile.mkdtemp(prefix="photon_test_jax_cache_")
-)
+# keep test-shaped executables out of the real ~/.cache.  The dir must be
+# chosen before jax initializes (so no tmp_path fixture), but it can still
+# be cleaned up at interpreter exit.
+if "PHOTON_COMPILE_CACHE" not in os.environ:
+    import atexit
+    import shutil
+
+    _cache_tmp = tempfile.mkdtemp(prefix="photon_test_jax_cache_")
+    os.environ["PHOTON_COMPILE_CACHE"] = _cache_tmp
+    atexit.register(shutil.rmtree, _cache_tmp, ignore_errors=True)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

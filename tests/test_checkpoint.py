@@ -364,3 +364,44 @@ class TestGlmDriverResume:
         )
         best = lambda r: r["metrics"][str(r["best_lambda"])]
         assert best(r2) >= best(r1) - 1e-6
+
+
+class TestCheckpointFormatCompat:
+    def test_loads_pre_nesting_list_states_format(self, tmp_path):
+        """Checkpoints written before nested-state support (meta carried
+        'list_states' lengths instead of 'state_specs') must still load."""
+        import json
+
+        import numpy as np
+
+        from photon_ml_tpu.io.checkpoint import (
+            CoordinateDescentCheckpointer,
+            _atomic_savez,
+        )
+
+        ck = CoordinateDescentCheckpointer(str(tmp_path))
+        arrays = {
+            "total": np.arange(4, dtype=np.float32),
+            "score__fixed": np.ones(4, np.float32),
+            "score__re": np.zeros(4, np.float32),
+            "state__fixed": np.arange(3, dtype=np.float32),
+            "state__re__0": np.ones((2, 2), np.float32),
+            "state__re__1": np.ones((1, 2), np.float32),
+            "__meta__": np.asarray(json.dumps({
+                "iteration": 1,
+                "coordinates": ["fixed", "re"],
+                "list_states": {"re": 2},
+                "history": [],
+            })),
+        }
+        import os
+
+        os.makedirs(str(tmp_path), exist_ok=True)
+        _atomic_savez(ck.path, arrays)
+        loaded = ck.load()
+        assert loaded["iteration"] == 1
+        np.testing.assert_array_equal(
+            loaded["states"]["fixed"], np.arange(3, dtype=np.float32)
+        )
+        assert len(loaded["states"]["re"]) == 2
+        assert loaded["states"]["re"][1].shape == (1, 2)
