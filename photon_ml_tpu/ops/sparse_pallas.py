@@ -283,11 +283,12 @@ def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, square,
     tab:  (chunk, WINS, 128) gather-side vector windows for this chunk
     out:  (batch, WINS, 128), accumulated across the chunked grid dim
 
-    Gather tables are built per tile with a one-hot MXU matmul
-    (A×WINS @ WINS×128) from each sublane's packed window id — the packed
-    layout has no fixed depth→window structure for ``pltpu.repeat`` to
-    exploit, and the matmul is exact for one-hot selectors at HIGHEST
-    precision.
+    Gather tables are built per tile by a masked SELECT over the WINS
+    windows from each sublane's packed window id — the packed layout has
+    no fixed depth→window structure for ``pltpu.repeat`` to exploit, and
+    a one-hot matmul is deliberately NOT used: 0·inf = NaN would leak a
+    non-finite vector entry into every sublane's table (see the in-body
+    comment and test_nonfinite_vector_entries_stay_localized).
     """
     from jax.experimental import pallas as pl
 
