@@ -500,11 +500,12 @@ class HostCoo:
         "spill",
         "dense_cols", "dense_col_ids",
         "dense_rows", "dense_row_ids",
+        "col_perm_fwd", "col_perm_inv",
     ],
     meta_fields=[
         "host_coo",
         "n_rows", "n_cols", "nbr", "nbc", "a_f", "a_b", "depth_f", "depth_b",
-        "has_dense_cols", "has_dense_rows",
+        "has_dense_cols", "has_dense_rows", "has_col_perm",
     ],
 )
 @dataclasses.dataclass
@@ -544,6 +545,11 @@ class PallasSparseMatrix:
     dense_col_ids: Array   # (kc,) int32 — global column of each stripe
     dense_rows: Array      # (kr, n_cols) f32
     dense_row_ids: Array   # (kr,) int32 — global row of each stripe
+    # Column permutation (clustered-data balance; identity when absent —
+    # placeholders gated by has_col_perm):
+    col_perm_fwd: Array    # (n_cols,) int32 — old col → tiled position
+    col_perm_inv: Array    # (nbc*TILE_C,) int32 — tiled position → old col
+    #                        (n_cols = "reads the appended zero slot")
     host_coo: HostCoo      # META: host triples for cold paths (never traced)
     n_rows: int
     n_cols: int
@@ -555,6 +561,7 @@ class PallasSparseMatrix:
     depth_b: int
     has_dense_cols: bool
     has_dense_rows: bool
+    has_col_perm: bool
 
     # -- shape protocol ----------------------------------------------------
     @property
@@ -566,8 +573,19 @@ class PallasSparseMatrix:
         return self.host_coo.nnz
 
     def _pad_cols(self, w: Array) -> Array:
+        """Column-side vector in TILED position space: zero-pad, or (with
+        a column permutation) a d-sized gather through the inverse map."""
         target = self.nbc * TILE_C
-        return jnp.pad(w, (0, target - self.n_cols))
+        if not self.has_col_perm:
+            return jnp.pad(w, (0, target - self.n_cols))
+        wp = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])
+        return jnp.take(wp, self.col_perm_inv, axis=0)
+
+    def _uncols(self, out_full: Array) -> Array:
+        """Column-space tiled output back to original column order."""
+        if not self.has_col_perm:
+            return out_full[: self.n_cols]
+        return jnp.take(out_full, self.col_perm_fwd, axis=0)
 
     def _pad_rows(self, u: Array) -> Array:
         target = self.nbr * TILE_R
@@ -588,10 +606,10 @@ class PallasSparseMatrix:
         return out
 
     def rmatvec(self, u: Array) -> Array:
-        out = _tiled_apply(
+        out = self._uncols(_tiled_apply(
             self.b_code, self.b_val, self._pad_rows(u),
             nbo=self.nbc, nbg=self.nbr, square=False,
-        )[: self.n_cols]
+        ))
         out = out + self.spill.rmatvec(u)
         if self.has_dense_cols:
             out = out.at[self.dense_col_ids].add(self.dense_cols @ u)
@@ -616,10 +634,10 @@ class PallasSparseMatrix:
         return out
 
     def sq_rmatvec(self, u: Array) -> Array:
-        out = _tiled_apply(
+        out = self._uncols(_tiled_apply(
             self.b_code, self.b_val, self._pad_rows(u),
             nbo=self.nbc, nbg=self.nbr, square=True,
-        )[: self.n_cols]
+        ))
         out = out + self.spill.sq_rmatvec(u)
         if self.has_dense_cols:
             out = out.at[self.dense_col_ids].add(
@@ -681,6 +699,72 @@ class SpillData:
         return self.spill_coo.sq_rmatvec(u)
 
 
+def _predict_a(rows, cols, nbr, nbc):
+    """Predicted packed sublane count (max over tiles of Σ_w max-lane-load)
+    for orientation F of the given entry set.  Counts only PRESENT cells
+    (sort + reduceat) — a dense bincount over every possible cell is
+    O(tiles · TILE · 128) host memory and OOMs at millions of tiles.
+    Used to choose between identity and permuted column layouts."""
+    t = (rows // TILE_R) * nbc + (cols // TILE_C)
+    w = (cols % TILE_C) // WIN
+    l = rows % WIN
+    key = np.sort((t * np.int64(WINS) + w) * np.int64(WIN) + l)
+    change = np.empty(len(key), dtype=bool)
+    change[0] = True
+    np.not_equal(key[1:], key[:-1], out=change[1:])
+    starts = np.flatnonzero(change)
+    counts = np.diff(np.append(starts, len(key)))
+    tw = key[starts] // WIN
+    tw_change = np.empty(len(tw), dtype=bool)
+    tw_change[0] = True
+    np.not_equal(tw[1:], tw[:-1], out=tw_change[1:])
+    tw_starts = np.flatnonzero(tw_change)
+    m = np.maximum.reduceat(counts, tw_starts)     # max lane load per (t,w)
+    a_t = np.bincount(
+        tw[tw_starts] // WINS, weights=m, minlength=nbr * nbc
+    )
+    return int(a_t.max())
+
+
+def _balance_col_perm(cols, n_cols, nbc):
+    """Frequency round-robin column relabeling: rank columns by entry count
+    (descending) and stripe them across ALL column windows of all tiles,
+    rotating the within-window offset so orientation B's lanes (col % 128)
+    spread too.  Returns ``m`` (old col → new col, len n_cols), a bijection
+    into [0, nbc*TILE_C).
+
+    Clustered real-world data (ids sorted by popularity, feature shards
+    grouped by type) concentrates hot columns in a few windows; each
+    window pays its own worst lane in the packed layout, so spreading the
+    mass is a direct A reduction.  Uniform data is unaffected — the
+    builder compares predicted A and keeps the identity when it wins.
+    """
+    counts = np.bincount(cols, minlength=n_cols)
+    ranks = np.argsort(-counts, kind="stable")
+    n_win_total = nbc * WINS
+    r = np.arange(n_cols, dtype=np.int64)
+    w = r % n_win_total            # window round-robin (F-side balance)
+    k = r // n_win_total           # round within the window
+    # Lane (= new_col % 128, orientation B's lane) must ALSO spread: within
+    # one column-tile, round k of window w gets lane (w % WINS) + WINS·σ
+    # via a transposed-grid bijection σ of the rounds, so the first WIN hot
+    # ranks of every tile land on WIN DISTINCT lanes (a plain (k + w) % WIN
+    # rotation made hot ranks from consecutive rounds collide on the same
+    # (col-tile, lane), blowing up orientation B's packing).
+    if WINS <= WIN:
+        q = WIN // WINS
+        # k = q·a + b → lane = w_in + WINS·b + a (mod WIN): bijective in k
+        # for fixed w, and the first q rounds of a tile's WINS windows
+        # cover all WIN lanes exactly once.
+        lane = (w % WINS + WINS * (k % q) + k // q) % WIN
+    else:  # very large tiles: windows outnumber lanes anyway
+        lane = (w + k) % WIN
+    new = w * WIN + lane
+    m = np.empty(n_cols, np.int64)
+    m[ranks] = new
+    return m
+
+
 def _extract_dense(counts, threshold, max_stripes):
     """Pick up to ``max_stripes`` indices whose entry count ≥ threshold,
     densest first."""
@@ -702,6 +786,7 @@ def build_pallas_matrix(
     dtype=jnp.float32,
     dense_frac: float = 1.0 / 32.0,
     max_dense: int = 8,
+    col_permutation: bool = True,
 ) -> PallasSparseMatrix:
     """Build the tiled layout from host COO triples.
 
@@ -762,10 +847,36 @@ def build_pallas_matrix(
     nbr = max(1, -(-n_rows // TILE_R))
     nbc = max(1, -(-n_cols // TILE_C))
 
+    # --- optional column permutation (clustered-data balance) -------------
+    # Relabel columns frequency-round-robin across windows when that
+    # predicts fewer packed sublanes (summed over both orientations).
+    # Spill/dense/cold paths keep ORIGINAL column ids; only the tiled
+    # layouts see permuted ones, at the cost of one d-sized gather of the
+    # input vector (matvec side) / output vector (rmatvec side).
+    col_perm = None
+    c_tiled = c
+    if col_permutation and r.size and n_cols > WIN:
+        m = _balance_col_perm(c, n_cols, nbc)
+        c_perm = m[c]
+        a_id = (_predict_a(r, c, nbr, nbc)
+                + _predict_a(c, r, nbc, nbr))
+        a_pm = (_predict_a(r, c_perm, nbr, nbc)
+                + _predict_a(c_perm, r, nbc, nbr))
+        # Engage only when the predicted slot-BYTE saving clearly exceeds
+        # the gather traffic the permutation adds (a d-sized take of w per
+        # matvec + an unpermute take per rmatvec).  The 8x margin covers
+        # jnp.take's per-byte inefficiency vs pure streaming for
+        # moderate-sized gathers; marginal predicted wins stay identity.
+        saving_bytes = (a_id - a_pm) * (nbr * nbc) * WIN * 6
+        gather_bytes = 2 * (nbc * TILE_C) * 4
+        if a_pm < a_id and saving_bytes >= 8 * gather_bytes:
+            col_perm = m
+            c_tiled = c_perm
+
     f_code, f_val, f_spill, a_f, depth_f = _build_orientation(
-        r, c, v, nbr, nbc, depth_cap)
+        r, c_tiled, v, nbr, nbc, depth_cap)
     b_code, b_val, b_spill, a_b, depth_b = _build_orientation(
-        c, r, v, nbc, nbr, depth_cap)
+        c_tiled, r, v, nbc, nbr, depth_cap)
 
     # Entries spilled from EITHER orientation go through the COO path for
     # BOTH directions (keeps matvec and rmatvec consistent with one X).
@@ -779,10 +890,10 @@ def build_pallas_matrix(
         keep = np.ones(r.shape[0], bool)
         keep[spilled] = False
         f_code, f_val, fs2, a_f, depth_f = _build_orientation(
-            r[keep], c[keep], v[keep], nbr, nbc, depth_cap,
+            r[keep], c_tiled[keep], v[keep], nbr, nbc, depth_cap,
             spill_cost_ratio=np.inf)
         b_code, b_val, bs2, a_b, depth_b = _build_orientation(
-            c[keep], r[keep], v[keep], nbc, nbr, depth_cap,
+            c_tiled[keep], r[keep], v[keep], nbc, nbr, depth_cap,
             spill_cost_ratio=np.inf)
         assert fs2.size == 0 and bs2.size == 0, "re-spill after rebuild"
     else:
@@ -790,6 +901,15 @@ def build_pallas_matrix(
             np.zeros(1, np.int64), np.zeros(1, np.int64),
             np.zeros(1, np.float32), n_rows, n_cols, dtype=dtype,
         )
+
+    if col_perm is not None:
+        inv = np.full(nbc * TILE_C, n_cols, np.int64)  # default: zero slot
+        inv[col_perm] = np.arange(n_cols)
+        perm_fwd = jnp.asarray(col_perm, jnp.int32)
+        perm_inv = jnp.asarray(inv, jnp.int32)
+    else:
+        perm_fwd = jnp.zeros((1,), jnp.int32)
+        perm_inv = jnp.zeros((1,), jnp.int32)
 
     return PallasSparseMatrix(
         f_code=jnp.asarray(f_code), f_val=jnp.asarray(f_val),
@@ -801,12 +921,14 @@ def build_pallas_matrix(
         dense_col_ids=jnp.asarray(dense_col_ids, jnp.int32),
         dense_rows=jnp.asarray(dense_rows),
         dense_row_ids=jnp.asarray(dense_row_ids, jnp.int32),
+        col_perm_fwd=perm_fwd, col_perm_inv=perm_inv,
         host_coo=host_coo,
         n_rows=int(n_rows), n_cols=int(n_cols),
         nbr=nbr, nbc=nbc, a_f=a_f, a_b=a_b,
         depth_f=depth_f, depth_b=depth_b,
         has_dense_cols=bool(dense_col_ids.size),
         has_dense_rows=bool(dense_row_ids.size),
+        has_col_perm=col_perm is not None,
     )
 
 
