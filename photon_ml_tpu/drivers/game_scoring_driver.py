@@ -53,6 +53,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     logger = PhotonLogger(args.output_dir)
     timer = Timer().start()
     enable_from_args(args, logger)
+    from photon_ml_tpu.parallel.multihost import initialize_logged
+
+    initialize_logged(logger)
 
     model, index_maps = load_game_model(os.path.join(args.model_dir, "models"))
     shards, ids, response, weight, offset, uids, _ = read_game_avro(

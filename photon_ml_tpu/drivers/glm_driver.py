@@ -133,6 +133,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     logger = PhotonLogger(args.output_dir)
     timer = Timer().start()
     enable_from_args(args, logger)
+    from photon_ml_tpu.parallel.multihost import initialize_logged
+
+    initialize_logged(logger)
 
     # Stage 1: read ---------------------------------------------------------
     X_train, y_train = libsvm.read_libsvm(

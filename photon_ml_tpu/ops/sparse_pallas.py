@@ -751,17 +751,21 @@ def _balance_col_perm(cols, n_cols, nbc):
     # ranks of every tile land on WIN DISTINCT lanes (a plain (k + w) % WIN
     # rotation made hot ranks from consecutive rounds collide on the same
     # (col-tile, lane), blowing up orientation B's packing).
-    if WINS <= WIN:
+    if WIN % WINS == 0:
         q = WIN // WINS
         # k = q·a + b → lane = w_in + WINS·b + a (mod WIN): bijective in k
         # for fixed w, and the first q rounds of a tile's WINS windows
         # cover all WIN lanes exactly once.
         lane = (w % WINS + WINS * (k % q) + k // q) % WIN
-    else:  # very large tiles: windows outnumber lanes anyway
-        lane = (w + k) % WIN
+    else:
+        # Non-power-of-two tiles (WINS ∤ WIN): the grid transpose is not a
+        # bijection, so fall back to the trivially bijective per-window
+        # round order (weaker B-lane spreading, never wrong).
+        lane = k
     new = w * WIN + lane
     m = np.empty(n_cols, np.int64)
     m[ranks] = new
+    assert len(np.unique(new)) == n_cols, "column relabeling not bijective"
     return m
 
 

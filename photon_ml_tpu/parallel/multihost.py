@@ -1,0 +1,158 @@
+"""Multi-host (pod-scale) runtime glue.
+
+The reference scales across machines through Spark's cluster manager;
+here the equivalent is JAX's distributed runtime: every host runs the
+SAME program, devices of all hosts join one global mesh, and XLA routes
+collectives over ICI within a slice and DCN across slices (SURVEY.md §2
+"Distributed communication backend", §5.8).  The compute code in
+``parallel/`` and ``game/`` is already host-count-agnostic — this module
+supplies the three pieces a pod job actually needs:
+
+1. :func:`initialize` — bring up the JAX distributed runtime from
+   explicit arguments or scheduler environment variables (GKE/Borg-style
+   ``COORDINATOR_ADDRESS`` / ``NUM_PROCESSES`` / ``PROCESS_ID``, with
+   JAX's own auto-detection as the fallback);
+2. :func:`global_data_mesh` — the all-hosts mesh (identical call on
+   every host);
+3. :func:`host_local_rows` + :func:`assemble_global` — split a global
+   row space into this host's contiguous block, and assemble per-host
+   arrays into one globally-sharded ``jax.Array`` without gathering
+   everything onto one machine (each host feeds only its own shard —
+   the analogue of executors reading their own HDFS splits).
+
+Single-host degenerates cleanly: ``initialize`` is a no-op,
+``host_local_rows`` returns the full range, ``assemble_global`` is a
+``device_put`` — so the same driver script runs anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.parallel.distributed import DATA_AXIS, data_mesh
+
+_ENV_COORD = ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS")
+_ENV_NPROC = ("NUM_PROCESSES", "JAX_NUM_PROCESSES")
+_ENV_PID = ("PROCESS_ID", "JAX_PROCESS_ID")
+
+
+def _env_first(names: Sequence[str]) -> Optional[str]:
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return None
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bring up the JAX distributed runtime; returns True if multi-host.
+
+    Arguments fall back to environment variables
+    (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``, or their
+    ``JAX_``-prefixed forms); with ``PHOTON_MULTIHOST=1`` and no explicit
+    config, JAX's own cluster auto-detection runs (it understands TPU pod
+    metadata).  Without any of those this is a no-op returning False —
+    safe to call unconditionally at driver start (auto-detect is opt-in
+    because it can block waiting for peers).
+    """
+    coordinator_address = coordinator_address or _env_first(_ENV_COORD)
+    env_nproc = _env_first(_ENV_NPROC)
+    env_pid = _env_first(_ENV_PID)
+    num_processes = (
+        num_processes if num_processes is not None
+        else (int(env_nproc) if env_nproc else None)
+    )
+    process_id = (
+        process_id if process_id is not None
+        else (int(env_pid) if env_pid else None)
+    )
+    if coordinator_address is None and num_processes is None:
+        # No explicit config: JAX pod auto-detection only on explicit
+        # opt-in (PHOTON_MULTIHOST=1) — auto-detect can BLOCK waiting for
+        # peers, which must never happen to a single-host driver run.
+        if os.environ.get("PHOTON_MULTIHOST") != "1":
+            return False
+        jax.distributed.initialize()
+        return jax.process_count() > 1
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count() > 1
+
+
+def global_data_mesh() -> Mesh:
+    """1-D mesh over the devices of ALL hosts (same call on every host).
+    Shares :data:`DATA_AXIS` with ``parallel/distributed.py`` so arrays
+    assembled here feed its ``shard_map`` programs directly."""
+    return data_mesh()
+
+
+def initialize_logged(logger=None) -> bool:
+    """Driver preamble: :func:`initialize` + a one-line topology log."""
+    multi = initialize()
+    if multi and logger is not None:
+        logger.info(
+            "multi-host runtime: %d processes, %d devices",
+            jax.process_count(), jax.device_count(),
+        )
+    return multi
+
+
+def _process_row_bounds(
+    n_global_rows: int, process_id: int, n_local_devices: int
+) -> Tuple[int, int]:
+    """[start, stop) owned by one process under a 1-D row sharding.
+
+    Must mirror how XLA chunks an uneven dimension over devices:
+    ceil-sized chunks per DEVICE (the last device's chunk may be short or
+    empty), with each process owning its local devices' consecutive
+    chunks — NOT an even per-process split, which would disagree with the
+    sharding whenever rows don't divide the device count."""
+    total = n_local_devices * jax.process_count()
+    chunk = -(-n_global_rows // total)
+    start = min(process_id * n_local_devices * chunk, n_global_rows)
+    stop = min((process_id + 1) * n_local_devices * chunk, n_global_rows)
+    return start, stop
+
+
+def host_local_rows(n_global_rows: int) -> Tuple[int, int]:
+    """This process's contiguous ``[start, stop)`` block of a global row
+    space, matching the device-chunked layout :func:`assemble_global`
+    uses."""
+    return _process_row_bounds(
+        n_global_rows, jax.process_index(), jax.local_device_count()
+    )
+
+
+def assemble_global(host_block: np.ndarray, n_global_rows: int,
+                    mesh: Mesh) -> jax.Array:
+    """One globally row-sharded ``jax.Array`` from per-host blocks.
+
+    ``host_block`` is THIS host's rows (its :func:`host_local_rows`
+    slice); no host ever materializes the global array.  Single-host:
+    equivalent to a sharded ``device_put`` of the whole array.
+    """
+    start, stop = host_local_rows(n_global_rows)
+    if host_block.shape[0] != stop - start:
+        raise ValueError(
+            f"host block has {host_block.shape[0]} rows; this process owns "
+            f"[{start}, {stop}) of {n_global_rows}"
+        )
+    sharding = NamedSharding(
+        mesh, P(DATA_AXIS, *([None] * (host_block.ndim - 1)))
+    )
+    global_shape = (n_global_rows,) + tuple(host_block.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, host_block, global_shape
+    )
