@@ -87,6 +87,49 @@ def _auc(scores, labels, weights) -> float:
     return float(np.sum(contrib) / (pos_w * neg_w))
 
 
+def _grouped_auc_mean(scores, labels, weights, group_ids) -> float:
+    """Unweighted mean of per-group weighted AUCs, fully vectorized.
+
+    One lexsort by (group, score) plus segment reductions replaces the
+    per-group Python loop — at 10⁵ per-query groups (MovieLens-scale) the
+    loop costs minutes, this costs one sort.  Math per group is identical
+    to :func:`_auc` (tie averaging included); groups lacking both classes
+    are skipped, as the reference does."""
+    if len(scores) == 0:  # all rows masked (e.g. zero weights)
+        return float("nan")
+    _, gidx = np.unique(group_ids, return_inverse=True)
+    order = np.lexsort((scores, gidx))  # group-major, score ascending
+    g = gidx[order]
+    s = scores[order]
+    y = labels[order]
+    w = weights[order]
+    wp = w * y
+    wn = w * (1.0 - y)
+
+    gb = np.concatenate([[True], g[1:] != g[:-1]])      # group starts
+    g_start = np.flatnonzero(gb)
+    cum_wn = np.concatenate([[0.0], np.cumsum(wn)])     # before each row
+    base_wn = cum_wn[g_start]                           # at group start
+    row_group = np.cumsum(gb) - 1                       # dense group seq
+
+    # Tie groups are (group, score) runs; negatives strictly below a tie
+    # group are group-LOCAL: global prefix minus the group's base.
+    tb = np.concatenate([[True], (g[1:] != g[:-1]) | (s[1:] != s[:-1])])
+    t_start = np.flatnonzero(tb)
+    t_id = np.cumsum(tb) - 1
+    neg_below = cum_wn[t_start][t_id] - base_wn[row_group]
+    tie_neg = np.add.reduceat(wn, t_start)[t_id]
+    contrib = wp * (neg_below + 0.5 * tie_neg)
+
+    contrib_g = np.add.reduceat(contrib, g_start)
+    pos_g = np.add.reduceat(wp, g_start)
+    neg_g = np.add.reduceat(wn, g_start)
+    valid = (pos_g > 0) & (neg_g > 0)
+    if not np.any(valid):
+        return float("nan")
+    return float(np.mean(contrib_g[valid] / (pos_g[valid] * neg_g[valid])))
+
+
 @dataclasses.dataclass(frozen=True)
 class AreaUnderROCCurveEvaluator(Evaluator):
     """AUC; with ``group_ids`` given, the unweighted mean of per-group AUCs
@@ -98,13 +141,7 @@ class AreaUnderROCCurveEvaluator(Evaluator):
     def _compute(self, scores, labels, weights, group_ids) -> float:
         if group_ids is None:
             return _auc(scores, labels, weights)
-        aucs = []
-        for gid in np.unique(group_ids):
-            m = group_ids == gid
-            a = _auc(scores[m], labels[m], weights[m])
-            if not np.isnan(a):
-                aucs.append(a)
-        return float(np.mean(aucs)) if aucs else float("nan")
+        return _grouped_auc_mean(scores, labels, weights, group_ids)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,14 +187,27 @@ class PrecisionAtKEvaluator(Evaluator):
     def _compute(self, scores, labels, weights, group_ids) -> float:
         if group_ids is None:
             raise ValueError("precision@k requires group_ids (per-query metric)")
-        precisions = []
-        for gid in np.unique(group_ids):
-            m = group_ids == gid
-            s, y = scores[m], labels[m]
-            k = min(self.k, len(s))
-            top = np.argsort(-s, kind="stable")[:k]
-            precisions.append(np.mean(y[top] > 0))
-        return float(np.mean(precisions))
+        # Vectorized over groups: one lexsort by (group, score desc) and
+        # segment reductions (the per-group argsort loop costs minutes at
+        # 10⁵ per-query groups).  lexsort is stable, so ties keep original
+        # order exactly like the per-group stable argsort did.
+        if len(scores) == 0:  # all rows masked (e.g. zero weights)
+            return float("nan")
+        _, gidx = np.unique(group_ids, return_inverse=True)
+        order = np.lexsort((-scores, gidx))
+        g = gidx[order]
+        y = labels[order]
+        gb = np.concatenate([[True], g[1:] != g[:-1]])
+        g_start = np.flatnonzero(gb)
+        row_group = np.cumsum(gb) - 1
+        pos_in_group = np.arange(len(g)) - g_start[row_group]
+        sizes = np.diff(np.append(g_start, len(g)))
+        k_eff = np.minimum(self.k, sizes)
+        in_top = pos_in_group < self.k
+        hits_g = np.add.reduceat(
+            np.where(in_top, (y > 0).astype(np.float64), 0.0), g_start
+        )
+        return float(np.mean(hits_g / k_eff))
 
 
 def get_evaluator(spec: str) -> Evaluator:

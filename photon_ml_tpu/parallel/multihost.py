@@ -75,7 +75,8 @@ def initialize(
         process_id if process_id is not None
         else (int(env_pid) if env_pid else None)
     )
-    if coordinator_address is None and num_processes is None:
+    explicit = (coordinator_address, num_processes, process_id)
+    if all(v is None for v in explicit):
         # No explicit config: JAX pod auto-detection only on explicit
         # opt-in (PHOTON_MULTIHOST=1) — auto-detect can BLOCK waiting for
         # peers, which must never happen to a single-host driver run.
@@ -83,6 +84,16 @@ def initialize(
             return False
         jax.distributed.initialize()
         return jax.process_count() > 1
+    if any(v is None for v in explicit):
+        # Partial config is a deployment bug (a scheduler template lost a
+        # variable) — fail loudly rather than hang on auto-detection or
+        # silently run single-host.
+        raise ValueError(
+            "multi-host initialization needs ALL of coordinator_address, "
+            "num_processes, process_id (or none of them); got "
+            f"coordinator_address={coordinator_address!r}, "
+            f"num_processes={num_processes!r}, process_id={process_id!r}"
+        )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -100,3 +100,16 @@ class TestAssembleGlobal:
             out_specs=P(),
         ))(arr)
         np.testing.assert_allclose(float(total), x.sum(), rtol=1e-5)
+
+
+class TestPartialConfig:
+    def test_partial_explicit_config_raises(self, monkeypatch):
+        for var in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                    "NUM_PROCESSES", "JAX_NUM_PROCESSES",
+                    "PROCESS_ID", "JAX_PROCESS_ID", "PHOTON_MULTIHOST"):
+            monkeypatch.delenv(var, raising=False)
+        with pytest.raises(ValueError, match="ALL of"):
+            multihost.initialize(num_processes=4)
+        monkeypatch.setenv("NUM_PROCESSES", "4")
+        with pytest.raises(ValueError, match="ALL of"):
+            multihost.initialize()
