@@ -64,17 +64,25 @@ def initialize(
     safe to call unconditionally at driver start (auto-detect is opt-in
     because it can block waiting for peers).
     """
+    from_args = any(
+        v is not None for v in (coordinator_address, num_processes, process_id)
+    )
     coordinator_address = coordinator_address or _env_first(_ENV_COORD)
-    env_nproc = _env_first(_ENV_NPROC)
-    env_pid = _env_first(_ENV_PID)
-    num_processes = (
-        num_processes if num_processes is not None
-        else (int(env_nproc) if env_nproc else None)
-    )
-    process_id = (
-        process_id if process_id is not None
-        else (int(env_pid) if env_pid else None)
-    )
+    # Env-var config is only considered when a coordinator address is
+    # present: the unprefixed NUM_PROCESSES / PROCESS_ID names are common
+    # enough (CI harnesses, process supervisors) that a stray one alone
+    # must not flip a single-host run into multi-host mode or an error.
+    if coordinator_address is not None or from_args:
+        env_nproc = _env_first(_ENV_NPROC)
+        env_pid = _env_first(_ENV_PID)
+        num_processes = (
+            num_processes if num_processes is not None
+            else (int(env_nproc) if env_nproc else None)
+        )
+        process_id = (
+            process_id if process_id is not None
+            else (int(env_pid) if env_pid else None)
+        )
     explicit = (coordinator_address, num_processes, process_id)
     if all(v is None for v in explicit):
         # No explicit config: JAX pod auto-detection only on explicit
@@ -85,9 +93,9 @@ def initialize(
         jax.distributed.initialize()
         return jax.process_count() > 1
     if any(v is None for v in explicit):
-        # Partial config is a deployment bug (a scheduler template lost a
-        # variable) — fail loudly rather than hang on auto-detection or
-        # silently run single-host.
+        # Partial config WITH a coordinator (or explicit arguments) is a
+        # deployment bug (a scheduler template lost a variable) — fail
+        # loudly rather than hang or silently run single-host.
         raise ValueError(
             "multi-host initialization needs ALL of coordinator_address, "
             "num_processes, process_id (or none of them); got "

@@ -110,6 +110,17 @@ class TestPartialConfig:
             monkeypatch.delenv(var, raising=False)
         with pytest.raises(ValueError, match="ALL of"):
             multihost.initialize(num_processes=4)
-        monkeypatch.setenv("NUM_PROCESSES", "4")
+        monkeypatch.setenv("COORDINATOR_ADDRESS", "1.2.3.4:99")
         with pytest.raises(ValueError, match="ALL of"):
             multihost.initialize()
+
+    def test_stray_generic_env_vars_are_ignored(self, monkeypatch):
+        """Unrelated tooling commonly exports NUM_PROCESSES / PROCESS_ID;
+        without a coordinator address they must not abort a single-host
+        run (regression: the all-or-none check fired on them)."""
+        for var in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                    "PHOTON_MULTIHOST"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("NUM_PROCESSES", "4")
+        monkeypatch.setenv("PROCESS_ID", "17")
+        assert multihost.initialize() is False

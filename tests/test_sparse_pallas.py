@@ -220,10 +220,12 @@ class TestColumnPermutation:
         return rows, cols, vals
 
     def test_permutation_engages_and_avoids_spill(self, rng):
+        # max_dense=0 isolates the permutation from dense-stripe
+        # extraction, which would otherwise absorb this hot cluster.
         rows, cols, vals = self._clustered(rng)
         n, d = 3000, 4096
-        P = build_pallas_matrix(rows, cols, vals, n, d)
-        P0 = build_pallas_matrix(rows, cols, vals, n, d,
+        P = build_pallas_matrix(rows, cols, vals, n, d, max_dense=0)
+        P0 = build_pallas_matrix(rows, cols, vals, n, d, max_dense=0,
                                  col_permutation=False)
         assert P.has_col_perm
         # The win is NOT raw sublane count — the identity build "solves"
@@ -236,7 +238,7 @@ class TestColumnPermutation:
     def test_permuted_numerics_match_coo(self, rng):
         rows, cols, vals = self._clustered(rng)
         n, d = 3000, 4096
-        P = build_pallas_matrix(rows, cols, vals, n, d)
+        P = build_pallas_matrix(rows, cols, vals, n, d, max_dense=0)
         assert P.has_col_perm
         C = from_coo(rows, cols, vals, n, d)
         w = jnp.asarray(rng.normal(size=d).astype(np.float32))
@@ -310,3 +312,25 @@ class TestStorageClasses:
         u = rng.normal(size=n).astype(np.float32)
         assert _rel(P.sq_rmatvec(jnp.asarray(u)),
                     C.sq_rmatvec(jnp.asarray(u))) < 1e-5
+
+
+class TestDenseStripeBudget:
+    def test_memory_budget_caps_stripe_count(self, rng):
+        """The per-side dense budget must bound stripes regardless of how
+        many columns clear the count threshold (at 10^8 rows each stripe
+        is ~400 MB — the count cap alone would blow HBM)."""
+        n, d = 4000, 600
+        # 40 columns all above threshold (max(256, n/32) = 256)
+        hot = np.repeat(np.arange(40, dtype=np.int64), 300)
+        rows = rng.integers(0, n, size=len(hot)).astype(np.int64)
+        vals = rng.normal(size=len(hot)).astype(np.float32)
+        budget = 10 * n * 4  # room for exactly 10 column stripes
+        P = build_pallas_matrix(rows, hot, vals, n, d,
+                                dense_budget_bytes=budget)
+        assert P.has_dense_cols
+        assert P.dense_col_ids.shape[0] <= 10
+        C = from_coo(rows, hot, vals, n, d)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        assert _rel(P.matvec(w), C.matvec(w)) < 1e-5
+        u = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        assert _rel(P.rmatvec(u), C.rmatvec(u)) < 1e-5
