@@ -37,8 +37,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from photon_ml_tpu.parallel.distributed import DATA_AXIS, data_mesh
 
 _ENV_COORD = ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS")
-_ENV_NPROC = ("NUM_PROCESSES", "JAX_NUM_PROCESSES")
-_ENV_PID = ("PROCESS_ID", "JAX_PROCESS_ID")
 
 
 def _env_first(names: Sequence[str]) -> Optional[str]:
@@ -68,21 +66,26 @@ def initialize(
         v is not None for v in (coordinator_address, num_processes, process_id)
     )
     coordinator_address = coordinator_address or _env_first(_ENV_COORD)
-    # Env-var config is only considered when a coordinator address is
-    # present: the unprefixed NUM_PROCESSES / PROCESS_ID names are common
-    # enough (CI harnesses, process supervisors) that a stray one alone
-    # must not flip a single-host run into multi-host mode or an error.
-    if coordinator_address is not None or from_args:
-        env_nproc = _env_first(_ENV_NPROC)
-        env_pid = _env_first(_ENV_PID)
-        num_processes = (
-            num_processes if num_processes is not None
-            else (int(env_nproc) if env_nproc else None)
-        )
-        process_id = (
-            process_id if process_id is not None
-            else (int(env_pid) if env_pid else None)
-        )
+    # JAX_-prefixed env vars are deliberate multi-host config and always
+    # count (a partial set fails loudly below).  The UNPREFIXED
+    # NUM_PROCESSES / PROCESS_ID names are common enough in unrelated
+    # tooling (CI harnesses, process supervisors) that they only count
+    # once a coordinator address or explicit argument shows intent.
+    intent = coordinator_address is not None or from_args
+    env_nproc = os.environ.get("JAX_NUM_PROCESSES") or (
+        os.environ.get("NUM_PROCESSES") if intent else None
+    )
+    env_pid = os.environ.get("JAX_PROCESS_ID") or (
+        os.environ.get("PROCESS_ID") if intent else None
+    )
+    num_processes = (
+        num_processes if num_processes is not None
+        else (int(env_nproc) if env_nproc else None)
+    )
+    process_id = (
+        process_id if process_id is not None
+        else (int(env_pid) if env_pid else None)
+    )
     explicit = (coordinator_address, num_processes, process_id)
     if all(v is None for v in explicit):
         # No explicit config: JAX pod auto-detection only on explicit

@@ -82,8 +82,11 @@ class TestPallasLayoutFuzz:
 
 
 class TestGameConfigFuzz:
-    @pytest.mark.parametrize("seed", [101, 202, 303])
-    def test_random_config_end_to_end(self, seed, tmp_path):
+    @pytest.mark.parametrize(
+        "seed,force_factored",
+        [(101, False), (202, False), (303, False), (404, True), (505, True)],
+    )
+    def test_random_config_end_to_end(self, seed, force_factored, tmp_path):
         from photon_ml_tpu.data.index_map import IndexMap
         from photon_ml_tpu.game.estimator import (
             FixedEffectCoordinateConfig,
@@ -168,11 +171,28 @@ class TestGameConfigFuzz:
                 bucket_growth=float(rng.choice([2.0, 3.0, 4.0])),
             ),
         }
-        if rng.uniform() < 0.5:
-            configs["per_item"] = RandomEffectCoordinateConfig(
-                "itemFeatures", "itemId", rand_opt(),
-                float(rng.uniform(0.1, 2.0)),
+        if force_factored or rng.uniform() < 0.5:
+            from photon_ml_tpu.game.estimator import (
+                FactoredRandomEffectCoordinateConfig,
             )
+
+            # Sometimes the item effect is FACTORED (w_e = V u_e) — it
+            # must compose with every optimizer/regularization draw and
+            # round-trip through the standard model store.  Two seeds
+            # force it so coverage doesn't depend on the draws.
+            if force_factored or rng.uniform() < 0.5:
+                configs["per_item"] = FactoredRandomEffectCoordinateConfig(
+                    "itemFeatures", "itemId",
+                    rank=int(rng.integers(1, 3)),
+                    optimization=rand_opt(),
+                    reg_weight=float(rng.uniform(0.1, 2.0)),
+                    alternations=int(rng.integers(1, 3)),
+                )
+            else:
+                configs["per_item"] = RandomEffectCoordinateConfig(
+                    "itemFeatures", "itemId", rand_opt(),
+                    float(rng.uniform(0.1, 2.0)),
+                )
 
         est = GameEstimator(
             str(task), configs, n_iterations=int(rng.integers(1, 3))

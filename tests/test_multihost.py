@@ -124,3 +124,14 @@ class TestPartialConfig:
         monkeypatch.setenv("NUM_PROCESSES", "4")
         monkeypatch.setenv("PROCESS_ID", "17")
         assert multihost.initialize() is False
+
+    def test_partial_jax_prefixed_env_fails_loudly(self, monkeypatch):
+        """JAX_-prefixed vars are deliberate config: a partial set (lost
+        coordinator) must error, not silently run single-host."""
+        for var in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                    "NUM_PROCESSES", "PROCESS_ID", "PHOTON_MULTIHOST"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "8")
+        monkeypatch.setenv("JAX_PROCESS_ID", "3")
+        with pytest.raises(ValueError, match="ALL of"):
+            multihost.initialize()
