@@ -19,7 +19,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from photon_ml_tpu.ops.sparse_pallas import (
-    TILE_C, WIN, WIN_SHIFT, WINS, build_pallas_matrix)
+    OBITS, TILE_C, WIN, WIN_SHIFT, WINS, build_pallas_matrix)
 
 N, D, K = 1 << 20, 1 << 13, 32
 R = 10
@@ -29,7 +29,7 @@ def make_kernel(mode, a):
     def kernel(code_ref, val_ref, tab_ref, out_ref):
         code = code_ref[0].astype(jnp.int32)
         lo = code & (WIN - 1)
-        ohi = (code >> 7) & (WINS - 1)
+        ohi = (code >> 7) & ((1 << OBITS) - 1)
         win = code[:, 0:1] >> WIN_SHIFT
         v = val_ref[0]
         if mode == "dma":

@@ -301,7 +301,7 @@ def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, square,
         j = t % chunk
         code = code_ref[b, j].astype(jnp.int32)
         lo = code & (WIN - 1)
-        ohi = (code >> 7) & (WINS - 1)
+        ohi = (code >> 7) & ((1 << OBITS) - 1)
         win = code[:, 0:1] >> WIN_SHIFT                       # (A, 1)
         a = code.shape[0]
 
@@ -886,7 +886,7 @@ def build_pallas_matrix(
         # matvec + an unpermute take per rmatvec).  The 8x margin covers
         # jnp.take's per-byte inefficiency vs pure streaming for
         # moderate-sized gathers; marginal predicted wins stay identity.
-        saving_bytes = (a_id - a_pm) * (nbr * nbc) * WIN * 6
+        saving_bytes = (a_id - a_pm) * (nbr * nbc) * WIN * (CODE_BYTES + 4)
         gather_bytes = 2 * (nbc * TILE_C) * 4
         if a_pm < a_id and saving_bytes >= 8 * gather_bytes:
             col_perm = m

@@ -334,3 +334,47 @@ class TestDenseStripeBudget:
         assert _rel(P.matvec(w), C.matvec(w)) < 1e-5
         u = jnp.asarray(rng.normal(size=n).astype(np.float32))
         assert _rel(P.rmatvec(u), C.rmatvec(u)) < 1e-5
+
+
+class TestNonPowerOfTwoTile:
+    def test_tile_384_decode_matches_coo(self):
+        """Regression: packed-code decode must mask ohi with (1<<OBITS)-1,
+        not (WINS-1) — for TILE=384 (WINS=3, OBITS=2) the old 0b10 mask
+        zeroed bit 0, so every slot with output window 1 (or 3) decoded to
+        the wrong window (advisor round 2).  TILE_R is frozen at import, so
+        the check runs in a subprocess."""
+        import subprocess
+        import sys
+
+        prog = """
+import numpy as np, jax.numpy as jnp
+from photon_ml_tpu.ops.sparse import from_coo
+from photon_ml_tpu.ops.sparse_pallas import WINS, build_pallas_matrix
+assert WINS == 3, WINS  # non-power-of-two windows per tile
+rng = np.random.default_rng(0)
+n, d, nnz = 1500, 900, 20000
+rows = rng.integers(0, n, size=nnz).astype(np.int64)
+cols = rng.integers(0, d, size=nnz).astype(np.int64)
+vals = rng.normal(size=nnz).astype(np.float32)
+P = build_pallas_matrix(rows, cols, vals, n, d, depth_cap=32)
+C = from_coo(rows, cols, vals, n, d)
+w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+u = jnp.asarray(rng.normal(size=n).astype(np.float32))
+rel_m = float(np.abs(np.asarray(P.matvec(w) - C.matvec(w))).max())
+rel_r = float(np.abs(np.asarray(P.rmatvec(u) - C.rmatvec(u))).max())
+scale_m = max(1e-6, float(np.abs(np.asarray(C.matvec(w))).max()))
+scale_r = max(1e-6, float(np.abs(np.asarray(C.rmatvec(u))).max()))
+assert rel_m / scale_m < 1e-5, rel_m / scale_m
+assert rel_r / scale_r < 1e-5, rel_r / scale_r
+print("OK")
+"""
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PHOTON_PALLAS_TILE"] = "384"
+        env["PHOTON_PALLAS_INTERPRET"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo_root + ":" + env.get("PYTHONPATH", "")
+        r = subprocess.run([sys.executable, "-c", prog], env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+        assert "OK" in r.stdout
