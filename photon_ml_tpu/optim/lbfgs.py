@@ -161,7 +161,7 @@ def lbfgs_solve(
     tol_scale = jnp.maximum(1.0, g0_norm)
 
     n_track = config.max_iters + 1
-    values0 = jnp.full((n_track,), jnp.nan, dtype).at[0].set(f0)
+    values0 = jnp.full((n_track,), jnp.nan, dtype).at[0].set(f0.astype(dtype))
     gnorms0 = jnp.full((n_track,), jnp.nan, dtype).at[0].set(g0_norm)
 
     init = _LBFGSState(
@@ -244,7 +244,7 @@ def lbfgs_solve(
             n_pairs=n_pairs,
             done=jnp.logical_or(converged, stalled),
             converged=converged,
-            values=s.values.at[k].set(value_next),
+            values=s.values.at[k].set(value_next.astype(s.values.dtype)),
             grad_norms=s.grad_norms.at[k].set(
                 jnp.where(stalled, pnorm(s.grad, w_axis), g_norm)
             ),

@@ -96,7 +96,11 @@ def wolfe_line_search(
     (directional derivatives are then psum'd globals).
     """
     dg0 = pvdot(direction, g0, w_axis)
-    t0 = jnp.asarray(initial_step, dtype=f0.dtype)
+    # Step sizes live in w-space dtype: with f64 VALUE accumulation
+    # (GlmObjective accumulate="f64") f0 is float64 while w stays float32 —
+    # tying t to f0.dtype would silently upcast every trial iterate (and
+    # the feature matvec behind it) to f64.
+    t0 = jnp.asarray(initial_step, dtype=w0.dtype)
 
     def evaluate(t):
         w = w0 + t * direction

@@ -50,6 +50,36 @@ class GlmObjective:
 
     loss: PointwiseLoss
     normalization: Optional[NormalizationContext] = None
+    # "f32": plain XLA tree reduction (default — summands are non-negative
+    # for every supported loss, so the tree sum's relative error is already
+    # ~log₂(n)·ε).  "f64": the VALUE reduction upcasts to float64 before
+    # summing (the reference accumulates in f64 end-to-end via Breeze) and
+    # the returned value STAYS f64 so convergence tests in the solvers see
+    # the extra precision; needs ``jax_enable_x64`` (works on this TPU —
+    # XLA emulates f64 — at a cost on the value pass only; the gradient's
+    # per-coordinate sums stay f32 tree reductions).
+    accumulate: str = "f32"
+
+    def __post_init__(self):
+        if self.accumulate not in ("f32", "f64"):
+            raise ValueError(
+                f"accumulate must be f32|f64, got {self.accumulate!r}"
+            )
+        if self.accumulate == "f64":
+            import jax as _jax
+
+            if not _jax.config.jax_enable_x64:
+                raise ValueError(
+                    "accumulate='f64' needs jax_enable_x64 "
+                    "(jax.config.update('jax_enable_x64', True))"
+                )
+
+    def _wsum(self, weights: Array, vals: Array) -> Array:
+        """The objective's weighted-sum reduction (see ``accumulate``)."""
+        prod = weights * vals
+        if self.accumulate == "f64":
+            return jnp.sum(prod.astype(jnp.float64))
+        return jnp.sum(prod)
 
     # -- normalized linear maps (see data/normalization.py) ----------------
     def _matvec(self, data: GlmData, w: Array) -> Array:
@@ -72,11 +102,11 @@ class GlmObjective:
     # -- local (per-shard) pieces, no regularization -----------------------
     def raw_value(self, w: Array, data: GlmData) -> Array:
         m = self.margins(w, data)
-        return jnp.sum(data.weights * self.loss.value(m, data.labels))
+        return self._wsum(data.weights, self.loss.value(m, data.labels))
 
     def raw_value_and_grad(self, w: Array, data: GlmData) -> tuple[Array, Array]:
         m = self.margins(w, data)
-        value = jnp.sum(data.weights * self.loss.value(m, data.labels))
+        value = self._wsum(data.weights, self.loss.value(m, data.labels))
         u = data.weights * self.loss.d1(m, data.labels)
         return value, self._rmatvec(data, u)
 

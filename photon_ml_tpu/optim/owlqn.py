@@ -109,7 +109,7 @@ def owlqn_solve(
     tol_scale = jnp.maximum(1.0, pg0_norm)
 
     n_track = config.max_iters + 1
-    values0 = jnp.full((n_track,), jnp.nan, dtype).at[0].set(f0)
+    values0 = jnp.full((n_track,), jnp.nan, dtype).at[0].set(f0.astype(dtype))
     gnorms0 = jnp.full((n_track,), jnp.nan, dtype).at[0].set(pg0_norm)
 
     init = _OWLQNState(
@@ -223,7 +223,7 @@ def owlqn_solve(
             k=k, n_pairs=n_pairs,
             done=jnp.logical_or(converged, stalled),
             converged=converged,
-            values=s.values.at[k].set(f_keep),
+            values=s.values.at[k].set(f_keep.astype(s.values.dtype)),
             grad_norms=s.grad_norms.at[k].set(pg_norm),
         )
 

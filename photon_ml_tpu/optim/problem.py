@@ -75,10 +75,13 @@ class GlmOptimizationProblem:
         task: str,
         config: GlmOptimizationConfig = GlmOptimizationConfig(),
         normalization: Optional[NormalizationContext] = None,
+        accumulate: str = "f32",
     ):
         self.task = losses_lib.get(task).name  # canonicalize aliases
         self.config = config
-        self.objective = GlmObjective(losses_lib.get(task), normalization)
+        self.objective = GlmObjective(
+            losses_lib.get(task), normalization, accumulate=accumulate
+        )
         self.normalization = normalization
         # One compiled program serves every single-device solve: data,
         # reg_weight, w0, and l1_mask are traced arguments, so a λ grid or

@@ -183,7 +183,7 @@ def tron_solve(
     tol_scale = jnp.maximum(1.0, g0_norm)
 
     n_track = config.max_iters + 1
-    values0 = jnp.full((n_track,), jnp.nan, dtype).at[0].set(f0)
+    values0 = jnp.full((n_track,), jnp.nan, dtype).at[0].set(f0.astype(dtype))
     gnorms0 = jnp.full((n_track,), jnp.nan, dtype).at[0].set(g0_norm)
 
     init = _TRONState(
@@ -269,7 +269,7 @@ def tron_solve(
             k=k,
             done=jnp.logical_or(converged, stalled),
             converged=converged,
-            values=s.values.at[k].set(f_new),
+            values=s.values.at[k].set(f_new.astype(s.values.dtype)),
             grad_norms=s.grad_norms.at[k].set(g_norm),
         )
 

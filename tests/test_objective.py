@@ -138,3 +138,97 @@ def test_sparse_to_dense_roundtrip(rng):
     sm = sparse.from_scipy_csr(X, pad_nnz=X.nnz + 11)
     np.testing.assert_allclose(np.asarray(sm.to_dense().data), X.toarray(),
                                rtol=1e-6, atol=1e-6)
+
+
+class TestAccumulatePrecision:
+    """Opt-in f64 value accumulation (VERDICT r2 missing #5): at 1e8 rows
+    the f32 weighted sum's rounding competes with 1e-7 convergence
+    tolerances; the f64 option must track the numpy f64 oracle tightly."""
+
+    def test_f64_value_tracks_oracle_at_1e8_rows(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.data.dataset import GlmData
+        from photon_ml_tpu.ops import losses
+        from photon_ml_tpu.ops.sparse import DenseMatrix
+        from photon_ml_tpu.optim.objective import GlmObjective
+
+        n = 100_000_000
+        rng = np.random.default_rng(0)
+        # Margins ride the offsets so no matvec is needed at this scale;
+        # a 1-column zero feature block keeps GlmData's shape contract.
+        offsets = rng.normal(size=n).astype(np.float32) * 3.0
+        labels = (rng.random(n) < 0.5).astype(np.float32)
+        data = GlmData(
+            features=DenseMatrix(jnp.zeros((n, 1), jnp.float32)),
+            labels=jnp.asarray(labels),
+            weights=jnp.ones(n, jnp.float32),
+            offsets=jnp.asarray(offsets),
+        )
+        w = jnp.zeros(1, jnp.float32)
+        v32 = float(GlmObjective(losses.logistic).raw_value(w, data))
+        obj64 = GlmObjective(losses.logistic, accumulate="f64")
+        v64 = float(obj64.raw_value(w, data))
+        # f64 oracle: numpy f64 sum over the same f32 per-row losses
+        oracle = float(np.sum(
+            np.asarray(
+                losses.logistic.value(
+                    jnp.asarray(offsets), jnp.asarray(labels)
+                ),
+                np.float64,
+            )
+        ))
+        assert abs(v64 - oracle) <= 1e-9 * abs(oracle)
+        # and it is at least as close as the f32 reduction
+        assert abs(v64 - oracle) <= abs(v32 - oracle) + 1e-12 * abs(oracle)
+
+    def test_f64_fit_matches_f32_fit(self, rng):
+        """The precise path changes the value dtype only — the solver must
+        land on the same solution, with w still float32 throughout."""
+        import numpy as np
+        import scipy.sparse as sp
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.data.dataset import make_glm_data
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            GlmOptimizationProblem,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        n, d = 600, 25
+        X = sp.random(n, d, density=0.2, random_state=1, format="csr",
+                      dtype=np.float32)
+        y = (np.random.default_rng(1).random(n) < 0.5).astype(np.float32)
+        data = make_glm_data(X, y)
+        cfg = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=100, tolerance=1e-8),
+            regularization=RegularizationContext.l2(),
+        )
+        res32 = GlmOptimizationProblem("logistic", cfg).solve_single_device(
+            data, 1.0
+        )
+        res64 = GlmOptimizationProblem(
+            "logistic", cfg, accumulate="f64"
+        ).solve_single_device(data, 1.0)
+        assert res64.w.dtype == jnp.float32
+        assert res64.value.dtype == jnp.float64
+        np.testing.assert_allclose(
+            np.asarray(res64.w), np.asarray(res32.w), atol=2e-4
+        )
+
+    def test_f64_requires_x64(self):
+        from photon_ml_tpu.ops import losses
+        from photon_ml_tpu.optim.objective import GlmObjective
+        import jax
+        import pytest as _pytest
+
+        old = jax.config.jax_enable_x64
+        try:
+            jax.config.update("jax_enable_x64", False)
+            with _pytest.raises(ValueError, match="x64"):
+                GlmObjective(losses.logistic, accumulate="f64")
+        finally:
+            jax.config.update("jax_enable_x64", old)
