@@ -1,0 +1,381 @@
+"""Out-of-core GLM training data: a host-RAM chunk store streamed to HBM.
+
+SURVEY.md §7 names "Host→device ingest bandwidth for 1B rows" as a hard
+part of the port: the reference keeps the dataset as a persisted Spark RDD
+across executor memory, re-scanned by every ``treeAggregate`` pass
+(SURVEY.md §3.1).  The TPU analogue here: the dataset lives in HOST RAM as
+a list of equal-shaped chunk pytrees, and every objective evaluation
+streams them through the chip with double-buffered ``device_put`` —
+HBM only ever holds ~2 chunks, so trainable dataset size is bounded by
+host RAM (and, with the Avro block reader, by disk), not by HBM.
+
+Design constraints that shape this module:
+
+- **One compiled program must serve every chunk** — per-chunk shapes and
+  pytree structure are uniformized at build time (row padding, a common
+  nnz budget, :func:`~photon_ml_tpu.ops.sparse_pallas.uniformize_pallas_layouts`
+  for the tiled layouts).  A retrace per chunk would dwarf the transfer
+  cost.
+- **Chunks hold numpy leaves**, never device arrays: the whole point is
+  that the resident set exceeds HBM.
+- **Ingest is incremental**: :func:`streaming_from_blocks` re-cuts an
+  arbitrary block stream (e.g. Avro ``iter_blocks``) at ``chunk_rows``
+  boundaries as blocks arrive, building each chunk's device layout the
+  moment it fills and dropping the raw rows — peak host memory is the
+  finished chunk store plus ~one chunk of raw buffer, never a second full
+  copy of the dataset.
+- **Padding discipline**: rows added to fill the last chunk carry weight 0
+  (exactly like the mesh row-padding in parallel/distributed.py), so every
+  objective/metric reduction is unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+import jax
+import numpy as np
+
+from photon_ml_tpu.data.dataset import GlmData
+from photon_ml_tpu.ops.sparse import (
+    DenseMatrix,
+    SparseMatrix,
+    canonicalize_coo,
+    pad_coo_triples,
+)
+
+
+def _cpu_device():
+    """The host CPU device, when a CPU backend exists next to the TPU —
+    layout builds placed there never round-trip chunk data through HBM."""
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        return None
+
+
+class _nullctx:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+@dataclasses.dataclass
+class StreamingGlmData:
+    """A GLM dataset as a list of uniform host-resident chunks.
+
+    ``chunks`` are :class:`GlmData` pytrees with numpy leaves, every chunk
+    identical in structure and shape (the last one row-padded with weight
+    0).  With ``n_shards > 1`` every array additionally carries a leading
+    shard axis for data-parallel placement (the streamed analogue of
+    parallel/distributed.DistributedGlmData).
+    """
+
+    chunks: list  # list[GlmData], numpy leaves
+    n_rows: int  # real (unpadded) row count over all chunks
+    n_features: int
+    chunk_rows: int  # rows per chunk (uniform, incl. padding)
+    n_shards: int = 1
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.chunks)
+
+    @property
+    def weight_sum(self) -> float:
+        return float(sum(np.sum(c.weights) for c in self.chunks))
+
+    def nbytes(self) -> int:
+        """Host bytes held by all chunk leaves (for HBM-vs-dataset checks)."""
+        return int(sum(
+            leaf.nbytes
+            for c in self.chunks
+            for leaf in jax.tree.leaves(c)
+            if hasattr(leaf, "nbytes")
+        ))
+
+
+def make_streaming_glm_data(
+    features,
+    labels,
+    weights=None,
+    offsets=None,
+    chunk_rows: int = 1 << 20,
+    use_pallas: bool | str = "auto",
+    depth_cap: int = 128,
+    n_shards: int = 1,
+) -> StreamingGlmData:
+    """Cut already-materialized host data into uniform chunks.
+
+    ``features``: numpy 2-D array or scipy sparse matrix.  A convenience
+    wrapper over :func:`streaming_from_blocks` with the whole dataset as
+    one block (the raw rows are the caller's array either way — no extra
+    full copy is built; chunks are cut and their layouts built one at a
+    time).
+    """
+    n = features.shape[0]
+    weights = (
+        np.ones(n, np.float32) if weights is None
+        else np.asarray(weights, np.float32)
+    )
+    offsets = (
+        np.zeros(n, np.float32) if offsets is None
+        else np.asarray(offsets, np.float32)
+    )
+    return streaming_from_blocks(
+        [(features, np.asarray(labels, np.float32), weights, offsets)],
+        n_features=features.shape[1],
+        chunk_rows=chunk_rows,
+        use_pallas=use_pallas,
+        depth_cap=depth_cap,
+        n_shards=n_shards,
+    )
+
+
+def streaming_from_blocks(
+    blocks: Iterable,
+    n_features: int,
+    chunk_rows: int = 1 << 20,
+    use_pallas: bool | str = "auto",
+    depth_cap: int = 128,
+    n_shards: int = 1,
+) -> StreamingGlmData:
+    """Build the chunk store from an iterator of ``(X, y[, w[, o]])``
+    blocks (e.g. Avro ``iter_blocks`` output), re-cut to ``chunk_rows``
+    boundaries AS THEY ARRIVE: each chunk's device layout is built the
+    moment it fills and its raw rows are dropped, so peak host memory is
+    the finished chunk store plus about one chunk of raw buffer — the
+    dataset is never materialized as one giant matrix.
+
+    Blocks may be scipy sparse or numpy (the first block decides; later
+    blocks are converted).  ``use_pallas`` chooses the tiled Pallas layout
+    for sparse chunks ("auto": on TPU, single-shard — matching
+    make_glm_data's resident heuristic); layouts are built with
+    ``col_permutation=False`` and uniformized at the end so one jitted
+    program serves every chunk.  ``n_shards > 1`` stacks each chunk into
+    per-device row blocks (COO/dense only — the tiled layout is
+    single-device for now).
+    """
+    import scipy.sparse as sp
+
+    if chunk_rows <= 0:
+        raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
+    if n_shards > 1 and chunk_rows % n_shards:
+        chunk_rows = -(-chunk_rows // n_shards) * n_shards
+    per_shard = chunk_rows // max(n_shards, 1)
+
+    d = int(n_features)
+    cpu = _cpu_device()
+
+    # Raw row buffer (≤ one chunk + one incoming block) and finished
+    # chunks.  For the tiled-Pallas path the finished entry is a host
+    # layout (uniformized at the end); for COO it is canonicalized
+    # triples (padded to the global nnz budget at the end); dense chunks
+    # are finished outright.
+    buf_X: list = []
+    buf_y: list = []
+    buf_w: list = []
+    buf_o: list = []
+    buffered = 0
+    finished: list = []
+    vectors: list = []  # (labels, weights, offsets) per chunk, padded
+    n_rows = 0
+    mode = None  # "pallas" | "coo" | "dense", fixed by the first block
+
+    def _decide_mode(first_sparse: bool) -> str:
+        up = use_pallas
+        if up == "auto":
+            up = (
+                first_sparse
+                and jax.default_backend() == "tpu"
+                and n_shards == 1
+            )
+        if up and not first_sparse:
+            raise ValueError("use_pallas=True needs sparse features")
+        if up and n_shards > 1:
+            raise ValueError(
+                "streamed data-parallel chunks use the COO layout; "
+                "pass use_pallas=False with n_shards > 1"
+            )
+        return "pallas" if up else ("coo" if first_sparse else "dense")
+
+    def _finish_chunk(X, y, w, o):
+        """X has exactly ``chunk_rows`` rows (zero rows appended for the
+        final partial chunk; their weights are 0)."""
+        vectors.append((y, w, o))
+        if mode == "pallas":
+            from photon_ml_tpu.ops.sparse_pallas import (
+                build_pallas_matrix,
+                layout_to_host,
+            )
+
+            coo = X.tocoo()
+            ctx = jax.default_device(cpu) if cpu is not None else _nullctx()
+            with ctx:
+                P = build_pallas_matrix(
+                    coo.row.astype(np.int64), coo.col.astype(np.int64),
+                    coo.data.astype(np.float32), chunk_rows, d,
+                    depth_cap=depth_cap, col_permutation=False,
+                )
+            finished.append(layout_to_host(P))
+        elif mode == "coo":
+            shards = []
+            for s in range(max(n_shards, 1)):
+                block = X[s * per_shard:(s + 1) * per_shard]
+                coo = block.tocoo()
+                shards.append(canonicalize_coo(
+                    coo.row, coo.col, coo.data.astype(np.float32),
+                    per_shard, d,
+                ))
+            finished.append(shards)
+        else:
+            dense = np.asarray(X, np.float32)
+            if n_shards == 1:
+                finished.append(DenseMatrix(dense))
+            else:
+                finished.append(
+                    DenseMatrix(dense.reshape(n_shards, per_shard, d))
+                )
+
+    buf_off = 0  # rows of buf_X[0] already consumed by earlier cuts
+
+    def _pop_rows(take: int):
+        """Copy exactly ``take`` rows off the front of the buffer.  A
+        cursor (``buf_off``) walks the straddling first entry instead of
+        re-slicing its tail, so each cut touches one chunk's worth of rows
+        — a single giant input block (the make_streaming_glm_data path) is
+        never re-copied once per chunk."""
+        nonlocal buffered, buf_off
+        Xp, yp, wp, op = [], [], [], []
+        got = 0
+        while got < take:
+            avail = buf_X[0].shape[0] - buf_off
+            use = min(avail, take - got)
+            lo, hi = buf_off, buf_off + use
+            Xp.append(buf_X[0][lo:hi])
+            yp.append(buf_y[0][lo:hi])
+            wp.append(buf_w[0][lo:hi])
+            op.append(buf_o[0][lo:hi])
+            got += use
+            buf_off += use
+            if buf_off == buf_X[0].shape[0]:
+                buf_X.pop(0)
+                buf_y.pop(0)
+                buf_w.pop(0)
+                buf_o.pop(0)
+                buf_off = 0
+        buffered -= take
+        X = (
+            np.vstack(Xp) if mode == "dense"
+            else sp.vstack(Xp).tocsr()
+        )
+        return X, np.concatenate(yp), np.concatenate(wp), np.concatenate(op)
+
+    def _drain(final: bool) -> None:
+        while buffered >= chunk_rows or (final and buffered > 0):
+            take = min(buffered, chunk_rows)
+            Xc, yc, wc, oc = _pop_rows(take)
+            pad = chunk_rows - take
+            if pad:
+                if mode == "dense":
+                    Xc = np.concatenate(
+                        [Xc, np.zeros((pad, d), np.float32)]
+                    )
+                else:
+                    Xc = sp.vstack(
+                        [Xc, sp.csr_matrix((pad, d), dtype=np.float32)]
+                    ).tocsr()
+                yc = np.concatenate([yc, np.zeros(pad, np.float32)])
+                wc = np.concatenate([wc, np.zeros(pad, np.float32)])
+                oc = np.concatenate([oc, np.zeros(pad, np.float32)])
+            _finish_chunk(Xc, yc, wc, oc)
+
+    for block in blocks:
+        X, y = block[0], block[1]
+        m = X.shape[0]
+        w = (
+            np.asarray(block[2], np.float32)
+            if len(block) > 2 and block[2] is not None
+            else np.ones(m, np.float32)
+        )
+        o = (
+            np.asarray(block[3], np.float32)
+            if len(block) > 3 and block[3] is not None
+            else np.zeros(m, np.float32)
+        )
+        if X.shape[1] != d:
+            raise ValueError(
+                f"block has {X.shape[1]} features, expected {d}"
+            )
+        if mode is None:
+            mode = _decide_mode(sp.issparse(X))
+        if mode == "dense":
+            X = X.toarray() if sp.issparse(X) else np.asarray(X, np.float32)
+        else:
+            X = sp.csr_matrix(X) if not sp.issparse(X) else X.tocsr()
+            X.sum_duplicates()
+        buf_X.append(X)
+        buf_y.append(np.asarray(y, np.float32))
+        buf_w.append(w)
+        buf_o.append(o)
+        buffered += m
+        n_rows += m
+        _drain(final=False)
+    if mode is None:
+        raise ValueError("no blocks")
+    _drain(final=True)
+
+    # Finalize: uniform shapes across chunks.
+    chunks = []
+    if mode == "pallas":
+        from photon_ml_tpu.ops.sparse_pallas import uniformize_pallas_layouts
+
+        mats = uniformize_pallas_layouts(finished)
+        for mat, (y, w, o) in zip(mats, vectors):
+            chunks.append(GlmData(mat, y, w, o))
+    elif mode == "coo":
+        budget = max(
+            1,
+            max(len(r) for shards in finished for (r, _, _) in shards),
+        )
+        for shards, (y, w, o) in zip(finished, vectors):
+            padded = [pad_coo_triples(*t, budget) for t in shards]
+            if n_shards == 1:
+                r, c, v = padded[0]
+                feat = SparseMatrix(r, c, v, chunk_rows, d)
+                chunks.append(GlmData(feat, y, w, o))
+            else:
+                feat = SparseMatrix(
+                    np.stack([p[0] for p in padded]),
+                    np.stack([p[1] for p in padded]),
+                    np.stack([p[2] for p in padded]),
+                    per_shard, d,
+                )
+                chunks.append(GlmData(
+                    feat,
+                    y.reshape(n_shards, per_shard),
+                    w.reshape(n_shards, per_shard),
+                    o.reshape(n_shards, per_shard),
+                ))
+    else:
+        for feat, (y, w, o) in zip(finished, vectors):
+            if n_shards == 1:
+                chunks.append(GlmData(feat, y, w, o))
+            else:
+                chunks.append(GlmData(
+                    feat,
+                    y.reshape(n_shards, per_shard),
+                    w.reshape(n_shards, per_shard),
+                    o.reshape(n_shards, per_shard),
+                ))
+
+    return StreamingGlmData(
+        chunks=chunks,
+        n_rows=n_rows,
+        n_features=d,
+        chunk_rows=chunk_rows,
+        n_shards=n_shards,
+    )

@@ -123,12 +123,46 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "whole λ grid with one fused psum per objective evaluation (the "
         "reference's treeAggregate loop on ICI)",
     )
+    p.add_argument(
+        "--precise-accumulation",
+        action="store_true",
+        help="accumulate the objective VALUE in float64 (the reference's "
+        "Breeze f64 end-to-end; here f64 on the value reduction only — "
+        "gradient sums stay f32 tree reductions). At 1e9 rows the f32 "
+        "value rounds at ~1e-7 relative, competing with tight convergence "
+        "tolerances. Costs one emulated-f64 pass per evaluation on TPU",
+    )
+    p.add_argument(
+        "--stream-chunk-rows",
+        type=int,
+        default=0,
+        help="out-of-core training: keep the dataset in host RAM as chunks "
+        "of this many rows and stream them through HBM per objective "
+        "evaluation (double-buffered device_put). 0 = device-resident. "
+        "Datasets larger than HBM train this way; smooth (none/L2) "
+        "regularization only",
+    )
     add_compile_cache_arg(p)
     return p
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
+    # x64 is process-global jax state; restore it afterwards so one
+    # --precise-accumulation run can't leak f64 defaults into later
+    # in-process runs (bench, tests, library users).
+    prev_x64 = None
+    if args.precise_accumulation:
+        prev_x64 = bool(jax.config.jax_enable_x64)
+        jax.config.update("jax_enable_x64", True)
+    try:
+        return _run(args)
+    finally:
+        if prev_x64 is not None:
+            jax.config.update("jax_enable_x64", prev_x64)
+
+
+def _run(args) -> dict:
     os.makedirs(args.output_dir, exist_ok=True)
     logger = PhotonLogger(args.output_dir)
     timer = Timer().start()
@@ -154,9 +188,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
     # Stage 2: summarize + normalization ------------------------------------
     data_parallel = args.data_parallel == "auto" and len(jax.devices()) > 1
-    if data_parallel:
-        # The sharded path uploads the matrix across the mesh; a second
-        # full single-device copy just for summarization would double HBM.
+    streaming = args.stream_chunk_rows > 0
+    if data_parallel or streaming:
+        # The sharded path uploads the matrix across the mesh (and the
+        # streamed path never uploads it whole); a second full
+        # single-device copy just for summarization would defeat both.
         from photon_ml_tpu.data.stats import summarize_host
 
         train_data = None
@@ -203,6 +239,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             compute_variances=args.compute_variances,
         ),
         normalization=normalization,
+        accumulate="f64" if args.precise_accumulation else "f32",
     )
     reg_weights = [float(s) for s in args.reg_weights.split(",")]
     l1_mask = None
@@ -245,7 +282,36 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         logger.info("warm-starting from %s", args.initial_model)
 
     mesh = None
-    if data_parallel:
+    if streaming:
+        from photon_ml_tpu.data.streaming import make_streaming_glm_data
+        from photon_ml_tpu.optim.streaming import (
+            ensure_streamable,
+            streaming_run_grid,
+        )
+
+        # Reject unstreamable configs BEFORE the (possibly large) ingest.
+        ensure_streamable(problem.config)
+        n_shards = 1
+        if data_parallel:
+            from photon_ml_tpu.parallel.distributed import data_mesh
+
+            mesh = data_mesh()
+            n_shards = mesh.devices.size
+        stream = make_streaming_glm_data(
+            X_train, y_train, chunk_rows=args.stream_chunk_rows,
+            use_pallas=False if n_shards > 1 else "auto",
+            n_shards=n_shards,
+        )
+        logger.info(
+            "streaming: %d chunks x %d rows (%.1f MB host), %d shard(s)",
+            stream.n_chunks, stream.chunk_rows,
+            stream.nbytes() / 1e6, n_shards,
+        )
+        grid = streaming_run_grid(
+            problem, stream, reg_weights, w0=w0, mesh=mesh,
+            solved=solved, on_solved=on_solved,
+        )
+    elif data_parallel:
         from photon_ml_tpu.parallel.distributed import (
             data_mesh,
             run_grid_distributed,
@@ -290,7 +356,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         )
     else:
         X_val, y_val = X_train, y_train
-    val_data = None if data_parallel else (
+    host_scoring = data_parallel or streaming
+    val_data = None if host_scoring else (
         make_glm_data(X_val, y_val) if args.validate_data else train_data
     )
 
@@ -298,7 +365,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     best: tuple[float, GeneralizedLinearModel] | None = None
     best_metric = None
     for lam, model, _ in grid:
-        if data_parallel:
+        if host_scoring:
             # Host scipy matvec: validation never needs a device round trip
             # of a full unsharded copy.
             scores = np.asarray(

@@ -230,13 +230,23 @@ def canonicalize_coo(
     rows = rows[order].astype(np.int32)
     cols = cols[order].astype(np.int32)
     vals = vals[order]
+    budget = pad_nnz if pad_nnz is not None else rows.shape[0]
+    return pad_coo_triples(rows, cols, vals, budget)
+
+
+def pad_coo_triples(
+    rows: np.ndarray, cols: np.ndarray, vals: np.ndarray, budget: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad already-canonical (row-sorted) COO triples to a static nnz
+    budget.  THE padding invariant, shared by every builder (device COO,
+    Pallas spill, streaming chunk stores): pad entries carry value 0 and
+    the LAST row id, so the sorted-rows invariant holds and the entries
+    are numerically inert."""
     nnz = rows.shape[0]
-    budget = pad_nnz if pad_nnz is not None else nnz
     if budget < nnz:
         raise ValueError(f"pad_nnz={budget} < actual nnz={nnz}")
     pad = budget - nnz
     if pad:
-        # Pad at the end with the last row id to keep the sorted invariant.
         pad_row = rows[-1] if nnz else 0
         rows = np.concatenate([rows, np.full(pad, pad_row, np.int32)])
         cols = np.concatenate([cols, np.zeros(pad, np.int32)])

@@ -960,3 +960,133 @@ def from_scipy_csr_pallas(csr, depth_cap: int = 128, pad_nnz: Optional[int] = No
         coo.row.astype(np.int64), coo.col.astype(np.int64), coo.data,
         csr.shape[0], csr.shape[1], depth_cap=depth_cap, pad_nnz=pad_nnz,
         dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Streaming support: uniform chunk layouts
+# ---------------------------------------------------------------------------
+
+
+class DroppedHostCoo(HostCoo):
+    """Placeholder for streaming chunks whose host triples were freed.
+
+    Streaming keeps MANY chunk layouts resident in host RAM; the canonical
+    triples would roughly double that footprint for cold paths the trainer
+    never touches.  Shape-class equality/hash (nnz == 0) still works, so jit
+    caches behave; any cold-path use fails loudly instead of returning
+    empty statistics.
+    """
+
+    def __init__(self, n_rows, n_cols):
+        super().__init__(
+            np.zeros(0, np.int32), np.zeros(0, np.int32),
+            np.zeros(0, np.float32), int(n_rows), int(n_cols),
+        )
+
+    def _dropped(self, *args, **kwargs):
+        raise RuntimeError(
+            "host COO triples were dropped for this streaming chunk; "
+            "cold-path statistics (col_nnz / col_min_max / to_dense) are "
+            "unavailable — compute them at ingest time instead"
+        )
+
+    col_nnz = _dropped
+    col_min_max = _dropped
+    to_dense = _dropped
+
+
+def layout_to_host(P: PallasSparseMatrix) -> PallasSparseMatrix:
+    """Pull every array leaf of a layout back to host numpy (streaming
+    chunks live in host RAM and are ``device_put`` per optimizer pass)."""
+    return jax.tree.map(np.asarray, P)
+
+
+def _pad_axis(arr: np.ndarray, axis: int, target: int) -> np.ndarray:
+    cur = arr.shape[axis]
+    if cur == target:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - cur)
+    return np.pad(arr, widths)
+
+
+def uniformize_pallas_layouts(
+    mats: list[PallasSparseMatrix],
+    drop_host_coo: bool = True,
+) -> list[PallasSparseMatrix]:
+    """Pad a list of layouts over the SAME (n_rows, n_cols) shape to one
+    common pytree structure and shape set, so one jitted program serves
+    every chunk of a streamed dataset (out-of-core training — SURVEY.md §7
+    "Host→device ingest bandwidth for 1B rows").
+
+    Chunks differ in packed sublane counts (a_f/a_b), spill size, and dense
+    stripe counts; all are padded to the max across chunks with inert
+    entries (zero values contribute ``g·0 = 0`` in the kernels; zero-value
+    dense stripes and spill entries likewise).  Chunks must be built with
+    ``col_permutation=False`` — per-chunk permutations could not share one
+    compiled program.  All leaves must already be host numpy
+    (:func:`layout_to_host`); padding happens entirely on host.
+    """
+    if not mats:
+        return []
+    m0 = mats[0]
+    for m in mats[1:]:
+        if (m.n_rows, m.n_cols) != (m0.n_rows, m0.n_cols):
+            raise ValueError(
+                f"chunk shape mismatch: {(m.n_rows, m.n_cols)} vs "
+                f"{(m0.n_rows, m0.n_cols)}"
+            )
+    if any(m.has_col_perm for m in mats):
+        raise ValueError(
+            "streaming chunks must be built with col_permutation=False"
+        )
+    a_f = max(m.a_f for m in mats)
+    a_b = max(m.a_b for m in mats)
+    kc = max(m.dense_col_ids.shape[0] for m in mats)
+    kr = max(m.dense_row_ids.shape[0] for m in mats)
+    any_spill = any(m.spill.has_spill for m in mats)
+    spill_budget = max(max(m.spill.spill_coo.nnz for m in mats), 1)
+    depth_f = max(m.depth_f for m in mats)
+    depth_b = max(m.depth_b for m in mats)
+
+    out = []
+    for m in mats:
+        from photon_ml_tpu.ops.sparse import pad_coo_triples
+
+        sc = m.spill.spill_coo
+        rows, cols, vals = pad_coo_triples(
+            np.asarray(sc.row_ids), np.asarray(sc.col_ids),
+            np.asarray(sc.values), spill_budget,
+        )
+        spill = SpillData(
+            spill_coo=SparseMatrix(
+                row_ids=rows, col_ids=cols, values=vals,
+                n_rows=m.n_rows, n_cols=m.n_cols,
+            ),
+            has_spill=any_spill,
+        )
+        host_coo = (
+            DroppedHostCoo(m.n_rows, m.n_cols) if drop_host_coo
+            else m.host_coo
+        )
+        out.append(dataclasses.replace(
+            m,
+            f_code=_pad_axis(np.asarray(m.f_code), 2, a_f),
+            f_val=_pad_axis(np.asarray(m.f_val), 2, a_f),
+            b_code=_pad_axis(np.asarray(m.b_code), 2, a_b),
+            b_val=_pad_axis(np.asarray(m.b_val), 2, a_b),
+            spill=spill,
+            dense_cols=_pad_axis(np.asarray(m.dense_cols), 0, kc),
+            dense_col_ids=_pad_axis(
+                np.asarray(m.dense_col_ids), 0, kc
+            ),
+            dense_rows=_pad_axis(np.asarray(m.dense_rows), 0, kr),
+            dense_row_ids=_pad_axis(
+                np.asarray(m.dense_row_ids), 0, kr
+            ),
+            host_coo=host_coo,
+            a_f=a_f, a_b=a_b, depth_f=depth_f, depth_b=depth_b,
+            has_dense_cols=kc > 0,
+            has_dense_rows=kr > 0,
+        ))
+    return out
