@@ -83,11 +83,45 @@ def _matern52(X1: np.ndarray, X2: np.ndarray, length_scale: float) -> np.ndarray
     return (1.0 + s + s**2 / 3.0) * np.exp(-s)
 
 
+def _chol_lml(
+    X: np.ndarray, y: np.ndarray, length_scale: float, noise: float
+) -> tuple[np.ndarray, np.ndarray, float]:
+    """Cholesky + α + log marginal likelihood for one (ℓ, σ²) setting."""
+    K = _matern52(X, X, length_scale)
+    K[np.diag_indices_from(K)] += noise
+    L = np.linalg.cholesky(K)
+    alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+    lml = (
+        -0.5 * float(y @ alpha)
+        - float(np.sum(np.log(np.diagonal(L))))
+        - 0.5 * len(y) * np.log(2.0 * np.pi)
+    )
+    return L, alpha, lml
+
+
+# Hyperparameter grids for type-II maximum likelihood: inputs are
+# normalized to [0,1]^d, so ℓ spans "nearly white" to "nearly flat", and
+# targets are standardized, so σ² is relative to unit variance.
+_LS_GRID = np.geomspace(0.05, 2.0, 24)
+_NOISE_GRID = np.array([1e-6, 1e-4, 1e-2])
+
+
 class GaussianProcessModel:
     """GP posterior over normalized inputs (the reference's
-    ``GaussianProcessModel``): zero mean, Matérn-5/2, observation noise."""
+    ``GaussianProcessModel``): zero mean, Matérn-5/2, observation noise.
 
-    def __init__(self, length_scale: float = 0.3, noise: float = 1e-6):
+    ``length_scale="fit"`` selects the kernel length scale (and the noise
+    level) by maximizing the log marginal likelihood over a log-spaced
+    grid at each :meth:`fit` — the reference refits its GP kernel the same
+    way per search iteration.  The grid is exact enough in 1-D/3-point
+    noise space and costs ~70 Cholesky factorizations of a ≤tens-point
+    kernel, i.e. nothing next to one real evaluation of the objective."""
+
+    def __init__(self, length_scale: float | str = 0.3, noise: float = 1e-6):
+        if not (length_scale == "fit" or isinstance(length_scale, (int, float))):
+            raise ValueError(
+                f"length_scale must be a float or 'fit', got {length_scale!r}"
+            )
         self.length_scale = length_scale
         self.noise = noise
         self._X: Optional[np.ndarray] = None
@@ -97,22 +131,32 @@ class GaussianProcessModel:
         self._y_mean = float(np.mean(y))
         self._y_std = float(np.std(y)) or 1.0
         self._y = (np.asarray(y, float) - self._y_mean) / self._y_std
-        K = _matern52(self._X, self._X, self.length_scale)
-        K[np.diag_indices_from(K)] += self.noise
-        self._L = np.linalg.cholesky(K)
-        self._alpha = np.linalg.solve(
-            self._L.T, np.linalg.solve(self._L, self._y)
-        )
+        if self.length_scale == "fit":
+            best = None
+            for ls in _LS_GRID:
+                for nz in _NOISE_GRID:
+                    L, alpha, lml = _chol_lml(self._X, self._y, ls, nz)
+                    if best is None or lml > best[0]:
+                        best = (lml, ls, nz, L, alpha)
+            _, self.fitted_length_scale, self.fitted_noise, self._L, \
+                self._alpha = best
+        else:
+            self.fitted_length_scale = float(self.length_scale)
+            self.fitted_noise = self.noise
+            self._L, self._alpha, _ = _chol_lml(
+                self._X, self._y, self.fitted_length_scale, self.fitted_noise
+            )
         return self
 
     def predict(self, X: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Posterior mean and standard deviation at X."""
         X = np.atleast_2d(X)
-        Ks = _matern52(X, self._X, self.length_scale)
+        ls = self.fitted_length_scale
+        Ks = _matern52(X, self._X, ls)
         mean = Ks @ self._alpha
         v = np.linalg.solve(self._L, Ks.T)
         var = np.maximum(
-            _matern52(X, X, self.length_scale).diagonal() - np.sum(v**2, 0),
+            _matern52(X, X, ls).diagonal() - np.sum(v**2, 0),
             1e-12,
         )
         return (
@@ -143,8 +187,11 @@ class GaussianProcessSearch(RandomSearch):
         seed: int = 0,
         n_seed_points: int = 3,
         n_candidates: int = 512,
-        length_scale: float = 0.3,
+        length_scale: float | str = "fit",
     ):
+        """``length_scale="fit"`` (default) re-selects the kernel length
+        scale and noise by marginal likelihood at every GP refit; pass a
+        float to pin them (round-2 behavior was a pinned 0.3)."""
         super().__init__(bounds, log_scale, seed)
         self.n_seed_points = n_seed_points
         self.n_candidates = n_candidates

