@@ -12,24 +12,29 @@
    (read → index → summarize → train λ grid → validate → select → write) on
    an a1a-shaped dataset (BASELINE config 1).
 
-MEASUREMENT METHODOLOGY (fixed in round 2): iterations are chained inside
-ONE jitted ``fori_loop`` and the clock stops only after a small slice of the
-result is read back to host.  Round 1 timed a Python loop closed by
-``jax.block_until_ready``, which on this TPU transport returns before the
-computation finishes unless a host readback has primed the sync path — so
-round 1's number (27-29 M rows/s) measured DISPATCH rate, not compute.  The
-honest round-1 COO throughput, re-measured with this methodology, is
-~0.95 M rows/s; that is the ``real_round1_rows_per_sec`` recorded in
-bench_baseline.json.  ``vs_baseline`` continues to be reported against the
-COMMITTED round-1 number for round-over-round continuity, and is therefore
-a massive *understatement* of the real kernel speedup (~70x).
+MEASUREMENT METHODOLOGY: iterations are chained inside ONE jitted
+``fori_loop`` and the clock stops only after a small slice of the result is
+read back to host (``jax.block_until_ready`` returns before compute
+finishes on this TPU transport — round 1's committed 29.45 M rows/s was a
+dispatch-rate artifact of that; it lives on only in
+bench_baseline.json["history"]).
+
+CROSS-SESSION COMPARISON (round 3): the chip's effective stream rate
+drifts 24-90 GB/s between sessions for identical code, so the PRIMARY
+``vs_baseline`` is bandwidth-normalized — (rows/s ÷ this session's
+``chip_stream_gbps``) over the same quotient recorded in
+bench_baseline.json (round-2 measured numbers, honest methodology).  The
+raw rows/s ratio is still reported as ``extra.vs_baseline_raw``.  GAME CD
+is timed as the median over ``N_REPS`` runs of ≥3 iterations each with a
+spread report; the driver metric reports COLD (fresh compilation cache)
+and WARM (persistent-cache hit) wall seconds separately.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"} —
-the primary metric in the required fields, the other two under "extra" with
-their own vs_baseline ratios.
+the primary metric in the required fields, the other metrics under "extra"
+with their own vs_baseline ratios.
 
 Env knobs: BENCH_SMALL=1 shrinks every workload (CI/smoke); BENCH_ONLY=
-glm|game|driver runs a single section.
+glm|game|driver|stream runs a single section.
 """
 
 import json
@@ -61,9 +66,12 @@ GAME_ENTITIES = 2_000 if SMALL else 100_000
 GAME_FIXED_FEATURES = 512
 GAME_FIXED_NNZ = 8
 GAME_RE_DIM = 8
-GAME_TIMED_ITERS = 1
+GAME_TIMED_ITERS = 3   # iterations per timed run (VERDICT r2: >=3)
+GAME_TIMED_RUNS = 5    # median over this many runs, spread reported
 GAME_BUCKET_GROWTH = 4.0  # consolidate the zipf tail: ~5 compiled shapes
 GAME_ROW_CAP = 128
+
+STREAM_CHUNKS = 4  # streaming A/B: resident vs 4-chunk double-buffered
 
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
@@ -169,7 +177,7 @@ def bench_glm_throughput() -> float:
     return N_ROWS / best
 
 
-def bench_game_cd() -> float:
+def bench_game_cd() -> dict:
     """Full coordinate-descent iterations per second on a MovieLens-shaped
     synthetic: one fixed effect over sparse global features + one per-user
     random effect with a zipf long tail of rows per user."""
@@ -245,17 +253,49 @@ def bench_game_cd() -> float:
     _read_sync(warm.scores["per_user"])
     _log("game: warmup done; timing...")
 
-    best = np.inf
-    for _ in range(2):  # best-of-2 post-warmup: damp chip/run variance
+    # Median over GAME_TIMED_RUNS runs of GAME_TIMED_ITERS iterations each,
+    # with the within-session spread reported (the chip stream rate drifts
+    # even within a session; 1-iteration best-of-2 carried error bars
+    # comparable to round-over-round gains — VERDICT r2).
+    per_iter = []
+    for r in range(GAME_TIMED_RUNS):
         t0 = time.perf_counter()
         result = cd.run(base, n_iterations=GAME_TIMED_ITERS)
         _read_sync(result.scores["per_user"])
-        best = min(best, time.perf_counter() - t0)
-    _log(f"game: {GAME_TIMED_ITERS} iters in {best:.2f}s (best of 2)")
-    return GAME_TIMED_ITERS / best
+        per_iter.append((time.perf_counter() - t0) / GAME_TIMED_ITERS)
+    med = float(np.median(per_iter))
+    spread_pct = 100.0 * (max(per_iter) - min(per_iter)) / med
+    _log(f"game: median {med:.3f}s/iter over {GAME_TIMED_RUNS}x"
+         f"{GAME_TIMED_ITERS} iters (spread {spread_pct:.1f}%)")
+
+    # Per-coordinate breakdown: one manual pass per coordinate with a sync
+    # after each update (the headline number above keeps the production
+    # batched-readback path; this is diagnostic only).
+    states = {c.name: warm.states[c.name] for c in cd.coordinates}
+    scores = dict(warm.scores)
+    total = base
+    for s in scores.values():
+        total = total + s
+    breakdown = {}
+    for coord in cd.coordinates:
+        best_c = np.inf
+        for _ in range(2):
+            offsets = total - scores[coord.name]
+            t0 = time.perf_counter()
+            st = coord.train(offsets, warm_state=states[coord.name])
+            sc = coord.score(st)
+            _read_sync(sc)
+            best_c = min(best_c, time.perf_counter() - t0)
+        breakdown[coord.name] = round(best_c, 3)
+    _log(f"game: per-coordinate seconds {breakdown}")
+    return {
+        "iters_per_sec": 1.0 / med,
+        "spread_pct": round(spread_pct, 1),
+        "coordinate_seconds": breakdown,
+    }
 
 
-def bench_glm_driver() -> float:
+def bench_glm_driver() -> tuple[float, float]:
     """Wall-clock of the full legacy GLM driver on an a1a-shaped dataset
     (1605 train / 2000 validate rows, 123 binary features, 3-point λ grid)."""
     import scipy.sparse as sp
@@ -280,9 +320,17 @@ def bench_glm_driver() -> float:
         val = os.path.join(td, "a1a_shaped.t.libsvm")
         libsvm.write_libsvm(train, X[:n_train], y[:n_train])
         libsvm.write_libsvm(val, X[n_train:], y[n_train:])
-        _log("driver: running glm_driver end to end...")
-        t0 = time.perf_counter()
-        glm_driver.run([
+        # COLD vs WARM are separate metrics (VERDICT r2: the single number
+        # mostly measured compile-cache luck).  Cold runs in-process
+        # against a FRESH persistent-cache dir inside this tempdir (so
+        # neither a developer's ~/.cache nor a prior bench invocation can
+        # pre-warm it).  Warm runs in a FRESH SUBPROCESS with that same
+        # cache dir — a real repeat job: interpreter + import + re-trace
+        # cost paid, only the XLA executables come from the cache.  (A
+        # second in-process run would reuse live jit executables and
+        # understate it.)
+        cache = os.path.join(td, "jax_cache")
+        argv = [
             "--train-data", train,
             "--validate-data", val,
             "--output-dir", os.path.join(td, "out"),
@@ -290,13 +338,146 @@ def bench_glm_driver() -> float:
             "--reg-type", "l2",
             "--reg-weights", "0.1,1.0,10.0",
             "--n-features", str(d),
-            # Measure a COLD run: the persistent compilation cache (driver
-            # default 'auto') would make repeat bench runs on one machine
-            # incomparable with earlier rounds' cold numbers.  (Cache
-            # impact, measured on v5e: 149 s cold -> 9.1 s warm.)
-            "--compile-cache", "off",
-        ])
-        return time.perf_counter() - t0
+            "--compile-cache", cache,
+        ]
+        _log("driver: cold run (fresh compile cache)...")
+        t0 = time.perf_counter()
+        glm_driver.run(argv)
+        cold = time.perf_counter() - t0
+        _log(f"driver: cold {cold:.2f}s; warm run (fresh process, "
+             "cache hit)...")
+        import subprocess
+        import sys as _sys
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        # APPEND to PYTHONPATH: the TPU plugin loads from the existing
+        # entries; replacing the var kills backend init on this host.
+        env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [_sys.executable, "-m", "photon_ml_tpu.drivers.glm_driver",
+                 *argv],
+                env=env, capture_output=True, text=True,
+                # libtpu in the child may BLOCK waiting for the chip the
+                # parent holds instead of failing fast; bound it.
+                timeout=max(600.0, 20.0 * cold),
+            )
+        except subprocess.TimeoutExpired as e:
+            r = subprocess.CompletedProcess(
+                e.cmd, returncode=-1,
+                stdout="", stderr="timed out waiting for the chip",
+            )
+        warm = time.perf_counter() - t0
+        if r.returncode != 0:
+            # Standard libtpu grants EXCLUSIVE chip access per process, so
+            # while this bench process holds the chip a second one cannot
+            # init — fall back to an in-process repeat run there.  It
+            # reuses live jit executables too (slightly flattering), so
+            # the method is logged for the record.
+            err_tail = (
+                r.stderr.strip().splitlines()[-1][:200]
+                if r.stderr.strip() else "(no stderr)"
+            )
+            _log("driver: fresh-process warm run failed (exclusive TPU "
+                 f"access?) — falling back to in-process repeat: {err_tail}")
+            t0 = time.perf_counter()
+            glm_driver.run(argv)
+            warm = time.perf_counter() - t0
+        _log(f"driver: warm {warm:.2f}s")
+        return cold, warm
+
+
+def bench_streaming() -> dict:
+    """Out-of-core A/B: the streamed objective pass (host chunks,
+    double-buffered device_put — data/streaming.py) vs the device-resident
+    pass on the SAME data, timed identically (host loop per pass, readback
+    sync).  The VERDICT r2 acceptance bar is streamed ≥ 0.75x resident."""
+    import jax
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data.dataset import make_glm_data
+    from photon_ml_tpu.data.streaming import make_streaming_glm_data
+    from photon_ml_tpu.optim.objective import GlmObjective
+    from photon_ml_tpu.optim.streaming import StreamingObjective
+    from photon_ml_tpu.ops import losses
+
+    # Calibrate host→device FIRST and size the workload from it: each
+    # streamed pass re-transfers the whole chunk store, and on the
+    # tunneled dev chip h2d runs at ~5-10 MB/s (vs ~25 GB/s PCIe on
+    # production v5e hosts) — a fixed-size A/B would either starve real
+    # hardware or spend 10+ bench minutes measuring the tunnel.  Budget:
+    # ~15 s of transfer per streamed pass, reported so the ratio is
+    # interpretable anywhere.
+    blob = np.ones(32 << 20, np.uint8)
+    dev = jax.device_put(blob)  # warmup: backend init / first-call cost
+    np.asarray(dev[0:1])
+    del dev
+    t0 = time.perf_counter()
+    dev = jax.device_put(blob)
+    np.asarray(dev[0:1])
+    h2d_gbps = blob.nbytes / (time.perf_counter() - t0) / 1e9
+    del dev, blob
+    bytes_per_row = NNZ_PER_ROW * 16  # measured ~500 B/row incl. layout pad
+    n = int(min(N_ROWS, max(1 << 14, 15.0 * h2d_gbps * 1e9 / bytes_per_row)))
+    _log(f"stream: h2d {h2d_gbps:.3f} GB/s -> {n} rows")
+
+    rng = np.random.default_rng(5)
+    nnz = n * NNZ_PER_ROW
+    rows = np.repeat(np.arange(n, dtype=np.int64), NNZ_PER_ROW)
+    cols = rng.integers(0, N_FEATURES, size=nnz).astype(np.int64)
+    values = rng.normal(size=nnz).astype(np.float32)
+    X = sp.coo_matrix((values, (rows, cols)), shape=(n, N_FEATURES)).tocsr()
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+
+    _log(f"stream: building {STREAM_CHUNKS}-chunk store + resident copy...")
+    use_pallas = jax.default_backend() == "tpu"
+    stream = make_streaming_glm_data(
+        X, y, chunk_rows=-(-n // STREAM_CHUNKS), use_pallas=use_pallas
+    )
+    sobj = StreamingObjective("logistic", stream)
+    data = make_glm_data(X, y, use_pallas=use_pallas)
+    obj = GlmObjective(losses.logistic)
+    w = jnp.zeros(N_FEATURES, jnp.float32)
+
+    # Fairness: the resident side is ONE jitted program (data as an
+    # argument, never a closure constant), exactly like the streamed
+    # side's jitted per-chunk program — otherwise eager dispatch overhead
+    # inflates t_res and flatters the ratio.
+    res_fn = jax.jit(
+        lambda w, data: obj.value_and_grad(w, data, l2_weight=1.0)
+    )
+
+    # Warm both (compile) with a readback.
+    _v, g = res_fn(w, data)
+    _read_sync(g)
+    _v, g = sobj.value_and_grad(w, 1.0)
+    _read_sync(g)
+
+    def timed(fn, reps=3):
+        best = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _val, grad = fn()
+            _read_sync(grad)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_res = timed(lambda: res_fn(w, data))
+    t_str = timed(lambda: sobj.value_and_grad(w, 1.0))
+
+    _log(f"stream: resident {n / t_res / 1e6:.1f} M rows/s, "
+         f"streamed {n / t_str / 1e6:.1f} M rows/s "
+         f"(ratio {t_res / t_str:.3f}, h2d {h2d_gbps:.3f} GB/s)")
+    return {
+        "stream_rows_per_sec": round(n / t_str, 1),
+        "stream_rows": n,
+        "resident_rows_per_sec": round(n / t_res, 1),
+        "stream_vs_resident": round(t_res / t_str, 4),
+        "h2d_gbps": round(h2d_gbps, 3),
+    }
 
 
 def main() -> None:
@@ -312,20 +493,35 @@ def main() -> None:
         return round(base / value if smaller_is_better else value / base, 4)
 
     extra = {}
+    chip_gbps = None
     try:
-        extra["chip_stream_gbps"] = round(bench_chip_stream(), 1)
+        chip_gbps = bench_chip_stream()
+        extra["chip_stream_gbps"] = round(chip_gbps, 1)
     except Exception as e:  # calibration must never sink the bench
         extra["chip_stream_gbps"] = f"failed: {e}"
     if ONLY in ("", "game"):
-        v = bench_game_cd()
-        extra["game_cd_iters_per_sec"] = round(v, 3)
-        extra["game_cd_vs_baseline"] = ratio(v, "game_cd_iters_per_sec")
-    if ONLY in ("", "driver"):
-        v = bench_glm_driver()
-        extra["glm_driver_wall_seconds"] = round(v, 2)
-        extra["glm_driver_vs_baseline"] = ratio(
-            v, "glm_driver_wall_seconds", smaller_is_better=True
+        g = bench_game_cd()
+        extra["game_cd_iters_per_sec"] = round(g["iters_per_sec"], 3)
+        extra["game_cd_spread_pct"] = g["spread_pct"]
+        extra["game_cd_coordinate_seconds"] = g["coordinate_seconds"]
+        extra["game_cd_vs_baseline"] = ratio(
+            g["iters_per_sec"], "game_cd_iters_per_sec"
         )
+    if ONLY in ("", "driver"):
+        cold, warm = bench_glm_driver()
+        extra["glm_driver_wall_seconds_cold"] = round(cold, 2)
+        extra["glm_driver_wall_seconds_warm"] = round(warm, 2)
+        extra["glm_driver_cold_vs_baseline"] = ratio(
+            cold, "glm_driver_wall_seconds_cold", smaller_is_better=True
+        )
+        extra["glm_driver_warm_vs_baseline"] = ratio(
+            warm, "glm_driver_wall_seconds_warm", smaller_is_better=True
+        )
+    if ONLY in ("", "stream"):
+        try:
+            extra.update(bench_streaming())
+        except Exception as e:  # new section: never sink the headline
+            extra["stream_rows_per_sec"] = f"failed: {e}"
     out = {
         "metric": "logistic_glm_rows_per_sec",
         "unit": "rows/s",
@@ -334,7 +530,26 @@ def main() -> None:
     if ONLY in ("", "glm"):
         rows_per_sec = bench_glm_throughput()
         out["value"] = round(rows_per_sec, 1)
-        out["vs_baseline"] = ratio(rows_per_sec, "logistic_glm_rows_per_sec")
+        # PRIMARY comparison: bandwidth-normalized (rows/s per GB/s of the
+        # same-session stream calibration) vs the round-2 recorded quotient
+        # — the chip drifts 24-90 GB/s between sessions (bench_baseline
+        # "normalization_note").  Raw ratio kept as extra.vs_baseline_raw.
+        base_per_gbps = baseline.get("logistic_glm_rows_per_sec_per_gbps")
+        if chip_gbps and base_per_gbps:
+            out["vs_baseline"] = round(
+                (rows_per_sec / chip_gbps) / base_per_gbps, 4
+            )
+            extra["rows_per_sec_per_gbps"] = round(
+                rows_per_sec / chip_gbps, 1
+            )
+            extra["vs_baseline_raw"] = ratio(
+                rows_per_sec, "logistic_glm_rows_per_sec"
+            )
+        else:
+            out["vs_baseline"] = ratio(
+                rows_per_sec, "logistic_glm_rows_per_sec"
+            )
+            out["note"] = "chip calibration unavailable; raw rows/s ratio"
     else:
         # Debug-only partial run: never report a fake 0.0 regression.
         out["value"] = None
