@@ -349,11 +349,19 @@ def bench_glm_driver() -> tuple[float, float]:
         import subprocess
         import sys as _sys
 
+        import jax
+
         repo = os.path.dirname(os.path.abspath(__file__))
         env = dict(os.environ)
         # APPEND to PYTHONPATH: the TPU plugin loads from the existing
         # entries; replacing the var kills backend init on this host.
         env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+        # Pin the child to the parent's backend: without this, a child
+        # that cannot init the TPU (exclusive access) would silently fall
+        # back to CPU with returncode 0 and report a bogus warm number.
+        # Pinned, the failure is hard and the in-process fallback below
+        # takes over instead.
+        env["JAX_PLATFORMS"] = jax.default_backend()
         t0 = time.perf_counter()
         try:
             r = subprocess.run(
@@ -386,6 +394,12 @@ def bench_glm_driver() -> tuple[float, float]:
             glm_driver.run(argv)
             warm = time.perf_counter() - t0
         _log(f"driver: warm {warm:.2f}s")
+        # The driver enabled the persistent compile cache at the tempdir
+        # path process-wide; switch it off so later bench sections don't
+        # serialize compilations into an orphaned /tmp path.
+        from photon_ml_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache("off")
         return cold, warm
 
 

@@ -19,7 +19,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from photon_ml_tpu.ops.sparse_pallas import (
-    OBITS, TILE_C, WIN, WIN_SHIFT, WINS, build_pallas_matrix)
+    CODE_MASK, OBITS, TILE_C, WIN, WIN_SHIFT, WINS, build_pallas_matrix)
 
 N, D, K = 1 << 20, 1 << 13, 32
 R = 10
@@ -28,9 +28,10 @@ R = 10
 def make_kernel(mode, a):
     def kernel(code_ref, val_ref, tab_ref, out_ref):
         code = code_ref[0].astype(jnp.int32)
-        lo = code & (WIN - 1)
-        ohi = (code >> 7) & ((1 << OBITS) - 1)
-        win = code[:, 0:1] >> WIN_SHIFT
+        fields = code & CODE_MASK  # empty slots carry the EMPTY sign bit
+        lo = fields & (WIN - 1)
+        ohi = (fields >> 7) & ((1 << OBITS) - 1)
+        win = fields[:, 0:1] >> WIN_SHIFT
         v = val_ref[0]
         if mode == "dma":
             contrib = v

@@ -98,6 +98,13 @@ WIN_SHIFT = 7 + OBITS
 _CODE_BITS = 7 + 2 * OBITS
 CODE_DTYPE = np.int16 if _CODE_BITS <= 15 else np.int32
 CODE_BYTES = 2 if _CODE_BITS <= 15 else 4
+# Empty slots carry the code dtype's SIGN bit (win bits preserved — the
+# kernel still reads lane 0's window id through CODE_MASK).  This lets the
+# unit-value layout drop the f32 val stream entirely: validity is
+# ``code >= 0``, cutting slot DMA 6 → 2 bytes on binary feature matrices
+# (the reference's canonical case — a1a features, one-hot GAME features).
+CODE_MASK = (1 << _CODE_BITS) - 1
+EMPTY_MARK = np.iinfo(CODE_DTYPE).min
 # Sublane-count granularity: the int16 slot arrays tile as (16, 128) on TPU,
 # so A is padded to a multiple of 16 (8 would re-pad internally).
 SUBPAD = 16
@@ -174,7 +181,7 @@ def _build_orientation(
 
     if len(rows) == 0:  # all-zero / empty matrix: one empty sublane group
         return (
-            np.zeros((nbr, nbc, SUBPAD, WIN), CODE_DTYPE),
+            np.full((nbr, nbc, SUBPAD, WIN), EMPTY_MARK, CODE_DTYPE),
             np.zeros((nbr, nbc, SUBPAD, WIN), np.float32),
             np.empty(0, np.intp),
             SUBPAD,
@@ -246,7 +253,11 @@ def _build_orientation(
         np.tile(np.arange(WINS, dtype=CODE_DTYPE), nt), need.ravel()
     )
     code = np.empty((nt, a, WIN), CODE_DTYPE)
-    code[:] = (winid << np.array(WIN_SHIFT, CODE_DTYPE))[:, :, None]
+    # Empty slots: window id in the high FIELD bits + the EMPTY sign bit.
+    code[:] = (
+        (winid << np.array(WIN_SHIFT, CODE_DTYPE))
+        | np.array(EMPTY_MARK, CODE_DTYPE)
+    )[:, :, None]
     val = np.zeros((nt, a, WIN), np.float32)
 
     t_s = cell // (WINS * WIN)
@@ -255,8 +266,10 @@ def _build_orientation(
     kt = t_s[keep]
     kl = l_s[keep]
     sub = base[kt, g_s[keep]] + depth_pos[keep]
-    code[kt, sub, kl] |= (
-        (ohi[order][keep] << 7) | glo[order][keep]
+    # Filled slots: full positive code (sign bit clear).  The window id of
+    # slot (kt, sub) is g_s by construction (sub lies in window g's run).
+    code[kt, sub, kl] = (
+        (g_s[keep] << WIN_SHIFT) | (ohi[order][keep] << 7) | glo[order][keep]
     ).astype(CODE_DTYPE)
     val[kt, sub, kl] = vals[order][keep]
 
@@ -270,16 +283,18 @@ def _build_orientation(
 # ---------------------------------------------------------------------------
 
 
-def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, square,
-                 batch, chunk):
+def _tile_kernel(*refs, square, batch, chunk, unit):
     """A (batch x chunk) rectangle of tiles per grid step.
 
     Batching many tiles per step keeps DMAs large (MBs, not hundreds of KB)
     so the stream stays bandwidth-bound instead of per-step-overhead-bound
     (measured: 2048 one-tile steps cost ~5 us each — more than the data).
 
-    code: (batch, chunk, A, 128) packed (win<<WIN_SHIFT | ohi<<7 | lo)
-    val:  (batch, chunk, A, 128) f32
+    code: (batch, chunk, A, 128) packed (win<<WIN_SHIFT | ohi<<7 | lo);
+          empty slots carry EMPTY_MARK's sign bit (win bits preserved)
+    val:  (batch, chunk, A, 128) f32 — ABSENT in ``unit`` mode: binary
+          matrices (every tiled value 1.0) stream codes only, 3x less
+          DMA on a bandwidth-bound kernel; validity is ``code >= 0``
     tab:  (chunk, WINS, 128) gather-side vector windows for this chunk
     out:  (batch, WINS, 128), accumulated across the chunked grid dim
 
@@ -292,6 +307,12 @@ def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, square,
     """
     from jax.experimental import pallas as pl
 
+    if unit:
+        code_ref, tab_ref, out_ref = refs
+        val_ref = None
+    else:
+        code_ref, val_ref, tab_ref, out_ref = refs
+
     @pl.when(pl.program_id(1) == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
@@ -300,9 +321,13 @@ def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, square,
         b = t // chunk
         j = t % chunk
         code = code_ref[b, j].astype(jnp.int32)
-        lo = code & (WIN - 1)
-        ohi = (code >> 7) & ((1 << OBITS) - 1)
-        win = code[:, 0:1] >> WIN_SHIFT                       # (A, 1)
+        # Field bits through CODE_MASK: empty slots are sign-marked, and
+        # int16→int32 sign extension would otherwise corrupt the window
+        # id read from a lane-0-empty sublane.
+        fields = code & CODE_MASK
+        lo = fields & (WIN - 1)
+        ohi = (fields >> 7) & ((1 << OBITS) - 1)
+        win = fields[:, 0:1] >> WIN_SHIFT                     # (A, 1)
         a = code.shape[0]
 
         # Per-sublane tables by masked selection over the WINS windows —
@@ -320,16 +345,23 @@ def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, square,
             0, WINS, w_body, jnp.zeros((a, WIN), jnp.float32)
         )                                                     # (A, 128)
         g = jnp.take_along_axis(tables, lo, axis=1)           # (A, 128)
-        v = val_ref[b, j]
-        if square:
-            contrib = v * v * g
+        if unit:
+            # Unit values: v = v² = 1 for every real slot; empty slots
+            # (sign bit set) must contribute EXACT zero even when their
+            # placeholder gather hits a non-finite vector entry.
+            contrib = jnp.where(code >= 0, g, 0.0)
         else:
-            contrib = v * g
-        # Empty slots (v == 0; zero-valued entries are excluded at build
-        # time) must contribute EXACT zero even when their placeholder
-        # gather (lo = 0) hits a non-finite vector entry — 0 * inf = NaN
-        # would otherwise leak into output window 0 of unrelated rows.
-        contrib = jnp.where(v != 0.0, contrib, 0.0)
+            v = val_ref[b, j]
+            if square:
+                contrib = v * v * g
+            else:
+                contrib = v * g
+            # Empty slots (v == 0; zero-valued entries are excluded at
+            # build time) must contribute EXACT zero even when their
+            # placeholder gather (lo = 0) hits a non-finite vector entry
+            # — 0 * inf = NaN would otherwise leak into output window 0
+            # of unrelated rows.
+            contrib = jnp.where(v != 0.0, contrib, 0.0)
 
         def h_body(h, _):
             part = jnp.sum(jnp.where(ohi == h, contrib, 0.0), axis=0)
@@ -343,11 +375,12 @@ def _tile_kernel(code_ref, val_ref, tab_ref, out_ref, *, square,
 
 
 def _pick_rect(nbo: int, nbg: int, a: int,
-               budget: int = None) -> tuple[int, int]:
+               budget: int = None, unit: bool = False) -> tuple[int, int]:
     """(batch, chunk) tiles per grid step fitting ~``budget`` input bytes."""
     if budget is None:
         budget = DMA_BUDGET
-    per_tile = a * WIN * (CODE_BYTES + 4)  # packed code + f32 val
+    # packed code (+ f32 val unless the unit-value layout dropped it)
+    per_tile = a * WIN * (CODE_BYTES + (0 if unit else 4))
     cap = max(1, budget // per_tile)
 
     def largest_divisor_leq(n, m):
@@ -361,40 +394,49 @@ def _pick_rect(nbo: int, nbg: int, a: int,
     return batch, chunk
 
 
-@functools.partial(jax.jit, static_argnames=("nbo", "nbg", "square"))
-def _tiled_apply(code, val, vec_padded, *, nbo, nbg, square):
+@functools.partial(jax.jit, static_argnames=("nbo", "nbg", "square", "unit"))
+def _tiled_apply(code, val, vec_padded, *, nbo, nbg, square, unit=False):
     """out[i] = sum over entries (i, j, v) of v * vec[j] (+ optional v²).
 
     ``code``/``val``: (nbo, nbg, A, 128); ``vec_padded``: (nbg * TILE_C,).
     Returns (nbo * TILE_R,) output.  The packed sublane count A comes from
-    the array shape (jit already specializes on it).
+    the array shape (jit already specializes on it).  ``unit``: the
+    binary-matrix layout — ``val`` is ignored (pass the placeholder) and
+    only codes stream through the kernel.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     a = code.shape[2]
-    batch, chunk = _pick_rect(nbo, nbg, a)
+    batch, chunk = _pick_rect(nbo, nbg, a, unit=unit)
     tab = vec_padded.reshape(nbg, WINS, WIN)
     kernel = functools.partial(_tile_kernel, square=square,
-                               batch=batch, chunk=chunk)
+                               batch=batch, chunk=chunk, unit=unit)
+    slot_spec = pl.BlockSpec(
+        (batch, chunk, a, WIN), lambda i, j: (i, j, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    in_specs = [slot_spec]
+    operands = [code]
+    if not unit:
+        in_specs.append(slot_spec)
+        operands.append(val)
+    in_specs.append(
+        pl.BlockSpec((chunk, WINS, WIN), lambda i, j: (j, 0, 0),
+                     memory_space=pltpu.VMEM)
+    )
+    operands.append(tab)
     out = pl.pallas_call(
         kernel,
         grid=(nbo // batch, nbg // chunk),
         out_shape=jax.ShapeDtypeStruct((nbo, WINS, WIN), jnp.float32),
-        in_specs=[
-            pl.BlockSpec((batch, chunk, a, WIN), lambda i, j: (i, j, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((batch, chunk, a, WIN), lambda i, j: (i, j, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((chunk, WINS, WIN), lambda i, j: (j, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((batch, WINS, WIN), lambda i, j: (i, 0, 0),
                                memory_space=pltpu.VMEM),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=_interpret(),
-    )(code, val, tab)
+    )(*operands)
     # out[i, h, l] = output element i*TILE_R + h*128 + l
     return out.reshape(nbo * TILE_R)
 
@@ -505,7 +547,7 @@ class HostCoo:
     meta_fields=[
         "host_coo",
         "n_rows", "n_cols", "nbr", "nbc", "a_f", "a_b", "depth_f", "depth_b",
-        "has_dense_cols", "has_dense_rows", "has_col_perm",
+        "has_dense_cols", "has_dense_rows", "has_col_perm", "unit_vals",
     ],
 )
 @dataclasses.dataclass
@@ -562,6 +604,11 @@ class PallasSparseMatrix:
     has_dense_cols: bool
     has_dense_rows: bool
     has_col_perm: bool
+    # Binary-matrix fast path: every TILED value is 1.0, so the f32 val
+    # arrays are 1-element placeholders and the kernels stream codes only
+    # (3x less slot DMA); validity rides the codes' EMPTY sign bit.
+    # Dense stripes and the spill keep true values either way.
+    unit_vals: bool = False
 
     # -- shape protocol ----------------------------------------------------
     @property
@@ -595,7 +642,7 @@ class PallasSparseMatrix:
     def matvec(self, w: Array) -> Array:
         out = _tiled_apply(
             self.f_code, self.f_val, self._pad_cols(w),
-            nbo=self.nbr, nbg=self.nbc, square=False,
+            nbo=self.nbr, nbg=self.nbc, square=False, unit=self.unit_vals,
         )[: self.n_rows]
         out = out + self.spill.matvec(w)
         if self.has_dense_cols:
@@ -608,7 +655,7 @@ class PallasSparseMatrix:
     def rmatvec(self, u: Array) -> Array:
         out = self._uncols(_tiled_apply(
             self.b_code, self.b_val, self._pad_rows(u),
-            nbo=self.nbc, nbg=self.nbr, square=False,
+            nbo=self.nbc, nbg=self.nbr, square=False, unit=self.unit_vals,
         ))
         out = out + self.spill.rmatvec(u)
         if self.has_dense_cols:
@@ -621,7 +668,7 @@ class PallasSparseMatrix:
     def row_sq_matvec(self, v: Array) -> Array:
         out = _tiled_apply(
             self.f_code, self.f_val, self._pad_cols(v),
-            nbo=self.nbr, nbg=self.nbc, square=True,
+            nbo=self.nbr, nbg=self.nbc, square=True, unit=self.unit_vals,
         )[: self.n_rows]
         out = out + self.spill.row_sq_matvec(v)
         if self.has_dense_cols:
@@ -636,7 +683,7 @@ class PallasSparseMatrix:
     def sq_rmatvec(self, u: Array) -> Array:
         out = self._uncols(_tiled_apply(
             self.b_code, self.b_val, self._pad_rows(u),
-            nbo=self.nbc, nbg=self.nbr, square=True,
+            nbo=self.nbc, nbg=self.nbr, square=True, unit=self.unit_vals,
         ))
         out = out + self.spill.sq_rmatvec(u)
         if self.has_dense_cols:
@@ -800,6 +847,7 @@ def build_pallas_matrix(
     max_dense: int = 64,
     dense_budget_bytes: int = 512 << 20,
     col_permutation: bool = True,
+    unit_values: bool | str = "auto",
 ) -> PallasSparseMatrix:
     """Build the tiled layout from host COO triples.
 
@@ -930,6 +978,23 @@ def build_pallas_matrix(
         perm_fwd = jnp.zeros((1,), jnp.int32)
         perm_inv = jnp.zeros((1,), jnp.int32)
 
+    # Binary-matrix fast path: when every TILED value is 1.0 (dense
+    # stripes and spill keep their true values), drop the f32 val stream —
+    # the kernels then move 2 bytes/slot instead of 6 ("auto"; False
+    # forces the valued layout, e.g. for A/B measurement).
+    tiled_vals = v[keep] if spilled.size else v
+    unit = (
+        unit_values == "auto"
+        and (tiled_vals.size == 0 or bool(np.all(tiled_vals == 1.0)))
+    ) or unit_values is True
+    if unit_values is True and tiled_vals.size and not np.all(
+        tiled_vals == 1.0
+    ):
+        raise ValueError("unit_values=True but tiled values are not all 1.0")
+    if unit:
+        f_val = np.zeros((1,), np.float32)
+        b_val = np.zeros((1,), np.float32)
+
     return PallasSparseMatrix(
         f_code=jnp.asarray(f_code), f_val=jnp.asarray(f_val),
         b_code=jnp.asarray(b_code), b_val=jnp.asarray(b_val),
@@ -948,6 +1013,7 @@ def build_pallas_matrix(
         has_dense_cols=bool(dense_col_ids.size),
         has_dense_rows=bool(dense_row_ids.size),
         has_col_perm=col_perm is not None,
+        unit_vals=unit,
     )
 
 
@@ -1001,13 +1067,18 @@ def layout_to_host(P: PallasSparseMatrix) -> PallasSparseMatrix:
     return jax.tree.map(np.asarray, P)
 
 
-def _pad_axis(arr: np.ndarray, axis: int, target: int) -> np.ndarray:
+def _pad_axis(
+    arr: np.ndarray, axis: int, target: int, constant_values=0
+) -> np.ndarray:
+    """Zero-pad by default; slot-CODE arrays must pass
+    ``constant_values=EMPTY_MARK`` — an all-zero code pad reads as a VALID
+    slot (win 0, ohi 0, lo 0) under the unit-value layout."""
     cur = arr.shape[axis]
     if cur == target:
         return arr
     widths = [(0, 0)] * arr.ndim
     widths[axis] = (0, target - cur)
-    return np.pad(arr, widths)
+    return np.pad(arr, widths, constant_values=constant_values)
 
 
 def uniformize_pallas_layouts(
@@ -1049,6 +1120,20 @@ def uniformize_pallas_layouts(
     depth_f = max(m.depth_f for m in mats)
     depth_b = max(m.depth_b for m in mats)
 
+    # unit_vals must be uniform (it is pytree meta).  A mixed set keeps the
+    # valued layout: unit chunks materialize val = 1.0 at valid slots.
+    all_unit = all(m.unit_vals for m in mats)
+    if not all_unit:
+        mats = [
+            dataclasses.replace(
+                m,
+                f_val=(np.asarray(m.f_code) >= 0).astype(np.float32),
+                b_val=(np.asarray(m.b_code) >= 0).astype(np.float32),
+                unit_vals=False,
+            ) if m.unit_vals else m
+            for m in mats
+        ]
+
     out = []
     for m in mats:
         from photon_ml_tpu.ops.sparse import pad_coo_triples
@@ -1071,10 +1156,18 @@ def uniformize_pallas_layouts(
         )
         out.append(dataclasses.replace(
             m,
-            f_code=_pad_axis(np.asarray(m.f_code), 2, a_f),
-            f_val=_pad_axis(np.asarray(m.f_val), 2, a_f),
-            b_code=_pad_axis(np.asarray(m.b_code), 2, a_b),
-            b_val=_pad_axis(np.asarray(m.b_val), 2, a_b),
+            f_code=_pad_axis(np.asarray(m.f_code), 2, a_f,
+                             constant_values=EMPTY_MARK),
+            f_val=(
+                np.asarray(m.f_val) if all_unit
+                else _pad_axis(np.asarray(m.f_val), 2, a_f)
+            ),
+            b_code=_pad_axis(np.asarray(m.b_code), 2, a_b,
+                             constant_values=EMPTY_MARK),
+            b_val=(
+                np.asarray(m.b_val) if all_unit
+                else _pad_axis(np.asarray(m.b_val), 2, a_b)
+            ),
             spill=spill,
             dense_cols=_pad_axis(np.asarray(m.dense_cols), 0, kc),
             dense_col_ids=_pad_axis(

@@ -378,3 +378,130 @@ print("OK")
                            capture_output=True, text=True, timeout=300)
         assert r.returncode == 0, r.stderr
         assert "OK" in r.stdout
+
+
+class TestUnitValueLayout:
+    """Binary matrices drop the f32 val stream (codes only, 3x less DMA);
+    validity rides the codes' EMPTY sign bit.  Numerics must stay exact."""
+
+    def _binary_problem(self, rng, n, d, nnz, bias=True):
+        # UNIQUE coordinates: duplicate (row, col) pairs canonicalize by
+        # summing to 2.0, which correctly disables the unit layout.
+        flat = rng.choice(n * (d - 1), size=nnz, replace=False)
+        rows = (flat // (d - 1)).astype(np.int64)
+        cols = (flat % (d - 1) + 1).astype(np.int64)  # keep col 0 for bias
+        if bias:  # dense stripe: kept VALUED even in unit mode
+            rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+            cols = np.concatenate([cols, np.zeros(n, np.int64)])
+        vals = np.ones(len(rows), np.float32)
+        return rows, cols, vals
+
+    @pytest.mark.parametrize("n,d,nnz", [(5000, 3000, 40000), (300, 4100, 20000)])
+    def test_all_four_ops_match_coo(self, rng, n, d, nnz):
+        rows, cols, vals = self._binary_problem(rng, n, d, nnz)
+        P = build_pallas_matrix(rows, cols, vals, n, d, depth_cap=32)
+        assert P.unit_vals
+        assert P.f_val.size == 1 and P.b_val.size == 1  # placeholders
+        C = from_coo(rows, cols, vals, n, d)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        u = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        assert _rel(P.matvec(w), C.matvec(w)) < 1e-5
+        assert _rel(P.rmatvec(u), C.rmatvec(u)) < 1e-5
+        assert _rel(P.row_sq_matvec(w * w), C.row_sq_matvec(w * w)) < 1e-5
+        assert _rel(P.sq_rmatvec(u * u), C.sq_rmatvec(u * u)) < 1e-5
+
+    def test_non_binary_values_keep_valued_layout(self, rng):
+        rows, cols, _ = self._binary_problem(rng, 1000, 500, 5000, bias=False)
+        vals = rng.normal(size=len(rows)).astype(np.float32)
+        P = build_pallas_matrix(rows, cols, vals, 1000, 500)
+        assert not P.unit_vals
+
+    def test_unit_values_forced_off(self, rng):
+        rows, cols, vals = self._binary_problem(rng, 1000, 500, 5000)
+        P = build_pallas_matrix(
+            rows, cols, vals, 1000, 500, unit_values=False
+        )
+        assert not P.unit_vals
+        C = from_coo(rows, cols, vals, 1000, 500)
+        w = jnp.asarray(rng.normal(size=500).astype(np.float32))
+        assert _rel(P.matvec(w), C.matvec(w)) < 1e-5
+
+    def test_forced_on_with_nonunit_values_rejected(self, rng):
+        rows, cols, _ = self._binary_problem(rng, 500, 300, 2000, bias=False)
+        vals = rng.normal(size=len(rows)).astype(np.float32)
+        with pytest.raises(ValueError, match="unit_values"):
+            build_pallas_matrix(
+                rows, cols, vals, 500, 300, unit_values=True
+            )
+
+    def test_nonfinite_vector_stays_localized_unit_mode(self, rng):
+        """An inf in w must only reach rows that actually touch that
+        column — empty slots (sign-marked) must contribute exact zero even
+        though there is no val array to mask with."""
+        n, d = 2000, 1500
+        rows, cols, vals = self._binary_problem(
+            rng, n, d, 8000, bias=False
+        )
+        P = build_pallas_matrix(rows, cols, vals, n, d, depth_cap=32)
+        assert P.unit_vals
+        bad_col = 777
+        w = np.ones(d, np.float32)
+        w[bad_col] = np.inf
+        out = np.asarray(P.matvec(jnp.asarray(w)))
+        touches = np.zeros(n, bool)
+        touches[rows[cols == bad_col]] = True
+        assert np.all(np.isinf(out[touches]) | np.isnan(out[touches]))
+        assert np.all(np.isfinite(out[~touches]))
+
+    def test_mixed_unit_chunks_uniformize(self, rng):
+        """Streaming: a binary chunk next to a weighted chunk falls back to
+        the valued layout with materialized 1.0 values — parity holds."""
+        from photon_ml_tpu.ops.sparse_pallas import (
+            layout_to_host,
+            uniformize_pallas_layouts,
+        )
+
+        n, d = 1500, 800
+        r1, c1, v1 = self._binary_problem(rng, n, d, 6000, bias=False)
+        r2 = rng.integers(0, n, size=5000).astype(np.int64)
+        c2 = rng.integers(0, d, size=5000).astype(np.int64)
+        v2 = rng.normal(size=5000).astype(np.float32)
+        m1 = build_pallas_matrix(r1, c1, v1, n, d, col_permutation=False)
+        m2 = build_pallas_matrix(r2, c2, v2, n, d, col_permutation=False)
+        assert m1.unit_vals and not m2.unit_vals
+        uni = uniformize_pallas_layouts(
+            [layout_to_host(m1), layout_to_host(m2)]
+        )
+        assert not uni[0].unit_vals and not uni[1].unit_vals
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        import jax as _jax
+
+        fn = _jax.jit(lambda P, w: P.matvec(w))
+        for U, (r, c, v) in zip(uni, [(r1, c1, v1), (r2, c2, v2)]):
+            C = from_coo(r, c, v, n, d)
+            assert _rel(fn(_jax.device_put(U), w), C.matvec(w)) < 1e-5
+
+    def test_all_unit_chunks_stay_unit(self, rng):
+        from photon_ml_tpu.ops.sparse_pallas import (
+            layout_to_host,
+            uniformize_pallas_layouts,
+        )
+
+        n, d = 1200, 600
+        mats, oracles = [], []
+        for k in range(3):
+            r, c, v = self._binary_problem(
+                rng, n, d, 3000 + 2000 * k, bias=False
+            )
+            mats.append(layout_to_host(build_pallas_matrix(
+                r, c, v, n, d, col_permutation=False
+            )))
+            oracles.append(from_coo(r, c, v, n, d))
+        uni = uniformize_pallas_layouts(mats)
+        assert all(m.unit_vals for m in uni)
+        import jax as _jax
+
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        fn = _jax.jit(lambda P, w: P.matvec(w))
+        for U, C in zip(uni, oracles):
+            assert _rel(fn(_jax.device_put(U), w), C.matvec(w)) < 1e-5
