@@ -160,6 +160,23 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "entity axis (random effects) over a mesh of all devices — the "
         "reference's Spark-cluster layout on ICI",
     )
+    p.add_argument(
+        "--max-retries",
+        type=int,
+        default=0,
+        help="automatic recovery from TRANSIENT failures (lost device, "
+        "transport drop): re-enter training up to this many times. The "
+        "single-config path resumes from the per-iteration CD checkpoint; "
+        "a config GRID has no checkpoint and restarts the whole grid fit "
+        "on retry. 0 disables",
+    )
+    p.add_argument(
+        "--retry-backoff",
+        type=float,
+        default=5.0,
+        help="initial seconds between retries (exponential, x2 per "
+        "attempt, capped at 300s)",
+    )
     add_compile_cache_arg(p)
     return p
 
@@ -377,11 +394,20 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         task, coordinate_configs, n_iterations=n_cd_iterations, logger=logger,
         mesh=mesh,
     )
+    from photon_ml_tpu.utils.watchdog import RetryPolicy, run_with_retries
+
+    retry_policy = RetryPolicy(
+        max_retries=args.max_retries, backoff_seconds=args.retry_backoff
+    )
     if len(config_grid) > 1:
         # Config-grid fit with validation-driven selection (SURVEY.md §3.2).
-        model, grid_results = estimator.fit_grid(
-            config_grid, shards, ids, response, weight=weight, offset=offset,
-            validation=val_tuple, suite=suite, initial_model=initial_model,
+        model, grid_results = run_with_retries(
+            lambda attempt: estimator.fit_grid(
+                config_grid, shards, ids, response, weight=weight,
+                offset=offset, validation=val_tuple, suite=suite,
+                initial_model=initial_model,
+            ),
+            retry_policy, logger,
         )
         best = next(r for r in grid_results if r["best"])
         history = best["history"]
@@ -403,10 +429,15 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             best["metric"],
         )
     else:
-        model, history = estimator.fit(
-            shards, ids, response, weight=weight, offset=offset,
-            validation=val_tuple, suite=suite,
-            initial_model=initial_model, checkpointer=checkpointer,
+        # A retry resumes from the per-iteration CD checkpoint (the
+        # CoordinateDescent loop reloads it on entry — SURVEY.md §5.3).
+        model, history = run_with_retries(
+            lambda attempt: estimator.fit(
+                shards, ids, response, weight=weight, offset=offset,
+                validation=val_tuple, suite=suite,
+                initial_model=initial_model, checkpointer=checkpointer,
+            ),
+            retry_policy, logger,
         )
     result["history"] = history
     result["train_metric"] = history[-1].get("train_metric") if history else None

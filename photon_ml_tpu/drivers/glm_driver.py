@@ -124,6 +124,23 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "reference's treeAggregate loop on ICI)",
     )
     p.add_argument(
+        "--max-retries",
+        type=int,
+        default=0,
+        help="automatic recovery from TRANSIENT failures (lost device, "
+        "transport drop, preemption): re-enter training up to this many "
+        "times, resuming from the λ-grid checkpoint so finished work is "
+        "never repeated (the Spark cluster manager's task-retry analogue). "
+        "0 disables",
+    )
+    p.add_argument(
+        "--retry-backoff",
+        type=float,
+        default=5.0,
+        help="initial seconds between retries (exponential, x2 per "
+        "attempt, capped at 300s)",
+    )
+    p.add_argument(
         "--precise-accumulation",
         action="store_true",
         help="accumulate the objective VALUE in float64 (the reference's "
@@ -265,11 +282,6 @@ def _run(args) -> dict:
             "resuming: %d of %d grid points already solved",
             len(solved), len(reg_weights),
         )
-    solved_acc = dict(solved)
-
-    def on_solved(lam, w):
-        solved_acc[lam] = np.asarray(w)
-        ckpt.save(solved_acc)
 
     w0 = None
     if args.initial_model:
@@ -282,12 +294,10 @@ def _run(args) -> dict:
         logger.info("warm-starting from %s", args.initial_model)
 
     mesh = None
+    stream = None
     if streaming:
         from photon_ml_tpu.data.streaming import make_streaming_glm_data
-        from photon_ml_tpu.optim.streaming import (
-            ensure_streamable,
-            streaming_run_grid,
-        )
+        from photon_ml_tpu.optim.streaming import ensure_streamable
 
         # Reject unstreamable configs BEFORE the (possibly large) ingest.
         ensure_streamable(problem.config)
@@ -307,29 +317,67 @@ def _run(args) -> dict:
             stream.n_chunks, stream.chunk_rows,
             stream.nbytes() / 1e6, n_shards,
         )
-        grid = streaming_run_grid(
-            problem, stream, reg_weights, w0=w0, mesh=mesh,
-            solved=solved, on_solved=on_solved,
-        )
     elif data_parallel:
-        from photon_ml_tpu.parallel.distributed import (
-            data_mesh,
-            run_grid_distributed,
-            shard_glm_data,
-        )
+        from photon_ml_tpu.parallel.distributed import data_mesh
 
         mesh = data_mesh()
         logger.info("data-parallel: %d-device mesh", len(jax.devices()))
-        dist = shard_glm_data(X_train, y_train, mesh)
-        grid = run_grid_distributed(
-            problem, dist, mesh, reg_weights, w0=w0, l1_mask=l1_mask,
-            solved=solved, on_solved=on_solved,
+
+    def train(attempt: int):
+        """One training attempt over the λ grid.  Re-entered by the
+        watchdog after a transient failure (SURVEY.md §5.3): checkpointed
+        λs are reloaded so finished work is never repeated, and device-
+        resident data is re-placed (a lost device invalidates buffers)."""
+        solved_now = dict(solved)
+        if attempt:
+            solved_now.update(ckpt.load())
+            logger.info(
+                "retry %d: %d grid points restored from checkpoints",
+                attempt, len(solved_now),
+            )
+        solved_acc = dict(solved_now)
+
+        def on_solved(lam, w):
+            solved_acc[lam] = np.asarray(w)
+            ckpt.save(solved_acc)
+
+        if streaming:
+            from photon_ml_tpu.optim.streaming import streaming_run_grid
+
+            # Chunks are host-resident numpy; nothing to re-place.
+            return streaming_run_grid(
+                problem, stream, reg_weights, w0=w0, mesh=mesh,
+                solved=solved_now, on_solved=on_solved,
+            )
+        if data_parallel:
+            from photon_ml_tpu.parallel.distributed import (
+                run_grid_distributed,
+                shard_glm_data,
+            )
+
+            dist = shard_glm_data(X_train, y_train, mesh)
+            return run_grid_distributed(
+                problem, dist, mesh, reg_weights, w0=w0, l1_mask=l1_mask,
+                solved=solved_now, on_solved=on_solved,
+            )
+        data = train_data if attempt == 0 else make_glm_data(
+            X_train, y_train
         )
-    else:
-        grid = problem.run_grid(
-            train_data, reg_weights, w0=w0, l1_mask=l1_mask,
-            solved=solved, on_solved=on_solved,
+        return problem.run_grid(
+            data, reg_weights, w0=w0, l1_mask=l1_mask,
+            solved=solved_now, on_solved=on_solved,
         )
+
+    from photon_ml_tpu.utils.watchdog import RetryPolicy, run_with_retries
+
+    grid = run_with_retries(
+        train,
+        RetryPolicy(
+            max_retries=args.max_retries,
+            backoff_seconds=args.retry_backoff,
+        ),
+        logger,
+    )
     for lam, _, res in grid:
         if res is None:
             logger.info("lambda=%g: restored from checkpoint", lam)

@@ -1,0 +1,127 @@
+"""Transient-failure watchdog: bounded retries around long training runs.
+
+The reference gets elastic recovery for free from Spark's cluster manager
+(failed tasks re-run on other executors — SURVEY.md §5.3).  A TPU driver
+is one process talking to devices over a transport that can drop
+(preemption, coordinator restart, network): the idiomatic SPMD recovery is
+checkpoint + resume, which both drivers already persist per solved λ /
+per CD iteration (io/checkpoint.py).  This module supplies the missing
+AUTOMATIC piece: classify an exception as transient, back off, and re-run
+the training closure — which reloads the checkpoint and continues where
+the crashed attempt stopped, so a retry never repeats finished work.
+
+Classification is by exception type name + message patterns rather than
+imports: the concrete error type for a lost device is
+``jaxlib.xla_extension.XlaRuntimeError`` with a gRPC-style status prefix
+("UNAVAILABLE: Socket closed", "DEADLINE_EXCEEDED", ...), and importing
+jaxlib internals just to isinstance them is brittle across versions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+
+# gRPC-ish status markers + transport phrases that indicate the RUN may
+# succeed on retry.  Deliberately NOT included: RESOURCE_EXHAUSTED /
+# out-of-memory (a retry recomputes the same allocation and dies again)
+# and INVALID_ARGUMENT-style programming errors.
+_TRANSIENT_PATTERNS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "CANCELLED",
+    "INTERNAL",
+    "socket closed",
+    "connection reset",
+    "connection refused",
+    "transport",
+    "device lost",
+    "heartbeat",
+    "preempted",
+)
+
+# Status markers that mean a retry will deterministically fail again —
+# they VETO the XlaRuntimeError type-name fallback below.
+_NON_TRANSIENT_PATTERNS = (
+    "RESOURCE_EXHAUSTED",
+    "out of memory",
+    "INVALID_ARGUMENT",
+    "FAILED_PRECONDITION",
+    "NOT_FOUND",
+    "UNIMPLEMENTED",
+)
+
+_TRANSIENT_TYPE_NAMES = ("XlaRuntimeError",)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """How a long run reacts to transient failures.
+
+    ``max_retries=0`` disables the watchdog (failures propagate, exactly
+    the pre-watchdog behavior).  Backoff is exponential:
+    ``backoff_seconds * multiplier**attempt``, capped at ``max_backoff``.
+    """
+
+    max_retries: int = 0
+    backoff_seconds: float = 5.0
+    backoff_multiplier: float = 2.0
+    max_backoff_seconds: float = 300.0
+    extra_patterns: Sequence[str] = ()
+
+    def is_transient(self, exc: BaseException) -> bool:
+        msg = str(exc).lower()
+        # Deterministic-failure markers veto everything, including the
+        # type-name fallback: an XlaRuntimeError carrying
+        # RESOURCE_EXHAUSTED re-runs the same allocation and dies again.
+        if any(p.lower() in msg for p in _NON_TRANSIENT_PATTERNS):
+            return False
+        patterns = tuple(_TRANSIENT_PATTERNS) + tuple(
+            p.lower() for p in self.extra_patterns
+        )
+        if any(p.lower() in msg for p in patterns):
+            return True
+        return type(exc).__name__ in _TRANSIENT_TYPE_NAMES
+
+    def backoff(self, attempt: int) -> float:
+        return min(
+            self.backoff_seconds * self.backoff_multiplier**attempt,
+            self.max_backoff_seconds,
+        )
+
+
+def run_with_retries(
+    fn: Callable[[int], T],
+    policy: RetryPolicy,
+    logger=None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Run ``fn(attempt)`` until it returns, retrying transient failures.
+
+    ``fn`` receives the attempt number (0 = first try) and MUST re-read
+    its checkpoint state each call — that is what makes a retry resume
+    instead of restart (the drivers' closures reload the grid / CD
+    checkpointers).  Non-transient exceptions and exhausted budgets
+    propagate unchanged.
+    """
+    attempt = 0
+    while True:
+        try:
+            return fn(attempt)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if attempt >= policy.max_retries or not policy.is_transient(exc):
+                raise
+            delay = policy.backoff(attempt)
+            if logger is not None:
+                logger.warning(
+                    "transient failure (attempt %d/%d), retrying in %.1fs: "
+                    "%s: %s",
+                    attempt + 1, policy.max_retries, delay,
+                    type(exc).__name__, exc,
+                )
+            sleep(delay)
+            attempt += 1

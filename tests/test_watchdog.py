@@ -1,0 +1,310 @@
+"""Failure/elastic recovery (SURVEY.md §5.3): the transient-failure
+watchdog and its driver integration.
+
+The reference's elastic recovery is Spark's cluster manager re-running
+failed tasks; the TPU analogue is checkpoint + automatic resume.  The
+driver tests here kill training MID-GRID with a transport-shaped error
+and assert the retry completes from the checkpoint without repeating
+finished λs."""
+
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.utils.watchdog import RetryPolicy, run_with_retries
+
+
+class _FakeLogger:
+    def __init__(self):
+        self.warnings = []
+
+    def warning(self, msg, *args):
+        self.warnings.append(msg % args if args else msg)
+
+    def info(self, *a, **k):
+        pass
+
+
+class TestRetryPolicy:
+    def test_transient_classification(self):
+        p = RetryPolicy(max_retries=3)
+        assert p.is_transient(RuntimeError("UNAVAILABLE: Socket closed"))
+        assert p.is_transient(RuntimeError("DEADLINE_EXCEEDED: timed out"))
+        assert p.is_transient(OSError("connection reset by peer"))
+        assert not p.is_transient(ValueError("bad shape"))
+        assert not p.is_transient(
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        )
+
+    def test_type_name_classification(self):
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        assert RetryPolicy().is_transient(XlaRuntimeError("whatever"))
+
+    def test_extra_patterns(self):
+        p = RetryPolicy(extra_patterns=("my-cluster-oops",))
+        assert p.is_transient(RuntimeError("MY-CLUSTER-OOPS happened"))
+
+    def test_backoff_exponential_capped(self):
+        p = RetryPolicy(backoff_seconds=2.0, backoff_multiplier=3.0,
+                        max_backoff_seconds=10.0)
+        assert p.backoff(0) == 2.0
+        assert p.backoff(1) == 6.0
+        assert p.backoff(2) == 10.0  # capped
+
+
+class TestRunWithRetries:
+    def test_retries_then_succeeds(self):
+        calls = []
+        slept = []
+
+        def fn(attempt):
+            calls.append(attempt)
+            if attempt < 2:
+                raise RuntimeError("UNAVAILABLE: transport lost")
+            return "ok"
+
+        log = _FakeLogger()
+        out = run_with_retries(
+            fn, RetryPolicy(max_retries=3, backoff_seconds=0.01),
+            log, sleep=slept.append,
+        )
+        assert out == "ok"
+        assert calls == [0, 1, 2]
+        assert len(slept) == 2
+        assert len(log.warnings) == 2
+
+    def test_budget_exhausted_raises(self):
+        def fn(attempt):
+            raise RuntimeError("UNAVAILABLE: still down")
+
+        with pytest.raises(RuntimeError, match="still down"):
+            run_with_retries(
+                fn, RetryPolicy(max_retries=2, backoff_seconds=0),
+                sleep=lambda s: None,
+            )
+
+    def test_non_transient_propagates_immediately(self):
+        calls = []
+
+        def fn(attempt):
+            calls.append(attempt)
+            raise ValueError("programming error")
+
+        with pytest.raises(ValueError):
+            run_with_retries(
+                fn, RetryPolicy(max_retries=5), sleep=lambda s: None
+            )
+        assert calls == [0]
+
+    def test_disabled_by_default(self):
+        def fn(attempt):
+            raise RuntimeError("UNAVAILABLE")
+
+        with pytest.raises(RuntimeError):
+            run_with_retries(fn, RetryPolicy(), sleep=lambda s: None)
+
+
+class TestGlmDriverRecovery:
+    def test_mid_grid_crash_resumes_from_checkpoint(
+        self, tmp_path, monkeypatch, rng
+    ):
+        """Kill the run after the FIRST λ checkpoints; --max-retries must
+        finish the grid with the first λ restored, matching an
+        uninterrupted run's models."""
+        from photon_ml_tpu.data import libsvm
+        from photon_ml_tpu.drivers import glm_driver
+        from photon_ml_tpu.optim.problem import GlmOptimizationProblem
+
+        n, d = 400, 60
+        X = sp.random(n, d, density=0.1, random_state=1, format="csr")
+        X.data[:] = 1.0
+        w_true = rng.normal(size=d) * (rng.uniform(size=d) < 0.4)
+        y = np.where(
+            rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true))), 1.0, -1.0
+        )
+        train = str(tmp_path / "t.libsvm")
+        libsvm.write_libsvm(train, X, y)
+        common = [
+            "--train-data", train,
+            "--task", "logistic",
+            "--reg-type", "l2",
+            "--reg-weights", "0.5,5.0",
+            "--n-features", str(d),
+        ]
+
+        out_ok = str(tmp_path / "ok")
+        res_ok = glm_driver.run(common + ["--output-dir", out_ok])
+
+        orig = GlmOptimizationProblem.run_grid
+        state = {"attempts": 0, "solves_before_crash": []}
+
+        def flaky_run_grid(self, data, reg_weights, **kw):
+            state["attempts"] += 1
+            if state["attempts"] == 1:
+                inner = kw.get("on_solved")
+
+                def dying_on_solved(lam, w):
+                    inner(lam, w)  # persist the checkpoint FIRST
+                    state["solves_before_crash"].append(lam)
+                    raise RuntimeError(
+                        "UNAVAILABLE: TPU transport lost (induced)"
+                    )
+
+                kw["on_solved"] = dying_on_solved
+            return orig(self, data, reg_weights, **kw)
+
+        monkeypatch.setattr(
+            GlmOptimizationProblem, "run_grid", flaky_run_grid
+        )
+        out = str(tmp_path / "recovered")
+        res = glm_driver.run(common + [
+            "--output-dir", out, "--max-retries", "2",
+            "--retry-backoff", "0.01",
+        ])
+        # Crashed once after λ=5.0 (grid solves big-to-small), retried,
+        # and did NOT re-solve the checkpointed λ.
+        assert state["attempts"] == 2
+        assert state["solves_before_crash"] == [5.0]
+        assert res["best_lambda"] == res_ok["best_lambda"]
+        for lam in ("0.5", "5.0"):
+            assert res["metrics"][lam] == pytest.approx(
+                res_ok["metrics"][lam], abs=1e-6
+            )
+
+    def test_non_transient_failure_still_fatal(
+        self, tmp_path, monkeypatch, rng
+    ):
+        from photon_ml_tpu.data import libsvm
+        from photon_ml_tpu.drivers import glm_driver
+        from photon_ml_tpu.optim.problem import GlmOptimizationProblem
+
+        n, d = 100, 10
+        X = sp.random(n, d, density=0.3, random_state=2, format="csr")
+        y = np.where(rng.uniform(size=n) < 0.5, 1.0, -1.0)
+        train = str(tmp_path / "t.libsvm")
+        libsvm.write_libsvm(train, X, y)
+
+        def broken(self, *a, **k):
+            raise ValueError("genuinely broken config")
+
+        monkeypatch.setattr(GlmOptimizationProblem, "run_grid", broken)
+        with pytest.raises(ValueError, match="genuinely broken"):
+            glm_driver.run([
+                "--train-data", train,
+                "--output-dir", str(tmp_path / "out"),
+                "--task", "logistic",
+                "--n-features", str(d),
+                "--max-retries", "5",
+                "--retry-backoff", "0.01",
+            ])
+
+
+class TestGameDriverRecovery:
+    def test_cd_crash_resumes_per_iteration(self, tmp_path, monkeypatch):
+        """Crash the GAME fit after iteration 0 checkpoints; the retry must
+        resume at iteration 1 (not restart) and produce a model."""
+        import json
+
+        from photon_ml_tpu.drivers import game_training_driver
+        from photon_ml_tpu.game import descent as descent_mod
+        from photon_ml_tpu.data.game_reader import write_game_avro
+
+        rng = np.random.default_rng(5)
+        n = 300
+        records = [
+            {
+                "uid": f"row{i}",
+                "response": float(rng.integers(2)),
+                "weight": None,
+                "offset": None,
+                "ids": {"userId": f"u{rng.integers(20)}"},
+                "features": {
+                    "global": [
+                        {"name": f"g{j}", "term": "",
+                         "value": float(rng.normal())}
+                        for j in range(3)
+                    ],
+                    "userFeatures": [
+                        {"name": "bias", "term": "", "value": 1.0}
+                    ],
+                },
+            }
+            for i in range(n)
+        ]
+        train = str(tmp_path / "game.avro")
+        write_game_avro(train, records)
+        config = {
+            "task": "logistic",
+            "iterations": 2,
+            "coordinates": [
+                {"name": "fixed", "type": "fixed",
+                 "feature_shard": "global", "reg_type": "l2",
+                 "reg_weight": 1.0, "max_iters": 5},
+                {"name": "per_user", "type": "random",
+                 "feature_shard": "userFeatures", "entity_key": "userId",
+                 "reg_type": "l2", "reg_weight": 1.0, "max_iters": 5},
+            ],
+        }
+        cfg_path = str(tmp_path / "cfg.json")
+        with open(cfg_path, "w") as f:
+            json.dump(config, f)
+
+        orig_run = descent_mod.CoordinateDescent.run
+        state = {"calls": 0, "resumed_from": None}
+
+        def flaky_run(self, base_offsets, n_iterations=1, eval_fn=None,
+                      logger=None, checkpointer=None, initial_states=None):
+            state["calls"] += 1
+            if state["calls"] == 1:
+                # First attempt: run ONE iteration (checkpointing), then
+                # die as the transport would.
+                orig_run(
+                    self, base_offsets, n_iterations=1, eval_fn=eval_fn,
+                    logger=logger, checkpointer=checkpointer,
+                    initial_states=initial_states,
+                )
+                raise RuntimeError("UNAVAILABLE: device lost (induced)")
+            saved = checkpointer.load() if checkpointer else None
+            state["resumed_from"] = (
+                saved["iteration"] if saved is not None else None
+            )
+            return orig_run(
+                self, base_offsets, n_iterations=n_iterations,
+                eval_fn=eval_fn, logger=logger, checkpointer=checkpointer,
+                initial_states=initial_states,
+            )
+
+        monkeypatch.setattr(
+            descent_mod.CoordinateDescent, "run", flaky_run
+        )
+        out = str(tmp_path / "out")
+        result = game_training_driver.run([
+            "--train-data", train,
+            "--config", cfg_path,
+            "--output-dir", out,
+            "--max-retries", "1",
+            "--retry-backoff", "0.01",
+        ])
+        assert state["calls"] == 2
+        assert state["resumed_from"] == 0  # resumed AFTER iteration 0
+        assert os.path.isdir(os.path.join(out, "models"))
+        assert result["history"]
+
+
+class TestTypeNameVeto:
+    def test_xla_error_with_oom_status_not_retried(self):
+        """RESOURCE_EXHAUSTED inside an XlaRuntimeError must veto the
+        type-name fallback — a retry re-runs the same allocation."""
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        p = RetryPolicy(max_retries=3)
+        assert not p.is_transient(
+            XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating")
+        )
+        assert not p.is_transient(
+            XlaRuntimeError("INVALID_ARGUMENT: shape mismatch")
+        )
+        # ...but a genuinely transient status still retries.
+        assert p.is_transient(XlaRuntimeError("UNAVAILABLE: Socket closed"))
+        assert p.is_transient(XlaRuntimeError("unrecognized plugin error"))
