@@ -1,0 +1,13 @@
+from photon_ml_tpu.diagnostics.report import (
+    TrainingReport,
+    bootstrap_metric_ci,
+    feature_importance,
+    hosmer_lemeshow,
+)
+
+__all__ = [
+    "TrainingReport",
+    "bootstrap_metric_ci",
+    "feature_importance",
+    "hosmer_lemeshow",
+]

@@ -124,6 +124,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "reference's treeAggregate loop on ICI)",
     )
     p.add_argument(
+        "--training-report",
+        action="store_true",
+        help="write report.json + report.html to the output dir: "
+        "per-lambda convergence traces, bootstrap CIs on the validation "
+        "metric, Hosmer-Lemeshow calibration (logistic), and "
+        "|coef|*std feature importance (the reference's old diagnostics "
+        "package, SURVEY.md 5.1)",
+    )
+    p.add_argument(
         "--max-retries",
         type=int,
         default=0,
@@ -409,10 +418,27 @@ def _run(args) -> dict:
         make_glm_data(X_val, y_val) if args.validate_data else train_data
     )
 
+    report = None
+    if args.training_report:
+        from photon_ml_tpu.diagnostics import (
+            TrainingReport,
+            bootstrap_metric_ci,
+            feature_importance,
+            hosmer_lemeshow,
+        )
+
+        report = TrainingReport(task=problem.task)
+        # Loop-invariant report inputs (d can be millions; the λ loop
+        # must not rebuild them per grid point).
+        report_names = [index_map.index_to_name(j) for j in range(d)]
+        report_std = np.sqrt(
+            np.maximum(np.asarray(summary.variance), 0.0)
+        )
+
     metrics = {}
     best: tuple[float, GeneralizedLinearModel] | None = None
     best_metric = None
-    for lam, model, _ in grid:
+    for lam, model, res in grid:
         if host_scoring:
             # Host scipy matvec: validation never needs a device round trip
             # of a full unsharded copy.
@@ -428,6 +454,25 @@ def _run(args) -> dict:
         logger.info("lambda=%g: %s=%.6f", lam, type(evaluator).__name__, m)
         if best_metric is None or evaluator.better_than(m, best_metric):
             best_metric, best = m, (lam, model)
+        if report is not None:
+            if res is not None:
+                report.add_convergence(lam, res.values, res.grad_norms)
+            report.add_metric(
+                type(evaluator).__name__, lam,
+                bootstrap_metric_ci(
+                    lambda s, l: evaluator.evaluate(s, l, None),
+                    scores, np.asarray(y_val),
+                ),
+            )
+            if problem.task == "logistic":
+                report.add_calibration(
+                    lam, hosmer_lemeshow(scores, np.asarray(y_val))
+                )
+            report.add_importance(lam, feature_importance(
+                np.asarray(model.coefficients.means),
+                feature_std=report_std,
+                names=report_names,
+            ))
 
     # Stage 5: write --------------------------------------------------------
     assert best is not None
@@ -447,6 +492,10 @@ def _run(args) -> dict:
         "n_features": int(d),
         "wall_seconds": timer.stop(),
     }
+    if report is not None:
+        jpath, hpath = report.save(args.output_dir)
+        result["report"] = {"json": jpath, "html": hpath}
+        logger.info("training report: %s", hpath)
     with open(os.path.join(args.output_dir, "training_result.json"), "w") as f:
         json.dump(result, f, indent=2)
     logger.info(

@@ -1,0 +1,175 @@
+"""Training diagnostics report (SURVEY.md §5.1's removed-upstream
+diagnostics package, rebuilt): Hosmer-Lemeshow, bootstrap CIs, feature
+importance, and the driver's report artifacts."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.diagnostics import (
+    TrainingReport,
+    bootstrap_metric_ci,
+    feature_importance,
+    hosmer_lemeshow,
+)
+
+
+class TestHosmerLemeshow:
+    def test_calibrated_model_passes(self, rng):
+        n = 20000
+        p = rng.uniform(0.05, 0.95, size=n)
+        y = (rng.uniform(size=n) < p).astype(np.float64)
+        hl = hosmer_lemeshow(p, y, scores_are_margins=False)
+        assert hl["p_value"] > 0.01  # well calibrated -> not rejected
+        assert len(hl["table"]) == 10
+
+    def test_miscalibrated_model_fails(self, rng):
+        n = 20000
+        p = rng.uniform(0.05, 0.95, size=n)
+        # True rates systematically squashed toward 0.5 vs predictions.
+        true_p = 0.5 + 0.3 * (p - 0.5)
+        y = (rng.uniform(size=n) < true_p).astype(np.float64)
+        hl = hosmer_lemeshow(p, y, scores_are_margins=False)
+        assert hl["p_value"] < 1e-4
+        assert hl["statistic"] > hosmer_lemeshow(
+            p, (rng.uniform(size=n) < p).astype(np.float64),
+            scores_are_margins=False,
+        )["statistic"]
+
+    def test_margins_squashed_by_default(self, rng):
+        n = 20000
+        m = rng.normal(size=n) * 2.0  # raw margins (the driver's input)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-m))).astype(np.float64)
+        hl = hosmer_lemeshow(m, y)
+        assert 0.0 <= hl["table"][0]["mean_predicted"] <= 1.0
+        assert hl["p_value"] > 0.01  # calibrated by construction
+
+    def test_margins_in_unit_interval_still_squashed(self, rng):
+        """A regularized model's margins can all fall inside [0,1]; the
+        explicit flag (not range detection) must still apply the link."""
+        n = 20000
+        m = rng.uniform(0.0, 1.0, size=n)  # margins that LOOK like probs
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-m))).astype(np.float64)
+        hl = hosmer_lemeshow(m, y)  # default: margins
+        assert hl["p_value"] > 0.01  # correctly squashed -> calibrated
+        # treated as probabilities instead, calibration is rejected
+        wrong = hosmer_lemeshow(m, y, scores_are_margins=False)
+        assert wrong["p_value"] < 1e-6
+
+    def test_probability_range_validated(self):
+        with pytest.raises(ValueError, match="outside"):
+            hosmer_lemeshow(
+                np.array([-0.5, 0.5, 2.0]), np.array([0.0, 1.0, 1.0]),
+                scores_are_margins=False,
+            )
+
+
+class TestBootstrapCI:
+    def test_ci_covers_point_and_tightens_with_n(self, rng):
+        from sklearn.metrics import roc_auc_score
+
+        def auc(s, l):
+            return roc_auc_score(l, s)
+
+        def make(n):
+            m = rng.normal(size=n) + 1.0
+            y = (rng.uniform(size=n) < 1 / (1 + np.exp(-m))).astype(float)
+            return m, y
+
+        s_small, y_small = make(300)
+        s_big, y_big = make(10000)
+        ci_small = bootstrap_metric_ci(auc, s_small, y_small, n_boot=100)
+        ci_big = bootstrap_metric_ci(auc, s_big, y_big, n_boot=100)
+        for ci in (ci_small, ci_big):
+            assert ci["lo"] <= ci["point"] <= ci["hi"]
+            assert ci["n_boot"] > 50
+        assert (ci_big["hi"] - ci_big["lo"]) < (
+            ci_small["hi"] - ci_small["lo"]
+        )
+
+    def test_degenerate_resamples_skipped(self):
+        # 2 rows, one per class: many resamples are single-class and the
+        # metric raises; the CI must still come back.
+        from sklearn.metrics import roc_auc_score
+
+        ci = bootstrap_metric_ci(
+            lambda s, l: roc_auc_score(l, s),
+            np.array([0.1, 0.9]), np.array([0.0, 1.0]), n_boot=50,
+        )
+        assert ci["point"] == 1.0
+
+
+class TestFeatureImportance:
+    def test_ranking_uses_std(self):
+        w = np.array([1.0, 1.0, 0.0])
+        std = np.array([0.1, 10.0, 5.0])
+        top = feature_importance(w, std, names=["a", "b", "c"])
+        assert top[0]["feature"] == "b"
+        assert [t["feature"] for t in top] == ["b", "a"]  # zero-coef dropped
+
+    def test_top_k(self, rng):
+        w = rng.normal(size=100)
+        top = feature_importance(w, top_k=7)
+        assert len(top) == 7
+        imps = [t["importance"] for t in top]
+        assert imps == sorted(imps, reverse=True)
+
+
+class TestReportArtifacts:
+    def test_report_roundtrip(self, tmp_path, rng):
+        r = TrainingReport(task="logistic")
+        r.add_convergence(1.0, [10.0, 5.0, 4.0, np.nan], [3.0, 1.0, 0.1])
+        r.add_metric("AUC", 1.0, {"point": 0.8, "lo": 0.75, "hi": 0.85,
+                                  "n_boot": 100})
+        r.add_calibration(1.0, hosmer_lemeshow(
+            rng.uniform(size=500), (rng.uniform(size=500) < 0.5).astype(float)
+        ))
+        r.add_importance(1.0, [{"feature": "f<0>", "coefficient": 1.0,
+                                "importance": 2.0}])
+        jpath, hpath = r.save(str(tmp_path))
+        data = json.load(open(jpath))
+        assert data["task"] == "logistic"
+        assert [s["kind"] for s in data["sections"]] == [
+            "convergence", "metric", "calibration", "feature_importance",
+        ]
+        html = open(hpath).read()
+        assert "Hosmer" in html and "AUC" in html
+        assert "f&lt;0&gt;" in html  # names are escaped
+        assert "<svg" in html  # convergence sparkline
+
+    def test_driver_writes_report(self, tmp_path, rng):
+        from photon_ml_tpu.data import libsvm
+        from photon_ml_tpu.drivers import glm_driver
+
+        n, d = 500, 40
+        X = sp.random(n, d, density=0.15, random_state=3, format="csr")
+        X.data[:] = 1.0
+        w_true = rng.normal(size=d) * (rng.uniform(size=d) < 0.4)
+        y = np.where(
+            rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true))), 1.0, -1.0
+        )
+        train = str(tmp_path / "t.libsvm")
+        libsvm.write_libsvm(train, X, y)
+        out = str(tmp_path / "out")
+        result = glm_driver.run([
+            "--train-data", train,
+            "--output-dir", out,
+            "--task", "logistic",
+            "--reg-type", "l2",
+            "--reg-weights", "0.5,5.0",
+            "--n-features", str(d),
+            "--training-report",
+        ])
+        assert os.path.exists(os.path.join(out, "report.json"))
+        assert os.path.exists(os.path.join(out, "report.html"))
+        rep = json.load(open(os.path.join(out, "report.json")))
+        kinds = [s["kind"] for s in rep["sections"]]
+        # Per lambda: convergence + metric + calibration + importance.
+        assert kinds.count("convergence") == 2
+        assert kinds.count("calibration") == 2
+        metric = next(s for s in rep["sections"] if s["kind"] == "metric")
+        assert metric["lo"] <= metric["point"] <= metric["hi"]
+        assert "report" in result
