@@ -139,9 +139,14 @@ def feature_importance(
     feature_std: Optional[np.ndarray] = None,
     names: Optional[Sequence[str]] = None,
     top_k: int = 25,
+    name_fn: Optional[Callable[[int], str]] = None,
 ) -> list:
     """|coefficient| x feature-std importances (the standardized effect
-    size the reference's report ranked by), top-k descending."""
+    size the reference's report ranked by), top-k descending.
+
+    ``name_fn(index) -> name`` resolves names lazily for just the top-k —
+    at millions of features, materializing a full ``names`` list only to
+    label 25 rows would dominate the report cost."""
     w = np.asarray(coefficients, np.float64)
     std = (
         np.ones_like(w) if feature_std is None
@@ -149,11 +154,17 @@ def feature_importance(
     )
     imp = np.abs(w) * std
     order = np.argsort(-imp)[:top_k]
+
+    def _name(j: int) -> str:
+        if names is not None:
+            return str(names[j])
+        if name_fn is not None:
+            return str(name_fn(j))
+        return f"feature_{j}"
+
     return [
         {
-            "feature": (
-                str(names[j]) if names is not None else f"feature_{j}"
-            ),
+            "feature": _name(int(j)),
             "coefficient": float(w[j]),
             "importance": float(imp[j]),
         }

@@ -429,8 +429,8 @@ def _run(args) -> dict:
 
         report = TrainingReport(task=problem.task)
         # Loop-invariant report inputs (d can be millions; the λ loop
-        # must not rebuild them per grid point).
-        report_names = [index_map.index_to_name(j) for j in range(d)]
+        # must not rebuild them per grid point, and names resolve lazily
+        # for just the top-k rendered rows).
         report_std = np.sqrt(
             np.maximum(np.asarray(summary.variance), 0.0)
         )
@@ -471,7 +471,7 @@ def _run(args) -> dict:
             report.add_importance(lam, feature_importance(
                 np.asarray(model.coefficients.means),
                 feature_std=report_std,
-                names=report_names,
+                name_fn=index_map.index_to_name,
             ))
 
     # Stage 5: write --------------------------------------------------------

@@ -30,21 +30,25 @@ _CACHE: dict = {}
 logger = logging.getLogger(__name__)
 
 
-def _source_tag() -> str:
-    with open(_SRC, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()[:16]
-
-
-def _build() -> Optional[str]:
-    tag = _source_tag()
-    so_path = os.path.join(_DIR, f"_game_decoder_{tag}.so")
+def _compile_cached(src: str, prefix: str, what: str) -> Optional[str]:
+    """Lazy shared-library build: hash-tagged .so next to the source,
+    atomic install (concurrent builders race safely), None + a warning on
+    ANY failure (missing source/toolchain, compile error) — callers fall
+    back to their pure-Python paths."""
+    try:
+        with open(src, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError as e:
+        logger.warning("native %s source unreadable (%s)", what, e)
+        return None
+    so_path = os.path.join(_DIR, f"{prefix}_{tag}.so")
     if os.path.exists(so_path):
         return so_path
     tmp = f"{so_path}.build.{os.getpid()}"  # unique per builder: no
     # interleaved writes; the os.replace below is the atomic install
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-o", tmp, _SRC,
+        "-o", tmp, src,
     ]
     try:
         subprocess.run(
@@ -53,12 +57,16 @@ def _build() -> Optional[str]:
     except (OSError, subprocess.SubprocessError) as e:
         detail = getattr(e, "stderr", b"") or b""
         logger.warning(
-            "native game decoder build failed (%s): %s — using the Python "
-            "decoder", e, detail.decode(errors="replace")[:500],
+            "native %s build failed (%s): %s — using the Python path",
+            what, e, detail.decode(errors="replace")[:500],
         )
         return None
-    os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+    os.replace(tmp, so_path)
     return so_path
+
+
+def _build() -> Optional[str]:
+    return _compile_cached(_SRC, "_game_decoder", "game decoder")
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -124,4 +132,53 @@ def load_game_decoder() -> Optional[ctypes.CDLL]:
             except OSError as e:
                 logger.warning("native game decoder load failed: %s", e)
         _CACHE["lib"] = lib
+        return lib
+
+
+# ---------------------------------------------------------------------------
+# Layout sorter (the hot passes of the Pallas slot-layout build)
+# ---------------------------------------------------------------------------
+
+_SORT_SRC = os.path.join(_DIR, "layout_sort.cpp")
+
+
+def _build_sorter() -> Optional[str]:
+    return _compile_cached(_SORT_SRC, "_layout_sort", "layout sorter")
+
+
+def _bind_sorter(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64 = ctypes.c_int64
+    p_i64 = ctypes.POINTER(i64)
+    p_i32 = ctypes.POINTER(ctypes.c_int32)
+    p_f32 = ctypes.POINTER(ctypes.c_float)
+    lib.pl_sort_orientation.argtypes = [
+        p_i64, p_i64, i64, i64, i64, i64, p_i32, p_i32, p_i64,
+    ]
+    lib.pl_sort_orientation.restype = i64
+    lib.pl_scatter.argtypes = [
+        p_i64, p_i64, p_f32, p_i32, p_i32, p_i32,
+        i64, i64, i64, i64, i64, i64, i64,
+        ctypes.c_void_p, p_f32, p_i64,
+    ]
+    lib.pl_scatter.restype = i64
+    return lib
+
+
+def load_layout_sorter() -> Optional[ctypes.CDLL]:
+    """The layout-sorter library, building it if needed; None on failure
+    or when ``PHOTON_NO_NATIVE=1`` (numpy fallback — bit-identical
+    output, parity-tested)."""
+    if os.environ.get("PHOTON_NO_NATIVE") == "1":
+        return None
+    with _LOCK:
+        if "sorter" in _CACHE:
+            return _CACHE["sorter"]
+        so_path = _build_sorter()
+        lib = None
+        if so_path is not None:
+            try:
+                lib = _bind_sorter(ctypes.CDLL(so_path))
+            except OSError as e:
+                logger.warning("native layout sorter load failed: %s", e)
+        _CACHE["sorter"] = lib
         return lib

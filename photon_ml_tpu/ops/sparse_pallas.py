@@ -118,6 +118,32 @@ if DMA_BUDGET <= 0:
     )
 
 
+def _cptr(arr: np.ndarray, ct):
+    """ctypes pointer to a contiguous numpy array (native build glue)."""
+    import ctypes
+
+    return arr.ctypes.data_as(ctypes.POINTER(ct))
+
+
+def _extract_fields(r32: np.ndarray, c32: np.ndarray, nbc: int):
+    """(tile, gwin, lane) in int32, with shifts/masks where the tile edge
+    is a power of two (the default) — numpy's int64 floor-division is
+    scalar (~0.5 s per pass at 33M entries).  Shared by the layout build
+    and the permutation predictor."""
+    if TILE_R & (TILE_R - 1) == 0:
+        tshift = TILE_R.bit_length() - 1
+        tr = r32 >> tshift
+        tc = c32 >> tshift
+        gwin = (c32 >> 7) & (WINS - 1)
+    else:
+        tr = (r32 // TILE_R).astype(np.int32)
+        tc = (c32 // TILE_C).astype(np.int32)
+        gwin = ((c32 % TILE_C) // WIN).astype(np.int32)
+    tile = tr * np.int32(nbc) + tc
+    lane = r32 & np.int32(WIN - 1)
+    return tile, gwin, lane
+
+
 def _interpret() -> bool:
     """Run kernels in interpreter mode (CPU tests set this env var)."""
     return os.environ.get("PHOTON_PALLAS_INTERPRET", "") == "1"
@@ -170,13 +196,6 @@ def _build_orientation(
     floor, worth ~16 uniform depth levels).  ``spill_cost_ratio=inf``
     forces full coverage (used for the post-spill rebuild).
     """
-    tr = rows // TILE_R
-    tc = cols // TILE_C
-    tile = tr * nbc + tc
-    lane = rows % WIN
-    gwin = (cols % TILE_C) // WIN       # gather window within tile [0,16)
-    glo = cols % WIN                    # index into that window's table
-    ohi = (rows % TILE_R) // WIN        # output window within tile [0,16)
     nt = nbr * nbc
 
     if len(rows) == 0:  # all-zero / empty matrix: one empty sublane group
@@ -188,32 +207,71 @@ def _build_orientation(
             1,
         )
 
-    # Depth position within each (tile, gather-window, lane) cell.  One
-    # combined int64 sort key (≈2-3x faster than a 3-key lexsort at 33M
-    # entries); tile/gwin/lane recover from the key by div/mod.
-    key = (tile * np.int64(WINS) + gwin) * np.int64(WIN) + lane
-    order = np.argsort(key)
-    cell = key[order]
-    # run-length position within equal consecutive cells
-    change = np.empty(len(cell), dtype=bool)
-    change[0] = True
-    np.not_equal(cell[1:], cell[:-1], out=change[1:])
-    run_starts = np.flatnonzero(change)
-    run_ids = np.cumsum(change) - 1
-    depth_pos = np.arange(len(cell)) - run_starts[run_ids]
+    # Sort + per-cell depth positions + per-(tile, window) max lane loads:
+    # the NATIVE path (native/layout_sort.cpp — stable radix argsort with
+    # numpy's exact tie order, one sequential scan) when the library is
+    # available and the entry count is worth the ctypes round trip; the
+    # numpy formulation below otherwise.  Outputs are BIT-IDENTICAL
+    # (parity-tested), so everything downstream is shared.
+    rows64 = cols64 = None
+    lib = None
+    if len(rows) >= (1 << 18):
+        from photon_ml_tpu.native import load_layout_sorter
 
-    # Per-(tile, window) max lane load M — the sublanes window w needs at
-    # depth cap d is min(M[t, w], d) (max of min = min of max per lane).
-    # cell ids are sorted, so grouped reduceat beats the ufunc.at path
-    # (~10x at 33M entries).
-    counts = np.diff(np.append(run_starts, len(cell)))
-    cell_tw = (cell[run_starts] // WIN).astype(np.int64)  # tile*WINS + gwin
-    tw_change = np.empty(len(cell_tw), dtype=bool)
-    tw_change[0] = True
-    np.not_equal(cell_tw[1:], cell_tw[:-1], out=tw_change[1:])
-    tw_starts = np.flatnonzero(tw_change)
-    M = np.zeros(nt * WINS, np.int64)
-    M[cell_tw[tw_starts]] = np.maximum.reduceat(counts, tw_starts)
+        lib = load_layout_sorter()
+    if lib is not None:
+        import ctypes
+
+        rows64 = np.ascontiguousarray(rows, np.int64)
+        cols64 = np.ascontiguousarray(cols, np.int64)
+        nnz = len(rows64)
+        order = np.empty(nnz, np.int32)
+        depth_pos = np.empty(nnz, np.int32)
+        M = np.zeros(nt * WINS, np.int64)
+
+        rc = lib.pl_sort_orientation(
+            _cptr(rows64, ctypes.c_int64), _cptr(cols64, ctypes.c_int64),
+            nnz, nbc, TILE_R, nt,
+            _cptr(order, ctypes.c_int32), _cptr(depth_pos, ctypes.c_int32),
+            _cptr(M, ctypes.c_int64),
+        )
+        if rc != 0:  # nnz beyond int32 indexing: numpy handles it
+            lib = None
+    if lib is None:
+        r32 = rows.astype(np.int32, copy=False)
+        c32 = cols.astype(np.int32, copy=False)
+        tile, gwin, lane = _extract_fields(r32, c32, nbc)
+
+        # One combined sort key (≈2-3x faster than a 3-key lexsort at 33M
+        # entries), in int32 when it fits; kind="stable" selects numpy's
+        # radix sort for integer keys (~2x quicksort at this size).
+        kmax = nt * WINS * WIN
+        kdtype = np.int32 if kmax < 2**31 else np.int64
+        key = (
+            (tile.astype(kdtype) * WINS + gwin) * WIN + lane
+        )
+        order = np.argsort(key, kind="stable")
+        cell = key[order]
+        # run-length position within equal consecutive cells
+        change = np.empty(len(cell), dtype=bool)
+        change[0] = True
+        np.not_equal(cell[1:], cell[:-1], out=change[1:])
+        run_starts = np.flatnonzero(change)
+        run_ids = np.cumsum(change) - 1
+        depth_pos = np.arange(len(cell)) - run_starts[run_ids]
+
+        # Per-(tile, window) max lane load M — the sublanes window w needs
+        # at depth cap d is min(M[t, w], d) (max of min = min of max per
+        # lane).  cell ids are sorted, so grouped reduceat beats the
+        # ufunc.at path (~10x at 33M entries).
+        counts = np.diff(np.append(run_starts, len(cell)))
+        cell_tw = (cell[run_starts] // WIN).astype(np.int64)
+        tw_change = np.empty(len(cell_tw), dtype=bool)
+        tw_change[0] = True
+        np.not_equal(cell_tw[1:], cell_tw[:-1], out=tw_change[1:])
+        tw_starts = np.flatnonzero(tw_change)
+        M = np.zeros(nt * WINS, np.int64)
+        M[cell_tw[tw_starts]] = np.maximum.reduceat(counts, tw_starts)
     M = M.reshape(nt, WINS)
 
     hist = np.bincount(depth_pos)
@@ -260,18 +318,64 @@ def _build_orientation(
     )[:, :, None]
     val = np.zeros((nt, a, WIN), np.float32)
 
-    t_s = cell // (WINS * WIN)
-    g_s = (cell // WIN) % WINS
-    l_s = cell % WIN
+    if lib is not None:
+        import ctypes
+
+        vals32 = np.ascontiguousarray(vals, np.float32)
+        base32 = np.ascontiguousarray(base, np.int32)
+        n_spill_expected = int(len(rows64) - hist[:depth].sum())
+        spill_idx = np.empty(max(n_spill_expected, 1), np.int64)
+
+        n_sp = lib.pl_scatter(
+            _cptr(rows64, ctypes.c_int64), _cptr(cols64, ctypes.c_int64),
+            _cptr(vals32, ctypes.c_float),
+            _cptr(order, ctypes.c_int32), _cptr(depth_pos, ctypes.c_int32),
+            _cptr(base32, ctypes.c_int32),
+            len(rows64), nbc, TILE_R, depth, a, WIN_SHIFT, CODE_BYTES,
+            code.ctypes.data_as(ctypes.c_void_p),
+            _cptr(val, ctypes.c_float),
+            _cptr(spill_idx, ctypes.c_int64),
+        )
+        assert n_sp == n_spill_expected, (n_sp, n_spill_expected)
+        spill_idx = spill_idx[:n_sp]
+        return (
+            code.reshape(nbr, nbc, a, WIN), val.reshape(nbr, nbc, a, WIN),
+            spill_idx, a, depth,
+        )
+
+    # Decompose sorted keys with shifts (WIN is always 2^7; WINS is a
+    # power of two for power-of-two tile edges), and gather per-entry
+    # payloads through ONE index array instead of gather-then-mask — the
+    # div/mod + double-gather formulation cost ~18 s at 33M entries.
+    if TILE_R & (TILE_R - 1) == 0:
+        ohi = (r32 >> 7) & (WINS - 1)
+    else:
+        ohi = ((r32 % TILE_R) // WIN).astype(np.int32)
+    glo = c32 & np.int32(WIN - 1)
+    if WINS & (WINS - 1) == 0:
+        wshift = WINS.bit_length() - 1
+        t_s = cell >> np.array(7 + wshift, cell.dtype)
+        g_s = (cell >> np.array(7, cell.dtype)) & np.array(
+            WINS - 1, cell.dtype
+        )
+    else:
+        t_s = cell // (WINS * WIN)
+        g_s = (cell // WIN) % WINS
+    l_s = cell & np.array(WIN - 1, cell.dtype)
+    kidx = order[keep]                  # original indices of kept entries
     kt = t_s[keep]
     kl = l_s[keep]
-    sub = base[kt, g_s[keep]] + depth_pos[keep]
+    kg = g_s[keep]
+    sub = base[kt, kg] + depth_pos[keep]
     # Filled slots: full positive code (sign bit clear).  The window id of
-    # slot (kt, sub) is g_s by construction (sub lies in window g's run).
-    code[kt, sub, kl] = (
-        (g_s[keep] << WIN_SHIFT) | (ohi[order][keep] << 7) | glo[order][keep]
+    # slot (kt, sub) is kg by construction (sub lies in window g's run).
+    flat = (kt.astype(np.int64) * a + sub) * WIN + kl
+    code.reshape(-1)[flat] = (
+        (kg.astype(np.int32) << WIN_SHIFT)
+        | (ohi[kidx].astype(np.int32) << 7)
+        | glo[kidx]
     ).astype(CODE_DTYPE)
-    val[kt, sub, kl] = vals[order][keep]
+    val.reshape(-1)[flat] = vals[kidx]
 
     spill_idx = order[~keep]            # indices into original entry arrays
     return (code.reshape(nbr, nbc, a, WIN), val.reshape(nbr, nbc, a, WIN),
@@ -752,10 +856,14 @@ def _predict_a(rows, cols, nbr, nbc):
     (sort + reduceat) — a dense bincount over every possible cell is
     O(tiles · TILE · 128) host memory and OOMs at millions of tiles.
     Used to choose between identity and permuted column layouts."""
-    t = (rows // TILE_R) * nbc + (cols // TILE_C)
-    w = (cols % TILE_C) // WIN
-    l = rows % WIN
-    key = np.sort((t * np.int64(WINS) + w) * np.int64(WIN) + l)
+    t, w, l = _extract_fields(
+        rows.astype(np.int32, copy=False),
+        cols.astype(np.int32, copy=False), nbc,
+    )
+    kdtype = np.int32 if nbr * nbc * WINS * WIN < 2**31 else np.int64
+    key = np.sort(
+        (t.astype(kdtype) * WINS + w) * WIN + l, kind="stable"
+    )
     change = np.empty(len(key), dtype=bool)
     change[0] = True
     np.not_equal(key[1:], key[:-1], out=change[1:])

@@ -505,3 +505,79 @@ class TestUnitValueLayout:
         fn = _jax.jit(lambda P, w: P.matvec(w))
         for U, C in zip(uni, oracles):
             assert _rel(fn(_jax.device_put(U), w), C.matvec(w)) < 1e-5
+
+
+class TestNativeLayoutSorter:
+    """native/layout_sort.cpp vs the numpy build: BIT-identical layouts
+    (stable radix sort with numpy's tie order), including spill."""
+
+    def _build_both(self, rows, cols, vals, n, d, **kw):
+        import photon_ml_tpu.native as native_mod
+
+        if native_mod.load_layout_sorter() is None:
+            pytest.skip("no native toolchain here")
+        P_nat = build_pallas_matrix(rows, cols, vals, n, d, **kw)
+        old = os.environ.get("PHOTON_NO_NATIVE")
+        os.environ["PHOTON_NO_NATIVE"] = "1"
+        try:
+            P_py = build_pallas_matrix(rows, cols, vals, n, d, **kw)
+        finally:
+            if old is None:
+                del os.environ["PHOTON_NO_NATIVE"]
+            else:
+                os.environ["PHOTON_NO_NATIVE"] = old
+        return P_nat, P_py
+
+    def test_bit_identical_layouts(self, rng):
+        # ≥ 2^18 entries so the native path engages.
+        n, d, nnz = 6000, 4000, 1 << 18
+        rows = rng.integers(0, n, size=nnz).astype(np.int64)
+        cols = rng.integers(0, d, size=nnz).astype(np.int64)
+        vals = rng.normal(size=nnz).astype(np.float32)
+        P_nat, P_py = self._build_both(rows, cols, vals, n, d)
+        assert P_nat.a_f == P_py.a_f and P_nat.depth_f == P_py.depth_f
+        for f in ("f_code", "f_val", "b_code", "b_val"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(P_nat, f)), np.asarray(getattr(P_py, f)),
+                err_msg=f,
+            )
+        np.testing.assert_array_equal(
+            np.asarray(P_nat.spill.spill_coo.values),
+            np.asarray(P_py.spill.spill_coo.values),
+        )
+
+    def test_bit_identical_with_forced_spill(self, rng):
+        n, d, nnz = 4000, 3000, 1 << 18
+        rows = rng.integers(0, n, size=nnz).astype(np.int64)
+        cols = rng.integers(0, d, size=nnz).astype(np.int64)
+        # hot cell: many entries in one (tile, window, lane) → spill
+        rows[:3000] = 7
+        cols[:3000] = np.arange(3000) % 40
+        vals = rng.normal(size=nnz).astype(np.float32)
+        P_nat, P_py = self._build_both(
+            rows, cols, vals, n, d, depth_cap=4, col_permutation=False
+        )
+        assert P_nat.spill.has_spill and P_py.spill.has_spill
+        assert P_nat.spill.spill_coo.nnz == P_py.spill.spill_coo.nnz
+        for f in ("f_code", "f_val", "b_code", "b_val"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(P_nat, f)), np.asarray(getattr(P_py, f)),
+                err_msg=f,
+            )
+        for f in ("row_ids", "col_ids", "values"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(P_nat.spill.spill_coo, f)),
+                np.asarray(getattr(P_py.spill.spill_coo, f)),
+            )
+
+    def test_unit_layout_through_native(self, rng):
+        n, d, nnz = 5000, 3000, 1 << 18
+        flat = rng.choice(n * d, size=nnz, replace=False)
+        rows = (flat // d).astype(np.int64)
+        cols = (flat % d).astype(np.int64)
+        vals = np.ones(nnz, np.float32)
+        P_nat, P_py = self._build_both(rows, cols, vals, n, d)
+        assert P_nat.unit_vals and P_py.unit_vals
+        C = from_coo(rows, cols, vals, n, d)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        assert _rel(P_nat.matvec(w), C.matvec(w)) < 1e-5
