@@ -1,0 +1,137 @@
+"""Out-of-core GAME: a fixed-effect coordinate over a streamed dataset.
+
+At BASELINE's north-star scale the GAME fixed-effect dataset alone
+exceeds one chip's HBM, exactly like the legacy-GLM case
+(SURVEY.md §7 "Host→device ingest bandwidth").  This coordinate plugs the
+host-RAM chunk store (data/streaming.py) into the block coordinate
+descent loop: training is the host-loop L-BFGS over double-buffered
+chunk passes with the OTHER coordinates' scores entering as per-chunk
+offset slices, and scoring streams ``X @ w`` back per chunk.  The rest
+of the descent (random effects, factored effects, validation hooks,
+checkpointing) is unchanged — coordinates compose through per-row score
+arrays, which stay device-resident and small.
+
+The streamed chunks must be built with ZERO data offsets: in GAME, the
+base offsets ride the coordinate-descent total (the estimator seeds it),
+so chunk-held offsets would double-count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.streaming import StreamingGlmData
+from photon_ml_tpu.game.coordinates import Coordinate
+from photon_ml_tpu.game.model import FixedEffectModel
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.ops import losses as losses_lib
+from photon_ml_tpu.optim.lbfgs import LBFGSConfig
+from photon_ml_tpu.optim.problem import GlmOptimizationConfig
+from photon_ml_tpu.optim.streaming import (
+    StreamingObjective,
+    ensure_streamable,
+    streaming_lbfgs_solve,
+)
+
+Array = jax.Array
+
+
+class StreamingFixedEffectCoordinate(Coordinate):
+    """FixedEffectCoordinate for datasets larger than HBM.
+
+    Drop-in for the resident coordinate inside ``CoordinateDescent``:
+    same ``train(offsets, warm) → w`` / ``score(w)`` / ``finalize``
+    surface, with every objective evaluation a streamed pass.  Smooth
+    (none/L2) regularization only (:func:`ensure_streamable`).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        stream: StreamingGlmData,
+        task: str,
+        config: GlmOptimizationConfig,
+        reg_weight: float = 0.0,
+        feature_shard: str = "global",
+        accumulate: str = "f32",
+    ):
+        ensure_streamable(config)
+        if stream.n_shards != 1:
+            raise NotImplementedError(
+                "the streamed fixed effect is single-device for now"
+            )
+        for chunk in stream.chunks:
+            if np.any(chunk.offsets):
+                raise ValueError(
+                    "streamed GAME chunks must carry zero offsets — base "
+                    "offsets ride the coordinate-descent total"
+                )
+        self.name = name
+        self.stream = stream
+        self.task = losses_lib.get(task).name
+        self.config = config
+        self.reg_weight = reg_weight
+        self.feature_shard = feature_shard
+        self._sobj = StreamingObjective(
+            self.task, stream, accumulate=accumulate
+        )
+        opt = config.optimizer
+        self._lbfgs = LBFGSConfig(
+            max_iters=opt.max_iters,
+            tolerance=opt.tolerance,
+            history=opt.history,
+        )
+
+    @property
+    def _l2(self) -> float:
+        return self.config.regularization.l2_weight(1.0) * self.reg_weight
+
+    def train(self, offsets: Array, warm_state: Optional[Array] = None):
+        w0 = (
+            jnp.zeros((self.stream.n_features,), jnp.float32)
+            if warm_state is None else warm_state
+        )
+        # Offsets are fixed for the whole solve: slice them per chunk ONCE
+        # (value_and_grad accepts the pre-sliced list), not per line-search
+        # probe.
+        slices = self._sobj.offset_slices(offsets)
+        res = streaming_lbfgs_solve(
+            lambda w: self._sobj.value_and_grad(
+                w, self._l2, offsets=slices
+            ),
+            w0, self._lbfgs,
+        )
+        return res.w
+
+    def score(self, state: Array) -> Array:
+        # Margin WITHOUT offsets: coordinate scores are additive pieces
+        # (chunks carry zero offsets by the constructor's contract).
+        return jnp.asarray(self._sobj.scores(state))
+
+    def finalize(self, state: Array, offsets=None) -> FixedEffectModel:
+        variances = None
+        if self.config.compute_variances and offsets is None:
+            # Same contract (and warning) as the distributed sibling: the
+            # variance Hessian needs the FULL final margins.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "compute_variances requested but finalize() got no "
+                "offsets; variances omitted for coordinate %r", self.name,
+            )
+        if self.config.compute_variances and offsets is not None:
+            diag = self._sobj.hessian_diagonal(state, offsets=offsets)
+            variances = 1.0 / jnp.maximum(diag + self._l2, 1e-12)
+        return FixedEffectModel(
+            GeneralizedLinearModel(Coefficients(state, variances), self.task),
+            self.feature_shard,
+        )
+
+    def make_validation_scorer(self, shards: dict, ids: dict):
+        from photon_ml_tpu.game.validation import FixedEffectValidationScorer
+
+        return FixedEffectValidationScorer(shards[self.feature_shard])

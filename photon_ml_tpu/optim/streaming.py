@@ -104,15 +104,21 @@ class StreamingObjective:
 
         obj = self.objective
 
-        def chunk_vg(w, chunk):
+        def chunk_vg(w, off, chunk):
             if mesh is not None:
                 local = jax.tree.map(lambda x: x[0], chunk)
                 v, g = obj.raw_value_and_grad(w, local)
                 return lax.psum(v, self._axis), lax.psum(g, self._axis)
+            # ``off``: extra per-row margin offsets (coordinate descent —
+            # the other coordinates' scores); a traced scalar 0 when
+            # absent, so the plain-GLM trace carries no extra transfer.
+            import dataclasses as _dc
+
+            chunk = _dc.replace(chunk, offsets=chunk.offsets + off)
             return obj.raw_value_and_grad(w, chunk)
 
-        def acc_step(carry, w, chunk):
-            v, g = chunk_vg(w, chunk)
+        def acc_step(carry, w, off, chunk):
+            v, g = chunk_vg(w, off, chunk)
             if accumulate == "f32":
                 vacc, gacc = carry
                 return (vacc + v, gacc + g)
@@ -126,18 +132,21 @@ class StreamingObjective:
             gc = (tg - gacc) - yg
             return (tv, vc, tg, gc)
 
-        def chunk_diag(w, chunk):
+        def chunk_diag(w, off, chunk):
             if mesh is not None:
                 local = jax.tree.map(lambda x: x[0], chunk)
                 d2w = obj.d2_weights(w, local)
                 return lax.psum(
                     local.features.sq_rmatvec(d2w), self._axis
                 )
+            import dataclasses as _dc
+
+            chunk = _dc.replace(chunk, offsets=chunk.offsets + off)
             d2w = obj.d2_weights(w, chunk)
             return chunk.features.sq_rmatvec(d2w)
 
-        def diag_step(diag, w, chunk):
-            return diag + chunk_diag(w, chunk)
+        def diag_step(diag, w, off, chunk):
+            return diag + chunk_diag(w, off, chunk)
 
         def score_step(w, chunk):
             if mesh is not None:
@@ -152,13 +161,13 @@ class StreamingObjective:
             n_acc = 2 if accumulate == "f32" else 4
             self._acc = jax.jit(jax.shard_map(
                 acc_step, mesh=mesh,
-                in_specs=((P(),) * n_acc, P(), spec),
+                in_specs=((P(),) * n_acc, P(), P(), spec),
                 out_specs=(P(),) * n_acc,
                 check_vma=False,
             ))
             self._diag = jax.jit(jax.shard_map(
                 diag_step, mesh=mesh,
-                in_specs=(P(), P(), spec), out_specs=P(),
+                in_specs=(P(), P(), P(), spec), out_specs=P(),
                 check_vma=False,
             ))
             self._score = jax.jit(jax.shard_map(
@@ -184,10 +193,38 @@ class StreamingObjective:
             return jax.device_put(chunk, self._sharding)
         return jax.device_put(chunk)
 
-    def _stream_accumulate(self, step: Callable, init, *args):
-        """Run ``carry = step(carry, *args, chunk)`` over all chunks with
-        double-buffered transfers: chunk k+1 moves host→HBM while chunk k
-        computes; a sync per chunk keeps at most 2 chunks in HBM."""
+    def offset_slices(self, offsets) -> list:
+        """Per-chunk slices of coordinate-descent offsets (the other
+        coordinates' scores), zero-padded to the chunk grid; a traced
+        scalar 0 per chunk when absent (no extra transfer, own trace).
+        Callers evaluating many passes against FIXED offsets (a whole
+        L-BFGS solve) should call this once and pass the list to
+        ``value_and_grad`` — it is accepted in place of the raw array."""
+        if isinstance(offsets, list):  # already sliced
+            return offsets
+        cr = self.stream.chunk_rows
+        n_chunks = self.stream.n_chunks
+        if offsets is None:
+            zero = jnp.zeros((), jnp.float32)
+            return [zero] * n_chunks
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "per-row offsets are single-device for now (the GAME "
+                "streamed fixed effect); shard the offsets per chunk to "
+                "extend"
+            )
+        off = jnp.asarray(offsets, jnp.float32)
+        pad = n_chunks * cr - off.shape[0]
+        if pad:
+            off = jnp.pad(off, (0, pad))
+        return [off[k * cr:(k + 1) * cr] for k in range(n_chunks)]
+
+    def _stream_accumulate(self, step: Callable, init, args=(),
+                           per_chunk=None):
+        """Run ``carry = step(carry, *args, per_chunk[k], chunk)`` over all
+        chunks with double-buffered transfers: chunk k+1 moves host→HBM
+        while chunk k computes; a sync per chunk keeps at most 2 chunks in
+        HBM."""
         chunks = self.stream.chunks
         carry = init
         nxt = self._put(chunks[0])
@@ -195,7 +232,8 @@ class StreamingObjective:
             cur = nxt
             if k + 1 < len(chunks):
                 nxt = self._put(chunks[k + 1])
-            carry = step(carry, *args, cur)
+            extra = (per_chunk[k],) if per_chunk is not None else ()
+            carry = step(carry, *args, *extra, cur)
             # Backpressure: without this the host loop would enqueue every
             # chunk's transfer ahead of compute and HBM would hold the whole
             # dataset again.  Blocking on the (tiny) carry leaves transfer
@@ -203,9 +241,12 @@ class StreamingObjective:
             jax.block_until_ready(jax.tree.leaves(carry)[0])
         return carry
 
-    def value_and_grad(self, w: Array, l2_weight=0.0) -> tuple[Array, Array]:
+    def value_and_grad(
+        self, w: Array, l2_weight=0.0, offsets=None
+    ) -> tuple[Array, Array]:
         """One full streamed pass; returns device (value, grad) with the L2
-        term applied."""
+        term applied.  ``offsets``: optional (n_rows,) extra margins added
+        per row (coordinate descent)."""
         d = self.stream.n_features
         if self.accumulate == "f32":
             init = (jnp.zeros((), jnp.float32), jnp.zeros((d,), jnp.float32))
@@ -214,17 +255,21 @@ class StreamingObjective:
                 jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
                 jnp.zeros((d,), jnp.float32), jnp.zeros((d,), jnp.float32),
             )
-        out = self._stream_accumulate(self._acc, init, w)
+        out = self._stream_accumulate(
+            self._acc, init, args=(w,),
+            per_chunk=self.offset_slices(offsets),
+        )
         v, g = (out[0], out[1]) if self.accumulate == "f32" else (
             out[0], out[2]
         )
         return self._finish(v, g, w, jnp.asarray(l2_weight, jnp.float32))
 
-    def hessian_diagonal(self, w: Array) -> Array:
+    def hessian_diagonal(self, w: Array, offsets=None) -> Array:
         """Σᵢ wᵢ·d2ᵢ·X²ᵢⱼ streamed over chunks (for coefficient variances)."""
         d = self.stream.n_features
         return self._stream_accumulate(
-            self._diag, jnp.zeros((d,), jnp.float32), w
+            self._diag, jnp.zeros((d,), jnp.float32), args=(w,),
+            per_chunk=self.offset_slices(offsets),
         )
 
     def scores(self, w: Array) -> np.ndarray:

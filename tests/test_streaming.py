@@ -358,3 +358,141 @@ class TestChunkStoreShapes:
         v_s, _ = sobj.value_and_grad(w)
         v_r, _ = obj.value_and_grad(w, data)
         np.testing.assert_allclose(float(v_s), float(v_r), rtol=1e-5)
+
+
+class TestStreamingGameCoordinate:
+    """StreamingFixedEffectCoordinate inside coordinate descent: same
+    result as the resident fixed effect, composed with a random effect."""
+
+    def _game_problem(self, rng, n=600, d=20, n_users=15):
+        X = sp.random(n, d, density=0.15, random_state=7, format="csr",
+                      dtype=np.float32)
+        users = np.array(
+            [f"u{rng.integers(n_users)}" for _ in range(n)], dtype=object
+        )
+        user_eff = {f"u{u}": rng.normal() for u in range(n_users)}
+        w_true = rng.normal(size=d).astype(np.float32)
+        margin = X @ w_true + np.array([user_eff[u] for u in users])
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(
+            np.float32
+        )
+        return X, users, y
+
+    def test_cd_matches_resident_fixed_effect(self, rng):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.coordinates import (
+            FixedEffectCoordinate,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.game.data import (
+            FixedEffectDataset,
+            build_random_effect_dataset,
+        )
+        from photon_ml_tpu.game.descent import CoordinateDescent
+        from photon_ml_tpu.game.streaming import (
+            StreamingFixedEffectCoordinate,
+        )
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        X, users, y = self._game_problem(rng)
+        n, d = X.shape
+        bias = sp.csr_matrix(np.ones((n, 1), np.float32))
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=50, tolerance=1e-8),
+            regularization=RegularizationContext.l2(),
+        )
+
+        def run_cd(fixed_coord):
+            re = RandomEffectCoordinate(
+                "per_user",
+                build_random_effect_dataset(
+                    users, bias, y, np.ones(n, np.float32)
+                ),
+                "logistic", opt, reg_weight=1.0, entity_key="userId",
+            )
+            return CoordinateDescent([fixed_coord, re]).run(
+                jnp.zeros(n, jnp.float32), n_iterations=2
+            )
+
+        resident = run_cd(FixedEffectCoordinate(
+            "fixed",
+            FixedEffectDataset(data=make_glm_data(X, y), n_global_rows=n),
+            "logistic", opt, reg_weight=0.5,
+        ))
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=200, use_pallas=False
+        )
+        streamed = run_cd(StreamingFixedEffectCoordinate(
+            "fixed", stream, "logistic", opt, reg_weight=0.5,
+        ))
+
+        np.testing.assert_allclose(
+            np.asarray(streamed.states["fixed"]),
+            np.asarray(resident.states["fixed"]),
+            atol=5e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(streamed.scores["fixed"]),
+            np.asarray(resident.scores["fixed"]),
+            atol=5e-3,
+        )
+        # The OTHER coordinate's solution must agree too (it trains
+        # against the streamed coordinate's scores).
+        for b_s, b_r in zip(
+            streamed.states["per_user"], resident.states["per_user"]
+        ):
+            np.testing.assert_allclose(
+                np.asarray(b_s), np.asarray(b_r), atol=5e-3
+            )
+
+    def test_finalize_variances_and_model(self, rng):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.streaming import (
+            StreamingFixedEffectCoordinate,
+        )
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        X, _, y = self._game_problem(rng, n=300, d=10)
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=128, use_pallas=False
+        )
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=40, tolerance=1e-7),
+            regularization=RegularizationContext.l2(),
+            compute_variances=True,
+        )
+        coord = StreamingFixedEffectCoordinate(
+            "fixed", stream, "logistic", opt, reg_weight=1.0,
+        )
+        offsets = jnp.zeros(stream.n_rows, jnp.float32)
+        w = coord.train(offsets)
+        model = coord.finalize(w, offsets=offsets)
+        assert model.model.task == "logistic"
+        v = np.asarray(model.model.coefficients.variances)
+        assert v.shape == (10,) and np.all(v > 0)
+
+    def test_nonzero_chunk_offsets_rejected(self, rng):
+        from photon_ml_tpu.game.streaming import (
+            StreamingFixedEffectCoordinate,
+        )
+        from photon_ml_tpu.optim.problem import GlmOptimizationConfig
+
+        X, _, y = self._game_problem(rng, n=200, d=8)
+        stream = make_streaming_glm_data(
+            X, y, offsets=np.ones(X.shape[0], np.float32),
+            chunk_rows=100, use_pallas=False,
+        )
+        with pytest.raises(ValueError, match="zero offsets"):
+            StreamingFixedEffectCoordinate(
+                "fixed", stream, "logistic", GlmOptimizationConfig(),
+            )
