@@ -105,6 +105,9 @@ def parse_coordinate_config(spec: dict):
             optimization=opt,
             reg_weight=float(spec.get("reg_weight", 0.0)),
             down_sampling_rate=float(spec.get("down_sampling_rate", 1.0)),
+            # >0: train this coordinate out-of-core (host-RAM chunks of
+            # this many rows streamed through HBM — game/streaming.py).
+            streaming_chunk_rows=int(spec.get("streaming_chunk_rows", 0)),
         )
     if spec["type"] == "random":
         return name, RandomEffectCoordinateConfig(

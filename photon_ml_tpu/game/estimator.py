@@ -50,6 +50,12 @@ class FixedEffectCoordinateConfig:
     #: objective stays unbiased.  Scoring always covers every row: dropped
     #: rows get training weight 0, not removal, so shapes stay static.
     down_sampling_rate: float = 1.0
+    #: >0 trains this coordinate OUT-OF-CORE: the shard lives in host RAM
+    #: as chunks of this many rows, double-buffered through HBM per
+    #: objective pass (game/streaming.py) — for fixed-effect datasets
+    #: larger than device memory.  Single-device, smooth (none/L2)
+    #: regularization only.
+    streaming_chunk_rows: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,7 +140,10 @@ class GameEstimator:
         reference builds per-coordinate datasets once, outside the config
         grid — SURVEY.md §3.2)."""
         if isinstance(cfg, FixedEffectCoordinateConfig):
-            return ("fixed", cfg.feature_shard, cfg.down_sampling_rate)
+            return (
+                "fixed", cfg.feature_shard, cfg.down_sampling_rate,
+                cfg.streaming_chunk_rows,
+            )
         # Plain and factored random effects need the SAME dataset shape,
         # so they share cache entries deliberately.
         return (
@@ -184,6 +193,32 @@ class GameEstimator:
                     tw[idx] = w_kept
                     return tw
 
+                if cfg.streaming_chunk_rows > 0:
+                    if self.mesh is not None:
+                        raise NotImplementedError(
+                            "streaming_chunk_rows composes with the "
+                            "single-device path only for now (drop the "
+                            "mesh or the streaming)"
+                        )
+                    from photon_ml_tpu.data.streaming import (
+                        make_streaming_glm_data,
+                    )
+                    from photon_ml_tpu.game.streaming import (
+                        StreamingFixedEffectCoordinate,
+                    )
+
+                    stream = cache.get(key)
+                    if stream is None:
+                        stream = make_streaming_glm_data(
+                            shard, response, weights=train_weight(),
+                            chunk_rows=cfg.streaming_chunk_rows,
+                        )
+                        cache[key] = stream
+                    coordinates.append(StreamingFixedEffectCoordinate(
+                        name, stream, self.task, cfg.optimization,
+                        cfg.reg_weight, feature_shard=cfg.feature_shard,
+                    ))
+                    continue
                 if self.mesh is not None:
                     coordinates.append(
                         self._distributed_fixed(

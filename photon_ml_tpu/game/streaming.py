@@ -64,12 +64,11 @@ class StreamingFixedEffectCoordinate(Coordinate):
             raise NotImplementedError(
                 "the streamed fixed effect is single-device for now"
             )
-        for chunk in stream.chunks:
-            if np.any(chunk.offsets):
-                raise ValueError(
-                    "streamed GAME chunks must carry zero offsets — base "
-                    "offsets ride the coordinate-descent total"
-                )
+        if stream.has_nonzero_offsets():  # cached: free per grid point
+            raise ValueError(
+                "streamed GAME chunks must carry zero offsets — base "
+                "offsets ride the coordinate-descent total"
+            )
         self.name = name
         self.stream = stream
         self.task = losses_lib.get(task).name

@@ -25,6 +25,7 @@ resident solution to float tolerance; tests/test_streaming.py pins that.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import Callable, Optional, Sequence
 
@@ -112,9 +113,7 @@ class StreamingObjective:
             # ``off``: extra per-row margin offsets (coordinate descent —
             # the other coordinates' scores); a traced scalar 0 when
             # absent, so the plain-GLM trace carries no extra transfer.
-            import dataclasses as _dc
-
-            chunk = _dc.replace(chunk, offsets=chunk.offsets + off)
+            chunk = dataclasses.replace(chunk, offsets=chunk.offsets + off)
             return obj.raw_value_and_grad(w, chunk)
 
         def acc_step(carry, w, off, chunk):
@@ -139,9 +138,7 @@ class StreamingObjective:
                 return lax.psum(
                     local.features.sq_rmatvec(d2w), self._axis
                 )
-            import dataclasses as _dc
-
-            chunk = _dc.replace(chunk, offsets=chunk.offsets + off)
+            chunk = dataclasses.replace(chunk, offsets=chunk.offsets + off)
             d2w = obj.d2_weights(w, chunk)
             return chunk.features.sq_rmatvec(d2w)
 
@@ -207,6 +204,13 @@ class StreamingObjective:
         if offsets is None:
             zero = jnp.zeros((), jnp.float32)
             return [zero] * n_chunks
+        if offsets.shape[0] != self.stream.n_rows:
+            # A silently zero-padded short array would train the tail rows
+            # against offset 0 and converge to a wrong model.
+            raise ValueError(
+                f"offsets has {offsets.shape[0]} rows; the stream has "
+                f"{self.stream.n_rows}"
+            )
         if self.mesh is not None:
             raise NotImplementedError(
                 "per-row offsets are single-device for now (the GAME "

@@ -319,3 +319,41 @@ class TestFactoredDriver:
             "--initial-model", os.path.join(out1, "models"),
         ])
         assert r2["validation_metric"] > 0.6
+
+
+class TestStreamingGameDriver:
+    def test_streamed_fixed_coordinate_matches_resident(
+        self, game_files, tmp_path
+    ):
+        """"streaming_chunk_rows" on a fixed coordinate: the CLI run must
+        select a model equivalent to the resident run."""
+        import copy
+
+        train, val, config_path = game_files
+        with open(config_path) as f:
+            config = json.load(f)
+        out_r = str(tmp_path / "resident")
+        res_r = game_training_driver.run([
+            "--train-data", train, "--validate-data", val,
+            "--config", config_path, "--output-dir", out_r,
+        ])
+        streamed_cfg = copy.deepcopy(config)
+        streamed_cfg["coordinates"][0]["streaming_chunk_rows"] = 150
+        cfg2 = str(tmp_path / "cfg_stream.json")
+        with open(cfg2, "w") as f:
+            json.dump(streamed_cfg, f)
+        out_s = str(tmp_path / "streamed")
+        res_s = game_training_driver.run([
+            "--train-data", train, "--validate-data", val,
+            "--config", cfg2, "--output-dir", out_s,
+        ])
+        assert res_s["validation_metric"] == pytest.approx(
+            res_r["validation_metric"], abs=2e-3
+        )
+        m_s, _ = load_game_model(os.path.join(out_s, "models"))
+        m_r, _ = load_game_model(os.path.join(out_r, "models"))
+        np.testing.assert_allclose(
+            np.asarray(m_s["fixed"].model.coefficients.means),
+            np.asarray(m_r["fixed"].model.coefficients.means),
+            atol=5e-3,
+        )
