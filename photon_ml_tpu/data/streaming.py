@@ -32,6 +32,7 @@ Design constraints that shape this module:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Iterable
 
 import jax
@@ -97,16 +98,16 @@ class StreamingGlmData:
             if hasattr(leaf, "nbytes")
         ))
 
+    @functools.cached_property
+    def _has_nonzero_offsets(self) -> bool:
+        return bool(any(np.any(c.offsets) for c in self.chunks))
+
     def has_nonzero_offsets(self) -> bool:
         """Whether any chunk carries data offsets.  Cached after the first
         call — the O(dataset) host scan must not repeat per consumer (a
         GAME config grid constructs one coordinate per grid point against
         the same cached stream)."""
-        cached = self.__dict__.get("_has_nonzero_offsets")
-        if cached is None:
-            cached = bool(any(np.any(c.offsets) for c in self.chunks))
-            self.__dict__["_has_nonzero_offsets"] = cached
-        return cached
+        return self._has_nonzero_offsets
 
 
 def make_streaming_glm_data(

@@ -46,23 +46,29 @@ def _compile_cached(src: str, prefix: str, what: str) -> Optional[str]:
         return so_path
     tmp = f"{so_path}.build.{os.getpid()}"  # unique per builder: no
     # interleaved writes; the os.replace below is the atomic install
-    cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-o", tmp, src,
+    base_cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src,
     ]
-    try:
-        subprocess.run(
-            cmd, check=True, capture_output=True, timeout=240
-        )
-    except (OSError, subprocess.SubprocessError) as e:
-        detail = getattr(e, "stderr", b"") or b""
-        logger.warning(
-            "native %s build failed (%s): %s — using the Python path",
-            what, e, detail.decode(errors="replace")[:500],
-        )
-        return None
-    os.replace(tmp, so_path)
-    return so_path
+    # Try with OpenMP (the layout sorter parallelizes; sources guard with
+    # #ifdef _OPENMP), then without — a toolchain missing libgomp must
+    # degrade to a single-threaded native build, not to the Python path.
+    last_err = None
+    for extra in (["-fopenmp"], []):
+        cmd = base_cmd[:-3] + extra + base_cmd[-3:]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, timeout=240
+            )
+            os.replace(tmp, so_path)
+            return so_path
+        except (OSError, subprocess.SubprocessError) as e:
+            last_err = e
+    detail = getattr(last_err, "stderr", b"") or b""
+    logger.warning(
+        "native %s build failed (%s): %s — using the Python path",
+        what, last_err, detail.decode(errors="replace")[:500],
+    )
+    return None
 
 
 def _build() -> Optional[str]:

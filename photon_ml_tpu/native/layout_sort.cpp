@@ -19,6 +19,10 @@
 #include <cstring>
 #include <vector>
 
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
 namespace {
 
 // Field extraction shared by both passes.  tile_edge is the (square)
@@ -40,6 +44,56 @@ struct Fields {
 
 }  // namespace
 
+namespace {
+
+// Actual deliverable team size: observed from a real parallel region with
+// dynamic adjustment disabled.  Every later region requests exactly this
+// size; a region body ADDITIONALLY verifies its own team and degrades to
+// sequential (thread 0 owns everything) on any mismatch — range math from
+// a team size the runtime did not deliver would silently drop elements.
+inline int observed_team() {
+#ifdef _OPENMP
+  omp_set_dynamic(0);
+  int team = 1;
+#pragma omp parallel
+  {
+#pragma omp single
+    team = omp_get_num_threads();
+  }
+  return team;
+#else
+  return 1;
+#endif
+}
+
+inline void my_range(int64_t nnz, int team, int64_t* lo, int64_t* hi) {
+#ifdef _OPENMP
+  const int actual = omp_get_num_threads();
+  const int tid = omp_get_thread_num();
+#else
+  const int actual = 1, tid = 0;
+#endif
+  if (actual != team) {  // degraded team: thread 0 does everything
+    *lo = (tid == 0) ? 0 : nnz;
+    *hi = (tid == 0) ? nnz : nnz;
+    return;
+  }
+  *lo = nnz * tid / team;
+  *hi = nnz * (tid + 1) / team;
+}
+
+inline int my_row(int team) {
+#ifdef _OPENMP
+  if (omp_get_num_threads() != team) return 0;
+  return omp_get_thread_num();
+#else
+  (void)team;
+  return 0;
+#endif
+}
+
+}  // namespace
+
 extern "C" {
 
 // Stable argsort of entries by (tile, gwin, lane) key + one sequential
@@ -56,33 +110,64 @@ int64_t pl_sort_orientation(
   const int64_t key_span = nt * F.wins * 128;
 
   std::vector<int64_t> keys(static_cast<size_t>(nnz));
+#pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < nnz; ++i) keys[i] = F.key(rows[i], cols[i]);
 
-  // LSD radix argsort, 16-bit digits — stable, matching numpy's
-  // kind="stable" tie order (original index order within equal keys).
+  // Parallel LSD radix argsort, 16-bit digits — STABLE with numpy's
+  // kind="stable" tie order: each thread owns a CONTIGUOUS input range,
+  // per-(thread, bucket) counts are prefix-summed bucket-major then
+  // thread-major, so equal keys keep their original relative order.
   int bits = 1;
   while ((int64_t(1) << bits) < key_span) ++bits;
   const int DIGIT = 16;
   const int n_buckets = 1 << DIGIT;
+  const int n_threads = observed_team();
   std::vector<int32_t> idx_a(static_cast<size_t>(nnz));
   std::vector<int32_t> idx_b(static_cast<size_t>(nnz));
+#pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < nnz; ++i) idx_a[i] = static_cast<int32_t>(i);
-  std::vector<int64_t> counts(n_buckets);
+  std::vector<int64_t> counts(
+      static_cast<size_t>(n_threads) * n_buckets);
   int32_t* src = idx_a.data();
   int32_t* dst = idx_b.data();
   for (int shift = 0; shift < bits; shift += DIGIT) {
-    std::memset(counts.data(), 0, sizeof(int64_t) * n_buckets);
-    for (int64_t i = 0; i < nnz; ++i)
-      ++counts[(keys[src[i]] >> shift) & (n_buckets - 1)];
-    int64_t run = 0;
-    for (int b = 0; b < n_buckets; ++b) {
-      int64_t c = counts[b];
-      counts[b] = run;
-      run += c;
-    }
-    for (int64_t i = 0; i < nnz; ++i) {
-      int32_t e = src[i];
-      dst[counts[(keys[e] >> shift) & (n_buckets - 1)]++] = e;
+    std::memset(counts.data(), 0,
+                sizeof(int64_t) * counts.size());
+    // Histogram + prefix + stable scatter in ONE parallel region: the
+    // two per-thread phases see the SAME team by construction (a
+    // degraded team degrades both), so range/row math can never mix
+    // team sizes.
+#pragma omp parallel num_threads(n_threads)
+    {
+      int64_t lo, hi;
+      my_range(nnz, n_threads, &lo, &hi);
+      int64_t* my =
+          counts.data() + static_cast<size_t>(my_row(n_threads)) * n_buckets;
+      for (int64_t i = lo; i < hi; ++i)
+        ++my[(keys[src[i]] >> shift) & (n_buckets - 1)];
+#ifdef _OPENMP
+#pragma omp barrier
+#pragma omp single
+#endif
+      {
+        // Exclusive prefix over (bucket, thread) pairs, bucket-major:
+        // thread t's entries in bucket b land after every thread's
+        // smaller buckets and earlier threads' bucket b — stability.
+        int64_t run = 0;
+        for (int b = 0; b < n_buckets; ++b) {
+          for (int t = 0; t < n_threads; ++t) {
+            int64_t& slot =
+                counts[static_cast<size_t>(t) * n_buckets + b];
+            int64_t c = slot;
+            slot = run;
+            run += c;
+          }
+        }
+      }
+      for (int64_t i = lo; i < hi; ++i) {
+        int32_t e = src[i];
+        dst[my[(keys[e] >> shift) & (n_buckets - 1)]++] = e;
+      }
     }
     std::swap(src, dst);
   }
@@ -118,31 +203,63 @@ int64_t pl_scatter(
     int64_t depth, int64_t a, int64_t win_shift, int64_t code_bytes,
     void* code_out, float* val_out, int64_t* spill_out) {
   const Fields F{nbc, tile_edge, tile_edge >> 7};
-  int64_t n_spill = 0;
   int16_t* code16 = static_cast<int16_t*>(code_out);
   int32_t* code32 = static_cast<int32_t*>(code_out);
-  for (int64_t i = 0; i < nnz; ++i) {
-    const int32_t e = order[i];
-    if (depth_pos[i] >= depth) {
-      spill_out[n_spill++] = e;
-      continue;
+
+  // Parallel over contiguous sorted ranges: slot targets are unique per
+  // kept entry (disjoint writes), and per-thread spill segments are laid
+  // out in thread order, which IS sorted order — identical spill
+  // ordering to the sequential loop (and the numpy path).
+  const int n_threads = observed_team();
+  std::vector<int64_t> spill_base(n_threads + 1, 0);
+  // Count + prefix + write in ONE region: both phases share the same
+  // team by construction (see the sort loop).
+#pragma omp parallel num_threads(n_threads)
+  {
+    int64_t lo, hi;
+    my_range(nnz, n_threads, &lo, &hi);
+    const int row = my_row(n_threads);
+    int64_t n = 0;
+    for (int64_t i = lo; i < hi; ++i)
+      if (depth_pos[i] >= depth) ++n;
+    // Atomic: in a degraded team every thread maps to row 0, and an
+    // empty-range thread's plain "= 0" store could clobber the total.
+#ifdef _OPENMP
+#pragma omp atomic
+#endif
+    spill_base[row + 1] += n;
+#ifdef _OPENMP
+#pragma omp barrier
+#pragma omp single
+#endif
+    {
+      for (int t = 0; t < n_threads; ++t)
+        spill_base[t + 1] += spill_base[t];
     }
-    const int64_t r = rows[e], c = cols[e];
-    const int64_t t = F.tile(r, c);
-    const int64_t g = F.gwin(c);
-    const int64_t sub = base[t * F.wins + g] + depth_pos[i];
-    const int64_t flat = (t * a + sub) * 128 + F.lane(r);
-    const int64_t ohi = (r % tile_edge) >> 7;
-    const int64_t code =
-        (g << win_shift) | (ohi << 7) | (c & 127);
-    if (code_bytes == 2) {
-      code16[flat] = static_cast<int16_t>(code);
-    } else {
-      code32[flat] = static_cast<int32_t>(code);
+    int64_t cursor = spill_base[row];
+    for (int64_t i = lo; i < hi; ++i) {
+      const int32_t e = order[i];
+      if (depth_pos[i] >= depth) {
+        spill_out[cursor++] = e;
+        continue;
+      }
+      const int64_t r = rows[e], c = cols[e];
+      const int64_t t = F.tile(r, c);
+      const int64_t g = F.gwin(c);
+      const int64_t sub = base[t * F.wins + g] + depth_pos[i];
+      const int64_t flat = (t * a + sub) * 128 + F.lane(r);
+      const int64_t ohi = (r % tile_edge) >> 7;
+      const int64_t code =
+          (g << win_shift) | (ohi << 7) | (c & 127);
+      if (code_bytes == 2) {
+        code16[flat] = static_cast<int16_t>(code);
+      } else {
+        code32[flat] = static_cast<int32_t>(code);
+      }
+      val_out[flat] = vals[e];
     }
-    val_out[flat] = vals[e];
   }
-  return n_spill;
+  return spill_base[n_threads];
 }
 
 }  // extern "C"
