@@ -165,8 +165,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="out-of-core training: keep the dataset in host RAM as chunks "
         "of this many rows and stream them through HBM per objective "
         "evaluation (double-buffered device_put). 0 = device-resident. "
-        "Datasets larger than HBM train this way; smooth (none/L2) "
-        "regularization only",
+        "Datasets larger than HBM train this way; L-BFGS and OWL-QN "
+        "(L1/elastic-net) supported, TRON needs the resident path",
     )
     add_compile_cache_arg(p)
     return p
@@ -356,7 +356,7 @@ def _run(args) -> dict:
             # Chunks are host-resident numpy; nothing to re-place.
             return streaming_run_grid(
                 problem, stream, reg_weights, w0=w0, mesh=mesh,
-                solved=solved_now, on_solved=on_solved,
+                solved=solved_now, on_solved=on_solved, l1_mask=l1_mask,
             )
         if data_parallel:
             from photon_ml_tpu.parallel.distributed import (

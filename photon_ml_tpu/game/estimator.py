@@ -53,8 +53,8 @@ class FixedEffectCoordinateConfig:
     #: >0 trains this coordinate OUT-OF-CORE: the shard lives in host RAM
     #: as chunks of this many rows, double-buffered through HBM per
     #: objective pass (game/streaming.py) — for fixed-effect datasets
-    #: larger than device memory.  Single-device, smooth (none/L2)
-    #: regularization only.
+    #: larger than device memory.  Single-device; L-BFGS and OWL-QN
+    #: (L1/elastic-net) supported, TRON is not.
     streaming_chunk_rows: int = 0
 
 

@@ -30,11 +30,13 @@ from photon_ml_tpu.game.model import FixedEffectModel
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_ml_tpu.ops import losses as losses_lib
 from photon_ml_tpu.optim.lbfgs import LBFGSConfig
-from photon_ml_tpu.optim.problem import GlmOptimizationConfig
+from photon_ml_tpu.optim.owlqn import OWLQNConfig
+from photon_ml_tpu.optim.problem import GlmOptimizationConfig, OptimizerType
 from photon_ml_tpu.optim.streaming import (
     StreamingObjective,
     ensure_streamable,
     streaming_lbfgs_solve,
+    streaming_owlqn_solve,
 )
 
 Array = jax.Array
@@ -45,8 +47,9 @@ class StreamingFixedEffectCoordinate(Coordinate):
 
     Drop-in for the resident coordinate inside ``CoordinateDescent``:
     same ``train(offsets, warm) → w`` / ``score(w)`` / ``finalize``
-    surface, with every objective evaluation a streamed pass.  Smooth
-    (none/L2) regularization only (:func:`ensure_streamable`).
+    surface, with every objective evaluation a streamed pass.  L-BFGS and
+    OWL-QN (L1/elastic-net); TRON is rejected
+    (:func:`ensure_streamable`).
     """
 
     def __init__(
@@ -84,6 +87,15 @@ class StreamingFixedEffectCoordinate(Coordinate):
             tolerance=opt.tolerance,
             history=opt.history,
         )
+        self._owlqn = OWLQNConfig(
+            max_iters=opt.max_iters,
+            tolerance=opt.tolerance,
+            history=opt.history,
+        )
+
+    @property
+    def _l1_frac(self) -> float:
+        return self.config.regularization.l1_weight(1.0)
 
     @property
     def _l2(self) -> float:
@@ -98,12 +110,18 @@ class StreamingFixedEffectCoordinate(Coordinate):
         # (value_and_grad accepts the pre-sliced list), not per line-search
         # probe.
         slices = self._sobj.offset_slices(offsets)
-        res = streaming_lbfgs_solve(
-            lambda w: self._sobj.value_and_grad(
-                w, self._l2, offsets=slices
-            ),
-            w0, self._lbfgs,
-        )
+        vg = lambda w: self._sobj.value_and_grad(w, self._l2, offsets=slices)
+        # Static routing as in problem.solve: any L1 component needs the
+        # orthant machinery.
+        if (
+            self.config.optimizer.optimizer is OptimizerType.OWLQN
+            or self._l1_frac > 0.0
+        ):
+            res = streaming_owlqn_solve(
+                vg, w0, self._l1_frac * self.reg_weight, self._owlqn
+            )
+        else:
+            res = streaming_lbfgs_solve(vg, w0, self._lbfgs)
         return res.w
 
     def score(self, state: Array) -> Array:

@@ -43,6 +43,7 @@ from photon_ml_tpu.optim.lbfgs import (
 )
 from photon_ml_tpu.optim.linesearch import LineSearchConfig
 from photon_ml_tpu.optim.objective import GlmObjective
+from photon_ml_tpu.optim.owlqn import OWLQNConfig, _pseudo_gradient
 
 Array = jax.Array
 
@@ -450,6 +451,145 @@ def streaming_lbfgs_solve(
 
 
 # ---------------------------------------------------------------------------
+# Host-loop OWL-QN (streamed L1 / elastic-net)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _ow_pseudo_jit(w, grad, l1, mask):
+    return _pseudo_gradient(w, grad, l1, mask)
+
+
+@jax.jit
+def _ow_dir_jit(pg, S, Y, rho, gamma, n_pairs):
+    direction = -_two_loop(pg, S, Y, rho, gamma, n_pairs)
+    # Orthant alignment (Andrew & Gao §3.2): zero coordinates whose sign
+    # disagrees with -pg; all-zero direction degrades to steepest descent.
+    direction = jnp.where(direction * (-pg) > 0, direction, 0.0)
+    deg = jnp.vdot(direction, direction) == 0.0
+    return jnp.where(deg, -pg, direction)
+
+
+@jax.jit
+def _ow_trial_jit(w, t, direction, xi):
+    wt = w + t * direction
+    return jnp.where(wt * xi >= 0, wt, 0.0)  # orthant projection
+
+
+@jax.jit
+def _ow_l1_jit(w, l1, mask):
+    return l1 * jnp.vdot(mask, jnp.abs(w))
+
+
+def streaming_owlqn_solve(
+    value_and_grad: Callable[[Array], tuple[Array, Array]],
+    w0: Array,
+    l1_weight: float,
+    config: OWLQNConfig = OWLQNConfig(),
+    l1_mask: Optional[Array] = None,
+) -> SolveResult:
+    """OWL-QN with the outer loop on the host — the streamed counterpart
+    of optim/owlqn.owlqn_solve (same pseudo-gradient, orthant alignment
+    and projection, projected-step Armijo with non-strict backtracking,
+    smooth-gradient history, stall rule, convergence tests).
+    ``value_and_grad`` evaluates only the smooth part."""
+    m = config.history
+    d = w0.shape[0]
+    dtype = w0.dtype
+    w0 = jnp.asarray(w0)
+    l1 = jnp.asarray(l1_weight, jnp.float32)
+    mask = (
+        jnp.ones((d,), dtype) if l1_mask is None
+        else jnp.asarray(l1_mask, dtype)
+    )
+
+    def full_value(w, smooth) -> float:
+        return float(smooth) + float(_ow_l1_jit(w, l1, mask))
+
+    f_smooth, g = value_and_grad(w0)
+    w = w0
+    f = full_value(w, f_smooth)
+    pg = _ow_pseudo_jit(w, g, l1, mask)
+    pg_norm = float(jnp.linalg.norm(pg))
+    tol_scale = max(1.0, pg_norm)
+
+    values = np.full(config.max_iters + 1, np.nan, np.float64)
+    gnorms = np.full(config.max_iters + 1, np.nan, np.float64)
+    values[0] = f
+    gnorms[0] = pg_norm
+
+    S = jnp.zeros((m, d), dtype)
+    Y = jnp.zeros((m, d), dtype)
+    rho = jnp.zeros((m,), dtype)
+    gamma = jnp.asarray(1.0, dtype)
+    n_pairs = jnp.asarray(0, jnp.int32)
+
+    k = 0
+    converged = pg_norm <= config.tolerance * tol_scale
+    while not converged and k < config.max_iters:
+        pg = _ow_pseudo_jit(w, g, l1, mask)
+        direction = _ow_dir_jit(pg, S, Y, rho, gamma, n_pairs)
+        # Orthant: sign(w) where nonzero, else the step's sign.
+        xi = jnp.where(w != 0, jnp.sign(w), jnp.sign(-pg))
+        t = (
+            min(1.0, 1.0 / float(jnp.linalg.norm(pg)))
+            if int(n_pairs) == 0 else 1.0
+        )
+
+        def trial(t):
+            wt = _ow_trial_jit(w, jnp.float32(t), direction, xi)
+            smooth, grad = value_and_grad(wt)
+            return wt, full_value(wt, smooth), grad
+
+        w_new, f_new, g_new = trial(t)
+        n_evals = 1
+        # Armijo on the PROJECTED step, non-strict (a fully-clamped trial
+        # must keep backtracking) — mirrors the resident solver.
+        while (
+            f_new >= f + config.armijo_c1 * float(_vdot_jit(pg, w_new - w))
+            and n_evals < config.max_line_search_evals
+        ):
+            t *= config.backtrack
+            w_new, f_new, g_new = trial(t)
+            n_evals += 1
+
+        S, Y, rho, gamma, n_pairs = _history_jit(
+            S, Y, rho, gamma, n_pairs, w_new, w, g_new, g
+        )
+
+        k += 1
+        rel_impr = abs(f - f_new) / max(abs(f), 1e-12)
+        stalled = f_new >= f
+        if stalled:
+            converged = (
+                float(jnp.linalg.norm(pg)) <= config.tolerance * tol_scale
+            )
+        else:
+            w, f, g = w_new, f_new, g_new
+            pg_new = _ow_pseudo_jit(w, g, l1, mask)
+            pg_norm = float(jnp.linalg.norm(pg_new))
+            converged = (
+                pg_norm <= config.tolerance * tol_scale
+                or rel_impr <= config.tolerance * 1e-2
+            )
+        values[k] = f
+        gnorms[k] = pg_norm
+        if stalled:
+            break
+
+    pg_final = _ow_pseudo_jit(w, g, l1, mask)
+    return SolveResult(
+        w=w,
+        value=jnp.asarray(f, jnp.float32),
+        grad=pg_final,
+        iterations=jnp.asarray(k, jnp.int32),
+        converged=jnp.asarray(bool(converged)),
+        values=jnp.asarray(values, jnp.float32),
+        grad_norms=jnp.asarray(gnorms, jnp.float32),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Grid sweep over a streamed dataset
 # ---------------------------------------------------------------------------
 
@@ -460,15 +600,16 @@ def ensure_streamable(config) -> None:
     :func:`streaming_run_grid`."""
     from photon_ml_tpu.optim.problem import OptimizerType
 
-    if config.regularization.l1_weight(1.0) > 0.0:
+    # A TRON config CARRYING an L1 component routes to OWL-QN on the
+    # resident path (problem.solve) and does here too — only a smooth
+    # TRON solve actually needs the unstreamed CG inner loop.
+    if (
+        config.optimizer.optimizer is OptimizerType.TRON
+        and config.regularization.l1_weight(1.0) == 0.0
+    ):
         raise NotImplementedError(
-            "streamed training supports smooth (none/L2) regularization; "
-            "L1/elastic-net needs the resident OWL-QN path"
-        )
-    if config.optimizer.optimizer is not OptimizerType.LBFGS:
-        raise NotImplementedError(
-            f"streamed training runs L-BFGS; got "
-            f"{config.optimizer.optimizer.value} (use the resident path)"
+            "streamed training runs L-BFGS / OWL-QN; TRON's CG inner loop "
+            "is not streamed — use the resident path"
         )
 
 
@@ -482,13 +623,15 @@ def streaming_run_grid(
     solved: Optional[dict] = None,
     on_solved=None,
     accumulate: str = "f32",
+    l1_mask: Optional[Array] = None,
 ):
     """The λ-grid warm-start chain (optim.problem.grid_loop) over a
-    streamed dataset.  Smooth objectives only: L1/elastic-net needs OWL-QN's
-    orthant projection inside the line search, which is not streamed yet —
-    configs carrying an L1 component are rejected loudly
+    streamed dataset.  L1/elastic-net routes to the streamed OWL-QN
+    (exactly like the resident problem.solve); TRON is rejected loudly
     (:func:`ensure_streamable`).
     """
+    from photon_ml_tpu.optim.problem import OptimizerType
+
     cfg = problem.config
     ensure_streamable(cfg)
     sobj = StreamingObjective(
@@ -500,11 +643,25 @@ def streaming_run_grid(
         tolerance=opt.tolerance,
         history=opt.history,
     )
+    owlqn_cfg = OWLQNConfig(
+        max_iters=opt.max_iters,
+        tolerance=opt.tolerance,
+        history=opt.history,
+    )
+    l1_frac = cfg.regularization.l1_weight(1.0)
 
     def solve_fn(lam, w_prev):
+        l1 = l1_frac * float(lam)
         l2 = cfg.regularization.l2_weight(1.0) * float(lam)
         if w_prev is None:
             w_prev = jnp.zeros((stream.n_features,), jnp.float32)
+        # Static routing, as in problem.solve: any L1 component needs the
+        # orthant machinery.
+        if opt.optimizer is OptimizerType.OWLQN or l1_frac > 0.0:
+            return streaming_owlqn_solve(
+                lambda w: sobj.value_and_grad(w, l2), w_prev, l1,
+                owlqn_cfg, l1_mask=l1_mask,
+            )
         return streaming_lbfgs_solve(
             lambda w: sobj.value_and_grad(w, l2), w_prev, lbfgs_cfg
         )

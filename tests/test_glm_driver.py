@@ -171,15 +171,38 @@ class TestStreamingDriver:
         )
         assert set(res["metrics"]) == {"0.1", "1.0"}
 
-    def test_streamed_l1_fails_loudly(self, a1a_like, tmp_path):
+    def test_streamed_l1_matches_resident(self, a1a_like, tmp_path):
+        """Streamed OWL-QN through the driver: same model (incl. the
+        sparsity pattern and the unpenalized intercept) as the resident
+        L1 run."""
         train, _, d = a1a_like
-        with pytest.raises(NotImplementedError, match="L1"):
-            glm_driver.run([
-                "--train-data", train,
-                "--output-dir", str(tmp_path / "out"),
-                "--task", "logistic",
-                "--reg-type", "l1",
-                "--reg-weights", "1.0",
-                "--n-features", str(d),
-                "--stream-chunk-rows", "200",
-            ])
+        common = [
+            "--train-data", train,
+            "--task", "logistic",
+            "--reg-type", "l1",
+            "--reg-weights", "2.0",
+            "--n-features", str(d),
+        ]
+        out_r = str(tmp_path / "resident")
+        res_r = glm_driver.run(common + ["--output-dir", out_r])
+        out_s = str(tmp_path / "streamed")
+        res_s = glm_driver.run(
+            common + ["--output-dir", out_s, "--stream-chunk-rows", "200"]
+        )
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.io.model_store import load_glm_model
+
+        m_r, _ = load_glm_model(
+            os.path.join(out_r, "model_lambda_2.avro"), IndexMap.load(out_r)
+        )
+        m_s, _ = load_glm_model(
+            os.path.join(out_s, "model_lambda_2.avro"), IndexMap.load(out_s)
+        )
+        w_r = np.asarray(m_r.coefficients.means)
+        w_s = np.asarray(m_s.coefficients.means)
+        np.testing.assert_allclose(w_s, w_r, atol=5e-3)
+        assert np.sum(w_r == 0.0) > 10  # L1 sparsified
+        np.testing.assert_array_equal(w_s == 0.0, w_r == 0.0)
+        assert res_s["metrics"]["2.0"] == pytest.approx(
+            res_r["metrics"]["2.0"], abs=1e-3
+        )

@@ -265,16 +265,41 @@ class TestStreamingGrid:
         v_s = np.asarray(grid_s[0][1].coefficients.variances)
         np.testing.assert_allclose(v_s, v_r, rtol=2e-2)
 
-    def test_l1_rejected(self, rng):
+    def test_l1_grid_matches_resident(self, rng):
+        """Streamed OWL-QN: L1 grid lands on the resident solution with
+        the same sparsity pattern."""
+        n, d = 700, 30
+        X, y = _logistic_problem(rng, n, d - 1, density=0.15)
+        problem = GlmOptimizationProblem(
+            "logistic",
+            GlmOptimizationConfig(
+                optimizer=OptimizerConfig(max_iters=200, tolerance=1e-9),
+                regularization=RegularizationContext.l1(),
+            ),
+        )
+        data = make_glm_data(X, y)
+        grid_r = problem.run_grid(data, [2.0])
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=256, use_pallas=False
+        )
+        grid_s = streaming_run_grid(problem, stream, [2.0])
+        w_r = np.asarray(grid_r[0][1].coefficients.means)
+        w_s = np.asarray(grid_s[0][1].coefficients.means)
+        np.testing.assert_allclose(w_s, w_r, atol=5e-3)
+        # L1 must actually sparsify, identically on both paths.
+        assert np.sum(w_r == 0.0) > d // 4
+        np.testing.assert_array_equal(w_s == 0.0, w_r == 0.0)
+
+    def test_tron_rejected(self, rng):
         X, y = _logistic_problem(rng, 100, 10)
         problem = GlmOptimizationProblem(
             "logistic",
             GlmOptimizationConfig(
-                regularization=RegularizationContext.l1(),
+                optimizer=OptimizerConfig(optimizer=OptimizerType.TRON),
             ),
         )
         stream = make_streaming_glm_data(X, y, chunk_rows=64, use_pallas=False)
-        with pytest.raises(NotImplementedError, match="L1"):
+        with pytest.raises(NotImplementedError, match="TRON"):
             streaming_run_grid(problem, stream, [1.0])
 
 
@@ -496,3 +521,69 @@ class TestStreamingGameCoordinate:
             StreamingFixedEffectCoordinate(
                 "fixed", stream, "logistic", GlmOptimizationConfig(),
             )
+
+    def test_streamed_game_l1_fixed_effect(self, rng):
+        """L1 on the STREAMED GAME fixed effect inside coordinate descent:
+        same solution and sparsity pattern as the resident coordinate
+        (exercises OWL-QN's orthant-projected trials against per-chunk
+        CD offsets and the l1 = l1_frac * reg_weight scaling)."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.coordinates import (
+            FixedEffectCoordinate,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.game.data import (
+            FixedEffectDataset,
+            build_random_effect_dataset,
+        )
+        from photon_ml_tpu.game.descent import CoordinateDescent
+        from photon_ml_tpu.game.streaming import (
+            StreamingFixedEffectCoordinate,
+        )
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        X, users, y = self._game_problem(rng, n=500, d=30)
+        n, d = X.shape
+        bias = sp.csr_matrix(np.ones((n, 1), np.float32))
+        l1_opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=60, tolerance=1e-8),
+            regularization=RegularizationContext.l1(),
+        )
+        re_opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=30, tolerance=1e-7),
+            regularization=RegularizationContext.l2(),
+        )
+
+        def run_cd(fixed_coord):
+            re = RandomEffectCoordinate(
+                "per_user",
+                build_random_effect_dataset(
+                    users, bias, y, np.ones(n, np.float32)
+                ),
+                "logistic", re_opt, reg_weight=1.0, entity_key="userId",
+            )
+            return CoordinateDescent([fixed_coord, re]).run(
+                jnp.zeros(n, jnp.float32), n_iterations=2
+            )
+
+        resident = run_cd(FixedEffectCoordinate(
+            "fixed",
+            FixedEffectDataset(data=make_glm_data(X, y), n_global_rows=n),
+            "logistic", l1_opt, reg_weight=2.0,
+        ))
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=180, use_pallas=False
+        )
+        streamed = run_cd(StreamingFixedEffectCoordinate(
+            "fixed", stream, "logistic", l1_opt, reg_weight=2.0,
+        ))
+        w_r = np.asarray(resident.states["fixed"])
+        w_s = np.asarray(streamed.states["fixed"])
+        assert np.sum(w_r == 0.0) > 0  # the penalty actually pruned
+        np.testing.assert_allclose(w_s, w_r, atol=5e-3)
+        np.testing.assert_array_equal(w_s == 0.0, w_r == 0.0)
