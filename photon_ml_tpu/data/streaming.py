@@ -164,12 +164,13 @@ def streaming_from_blocks(
 
     Blocks may be scipy sparse or numpy (the first block decides; later
     blocks are converted).  ``use_pallas`` chooses the tiled Pallas layout
-    for sparse chunks ("auto": on TPU, single-shard — matching
-    make_glm_data's resident heuristic); layouts are built with
-    ``col_permutation=False`` and uniformized at the end so one jitted
-    program serves every chunk.  ``n_shards > 1`` stacks each chunk into
-    per-device row blocks (COO/dense only — the tiled layout is
-    single-device for now).
+    for sparse chunks ("auto": on TPU — matching make_glm_data's resident
+    heuristic); layouts are built with ``col_permutation=False`` and
+    uniformized at the end so one jitted program serves every chunk.
+    ``n_shards > 1`` stacks each chunk into per-device row blocks on a
+    leading shard axis — for the tiled layout, one per-shard layout each,
+    uniformized across chunks × shards and stacked leaf-wise, so the
+    streamed-DP shard_map program runs the Pallas kernels per shard.
     """
     import scipy.sparse as sp
 
@@ -200,18 +201,9 @@ def streaming_from_blocks(
     def _decide_mode(first_sparse: bool) -> str:
         up = use_pallas
         if up == "auto":
-            up = (
-                first_sparse
-                and jax.default_backend() == "tpu"
-                and n_shards == 1
-            )
+            up = first_sparse and jax.default_backend() == "tpu"
         if up and not first_sparse:
             raise ValueError("use_pallas=True needs sparse features")
-        if up and n_shards > 1:
-            raise ValueError(
-                "streamed data-parallel chunks use the COO layout; "
-                "pass use_pallas=False with n_shards > 1"
-            )
         return "pallas" if up else ("coo" if first_sparse else "dense")
 
     def _finish_chunk(X, y, w, o):
@@ -224,15 +216,23 @@ def streaming_from_blocks(
                 layout_to_host,
             )
 
-            coo = X.tocoo()
+            # One tiled layout per shard's row block, over (per_shard, d);
+            # with n_shards == 1 that is the whole chunk.  All chunk×shard
+            # layouts are uniformized together at the end, so one
+            # shard_map program serves every chunk (streamed DP at the
+            # kernel rate, not the COO rate).
             ctx = jax.default_device(cpu) if cpu is not None else _nullctx()
-            with ctx:
-                P = build_pallas_matrix(
-                    coo.row.astype(np.int64), coo.col.astype(np.int64),
-                    coo.data.astype(np.float32), chunk_rows, d,
-                    depth_cap=depth_cap, col_permutation=False,
-                )
-            finished.append(layout_to_host(P))
+            shard_mats = []
+            for s in range(max(n_shards, 1)):
+                coo = X[s * per_shard:(s + 1) * per_shard].tocoo()
+                with ctx:
+                    P = build_pallas_matrix(
+                        coo.row.astype(np.int64), coo.col.astype(np.int64),
+                        coo.data.astype(np.float32), per_shard, d,
+                        depth_cap=depth_cap, col_permutation=False,
+                    )
+                shard_mats.append(layout_to_host(P))
+            finished.append(shard_mats)
         elif mode == "coo":
             shards = []
             for s in range(max(n_shards, 1)):
@@ -345,9 +345,26 @@ def streaming_from_blocks(
     if mode == "pallas":
         from photon_ml_tpu.ops.sparse_pallas import uniformize_pallas_layouts
 
-        mats = uniformize_pallas_layouts(finished)
-        for mat, (y, w, o) in zip(mats, vectors):
-            chunks.append(GlmData(mat, y, w, o))
+        n_sh = max(n_shards, 1)
+        # Uniformize across chunks AND shards in one pass: every layout
+        # shares one pytree structure/shape set, so the per-chunk program
+        # compiles once and the stacked shard leaves carry one common
+        # leading axis for the mesh sharding.
+        flat = uniformize_pallas_layouts(
+            [m for shard_mats in finished for m in shard_mats]
+        )
+        for k, (y, w, o) in enumerate(vectors):
+            ms = flat[k * n_sh:(k + 1) * n_sh]
+            if n_shards == 1:
+                chunks.append(GlmData(ms[0], y, w, o))
+            else:
+                feat = jax.tree.map(lambda *xs: np.stack(xs), *ms)
+                chunks.append(GlmData(
+                    feat,
+                    y.reshape(n_shards, per_shard),
+                    w.reshape(n_shards, per_shard),
+                    o.reshape(n_shards, per_shard),
+                ))
     elif mode == "coo":
         budget = max(
             1,

@@ -165,8 +165,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="out-of-core training: keep the dataset in host RAM as chunks "
         "of this many rows and stream them through HBM per objective "
         "evaluation (double-buffered device_put). 0 = device-resident. "
-        "Datasets larger than HBM train this way; L-BFGS and OWL-QN "
-        "(L1/elastic-net) supported, TRON needs the resident path",
+        "Datasets larger than HBM train this way; L-BFGS, OWL-QN "
+        "(L1/elastic-net) and smooth TRON all stream",
     )
     add_compile_cache_arg(p)
     return p

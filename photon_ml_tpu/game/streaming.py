@@ -37,6 +37,7 @@ from photon_ml_tpu.optim.streaming import (
     ensure_streamable,
     streaming_lbfgs_solve,
     streaming_owlqn_solve,
+    streaming_tron_solve,
 )
 
 Array = jax.Array
@@ -47,9 +48,9 @@ class StreamingFixedEffectCoordinate(Coordinate):
 
     Drop-in for the resident coordinate inside ``CoordinateDescent``:
     same ``train(offsets, warm) → w`` / ``score(w)`` / ``finalize``
-    surface, with every objective evaluation a streamed pass.  L-BFGS and
-    OWL-QN (L1/elastic-net); TRON is rejected
-    (:func:`ensure_streamable`).
+    surface, with every objective evaluation a streamed pass.  All three
+    optimizers stream: L-BFGS, OWL-QN (L1/elastic-net), and smooth TRON
+    (each CG step one streamed HVP pass).
     """
 
     def __init__(
@@ -61,11 +62,18 @@ class StreamingFixedEffectCoordinate(Coordinate):
         reg_weight: float = 0.0,
         feature_shard: str = "global",
         accumulate: str = "f32",
+        mesh=None,
     ):
+        """``mesh``: streams each chunk SHARDED over the mesh's first axis
+        (chunks must be built with ``n_shards == mesh size``) — streamed
+        data parallelism composed with GAME: the per-chunk reduction runs
+        under shard_map with one fused psum, and the coordinate-descent
+        offsets ride per-chunk as sharded row slices."""
         ensure_streamable(config)
-        if stream.n_shards != 1:
-            raise NotImplementedError(
-                "the streamed fixed effect is single-device for now"
+        if mesh is None and stream.n_shards != 1:
+            raise ValueError(
+                f"stream has n_shards={stream.n_shards}; pass the mesh it "
+                "was built for"
             )
         if stream.has_nonzero_offsets():  # cached: free per grid point
             raise ValueError(
@@ -79,7 +87,7 @@ class StreamingFixedEffectCoordinate(Coordinate):
         self.reg_weight = reg_weight
         self.feature_shard = feature_shard
         self._sobj = StreamingObjective(
-            self.task, stream, accumulate=accumulate
+            self.task, stream, accumulate=accumulate, mesh=mesh
         )
         opt = config.optimizer
         self._lbfgs = LBFGSConfig(
@@ -119,6 +127,20 @@ class StreamingFixedEffectCoordinate(Coordinate):
         ):
             res = streaming_owlqn_solve(
                 vg, w0, self._l1_frac * self.reg_weight, self._owlqn
+            )
+        elif self.config.optimizer.optimizer is OptimizerType.TRON:
+            from photon_ml_tpu.optim.tron import TRONConfig
+
+            opt = self.config.optimizer
+            res = streaming_tron_solve(
+                vg,
+                lambda w, v: self._sobj.hvp(
+                    w, v, self._l2, offsets=slices
+                ),
+                w0,
+                TRONConfig(
+                    max_iters=opt.max_iters, tolerance=opt.tolerance
+                ),
             )
         else:
             res = streaming_lbfgs_solve(vg, w0, self._lbfgs)

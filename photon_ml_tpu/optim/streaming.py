@@ -107,13 +107,20 @@ class StreamingObjective:
         obj = self.objective
 
         def chunk_vg(w, off, chunk):
-            if mesh is not None:
-                local = jax.tree.map(lambda x: x[0], chunk)
-                v, g = obj.raw_value_and_grad(w, local)
-                return lax.psum(v, self._axis), lax.psum(g, self._axis)
             # ``off``: extra per-row margin offsets (coordinate descent —
             # the other coordinates' scores); a traced scalar 0 when
             # absent, so the plain-GLM trace carries no extra transfer.
+            # Under a mesh, a non-scalar ``off`` arrives SHARDED like the
+            # chunk (leading shard axis) — the streamed-GAME × DP
+            # composition.
+            if mesh is not None:
+                local = jax.tree.map(lambda x: x[0], chunk)
+                off_local = off if off.ndim == 0 else off[0]
+                local = dataclasses.replace(
+                    local, offsets=local.offsets + off_local
+                )
+                v, g = obj.raw_value_and_grad(w, local)
+                return lax.psum(v, self._axis), lax.psum(g, self._axis)
             chunk = dataclasses.replace(chunk, offsets=chunk.offsets + off)
             return obj.raw_value_and_grad(w, chunk)
 
@@ -132,9 +139,41 @@ class StreamingObjective:
             gc = (tg - gacc) - yg
             return (tv, vc, tg, gc)
 
+        def chunk_hvp(w, v, off, chunk):
+            # Recomputes the d2 weights inside the chunk program (one extra
+            # margins matvec) — the streamed analogue of the reference's
+            # HessianVectorAggregator, which recomputes per-row d2 on every
+            # treeAggregate round (SURVEY.md §3.1).  The resident TRON's
+            # per-iterate d2 cache (optim/tron.py) is an HBM-resident
+            # luxury the chunk store deliberately forgoes: caching would
+            # mean either holding n_rows of d2 weights in HBM (not
+            # out-of-core) or round-tripping them host↔device per CG step.
+            if mesh is not None:
+                local = jax.tree.map(lambda x: x[0], chunk)
+                off_local = off if off.ndim == 0 else off[0]
+                local = dataclasses.replace(
+                    local, offsets=local.offsets + off_local
+                )
+                return lax.psum(obj.raw_hvp(w, v, local), self._axis)
+            chunk = dataclasses.replace(chunk, offsets=chunk.offsets + off)
+            return obj.raw_hvp(w, v, chunk)
+
+        def hvp_step(acc, w, v, off, chunk):
+            h = chunk_hvp(w, v, off, chunk)
+            if accumulate == "f32":
+                return acc + h
+            hacc, hc = acc  # Kahan, matching acc_step's gradient pair
+            yh = h - hc
+            th = hacc + yh
+            return (th, (th - hacc) - yh)
+
         def chunk_diag(w, off, chunk):
             if mesh is not None:
                 local = jax.tree.map(lambda x: x[0], chunk)
+                off_local = off if off.ndim == 0 else off[0]
+                local = dataclasses.replace(
+                    local, offsets=local.offsets + off_local
+                )
                 d2w = obj.d2_weights(w, local)
                 return lax.psum(
                     local.features.sq_rmatvec(d2w), self._axis
@@ -157,17 +196,40 @@ class StreamingObjective:
 
             spec = P(self._axis)
             n_acc = 2 if accumulate == "f32" else 4
-            self._acc = jax.jit(jax.shard_map(
-                acc_step, mesh=mesh,
-                in_specs=((P(),) * n_acc, P(), P(), spec),
-                out_specs=(P(),) * n_acc,
-                check_vma=False,
-            ))
-            self._diag = jax.jit(jax.shard_map(
-                diag_step, mesh=mesh,
-                in_specs=(P(), P(), P(), spec), out_specs=P(),
-                check_vma=False,
-            ))
+            acc_carry = (P(),) * n_acc
+            hvp_carry = P() if accumulate == "f32" else (P(), P())
+            # Two shard_map variants per pass, built lazily and cached:
+            # scalar offsets (plain GLM — a replicated traced 0, no
+            # transfer) vs ROW offsets sharded like the chunk (streamed
+            # GAME × data parallelism, the other coordinates' scores).
+            self._mesh_progs: dict = {}
+            builders = {
+                "acc": lambda off_spec: jax.shard_map(
+                    acc_step, mesh=mesh,
+                    in_specs=(acc_carry, P(), off_spec, spec),
+                    out_specs=acc_carry, check_vma=False,
+                ),
+                "diag": lambda off_spec: jax.shard_map(
+                    diag_step, mesh=mesh,
+                    in_specs=(P(), P(), off_spec, spec), out_specs=P(),
+                    check_vma=False,
+                ),
+                "hvp": lambda off_spec: jax.shard_map(
+                    hvp_step, mesh=mesh,
+                    in_specs=(hvp_carry, P(), P(), off_spec, spec),
+                    out_specs=hvp_carry, check_vma=False,
+                ),
+            }
+
+            def _program(name: str, row_off: bool):
+                key = (name, row_off)
+                if key not in self._mesh_progs:
+                    self._mesh_progs[key] = jax.jit(
+                        builders[name](spec if row_off else P())
+                    )
+                return self._mesh_progs[key]
+
+            self._mesh_program = _program
             self._score = jax.jit(jax.shard_map(
                 score_step, mesh=mesh, in_specs=(P(), spec), out_specs=spec,
                 check_vma=False,
@@ -175,12 +237,14 @@ class StreamingObjective:
         else:
             self._acc = jax.jit(acc_step)
             self._diag = jax.jit(diag_step)
+            self._hvp = jax.jit(hvp_step)
             self._score = jax.jit(score_step)
         self._finish = jax.jit(
             lambda v, g, w, l2: (
                 v + 0.5 * l2 * jnp.dot(w, w), g + l2 * w
             )
         )
+        self._hvp_finish = jax.jit(lambda h, v, l2: h + l2 * v)
 
     @property
     def n_features(self) -> int:
@@ -190,6 +254,17 @@ class StreamingObjective:
         if self._sharding is not None:
             return jax.device_put(chunk, self._sharding)
         return jax.device_put(chunk)
+
+    def _select(self, name: str, per_chunk) -> Callable:
+        """The compiled per-chunk program for pass ``name`` — on a mesh,
+        picked by whether the offset slices are scalars or sharded rows
+        (two distinct shard_map signatures)."""
+        if self.mesh is None:
+            return {
+                "acc": self._acc, "diag": self._diag, "hvp": self._hvp,
+            }[name]
+        row_off = getattr(per_chunk[0], "ndim", 0) != 0
+        return self._mesh_program(name, row_off)
 
     def offset_slices(self, offsets) -> list:
         """Per-chunk slices of coordinate-descent offsets (the other
@@ -213,11 +288,23 @@ class StreamingObjective:
                 f"{self.stream.n_rows}"
             )
         if self.mesh is not None:
-            raise NotImplementedError(
-                "per-row offsets are single-device for now (the GAME "
-                "streamed fixed effect); shard the offsets per chunk to "
-                "extend"
-            )
+            # Streamed GAME × DP: each chunk's offset slice is reshaped to
+            # the chunk's (shard, row) grid and placed SHARDED over the
+            # mesh, so the per-chunk program adds it to the local rows with
+            # no gather (row k of shard s is chunk row s·per_shard + k,
+            # matching data/streaming's reshape layout).
+            n_sh = self.stream.n_shards
+            off = np.asarray(offsets, np.float32)
+            pad = n_chunks * cr - off.shape[0]
+            if pad:
+                off = np.pad(off, (0, pad))
+            return [
+                jax.device_put(
+                    off[k * cr:(k + 1) * cr].reshape(n_sh, cr // n_sh),
+                    self._sharding,
+                )
+                for k in range(n_chunks)
+            ]
         off = jnp.asarray(offsets, jnp.float32)
         pad = n_chunks * cr - off.shape[0]
         if pad:
@@ -260,9 +347,9 @@ class StreamingObjective:
                 jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
                 jnp.zeros((d,), jnp.float32), jnp.zeros((d,), jnp.float32),
             )
+        slices = self.offset_slices(offsets)
         out = self._stream_accumulate(
-            self._acc, init, args=(w,),
-            per_chunk=self.offset_slices(offsets),
+            self._select("acc", slices), init, args=(w,), per_chunk=slices,
         )
         v, g = (out[0], out[1]) if self.accumulate == "f32" else (
             out[0], out[2]
@@ -272,10 +359,30 @@ class StreamingObjective:
     def hessian_diagonal(self, w: Array, offsets=None) -> Array:
         """Σᵢ wᵢ·d2ᵢ·X²ᵢⱼ streamed over chunks (for coefficient variances)."""
         d = self.stream.n_features
+        slices = self.offset_slices(offsets)
         return self._stream_accumulate(
-            self._diag, jnp.zeros((d,), jnp.float32), args=(w,),
-            per_chunk=self.offset_slices(offsets),
+            self._select("diag", slices), jnp.zeros((d,), jnp.float32),
+            args=(w,), per_chunk=slices,
         )
+
+    def hvp(self, w: Array, v: Array, l2_weight=0.0, offsets=None) -> Array:
+        """H(w)·v = Xᵀ(d2w ⊙ (Xv)) + λ·v as ONE streamed pass over the
+        chunks — the ``HessianVectorAggregator`` ``treeAggregate`` round of
+        the reference's distributed TRON (SURVEY.md §3.1), here a
+        double-buffered chunk stream.  Callers issuing many HVPs against
+        fixed offsets (a whole CG solve) should pre-slice via
+        :meth:`offset_slices` and pass the list."""
+        d = self.stream.n_features
+        zero = jnp.zeros((d,), jnp.float32)
+        init = zero if self.accumulate == "f32" else (zero, zero)
+        slices = self.offset_slices(offsets)
+        h = self._stream_accumulate(
+            self._select("hvp", slices), init, args=(w, v),
+            per_chunk=slices,
+        )
+        if self.accumulate != "f32":
+            h = h[0]
+        return self._hvp_finish(h, v, jnp.asarray(l2_weight, jnp.float32))
 
     def scores(self, w: Array) -> np.ndarray:
         """Margins for every real row, streamed (validation scoring)."""
@@ -590,6 +697,155 @@ def streaming_owlqn_solve(
 
 
 # ---------------------------------------------------------------------------
+# Host-loop TRON (streamed trust-region Newton)
+# ---------------------------------------------------------------------------
+
+
+def _host_steihaug_cg(hvp, g, delta, max_iters, tol):
+    """Steihaug CG with host control flow — same math as optim/tron.py's
+    ``_steihaug_cg`` (negative-curvature and radius-crossing exits to the
+    boundary, residual kept consistent with the returned step), but each
+    Hessian-vector product is a full streamed pass, so host round-trips
+    are free by comparison.
+
+    Returns ``(s, r, n_hvp)`` with ``r = -g - H·s`` for the returned ``s``
+    (so sᵀHs is recoverable without another streamed pass)."""
+    s = jnp.zeros_like(g)
+    r = _axpy_jit(jnp.zeros_like(g), jnp.float32(-1.0), g)
+    p = r
+    rr = float(_vdot_jit(r, r))
+    if math.sqrt(rr) <= tol:
+        return s, r, 0
+    n_hvp = 0
+    for _ in range(max_iters):
+        Hp = hvp(p)
+        n_hvp += 1
+        pHp = float(_vdot_jit(p, Hp))
+        neg_curv = pHp <= 0.0
+        alpha = rr / (pHp if pHp > 0.0 else 1.0)
+        s_next = _axpy_jit(s, jnp.float32(alpha), p)
+        crosses = math.sqrt(float(_vdot_jit(s_next, s_next))) >= delta
+        if neg_curv or crosses:
+            # Go to the trust-region boundary along p: ‖s + τp‖ = delta.
+            pp = float(_vdot_jit(p, p))
+            sp = float(_vdot_jit(s, p))
+            ss = float(_vdot_jit(s, s))
+            disc = max(sp * sp + pp * (delta * delta - ss), 0.0)
+            tau = (-sp + math.sqrt(disc)) / max(pp, 1e-30)
+            s = _axpy_jit(s, jnp.float32(tau), p)
+            r = _axpy_jit(r, jnp.float32(-tau), Hp)
+            break
+        s = s_next
+        r = _axpy_jit(r, jnp.float32(-alpha), Hp)
+        rr_new = float(_vdot_jit(r, r))
+        if math.sqrt(rr_new) <= tol:
+            break
+        beta = rr_new / max(rr, 1e-30)
+        p = _axpy_jit(r, jnp.float32(beta), p)
+        rr = rr_new
+    return s, r, n_hvp
+
+
+def streaming_tron_solve(
+    value_and_grad: Callable[[Array], tuple[Array, Array]],
+    hvp_fn: Callable[[Array, Array], Array],
+    w0: Array,
+    config=None,
+) -> SolveResult:
+    """Trust-region Newton-CG with the outer loop on the host — the
+    streamed counterpart of optim/tron.tron_solve, closing the last
+    optimizer×residency cell: the reference runs TRON distributed, one
+    ``HessianVectorAggregator`` treeAggregate round per CG step
+    (SURVEY.md §3.1 / BASELINE config 3); here each CG step is one
+    streamed :meth:`StreamingObjective.hvp` pass.
+
+    Math mirrors the resident solver step-for-step: LIBLINEAR initial
+    radius ``‖g0‖``, the same forcing tolerance, acceptance threshold and
+    radius-update constants (via the shared ``TRONConfig``), the same
+    boundary-consistent residual trick recovering sᵀHs without an extra
+    HVP, and the same convergence/stall rules — so a single-chunk streamed
+    solve tracks the resident trajectory to float tolerance.
+
+    ``hvp_fn(w, v)`` must return the REGULARIZED Hessian-vector product.
+    """
+    from photon_ml_tpu.optim.tron import TRONConfig
+
+    if config is None:
+        config = TRONConfig()
+    w = jnp.asarray(w0)
+
+    f_dev, g = value_and_grad(w)
+    f = float(f_dev)
+    g_norm = float(jnp.linalg.norm(g))
+    tol_scale = max(1.0, g_norm)
+    delta = g_norm  # LIBLINEAR: initial radius = ||g0||
+
+    values = np.full(config.max_iters + 1, np.nan, np.float64)
+    gnorms = np.full(config.max_iters + 1, np.nan, np.float64)
+    values[0] = f
+    gnorms[0] = g_norm
+
+    k = 0
+    converged = g_norm <= config.tolerance * tol_scale
+    while not converged and k < config.max_iters:
+        cg_tol = config.cg_tol * g_norm
+        step, residual, _ = _host_steihaug_cg(
+            lambda v: hvp_fn(w, v), g, delta, config.max_cg_iters, cg_tol
+        )
+
+        w_try = _axpy_jit(w, jnp.float32(1.0), step)
+        f_try_dev, g_try = value_and_grad(w_try)
+        f_try = float(f_try_dev)
+
+        gs = float(_vdot_jit(g, step))
+        # r = -g - H·s  ⇒  sᵀHs = -s·r - s·g (one saved streamed pass per
+        # outer iteration, as in the resident solver).
+        sHs = -float(_vdot_jit(step, residual)) - gs
+        pred = -(gs + 0.5 * sHs)
+        ared = f - f_try
+        rho = ared / (pred if pred > 0.0 else 1e-30)
+        accept = rho > config.eta0 and pred > 0.0
+
+        # Radius update (LIBLINEAR-style, same constants as the resident).
+        snorm = math.sqrt(max(float(_vdot_jit(step, step)), 0.0))
+        if rho < config.eta1:
+            delta_new = max(config.sigma1 * snorm, config.sigma2 * delta)
+            if rho < config.eta0:
+                delta_new *= config.sigma2
+        elif rho > config.eta2:
+            delta_new = max(delta, config.sigma3 * snorm)
+        else:
+            delta_new = delta
+        delta = max(delta_new, 1e-20)
+
+        k += 1
+        if accept:
+            rel_impr = abs(ared) / max(abs(f), 1e-12)
+            w, f, g = w_try, f_try, g_try
+            g_norm = float(jnp.linalg.norm(g))
+        else:
+            rel_impr = math.inf
+        converged = (
+            g_norm <= config.tolerance * tol_scale
+            or rel_impr <= config.tolerance * 1e-2
+        )
+        values[k] = f
+        gnorms[k] = g_norm
+        if delta <= 1e-18:  # radius collapsed: no further progress possible
+            break
+
+    return SolveResult(
+        w=w,
+        value=jnp.asarray(f, jnp.float32),
+        grad=g,
+        iterations=jnp.asarray(k, jnp.int32),
+        converged=jnp.asarray(bool(converged)),
+        values=jnp.asarray(values, jnp.float32),
+        grad_norms=jnp.asarray(gnorms, jnp.float32),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Grid sweep over a streamed dataset
 # ---------------------------------------------------------------------------
 
@@ -597,20 +853,12 @@ def streaming_owlqn_solve(
 def ensure_streamable(config) -> None:
     """Reject configs the streamed path cannot train — callable BEFORE the
     (possibly hours-long) chunk-store ingest, and always re-checked by
-    :func:`streaming_run_grid`."""
-    from photon_ml_tpu.optim.problem import OptimizerType
+    :func:`streaming_run_grid`.
 
-    # A TRON config CARRYING an L1 component routes to OWL-QN on the
-    # resident path (problem.solve) and does here too — only a smooth
-    # TRON solve actually needs the unstreamed CG inner loop.
-    if (
-        config.optimizer.optimizer is OptimizerType.TRON
-        and config.regularization.l1_weight(1.0) == 0.0
-    ):
-        raise NotImplementedError(
-            "streamed training runs L-BFGS / OWL-QN; TRON's CG inner loop "
-            "is not streamed — use the resident path"
-        )
+    Every optimizer now streams (L-BFGS, OWL-QN, and smooth TRON via
+    :func:`streaming_tron_solve`), so this currently accepts everything;
+    it remains the single gate future unstreamable features must fail
+    loudly through."""
 
 
 def streaming_run_grid(
@@ -626,11 +874,12 @@ def streaming_run_grid(
     l1_mask: Optional[Array] = None,
 ):
     """The λ-grid warm-start chain (optim.problem.grid_loop) over a
-    streamed dataset.  L1/elastic-net routes to the streamed OWL-QN
-    (exactly like the resident problem.solve); TRON is rejected loudly
-    (:func:`ensure_streamable`).
+    streamed dataset.  L1/elastic-net routes to the streamed OWL-QN and
+    smooth TRON to the streamed trust-region solver (exactly like the
+    resident problem.solve's static routing).
     """
     from photon_ml_tpu.optim.problem import OptimizerType
+    from photon_ml_tpu.optim.tron import TRONConfig
 
     cfg = problem.config
     ensure_streamable(cfg)
@@ -661,6 +910,13 @@ def streaming_run_grid(
             return streaming_owlqn_solve(
                 lambda w: sobj.value_and_grad(w, l2), w_prev, l1,
                 owlqn_cfg, l1_mask=l1_mask,
+            )
+        if opt.optimizer is OptimizerType.TRON:
+            return streaming_tron_solve(
+                lambda w: sobj.value_and_grad(w, l2),
+                lambda w, v: sobj.hvp(w, v, l2),
+                w_prev,
+                TRONConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
             )
         return streaming_lbfgs_solve(
             lambda w: sobj.value_and_grad(w, l2), w_prev, lbfgs_cfg

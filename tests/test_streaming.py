@@ -210,6 +210,38 @@ class TestStreamingPallasChunks:
             np.asarray(g1), np.asarray(g2), atol=1e-4
         )
 
+    def test_sharded_pallas_chunks_match_coo_stream(self, rng):
+        """Tiled Pallas layouts on SHARDED streams (VERDICT r3 #4): one
+        per-shard layout each, uniformized across chunks × shards, stacked
+        on the shard axis — the streamed-DP shard_map program must match
+        the COO-layout stream bit-for-tolerance, offsets included."""
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+        n_dev = mesh.devices.size
+        n, d = 700, 300
+        X, y = _logistic_problem(rng, n, d - 1, density=0.05)
+        offs = rng.normal(size=n).astype(np.float32)
+        s_coo = make_streaming_glm_data(
+            X, y, chunk_rows=256, use_pallas=False, n_shards=n_dev
+        )
+        s_pal = make_streaming_glm_data(
+            X, y, chunk_rows=256, use_pallas=True, n_shards=n_dev,
+            depth_cap=16,
+        )
+        assert s_pal.n_shards == n_dev
+        o_coo = StreamingObjective("logistic", s_coo, mesh=mesh)
+        o_pal = StreamingObjective("logistic", s_pal, mesh=mesh)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v1, g1 = o_coo.value_and_grad(w, 0.5, offsets=offs)
+        v2, g2 = o_pal.value_and_grad(w, 0.5, offsets=offs)
+        np.testing.assert_allclose(float(v2), float(v1), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(g2), np.asarray(g1), atol=1e-3)
+        v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(o_pal.hvp(w, v, 0.5, offsets=offs)),
+            np.asarray(o_coo.hvp(w, v, 0.5, offsets=offs)),
+            atol=1e-3,
+        )
+
     def test_dropped_host_coo_fails_loudly(self, rng):
         n, d = 300, 200
         X, y = _logistic_problem(rng, n, d - 1, density=0.05)
@@ -290,17 +322,161 @@ class TestStreamingGrid:
         assert np.sum(w_r == 0.0) > d // 4
         np.testing.assert_array_equal(w_s == 0.0, w_r == 0.0)
 
-    def test_tron_rejected(self, rng):
-        X, y = _logistic_problem(rng, 100, 10)
+    def test_tron_grid_matches_resident(self, rng):
+        """Smooth TRON streams (VERDICT r3 #2: the last optimizer ×
+        residency cell): the streamed grid lands on the resident TRON
+        solution."""
+        n, d = 800, 30
+        X, y = _logistic_problem(rng, n, d - 1, density=0.1)
         problem = GlmOptimizationProblem(
             "logistic",
             GlmOptimizationConfig(
-                optimizer=OptimizerConfig(optimizer=OptimizerType.TRON),
+                optimizer=OptimizerConfig(
+                    optimizer=OptimizerType.TRON,
+                    max_iters=100,
+                    tolerance=1e-8,
+                ),
+                regularization=RegularizationContext.l2(),
             ),
         )
-        stream = make_streaming_glm_data(X, y, chunk_rows=64, use_pallas=False)
-        with pytest.raises(NotImplementedError, match="TRON"):
-            streaming_run_grid(problem, stream, [1.0])
+        lams = [0.5, 2.0]
+        data = make_glm_data(X, y)
+        grid_r = problem.run_grid(data, lams)
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=256, use_pallas=False
+        )
+        grid_s = streaming_run_grid(problem, stream, lams)
+        for (lam_r, model_r, _), (lam_s, model_s, _) in zip(grid_r, grid_s):
+            assert lam_r == lam_s
+            np.testing.assert_allclose(
+                np.asarray(model_s.coefficients.means),
+                np.asarray(model_r.coefficients.means),
+                atol=5e-3,
+            )
+
+
+class TestStreamingTRON:
+    def test_hvp_matches_resident(self, rng):
+        """One streamed HVP pass == the resident Hessian-vector product
+        (the HessianVectorAggregator treeAggregate analogue)."""
+        n, d = 900, 40
+        X, y = _logistic_problem(rng, n, d - 1, density=0.1)
+        data = make_glm_data(X, y)
+        obj = GlmObjective(losses.logistic)
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=256, use_pallas=False
+        )
+        sobj = StreamingObjective("logistic", stream)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        h_r = obj.hvp(w, v, data, l2_weight=0.7)
+        h_s = sobj.hvp(w, v, l2_weight=0.7)
+        np.testing.assert_allclose(
+            np.asarray(h_s), np.asarray(h_r), atol=1e-3
+        )
+        # The kahan accumulator must carry through the HVP pass too (its
+        # compensation pair changes the carry structure, not the result).
+        h_k = StreamingObjective(
+            "logistic", stream, accumulate="kahan"
+        ).hvp(w, v, l2_weight=0.7)
+        np.testing.assert_allclose(
+            np.asarray(h_k), np.asarray(h_r), atol=1e-3
+        )
+
+    def test_single_chunk_mirrors_resident_trajectory(self, rng):
+        """With ONE chunk the streamed trust-region solver runs identical
+        math (same radius updates, same CG, same acceptance): the
+        per-iteration objective trace must track the resident solver."""
+        from photon_ml_tpu.optim.streaming import streaming_tron_solve
+        from photon_ml_tpu.optim.tron import TRONConfig, tron_solve
+
+        n, d = 400, 20
+        X, y = _logistic_problem(rng, n, d - 1, density=0.15)
+        data = make_glm_data(X, y)
+        obj = GlmObjective(losses.logistic)
+        cfg = TRONConfig(max_iters=40, tolerance=1e-9)
+        res_r = tron_solve(
+            lambda w: obj.value_and_grad(w, data, l2_weight=0.3),
+            lambda w, v, aux: obj.hvp(w, v, data, l2_weight=0.3, d2w=aux),
+            jnp.zeros(d, jnp.float32),
+            cfg,
+            d2_fn=lambda w: obj.d2_weights(w, data),
+        )
+        stream = make_streaming_glm_data(X, y, chunk_rows=n, use_pallas=False)
+        sobj = StreamingObjective("logistic", stream)
+        res_s = streaming_tron_solve(
+            lambda w: sobj.value_and_grad(w, 0.3),
+            lambda w, v: sobj.hvp(w, v, 0.3),
+            jnp.zeros(d, jnp.float32),
+            cfg,
+        )
+        vr = np.asarray(res_r.values)
+        vs = np.asarray(res_s.values)
+        k = min(5, int(res_r.iterations), int(res_s.iterations))
+        np.testing.assert_allclose(vs[: k + 1], vr[: k + 1], rtol=1e-4)
+        np.testing.assert_allclose(
+            float(res_s.value), float(res_r.value), rtol=1e-5
+        )
+
+    def test_multi_chunk_matches_resident_solution(self, rng):
+        from photon_ml_tpu.optim.streaming import streaming_tron_solve
+        from photon_ml_tpu.optim.tron import TRONConfig, tron_solve
+
+        n, d = 1200, 50
+        X, y = _logistic_problem(rng, n, d - 1, density=0.1)
+        data = make_glm_data(X, y)
+        obj = GlmObjective(losses.logistic)
+        cfg = TRONConfig(max_iters=100, tolerance=1e-9)
+        res_r = tron_solve(
+            lambda w: obj.value_and_grad(w, data, l2_weight=1.0),
+            lambda w, v, aux: obj.hvp(w, v, data, l2_weight=1.0, d2w=aux),
+            jnp.zeros(d, jnp.float32),
+            cfg,
+            d2_fn=lambda w: obj.d2_weights(w, data),
+        )
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=400, use_pallas=False
+        )
+        sobj = StreamingObjective("logistic", stream)
+        res_s = streaming_tron_solve(
+            lambda w: sobj.value_and_grad(w, 1.0),
+            lambda w, v: sobj.hvp(w, v, 1.0),
+            jnp.zeros(d, jnp.float32),
+            cfg,
+        )
+        np.testing.assert_allclose(
+            float(res_s.value), float(res_r.value), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(res_s.w), np.asarray(res_r.w), atol=5e-3
+        )
+
+    def test_tron_l1_still_routes_to_owlqn(self, rng):
+        """A TRON config carrying L1 routes to streamed OWL-QN (static
+        routing parity with the resident problem.solve)."""
+        n, d = 400, 20
+        X, y = _logistic_problem(rng, n, d - 1, density=0.15)
+        problem = GlmOptimizationProblem(
+            "logistic",
+            GlmOptimizationConfig(
+                optimizer=OptimizerConfig(
+                    optimizer=OptimizerType.TRON,
+                    max_iters=150,
+                    tolerance=1e-9,
+                ),
+                regularization=RegularizationContext.elastic_net(0.5),
+            ),
+        )
+        data = make_glm_data(X, y)
+        grid_r = problem.run_grid(data, [1.0])
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=128, use_pallas=False
+        )
+        grid_s = streaming_run_grid(problem, stream, [1.0])
+        w_r = np.asarray(grid_r[0][1].coefficients.means)
+        w_s = np.asarray(grid_s[0][1].coefficients.means)
+        np.testing.assert_allclose(w_s, w_r, atol=5e-3)
+        np.testing.assert_array_equal(w_s == 0.0, w_r == 0.0)
 
 
 class TestStreamingDataParallel:
@@ -323,6 +499,13 @@ class TestStreamingDataParallel:
         np.testing.assert_allclose(float(vN), float(v1), rtol=1e-4)
         np.testing.assert_allclose(
             np.asarray(gN), np.asarray(g1), atol=1e-3
+        )
+        # HVP parity under the mesh too (streamed-DP TRON's inner pass).
+        v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        h1 = sobj1.hvp(w, v, 0.7)
+        hN = sobjN.hvp(w, v, 0.7)
+        np.testing.assert_allclose(
+            np.asarray(hN), np.asarray(h1), atol=1e-3
         )
 
     def test_sharded_grid_fit(self, rng):
@@ -348,6 +531,164 @@ class TestStreamingDataParallel:
             np.asarray(grid_r[0][1].coefficients.means),
             atol=5e-3,
         )
+
+    def test_sharded_row_offsets_match_single_device(self, rng):
+        """Per-row CD offsets under the mesh (VERDICT r3 #3): each chunk's
+        offset slice rides SHARDED next to the chunk, and value/grad/HVP/
+        hessian-diagonal all match the single-device streamed pass."""
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+        n_dev = mesh.devices.size
+        n, d = 960, 25
+        X, y = _logistic_problem(rng, n, d - 1, density=0.1)
+        offs = rng.normal(size=n).astype(np.float32)
+        stream1 = make_streaming_glm_data(
+            X, y, chunk_rows=320, use_pallas=False
+        )
+        streamN = make_streaming_glm_data(
+            X, y, chunk_rows=320, use_pallas=False, n_shards=n_dev
+        )
+        sobj1 = StreamingObjective("logistic", stream1)
+        sobjN = StreamingObjective("logistic", streamN, mesh=mesh)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v1, g1 = sobj1.value_and_grad(w, 0.5, offsets=offs)
+        vN, gN = sobjN.value_and_grad(w, 0.5, offsets=offs)
+        np.testing.assert_allclose(float(vN), float(v1), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(gN), np.asarray(g1), atol=1e-3)
+        v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(sobjN.hvp(w, v, 0.5, offsets=offs)),
+            np.asarray(sobj1.hvp(w, v, 0.5, offsets=offs)),
+            atol=1e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sobjN.hessian_diagonal(w, offsets=offs)),
+            np.asarray(sobj1.hessian_diagonal(w, offsets=offs)),
+            atol=1e-3,
+        )
+
+    def test_streamed_game_cd_on_mesh(self, rng):
+        """BASELINE config 5's minimum viable shape: streaming AND
+        multi-device AND GAME simultaneously — a mesh-sharded streamed
+        fixed effect composed with a resident random effect in one
+        coordinate descent, matching the single-device streamed run."""
+        from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+        from photon_ml_tpu.game.data import build_random_effect_dataset
+        from photon_ml_tpu.game.descent import CoordinateDescent
+        from photon_ml_tpu.game.streaming import (
+            StreamingFixedEffectCoordinate,
+        )
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+        n_dev = mesh.devices.size
+        n, d, n_users = 640, 16, 12
+        X = sp.random(n, d, density=0.15, random_state=9, format="csr",
+                      dtype=np.float32)
+        users = np.array(
+            [f"u{rng.integers(n_users)}" for _ in range(n)], dtype=object
+        )
+        margin = X @ rng.normal(size=d).astype(np.float32)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(
+            np.float32
+        )
+        bias = sp.csr_matrix(np.ones((n, 1), np.float32))
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=50, tolerance=1e-8),
+            regularization=RegularizationContext.l2(),
+        )
+
+        def run_cd(fixed_coord):
+            re = RandomEffectCoordinate(
+                "per_user",
+                build_random_effect_dataset(
+                    users, bias, y, np.ones(n, np.float32)
+                ),
+                "logistic", opt, reg_weight=1.0, entity_key="userId",
+            )
+            return CoordinateDescent([fixed_coord, re]).run(
+                jnp.zeros(n, jnp.float32), n_iterations=2
+            )
+
+        stream1 = make_streaming_glm_data(
+            X, y, chunk_rows=160, use_pallas=False
+        )
+        streamN = make_streaming_glm_data(
+            X, y, chunk_rows=160, use_pallas=False, n_shards=n_dev
+        )
+        single = run_cd(StreamingFixedEffectCoordinate(
+            "fixed", stream1, "logistic", opt, reg_weight=0.5,
+        ))
+        meshed = run_cd(StreamingFixedEffectCoordinate(
+            "fixed", streamN, "logistic", opt, reg_weight=0.5, mesh=mesh,
+        ))
+        np.testing.assert_allclose(
+            np.asarray(meshed.states["fixed"]),
+            np.asarray(single.states["fixed"]),
+            atol=5e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(meshed.scores["fixed"]),
+            np.asarray(single.scores["fixed"]),
+            atol=5e-3,
+        )
+        # Downstream coordinate: trained against the streamed-DP scores,
+        # so psum-order f32 drift compounds once more — slightly looser.
+        for b_m, b_s in zip(
+            meshed.states["per_user"], single.states["per_user"]
+        ):
+            np.testing.assert_allclose(
+                np.asarray(b_m), np.asarray(b_s), atol=1e-2
+            )
+
+    def test_estimator_mesh_plus_streaming(self, rng):
+        """GameEstimator accepts mesh + streaming_chunk_rows together now
+        (the round-3 rejection at game/estimator.py:198 is lifted)."""
+        from photon_ml_tpu.game.estimator import (
+            FixedEffectCoordinateConfig,
+            GameEstimator,
+            RandomEffectCoordinateConfig,
+        )
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+        n, d, n_users = 512, 12, 10
+        X = sp.random(n, d, density=0.2, random_state=3, format="csr",
+                      dtype=np.float32)
+        users = np.array(
+            [f"u{rng.integers(n_users)}" for _ in range(n)], dtype=object
+        )
+        margin = X @ rng.normal(size=d).astype(np.float32)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(
+            np.float32
+        )
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=40, tolerance=1e-7),
+            regularization=RegularizationContext.l2(),
+        )
+        configs = {
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="global", optimization=opt, reg_weight=0.5,
+                streaming_chunk_rows=128,
+            ),
+            "per_user": RandomEffectCoordinateConfig(
+                feature_shard="global", entity_key="userId",
+                optimization=opt, reg_weight=1.0,
+            ),
+        }
+        shards = {"global": X}
+        ids = {"userId": users}
+
+        fit_m = GameEstimator(
+            "logistic", configs, n_iterations=2, mesh=mesh
+        ).fit(shards, ids, y)
+        fit_1 = GameEstimator(
+            "logistic", configs, n_iterations=2
+        ).fit(shards, ids, y)
+        w_m = np.asarray(
+            fit_m[0].models["fixed"].model.coefficients.means
+        )
+        w_1 = np.asarray(
+            fit_1[0].models["fixed"].model.coefficients.means
+        )
+        np.testing.assert_allclose(w_m, w_1, atol=5e-3)
 
 
 class TestChunkStoreShapes:
@@ -587,3 +928,139 @@ class TestStreamingGameCoordinate:
         assert np.sum(w_r == 0.0) > 0  # the penalty actually pruned
         np.testing.assert_allclose(w_s, w_r, atol=5e-3)
         np.testing.assert_array_equal(w_s == 0.0, w_r == 0.0)
+
+    def test_streamed_game_tron_fixed_effect(self, rng):
+        """Smooth TRON on the STREAMED GAME fixed effect: exercises the
+        streamed HVP against per-chunk CD offsets (the d2 weights depend
+        on the other coordinates' scores through the margin)."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.coordinates import (
+            FixedEffectCoordinate,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.game.data import (
+            FixedEffectDataset,
+            build_random_effect_dataset,
+        )
+        from photon_ml_tpu.game.descent import CoordinateDescent
+        from photon_ml_tpu.game.streaming import (
+            StreamingFixedEffectCoordinate,
+        )
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        X, users, y = self._game_problem(rng, n=500, d=20)
+        n, d = X.shape
+        bias = sp.csr_matrix(np.ones((n, 1), np.float32))
+        tron_opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(
+                optimizer=OptimizerType.TRON, max_iters=50, tolerance=1e-8
+            ),
+            regularization=RegularizationContext.l2(),
+        )
+        re_opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=30, tolerance=1e-7),
+            regularization=RegularizationContext.l2(),
+        )
+
+        def run_cd(fixed_coord):
+            re = RandomEffectCoordinate(
+                "per_user",
+                build_random_effect_dataset(
+                    users, bias, y, np.ones(n, np.float32)
+                ),
+                "logistic", re_opt, reg_weight=1.0, entity_key="userId",
+            )
+            return CoordinateDescent([fixed_coord, re]).run(
+                jnp.zeros(n, jnp.float32), n_iterations=2
+            )
+
+        resident = run_cd(FixedEffectCoordinate(
+            "fixed",
+            FixedEffectDataset(data=make_glm_data(X, y), n_global_rows=n),
+            "logistic", tron_opt, reg_weight=0.5,
+        ))
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=180, use_pallas=False
+        )
+        streamed = run_cd(StreamingFixedEffectCoordinate(
+            "fixed", stream, "logistic", tron_opt, reg_weight=0.5,
+        ))
+        np.testing.assert_allclose(
+            np.asarray(streamed.states["fixed"]),
+            np.asarray(resident.states["fixed"]),
+            atol=5e-3,
+        )
+
+
+class TestDoubleBufferStructure:
+    """VERDICT r3 weak #3: the overlap claim, pinned by structure instead
+    of arithmetic — transfer k+1 must be ENQUEUED before the host blocks
+    on compute k, and at most 2 chunks may be alive on the device."""
+
+    def test_transfer_enqueued_before_block_and_hbm_bound(
+        self, rng, monkeypatch
+    ):
+        import gc
+        import weakref
+
+        n, d = 600, 10
+        X, y = _logistic_problem(rng, n, d - 1, density=0.2)
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=100, use_pallas=False
+        )
+        assert stream.n_chunks == 6
+        sobj = StreamingObjective("logistic", stream)
+
+        events = []
+        live_refs = []
+        orig_put = sobj._put
+        put_idx = [0]
+
+        def tracked_put(chunk):
+            k = put_idx[0]
+            put_idx[0] += 1
+            events.append(("put", k))
+            dev = orig_put(chunk)
+            leaf = jax.tree.leaves(dev)[0]
+            live_refs.append(weakref.ref(leaf))
+            # HBM-residency bound: at the moment chunk k lands, only the
+            # chunk computing (k-1) and this one may be alive.
+            gc.collect()
+            alive = sum(1 for r in live_refs if r() is not None)
+            assert alive <= 2, f"{alive} chunks alive in device memory"
+            return dev
+
+        monkeypatch.setattr(sobj, "_put", tracked_put)
+
+        orig_block = jax.block_until_ready
+        block_idx = [0]
+
+        def tracked_block(x):
+            events.append(("block", block_idx[0]))
+            block_idx[0] += 1
+            return orig_block(x)
+
+        monkeypatch.setattr(jax, "block_until_ready", tracked_block)
+
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v, g = sobj.value_and_grad(w, 0.3)
+        monkeypatch.undo()
+        assert np.isfinite(float(v))
+
+        # Structure: put(k+1) strictly precedes block(k) for every k —
+        # the transfer is in flight while compute k runs (the double
+        # buffer); and exactly one blocking sync per chunk (backpressure).
+        order = {e: i for i, e in enumerate(events)}
+        n_chunks = stream.n_chunks
+        assert sum(1 for e in events if e[0] == "put") == n_chunks
+        assert sum(1 for e in events if e[0] == "block") == n_chunks
+        for k in range(n_chunks - 1):
+            assert order[("put", k + 1)] < order[("block", k)], (
+                f"transfer {k + 1} was not enqueued before the host "
+                f"blocked on compute {k}: {events}"
+            )
