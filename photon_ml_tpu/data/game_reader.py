@@ -169,82 +169,124 @@ class _Accumulator:
 
 def _decode_game_blocks(path: str, acc: _Accumulator) -> None:
     """Specialized streaming decoder for GAME-schema container files."""
-    unpack_double = struct.Struct("<d").unpack_from
     for _schema, count, payload in avro.iter_blocks(path):
-        pos = 0
-        mv = payload
+        _decode_game_payload(payload, count, acc)
 
-        def read_long():
-            nonlocal pos
-            shift = 0
-            n = 0
-            while True:
-                b = mv[pos]
-                pos += 1
-                n |= (b & 0x7F) << shift
-                if not b & 0x80:
-                    return (n >> 1) ^ -(n & 1)
-                shift += 7
 
-        def read_str():
-            nonlocal pos
-            ln = read_long()
-            s = mv[pos : pos + ln].decode("utf-8")
-            pos += ln
-            return s
+def _decode_game_payload(payload, count: int, acc: _Accumulator) -> None:
+    """Decode ONE container block's payload into ``acc`` (shared by the
+    whole-file reader and the bounded-block scoring iterator)."""
+    unpack_double = struct.Struct("<d").unpack_from
+    pos = 0
+    mv = payload
 
-        for _ in range(count):
-            acc.uids.append(read_str() if read_long() == 1 else None)
-            acc.response.append(unpack_double(mv, pos)[0])
+    def read_long():
+        nonlocal pos
+        shift = 0
+        n = 0
+        while True:
+            b = mv[pos]
+            pos += 1
+            n |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return (n >> 1) ^ -(n & 1)
+            shift += 7
+
+    def read_str():
+        nonlocal pos
+        ln = read_long()
+        s = mv[pos : pos + ln].decode("utf-8")
+        pos += ln
+        return s
+
+    for _ in range(count):
+        acc.uids.append(read_str() if read_long() == 1 else None)
+        acc.response.append(unpack_double(mv, pos)[0])
+        pos += 8
+        if read_long() == 1:
+            acc.weight.append(unpack_double(mv, pos)[0])
             pos += 8
-            if read_long() == 1:
-                acc.weight.append(unpack_double(mv, pos)[0])
-                pos += 8
-            else:
-                acc.weight.append(1.0)
-            if read_long() == 1:
-                acc.offset.append(unpack_double(mv, pos)[0])
-                pos += 8
-            else:
-                acc.offset.append(0.0)
-            # ids map
-            while True:
-                c = read_long()
-                if c == 0:
-                    break
-                if c < 0:
-                    c = -c
-                    read_long()  # skip byte-size prefix
-                for _ in range(c):
-                    k = read_str()
-                    acc.add_id(k, read_str())
-            # features map: shard -> [ {name, term, value} ]
-            while True:
-                c = read_long()
-                if c == 0:
-                    break
-                if c < 0:
-                    c = -c
-                    read_long()
-                for _ in range(c):
-                    shard = read_str()
-                    acc.touch_shard(shard)
-                    while True:
-                        fc = read_long()
-                        if fc == 0:
-                            break
-                        if fc < 0:
-                            fc = -fc
-                            read_long()
-                        for _ in range(fc):
-                            name = read_str()
-                            term = read_str()
-                            val = unpack_double(mv, pos)[0]
-                            pos += 8
-                            acc.add_feature(
-                                shard, feature_key(name, term), val
-                            )
-            acc.finish_row()
+        else:
+            acc.weight.append(1.0)
+        if read_long() == 1:
+            acc.offset.append(unpack_double(mv, pos)[0])
+            pos += 8
+        else:
+            acc.offset.append(0.0)
+        # ids map
+        while True:
+            c = read_long()
+            if c == 0:
+                break
+            if c < 0:
+                c = -c
+                read_long()  # skip byte-size prefix
+            for _ in range(c):
+                k = read_str()
+                acc.add_id(k, read_str())
+        # features map: shard -> [ {name, term, value} ]
+        while True:
+            c = read_long()
+            if c == 0:
+                break
+            if c < 0:
+                c = -c
+                read_long()
+            for _ in range(c):
+                shard = read_str()
+                acc.touch_shard(shard)
+                while True:
+                    fc = read_long()
+                    if fc == 0:
+                        break
+                    if fc < 0:
+                        fc = -fc
+                        read_long()
+                    for _ in range(fc):
+                        name = read_str()
+                        term = read_str()
+                        val = unpack_double(mv, pos)[0]
+                        pos += 8
+                        acc.add_feature(
+                            shard, feature_key(name, term), val
+                        )
+        acc.finish_row()
+
+
+def _native_preload_args(forward: dict) -> list:
+    """Encode the shard vocabularies ONCE for session preloading — the
+    per-block sessions of the streaming iterator must not re-sort and
+    re-encode a multi-million-key vocabulary per yielded block."""
+    import ctypes
+
+    out = []
+    for shard, fwd in forward.items():
+        keys = [k for k, _ in sorted(fwd.items(), key=lambda kv: kv[1])]
+        arr = (ctypes.c_char_p * len(keys))(
+            *[k.encode("utf-8") for k in keys]
+        )
+        out.append((shard.encode("utf-8"), arr, len(keys)))
+    return out
+
+
+def _native_new(lib, acc: _Accumulator, preload_args: list = None):
+    """Fresh native decode session with the accumulator's shard maps
+    preloaded (scoring mode)."""
+    h = lib.gd_new(1 if acc.building else 0)
+    if not acc.building:
+        if preload_args is None:
+            preload_args = _native_preload_args(acc.forward)
+        for shard_b, arr, nkeys in preload_args:
+            lib.gd_preload_shard(h, shard_b, arr, nkeys)
+    return h
+
+
+def _native_feed(lib, h, path: str, payload, count: int) -> None:
+    rc = lib.gd_decode_block(h, payload, len(payload), count)
+    if rc != 0:
+        raise ValueError(
+            f"{path}: {lib.gd_error(h).decode()} (native decoder)"
+        )
 
 
 def _decode_game_blocks_native(path: str, acc: _Accumulator) -> bool:
@@ -254,122 +296,121 @@ def _decode_game_blocks_native(path: str, acc: _Accumulator) -> bool:
     Returns False (leaving ``acc`` untouched) when the native library is
     unavailable, True on success.  Raises ValueError on malformed input,
     like the Python decoders."""
-    import ctypes
-
     from photon_ml_tpu.native import load_game_decoder
 
     lib = load_game_decoder()
     if lib is None:
         return False
-    h = lib.gd_new(1 if acc.building else 0)
+    h = _native_new(lib, acc)
     try:
-        if not acc.building:
-            for shard, fwd in acc.forward.items():
-                keys = [k for k, _ in sorted(fwd.items(), key=lambda kv: kv[1])]
-                arr = (ctypes.c_char_p * len(keys))(
-                    *[k.encode("utf-8") for k in keys]
-                )
-                lib.gd_preload_shard(h, shard.encode("utf-8"), arr, len(keys))
         for _schema, count, payload in avro.iter_blocks(path):
-            rc = lib.gd_decode_block(h, payload, len(payload), count)
-            if rc != 0:
-                raise ValueError(
-                    f"{path}: {lib.gd_error(h).decode()} (native decoder)"
-                )
-        n = lib.gd_n_rows(h)
-        acc.n = int(n)
-
-        resp = np.empty(n, np.float64)
-        wt = np.empty(n, np.float64)
-        off = np.empty(n, np.float64)
-        as_d = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
-        as_i = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
-        as_f = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-        if n:
-            lib.gd_copy_row_data(h, as_d(resp), as_d(wt), as_d(off))
-        acc.response = resp
-        acc.weight = wt
-        acc.offset = off
-
-        def _strings(blob_len, copy_fn):
-            blob = ctypes.create_string_buffer(max(int(blob_len), 1))
-            start = np.empty(n, np.int64)
-            end = np.empty(n, np.int64)
-            if n:
-                copy_fn(blob, as_i(start), as_i(end))
-            raw = blob.raw
-            return [
-                raw[s:e].decode("utf-8") if s >= 0 else None
-                for s, e in zip(start, end)
-            ]
-
-        acc.uids = _strings(
-            lib.gd_uid_blob_len(h),
-            lambda b, s, e: lib.gd_copy_uids(h, b, s, e),
-        )
-        for i in range(lib.gd_n_id_cols(h)):
-            name = lib.gd_id_col_name(h, i).decode("utf-8")
-            acc.id_cols[name] = _strings(
-                lib.gd_id_col_blob_len(h, i),
-                lambda b, s, e, i=i: lib.gd_copy_id_col(h, i, b, s, e),
-            )
-
-        for i in range(lib.gd_n_shards(h)):
-            shard = lib.gd_shard_name(h, i).decode("utf-8")
-            dropped = int(lib.gd_shard_dropped(h, i))
-            if dropped:
-                acc.dropped[shard] = dropped
-            if lib.gd_shard_unknown(h, i) or not lib.gd_shard_seen(h, i):
-                # Unknown shard (scoring) → excluded; preloaded shard never
-                # seen in the data → excluded (matches the Python paths).
-                continue
-            nnz = int(lib.gd_shard_nnz(h, i))
-            rows = np.empty(nnz, np.int64)
-            cols = np.empty(nnz, np.int64)
-            vals = np.empty(nnz, np.float32)
-            if nnz:
-                lib.gd_copy_shard_coo(h, i, as_i(rows), as_i(cols), as_f(vals))
-            acc.shard_rows[shard] = (rows, cols, vals)
-            if acc.building:
-                nkeys = int(lib.gd_shard_nkeys(h, i))
-                blob = ctypes.create_string_buffer(
-                    max(int(lib.gd_shard_keys_blob_len(h, i)), 1)
-                )
-                offsets = np.empty(nkeys, np.int64)
-                if nkeys:
-                    lib.gd_copy_shard_keys(h, i, blob, as_i(offsets))
-                raw = blob.raw
-                keys = []
-                pos = 0
-                for koff in offsets:
-                    keys.append(raw[pos:koff].decode("utf-8"))
-                    pos = int(koff)
-                acc.forward[shard] = {k: j for j, k in enumerate(keys)}
+            _native_feed(lib, h, path, payload, count)
+        _native_extract(lib, h, acc)
         return True
     finally:
         lib.gd_free(h)
 
 
+def _native_extract(lib, h, acc: _Accumulator) -> None:
+    """Pull the session's accumulated columnar arrays into ``acc``
+    (REPLACES the columnar fields — callers pass a fresh accumulator)."""
+    import ctypes
+
+    n = lib.gd_n_rows(h)
+    acc.n = int(n)
+
+    resp = np.empty(n, np.float64)
+    wt = np.empty(n, np.float64)
+    off = np.empty(n, np.float64)
+    as_d = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    as_i = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    as_f = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    if n:
+        lib.gd_copy_row_data(h, as_d(resp), as_d(wt), as_d(off))
+    acc.response = resp
+    acc.weight = wt
+    acc.offset = off
+
+    def _strings(blob_len, copy_fn):
+        blob = ctypes.create_string_buffer(max(int(blob_len), 1))
+        start = np.empty(n, np.int64)
+        end = np.empty(n, np.int64)
+        if n:
+            copy_fn(blob, as_i(start), as_i(end))
+        raw = blob.raw
+        return [
+            raw[s:e].decode("utf-8") if s >= 0 else None
+            for s, e in zip(start, end)
+        ]
+
+    acc.uids = _strings(
+        lib.gd_uid_blob_len(h),
+        lambda b, s, e: lib.gd_copy_uids(h, b, s, e),
+    )
+    for i in range(lib.gd_n_id_cols(h)):
+        name = lib.gd_id_col_name(h, i).decode("utf-8")
+        acc.id_cols[name] = _strings(
+            lib.gd_id_col_blob_len(h, i),
+            lambda b, s, e, i=i: lib.gd_copy_id_col(h, i, b, s, e),
+        )
+
+    for i in range(lib.gd_n_shards(h)):
+        shard = lib.gd_shard_name(h, i).decode("utf-8")
+        dropped = int(lib.gd_shard_dropped(h, i))
+        if dropped:
+            acc.dropped[shard] = dropped
+        if lib.gd_shard_unknown(h, i) or not lib.gd_shard_seen(h, i):
+            # Unknown shard (scoring) → excluded; preloaded shard never
+            # seen in the data → excluded (matches the Python paths).
+            continue
+        nnz = int(lib.gd_shard_nnz(h, i))
+        rows = np.empty(nnz, np.int64)
+        cols = np.empty(nnz, np.int64)
+        vals = np.empty(nnz, np.float32)
+        if nnz:
+            lib.gd_copy_shard_coo(h, i, as_i(rows), as_i(cols), as_f(vals))
+        acc.shard_rows[shard] = (rows, cols, vals)
+        if acc.building:
+            nkeys = int(lib.gd_shard_nkeys(h, i))
+            blob = ctypes.create_string_buffer(
+                max(int(lib.gd_shard_keys_blob_len(h, i)), 1)
+            )
+            offsets = np.empty(nkeys, np.int64)
+            if nkeys:
+                lib.gd_copy_shard_keys(h, i, blob, as_i(offsets))
+            raw = blob.raw
+            keys = []
+            pos = 0
+            for koff in offsets:
+                keys.append(raw[pos:koff].decode("utf-8"))
+                pos = int(koff)
+            acc.forward[shard] = {k: j for j, k in enumerate(keys)}
+
+
 def _decode_generic(path: str, acc: _Accumulator) -> None:
     """Fallback: stream records through the generic datum decoder."""
     for rec in avro.iter_container(path):
-        acc.uids.append(rec.get("uid"))
-        acc.response.append(float(rec["response"]))
-        acc.weight.append(
-            1.0 if rec.get("weight") is None else float(rec["weight"])
-        )
-        acc.offset.append(
-            0.0 if rec.get("offset") is None else float(rec["offset"])
-        )
-        for k, v in rec.get("ids", {}).items():
-            acc.add_id(k, v)
-        for shard, feats in rec.get("features", {}).items():
-            acc.touch_shard(shard)
-            for f in feats:
-                acc.add_feature(
-                    shard, feature_key(f["name"], f["term"]), f["value"]
-                )
-        acc.finish_row()
+        _add_generic_record(rec, acc)
+
+
+def _add_generic_record(rec, acc: _Accumulator) -> None:
+    acc.uids.append(rec.get("uid"))
+    acc.response.append(float(rec["response"]))
+    acc.weight.append(
+        1.0 if rec.get("weight") is None else float(rec["weight"])
+    )
+    acc.offset.append(
+        0.0 if rec.get("offset") is None else float(rec["offset"])
+    )
+    for k, v in rec.get("ids", {}).items():
+        acc.add_id(k, v)
+    for shard, feats in rec.get("features", {}).items():
+        acc.touch_shard(shard)
+        for f in feats:
+            acc.add_feature(
+                shard, feature_key(f["name"], f["term"]), f["value"]
+            )
+    acc.finish_row()
 
 
 def read_game_avro(
@@ -437,3 +478,128 @@ def read_game_avro(
     weight = np.asarray(acc.weight, np.float32)
     offset = np.asarray(acc.offset, np.float32)
     return shards, ids, response, weight, offset, acc.uids, out_maps
+
+
+def iter_game_avro(
+    path: str,
+    index_maps: dict,
+    block_rows: int = 1 << 16,
+    logger=None,
+    id_keys=(),
+):
+    """Stream GAME Avro data in bounded row blocks — the out-of-core
+    SCORING read path (SURVEY.md §3.3: the reference's scoring driver
+    handles arbitrary-size data via Spark partitions; here the bound is
+    one block of rows, never the file).
+
+    Yields ``(shards, ids, response, weight, offset, uids)`` per block.
+    Blocks flush at container-block boundaries once at least ``block_rows``
+    rows accumulated, so a yielded block can exceed ``block_rows`` by at
+    most one container block's rows.  ``index_maps`` is REQUIRED: scoring
+    uses the saved maps (unseen features drop); a block-local index build
+    would give inconsistent columns across blocks.
+
+    Every index-mapped shard materializes in every block (all-zero when
+    the block carries no features for it), and every key in ``id_keys``
+    (the model's entity-id columns) materializes in every block's ``ids``
+    (None-padded) — per-block consumers need stable dict layouts, not
+    ones keyed by what happened to appear in the block's rows.
+    """
+    if index_maps is None:
+        raise ValueError(
+            "iter_game_avro needs saved index maps (the scoring path)"
+        )
+    if block_rows <= 0:
+        raise ValueError(f"block_rows must be positive, got {block_rows}")
+    forward: dict[str, dict] = {
+        s: dict(m) for s, m in index_maps.items()
+    }
+    dropped_total: dict[str, int] = {}
+
+    def fresh_acc() -> _Accumulator:
+        return _Accumulator(False, forward)
+
+    def assemble(acc: _Accumulator):
+        n = acc.n
+        shards = {}
+        for shard, fwd in forward.items():
+            rows, cols, vals = acc.shard_rows.get(shard, ([], [], []))
+            shards[shard] = sp.csr_matrix(
+                (
+                    np.asarray(vals, np.float32),
+                    (
+                        np.asarray(rows, np.int64),
+                        np.asarray(cols, np.int64),
+                    ),
+                ),
+                shape=(n, len(fwd)),
+            )
+        ids = {}
+        for k in set(acc.id_cols) | set(id_keys):
+            lst = acc.id_cols.get(k, [])
+            if len(lst) < n:
+                lst.extend([None] * (n - len(lst)))
+            ids[k] = np.asarray(lst)
+        for s, c in acc.dropped.items():
+            dropped_total[s] = dropped_total.get(s, 0) + c
+        return (
+            shards,
+            ids,
+            np.asarray(acc.response, np.float32),
+            np.asarray(acc.weight, np.float32),
+            np.asarray(acc.offset, np.float32),
+            acc.uids,
+        )
+
+    acc = fresh_acc()
+    if _is_game_schema(avro.read_schema(path)):
+        from photon_ml_tpu.native import load_game_decoder
+
+        lib = load_game_decoder()
+        if lib is not None:
+            # Native path: one C++ session per yielded block — the varint
+            # + feature-hash hot loop stays native exactly where streaming
+            # matters (multi-GB files); only columnar arrays cross back.
+            h = None
+            preload = _native_preload_args(forward)
+            try:
+                for _schema, count, payload in avro.iter_blocks(path):
+                    if h is None:
+                        h = _native_new(lib, acc, preload)
+                    _native_feed(lib, h, path, payload, count)
+                    if int(lib.gd_n_rows(h)) >= block_rows:
+                        _native_extract(lib, h, acc)
+                        lib.gd_free(h)
+                        h = None
+                        yield assemble(acc)
+                        acc = fresh_acc()
+                if h is not None:
+                    _native_extract(lib, h, acc)
+                    lib.gd_free(h)
+                    h = None
+            finally:
+                if h is not None:
+                    lib.gd_free(h)
+        else:
+            for _schema, count, payload in avro.iter_blocks(path):
+                _decode_game_payload(payload, count, acc)
+                if acc.n >= block_rows:
+                    yield assemble(acc)
+                    acc = fresh_acc()
+    else:
+        for rec in avro.iter_container(path):
+            _add_generic_record(rec, acc)
+            if acc.n >= block_rows:
+                yield assemble(acc)
+                acc = fresh_acc()
+    if acc.n:
+        yield assemble(acc)
+    if dropped_total:
+        (logger or logging.getLogger(__name__)).warning(
+            "iter_game_avro(%s): dropped features absent from supplied "
+            "index maps: %s",
+            path,
+            ", ".join(
+                f"{s}={c}" for s, c in sorted(dropped_total.items())
+            ),
+        )

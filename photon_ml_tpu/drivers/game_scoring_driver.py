@@ -43,6 +43,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="emit mean responses (inverse link) instead of raw margins",
     )
     p.add_argument("--evaluator", help="also compute a metric if labels present")
+    p.add_argument(
+        "--stream-block-rows",
+        type=int,
+        default=0,
+        help="out-of-core scoring: read, score, and write the data in "
+        "bounded blocks of about this many rows — memory is one block, "
+        "never the dataset (plus 12 B/row of score/label/weight columns "
+        "kept ONLY when --evaluator needs a global metric; the reference "
+        "scores arbitrary-size data via Spark partitions, SURVEY.md 3.3). "
+        "0 = materialize the whole file",
+    )
     add_compile_cache_arg(p)
     return p
 
@@ -58,30 +69,95 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     initialize_logged(logger)
 
     model, index_maps = load_game_model(os.path.join(args.model_dir, "models"))
-    shards, ids, response, weight, offset, uids, _ = read_game_avro(
-        args.data, index_maps=index_maps, logger=logger
-    )
     transformer = GameTransformer(model, logger=logger)
-    scores = (
-        transformer.transform_with_mean(shards, ids, offset)
-        if args.mean
-        else transformer.transform(shards, ids, offset)
-    )
+    out_path = os.path.join(args.output_dir, "scores.avro")
 
-    records = [
-        {
-            "uid": uids[i],
-            "predictionScore": float(scores[i]),
-            "label": float(response[i]),
-            "ids": {k: str(v[i]) for k, v in ids.items()},
+    def score_record(uid, score, label, ids, i):
+        # ONE record shape for both paths — the streamed/resident parity
+        # tests assert bit-for-bit identical output files.
+        return {
+            "uid": uid,
+            "predictionScore": float(score),
+            "label": float(label),
+            "ids": {
+                k: str(v[i]) for k, v in ids.items() if v[i] is not None
+            },
         }
-        for i in range(len(scores))
-    ]
-    avro.write_container(
-        os.path.join(args.output_dir, "scores.avro"), SCORING_RESULT, records
-    )
 
-    result = {"n_rows": int(len(scores)), "wall_seconds": timer.stop()}
+    if args.stream_block_rows > 0:
+        # Out-of-core: decode → score → write per bounded block.  The
+        # score/label/weight columns (12 B/row) accumulate across blocks
+        # ONLY when a global metric needs them; without --evaluator the
+        # footprint stays one block.
+        from photon_ml_tpu.data.game_reader import iter_game_avro
+        from photon_ml_tpu.game.model import RandomEffectModel
+
+        keep_columns = bool(args.evaluator)
+        all_scores: list[np.ndarray] = []
+        all_labels: list[np.ndarray] = []
+        all_weights: list[np.ndarray] = []
+        n_streamed = [0]
+        # Every block must expose the model's entity-id columns even if
+        # none of its rows carry them (a block of id-less rows would
+        # otherwise KeyError inside the random-effect scorer).
+        entity_keys = [
+            sub.entity_key
+            for sub in model.models.values()
+            if isinstance(sub, RandomEffectModel)
+        ]
+
+        def block_records():
+            for shards, ids, response, weight, offset, uids in iter_game_avro(
+                args.data, index_maps, block_rows=args.stream_block_rows,
+                logger=logger, id_keys=entity_keys,
+            ):
+                blk = (
+                    transformer.transform_with_mean(shards, ids, offset)
+                    if args.mean
+                    else transformer.transform(shards, ids, offset)
+                )
+                n_streamed[0] += len(blk)
+                if keep_columns:
+                    all_scores.append(np.asarray(blk, np.float32))
+                    all_labels.append(response)
+                    all_weights.append(weight)
+                logger.info("scored block of %d rows", len(blk))
+                for i in range(len(blk)):
+                    yield score_record(uids[i], blk[i], response[i], ids, i)
+
+        # write_container consumes the generator block-by-block: records
+        # stream to disk as they are produced, never as one list.
+        avro.write_container(out_path, SCORING_RESULT, block_records())
+        n_rows = n_streamed[0]
+        if keep_columns:
+            scores = np.concatenate(all_scores) if all_scores else (
+                np.zeros(0, np.float32)
+            )
+            response = np.concatenate(all_labels) if all_labels else (
+                np.zeros(0, np.float32)
+            )
+            weight = np.concatenate(all_weights) if all_weights else (
+                np.zeros(0, np.float32)
+            )
+        else:
+            scores = response = weight = None  # never needed without a metric
+    else:
+        shards, ids, response, weight, offset, uids, _ = read_game_avro(
+            args.data, index_maps=index_maps, logger=logger
+        )
+        scores = (
+            transformer.transform_with_mean(shards, ids, offset)
+            if args.mean
+            else transformer.transform(shards, ids, offset)
+        )
+        records = [
+            score_record(uids[i], scores[i], response[i], ids, i)
+            for i in range(len(scores))
+        ]
+        avro.write_container(out_path, SCORING_RESULT, records)
+        n_rows = len(scores)
+
+    result = {"n_rows": int(n_rows), "wall_seconds": timer.stop()}
     if args.evaluator:
         ev = get_evaluator(args.evaluator)
         result["metric"] = ev.evaluate(scores, response, weight)

@@ -153,6 +153,7 @@ def build_random_effect_dataset(
     dtype=jnp.float32,
     device: bool = True,
     bucket_growth: float = 2.0,
+    allow_missing: bool = False,
 ) -> RandomEffectDataset:
     """Group rows by entity, project to per-entity subspaces, bucket by size.
 
@@ -178,10 +179,62 @@ def build_random_effect_dataset(
     assert entity_keys.shape[0] == n_rows
     if entity_keys.dtype == object:
         missing = sum(1 for k in entity_keys if k is None)
-        if missing:
+        if missing and not allow_missing:
+            # TRAINING: a row with no entity id is a data error (it would
+            # silently train some entity on foreign rows).
             raise ValueError(
                 f"{missing} of {n_rows} rows have no entity id for this "
                 "random effect (records missing the id column?)"
+            )
+        if missing:
+            # SCORING (allow_missing): id-less rows simply get no
+            # contribution from this coordinate — the reference's
+            # join-miss semantics.  Drop them from the grouping; the
+            # score scatter covers only grouped rows, everything else
+            # stays 0.
+            keep = np.array([k is not None for k in entity_keys])
+            rows_kept = np.flatnonzero(keep)
+            if rows_kept.size == 0:
+                # Every row id-less (e.g. one streamed scoring block):
+                # this coordinate contributes nothing to any row.
+                return RandomEffectDataset(
+                    blocks=[],
+                    entity_ids=[],
+                    entity_to_slot={},
+                    n_global_rows=n_rows,
+                    n_features=d,
+                    passive_blocks=[],
+                )
+            ds = build_random_effect_dataset(
+                entity_keys[keep], rows_csr[rows_kept], labels[keep],
+                weights[keep], max_rows_per_entity=max_rows_per_entity,
+                dtype=dtype, device=device, bucket_growth=bucket_growth,
+            )
+            # Re-point every block's row indices at the ORIGINAL row
+            # space (scatter targets), keeping the sentinel padding slot.
+            remap = np.concatenate([rows_kept, [n_rows]]).astype(np.int64)
+            kept_n = int(keep.sum())
+
+            def _repoint(block):
+                if block is None:  # bucket with no passive rows
+                    return None
+                ri = np.asarray(block.row_index)
+                ri = np.where(ri >= kept_n, kept_n, ri)  # sentinel slot
+                new_ri = (
+                    jnp.asarray(remap[ri])
+                    if isinstance(block.row_index, jax.Array)
+                    else remap[ri]
+                )
+                return dataclasses.replace(block, row_index=new_ri)
+
+            return dataclasses.replace(
+                ds,
+                blocks=[_repoint(b) for b in ds.blocks],
+                passive_blocks=(
+                    [_repoint(b) for b in ds.passive_blocks]
+                    if ds.passive_blocks else ds.passive_blocks
+                ),
+                n_global_rows=n_rows,
             )
     entity_keys = entity_keys.astype(str)
     _asarray = (lambda x, dt=None: jnp.asarray(x, dt)) if device else (

@@ -140,6 +140,121 @@ class TestGameDrivers:
         assert len(scores) == 200
         assert scores[0]["ids"]["userId"].startswith("u")
 
+    def test_streamed_scoring_matches_resident(self, game_files, tmp_path):
+        """Out-of-core scoring (VERDICT r3 #5): block-bounded read → score
+        → write matches the materialized path bit-for-bit, including the
+        AUC computed from streamed scores."""
+        from photon_ml_tpu.data.game_reader import GAME_EXAMPLE_SCHEMA
+        from photon_ml_tpu.io import avro as avro_io
+
+        train, val, config = game_files
+        out = str(tmp_path / "train_out")
+        game_training_driver.run([
+            "--train-data", train, "--config", config, "--output-dir", out,
+        ])
+        # Re-cut the validation file into small container blocks so the
+        # streamed read actually yields several blocks (the iterator
+        # flushes at container-block boundaries).
+        _, recs = avro_io.read_container(val)
+        val_mb = str(tmp_path / "val_mb.avro")
+        avro_io.write_container(
+            val_mb, GAME_EXAMPLE_SCHEMA, recs, records_per_block=32
+        )
+
+        r_out = str(tmp_path / "score_resident")
+        s_out = str(tmp_path / "score_streamed")
+        resident = game_scoring_driver.run([
+            "--data", val_mb, "--model-dir", out, "--output-dir", r_out,
+            "--evaluator", "auc",
+        ])
+        streamed = game_scoring_driver.run([
+            "--data", val_mb, "--model-dir", out, "--output-dir", s_out,
+            "--evaluator", "auc", "--stream-block-rows", "64",
+        ])
+        assert streamed["n_rows"] == resident["n_rows"] == 200
+        assert streamed["metric"] == resident["metric"]  # same scores → same AUC
+        _, r_recs = avro_io.read_container(os.path.join(r_out, "scores.avro"))
+        _, s_recs = avro_io.read_container(os.path.join(s_out, "scores.avro"))
+        assert len(s_recs) == len(r_recs) == 200
+        for rr, sr in zip(r_recs, s_recs):
+            assert sr["uid"] == rr["uid"]
+            assert sr["ids"] == rr["ids"]
+            assert sr["predictionScore"] == rr["predictionScore"]  # bit-for-bit
+
+    def test_iter_game_avro_blocks_concatenate_to_full_read(self, game_files):
+        from photon_ml_tpu.data.game_reader import iter_game_avro
+
+        train, _, _ = game_files
+        full = read_game_avro(train)
+        shards_f, ids_f, resp_f, w_f, off_f, uids_f, imaps = full
+        blocks = list(iter_game_avro(train, imaps, block_rows=100))
+        # 600 rows in one 4096-record container block: game-schema flushes
+        # at container boundaries, so everything lands in one yield here —
+        # the multi-block case is covered by the driver test's re-cut file.
+        assert sum(len(b[2]) for b in blocks) == 600
+        resp_cat = np.concatenate([b[2] for b in blocks])
+        np.testing.assert_array_equal(resp_cat, resp_f)
+        g_cat = np.concatenate(
+            [b[0]["global"].toarray() for b in blocks], axis=0
+        )
+        np.testing.assert_array_equal(g_cat, shards_f["global"].toarray())
+        uid_cat = [u for b in blocks for u in b[5]]
+        assert uid_cat == uids_f
+
+    def test_streamed_scoring_survives_idless_blocks(
+        self, game_files, tmp_path
+    ):
+        """A block consisting entirely of rows WITHOUT the entity id must
+        still score (the model's id columns materialize None-padded per
+        block) and match the whole-file path."""
+        from photon_ml_tpu.data.game_reader import GAME_EXAMPLE_SCHEMA
+        from photon_ml_tpu.io import avro as avro_io
+
+        train, val, config = game_files
+        out = str(tmp_path / "train_out")
+        game_training_driver.run([
+            "--train-data", train, "--config", config, "--output-dir", out,
+        ])
+        # 64 id-less rows FIRST (one full streamed block with no userId),
+        # then the real validation rows, in 32-record container blocks.
+        _, recs = avro_io.read_container(val)
+        idless = [
+            {
+                "uid": f"noid{i}", "response": float(i % 2),
+                "weight": None, "offset": None, "ids": {},
+                "features": {"global": [
+                    {"name": "g0", "term": "", "value": 1.0}
+                ]},
+            }
+            for i in range(64)
+        ]
+        mixed = str(tmp_path / "mixed.avro")
+        avro_io.write_container(
+            mixed, GAME_EXAMPLE_SCHEMA, idless + recs, records_per_block=32
+        )
+        r_out = str(tmp_path / "sc_res")
+        s_out = str(tmp_path / "sc_str")
+        resident = game_scoring_driver.run([
+            "--data", mixed, "--model-dir", out, "--output-dir", r_out,
+        ])
+        streamed = game_scoring_driver.run([
+            "--data", mixed, "--model-dir", out, "--output-dir", s_out,
+            "--stream-block-rows", "64",
+        ])
+        assert streamed["n_rows"] == resident["n_rows"] == 264
+        _, r_recs = avro_io.read_container(os.path.join(r_out, "scores.avro"))
+        _, s_recs = avro_io.read_container(os.path.join(s_out, "scores.avro"))
+        for rr, sr in zip(r_recs, s_recs):
+            assert sr["predictionScore"] == rr["predictionScore"]
+            assert sr["ids"] == rr["ids"]
+
+    def test_iter_game_avro_requires_index_maps(self, game_files):
+        from photon_ml_tpu.data.game_reader import iter_game_avro
+
+        train, _, _ = game_files
+        with pytest.raises(ValueError, match="index maps"):
+            list(iter_game_avro(train, None))
+
     def test_model_store_roundtrip_preserves_scores(self, game_files, tmp_path):
         train, val, config = game_files
         out = str(tmp_path / "rt_out")
