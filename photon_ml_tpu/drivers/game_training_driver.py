@@ -170,8 +170,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="automatic recovery from TRANSIENT failures (lost device, "
         "transport drop): re-enter training up to this many times. The "
         "single-config path resumes from the per-iteration CD checkpoint; "
-        "a config GRID has no checkpoint and restarts the whole grid fit "
-        "on retry. 0 disables",
+        "a config GRID resumes at the completed-grid-point boundary "
+        "(each finished point's model is checkpointed). 0 disables",
     )
     p.add_argument(
         "--retry-backoff",
@@ -368,26 +368,31 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         v_shards, v_ids, v_resp, v_weight, v_offset, _, _ = validation
         val_tuple = (v_shards, v_ids, v_resp, v_weight, v_offset)
 
-    # Per-iteration checkpointing (single-config path; a grid re-fits many
-    # configs, so resume there means re-running incomplete points).
+    # Checkpointing: per-CD-iteration for a single config, per-grid-point
+    # for a config grid (a finished point's model persists; an interrupted
+    # point re-fits, earlier points are skipped).
     checkpointer = None
+    grid_checkpointer = None
     checkpoint_enabled = bool(config.get("checkpoint", True))
-    if len(config_grid) == 1 and checkpoint_enabled:
-        from photon_ml_tpu.io.checkpoint import CoordinateDescentCheckpointer
-
-        checkpointer = CoordinateDescentCheckpointer(
-            os.path.join(args.output_dir, "checkpoints")
-        )
-        if not args.resume:
-            # A stale checkpoint from a previous job must not silently
-            # hijack a fresh run.
-            checkpointer.clear()
-    elif args.resume:
-        if len(config_grid) > 1:
-            raise ValueError(
-                "--resume requires a single coordinate config (no "
-                "reg_weights grid); grid points re-run from scratch"
+    if checkpoint_enabled:
+        ckpt_dir = os.path.join(args.output_dir, "checkpoints")
+        if len(config_grid) == 1:
+            from photon_ml_tpu.io.checkpoint import (
+                CoordinateDescentCheckpointer,
             )
+
+            checkpointer = CoordinateDescentCheckpointer(ckpt_dir)
+            if not args.resume:
+                # A stale checkpoint from a previous job must not silently
+                # hijack a fresh run.
+                checkpointer.clear()
+        else:
+            from photon_ml_tpu.io.checkpoint import GameGridCheckpointer
+
+            grid_checkpointer = GameGridCheckpointer(ckpt_dir, index_maps)
+            if not args.resume:
+                grid_checkpointer.clear()
+    elif args.resume:
         raise ValueError(
             '--resume requires checkpointing ("checkpoint": false is set '
             "in the config JSON)"
@@ -409,6 +414,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 config_grid, shards, ids, response, weight=weight,
                 offset=offset, validation=val_tuple, suite=suite,
                 initial_model=initial_model,
+                grid_checkpointer=grid_checkpointer,
             ),
             retry_policy, logger,
         )

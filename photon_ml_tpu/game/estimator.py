@@ -53,8 +53,8 @@ class FixedEffectCoordinateConfig:
     #: >0 trains this coordinate OUT-OF-CORE: the shard lives in host RAM
     #: as chunks of this many rows, double-buffered through HBM per
     #: objective pass (game/streaming.py) — for fixed-effect datasets
-    #: larger than device memory.  Single-device; L-BFGS and OWL-QN
-    #: (L1/elastic-net) supported, TRON is not.
+    #: larger than device memory.  Single-device; all three optimizers
+    #: stream (L-BFGS, OWL-QN for L1/elastic-net, smooth TRON).
     streaming_chunk_rows: int = 0
 
 
@@ -194,12 +194,6 @@ class GameEstimator:
                     return tw
 
                 if cfg.streaming_chunk_rows > 0:
-                    if self.mesh is not None:
-                        raise NotImplementedError(
-                            "streaming_chunk_rows composes with the "
-                            "single-device path only for now (drop the "
-                            "mesh or the streaming)"
-                        )
                     from photon_ml_tpu.data.streaming import (
                         make_streaming_glm_data,
                     )
@@ -209,14 +203,25 @@ class GameEstimator:
 
                     stream = cache.get(key)
                     if stream is None:
+                        # With a mesh, chunks are built pre-sharded (one
+                        # row block per device) and each objective pass
+                        # runs under shard_map with one fused psum —
+                        # streamed DP composed with the rest of the
+                        # descent (BASELINE config 5's shape: streaming
+                        # AND multi-device AND GAME at once).
                         stream = make_streaming_glm_data(
                             shard, response, weights=train_weight(),
                             chunk_rows=cfg.streaming_chunk_rows,
+                            n_shards=(
+                                1 if self.mesh is None
+                                else self.mesh.devices.size
+                            ),
                         )
                         cache[key] = stream
                     coordinates.append(StreamingFixedEffectCoordinate(
                         name, stream, self.task, cfg.optimization,
                         cfg.reg_weight, feature_shard=cfg.feature_shard,
+                        mesh=self.mesh,
                     ))
                     continue
                 if self.mesh is not None:
@@ -655,6 +660,7 @@ class GameEstimator:
         validation=None,
         suite=None,
         initial_model: Optional[GameModel] = None,
+        grid_checkpointer=None,
     ) -> tuple[GameModel, list[dict]]:
         """Fit EVERY coordinate-config combination, select best (SURVEY.md
         §3.2: "for each coordinate-config combination ... select best model
@@ -667,6 +673,11 @@ class GameEstimator:
         ``validation`` is given, else final train metric.  Returns
         ``(best_model, point_results)`` where each point result dict carries
         ``configs / model / history / metric``.
+
+        ``grid_checkpointer`` (io.checkpoint.GameGridCheckpointer):
+        completed points persist as saved models and are SKIPPED on
+        re-entry (retry / --resume), so an interrupted grid resumes at the
+        completed-point boundary instead of restarting.
         """
         from photon_ml_tpu.evaluation.suite import EvaluationSuite
 
@@ -678,7 +689,34 @@ class GameEstimator:
         scorer_cache: dict = {}
         results: list[dict] = []
         best_idx, best_metric = None, None
+        metric_key = (
+            "validation_metric" if validation is not None else "train_metric"
+        )
         for gi, configs in enumerate(grid_configs):
+            loaded = (
+                grid_checkpointer.load_point(gi, configs, metric_key)
+                if grid_checkpointer is not None else None
+            )
+            if loaded is not None:
+                model, metric, history = loaded
+                results.append({
+                    "grid_index": gi,
+                    "configs": configs,
+                    "model": model,
+                    "history": history,
+                    "metric": metric,
+                    "selected_by": metric_key,
+                    "resumed": True,
+                })
+                if best_idx is None or suite.better_than(metric, best_metric):
+                    best_idx, best_metric = gi, metric
+                if self.logger is not None:
+                    self.logger.info(
+                        "grid point %d/%d resumed from checkpoint "
+                        "(%s = %s)",
+                        gi + 1, len(grid_configs), metric_key, metric,
+                    )
+                continue
             coordinates = self._build_coordinates(
                 configs, shards, ids, response, weight, offset,
                 dataset_cache=dataset_cache,
@@ -712,10 +750,11 @@ class GameEstimator:
                 validation_scorers=scorers, initial_model=initial_model,
                 train_group_ids=train_groups,
             )
-            metric_key = (
-                "validation_metric" if validation is not None else "train_metric"
-            )
             metric = history[-1].get(metric_key) if history else None
+            if grid_checkpointer is not None:
+                grid_checkpointer.save_point(
+                    gi, configs, model, metric, metric_key, history
+                )
             results.append(
                 {
                     "grid_index": gi,
@@ -777,12 +816,21 @@ class GameTransformer:
         re_datasets = {}
         for name, sub in self.model.models.items():
             if isinstance(sub, RandomEffectModel):
+                # A file with NO rows carrying this id column yields no
+                # ids entry at all — same join-miss semantics as rows
+                # individually missing it: zero contribution, not a crash.
+                entity_col = ids.get(sub.entity_key)
+                if entity_col is None:
+                    entity_col = np.full(n, None, object)
                 re_datasets[name] = build_random_effect_dataset(
-                    np.asarray(ids[sub.entity_key]),
+                    np.asarray(entity_col),
                     shards[sub.feature_shard],
                     np.zeros(n, np.float32),
                     np.ones(n, np.float32),
                     device=False,
+                    # Scoring join semantics: rows without this entity id
+                    # get zero contribution, they are not a data error.
+                    allow_missing=True,
                 )
         return PreparedScoringSet(n_rows=n, re_datasets=re_datasets)
 

@@ -405,3 +405,77 @@ class TestCheckpointFormatCompat:
         )
         assert len(loaded["states"]["re"]) == 2
         assert loaded["states"]["re"][1].shape == (1, 2)
+
+
+class TestGameGridCheckpointer:
+    def _mini_model_and_maps(self):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.game.model import FixedEffectModel, GameModel
+        from photon_ml_tpu.models.glm import (
+            Coefficients,
+            GeneralizedLinearModel,
+        )
+
+        glm = GeneralizedLinearModel(
+            Coefficients(jnp.asarray(np.array([0.5, -1.0], np.float32))),
+            "logistic",
+        )
+        model = GameModel({"fixed": FixedEffectModel(glm, "global")},
+                          task="logistic")
+        imaps = {"global": IndexMap.build({"f0": 0, "f1": 1})}
+        return model, imaps
+
+    def _configs(self, **overrides):
+        from photon_ml_tpu.game.estimator import FixedEffectCoordinateConfig
+
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(
+                max_iters=overrides.pop("max_iters", 10)
+            ),
+            regularization=RegularizationContext.l2(),
+        )
+        return {"fixed": FixedEffectCoordinateConfig(
+            feature_shard="global", optimization=opt,
+            reg_weight=overrides.pop("reg_weight", 1.0),
+        )}
+
+    def test_roundtrip_and_fingerprint_covers_full_config(self, tmp_path):
+        from photon_ml_tpu.io.checkpoint import GameGridCheckpointer
+
+        model, imaps = self._mini_model_and_maps()
+        ck = GameGridCheckpointer(str(tmp_path), imaps)
+        configs = self._configs()
+        ck.save_point(0, configs, model, 0.8, "validation_metric",
+                      [{"train_metric": 0.7}])
+        loaded = ck.load_point(0, configs, "validation_metric")
+        assert loaded is not None
+        m2, metric, history = loaded
+        assert metric == 0.8
+        assert history == [{"train_metric": 0.7}]
+        np.testing.assert_allclose(
+            np.asarray(m2.models["fixed"].model.coefficients.means),
+            [0.5, -1.0],
+        )
+        # ANY config change invalidates the point — not just reg_weight
+        # (the round-4 review finding: a changed optimizer silently served
+        # stale models under the 3-field fingerprint).
+        assert ck.load_point(
+            0, self._configs(max_iters=99), "validation_metric"
+        ) is None
+        assert ck.load_point(
+            0, self._configs(reg_weight=2.0), "validation_metric"
+        ) is None
+
+    def test_metric_kind_mismatch_rejected(self, tmp_path):
+        """A point selected by train metric must not resume into a run
+        selecting by validation metric (different kind/direction)."""
+        from photon_ml_tpu.io.checkpoint import GameGridCheckpointer
+
+        model, imaps = self._mini_model_and_maps()
+        ck = GameGridCheckpointer(str(tmp_path), imaps)
+        configs = self._configs()
+        ck.save_point(0, configs, model, 0.69, "train_metric", [])
+        assert ck.load_point(0, configs, "validation_metric") is None
+        assert ck.load_point(0, configs, "train_metric") is not None

