@@ -99,6 +99,17 @@ class StreamingObjective:
                     f"stream has n_shards={stream.n_shards}, mesh has "
                     f"{mesh.devices.size} devices"
                 )
+            if stream.n_shards == 1:
+                # Single-shard chunks carry NO shard axis (data/streaming
+                # builds the stacked layout only for n_shards > 1).  The
+                # mesh path's x[0] unstack would then strip a DATA axis
+                # and silently compute the objective over wrong slices —
+                # no error, wrong numbers (verified).  Refuse loudly.
+                raise ValueError(
+                    "single-shard chunks carry no shard axis; the mesh "
+                    "path would silently compute over wrong data — pass "
+                    "mesh=None for single-device streams"
+                )
             self._axis = mesh.axis_names[0]
             self._sharding = NamedSharding(mesh, P(self._axis))
         elif stream.n_shards != 1:

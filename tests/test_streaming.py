@@ -691,6 +691,20 @@ class TestStreamingDataParallel:
         np.testing.assert_allclose(w_m, w_1, atol=5e-3)
 
 
+class TestStreamingMeshGuards:
+    def test_one_device_mesh_rejected(self, rng):
+        """Single-shard chunks carry no shard axis; the mesh path's x[0]
+        unstack would strip a DATA axis and silently return wrong
+        values/gradients — construction must refuse loudly instead."""
+        mesh1 = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+        X, y = _logistic_problem(rng, 100, 10)
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=64, use_pallas=False
+        )
+        with pytest.raises(ValueError, match="no shard axis"):
+            StreamingObjective("logistic", stream, mesh=mesh1)
+
+
 class TestChunkStoreShapes:
     def test_uniform_chunk_shapes(self, rng):
         X, y = _logistic_problem(rng, 1000, 64)
