@@ -30,14 +30,15 @@ The kernel design exploits exactly that:
   padding: A = Σ over windows of that window's own worst lane, instead of
   ``WINS ×`` the worst cell anywhere in the matrix.
 
-- matvec per tile: per-sublane gather tables are built by a 16-step masked
-  SELECT over the windows (from each sublane's packed window id — exact,
-  and non-finites stay localized to their own window), then ONE
-  ``dynamic_gather`` of the whole ``(A, 128)`` block, then a 16-step
-  masked sweep accumulates rows into the ``(16, 128)`` margin block
-  (``ohi = (row % 2048) // 128``, packed per slot, selects the output
-  sublane).  No scatter anywhere; the selects and sweep overlap the
-  slot-stream DMA (measured: the kernel is bandwidth-bound).
+- matvec per tile: per-sublane gather tables are built from each sublane's
+  packed window id — by default ONE one-hot matmul on the MXU
+  (f32-HIGHEST, guarded per chunk tile: any non-finite vector window
+  falls back to the exact 16-step masked-SELECT sweep so inf/nan stay
+  localized; measured 1.41x the select sweep on v5e — the table sweep
+  was the round-3 compute floor) — then ONE ``dynamic_gather`` of the
+  whole ``(A, 128)`` block, then a 16-step masked sweep accumulates rows
+  into the ``(16, 128)`` margin block (``ohi = (row % 2048) // 128``,
+  packed per slot, selects the output sublane).  No scatter anywhere.
 
 - rmatvec (the gradient side, Xᵀu) is the SAME kernel with roles mirrored
   (orientation "B": lane = col % 128, tables = 128-wide windows of ``u``,
@@ -147,6 +148,15 @@ def _extract_fields(r32: np.ndarray, c32: np.ndarray, nbc: int):
 def _interpret() -> bool:
     """Run kernels in interpreter mode (CPU tests set this env var)."""
     return os.environ.get("PHOTON_PALLAS_INTERPRET", "") == "1"
+
+
+# Gather-side table build: one-hot matmul on the MXU (all-finite fast
+# path, guarded per chunk tile) vs the 16-pass masked-select sweep.
+# Opt-out knob: the select sweep was the round-3 compute floor; set to
+# "0" if a TPU generation regresses on the tiny matmul.  Read ONCE at
+# import (the kernel bakes the choice at trace time) — A/B in separate
+# processes, exactly like PHOTON_PALLAS_TILE.
+_MXU_GATHER = os.environ.get("PHOTON_PALLAS_MXU_GATHER", "1") == "1"
 
 
 def pallas_available() -> bool:
@@ -402,11 +412,11 @@ def _tile_kernel(*refs, square, batch, chunk, unit):
     tab:  (chunk, WINS, 128) gather-side vector windows for this chunk
     out:  (batch, WINS, 128), accumulated across the chunked grid dim
 
-    Gather tables are built per tile by a masked SELECT over the WINS
-    windows from each sublane's packed window id — the packed layout has
-    no fixed depth→window structure for ``pltpu.repeat`` to exploit, and
-    a one-hot matmul is deliberately NOT used: 0·inf = NaN would leak a
-    non-finite vector entry into every sublane's table (see the in-body
+    Gather tables are built per tile from each sublane's packed window
+    id — by default a one-hot f32 matmul on the MXU, guarded per chunk
+    tile: a bare matmul would leak a non-finite vector entry into every
+    sublane's table via 0·inf = NaN, so tiles whose table windows carry
+    inf/nan take the exact masked-SELECT sweep instead (see the in-body
     comment and test_nonfinite_vector_entries_stay_localized).
     """
     from jax.experimental import pallas as pl
@@ -421,9 +431,24 @@ def _tile_kernel(*refs, square, batch, chunk, unit):
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    def tile_body(t, _):
-        b = t // chunk
-        j = t % chunk
+    def chunk_tile_body(j, _):
+        # Hoisted per CHUNK tile: the gather windows and their finiteness
+        # predicate are invariant across the batch dimension — slicing and
+        # reducing them once per (j) instead of per (b, j) saves
+        # batch-1 redundant (WINS, 128) passes.
+        if _MXU_GATHER:
+            tab_j = tab_ref[pl.ds(j, 1), :, :][0]             # (WINS, 128)
+            tab_finite = jnp.all(jnp.isfinite(tab_j))
+        _batch_tiles(j, tab_j if _MXU_GATHER else None,
+                     tab_finite if _MXU_GATHER else None)
+        return 0
+
+    def _batch_tiles(j, tab_j, tab_finite):
+        jax.lax.fori_loop(
+            0, batch, lambda b, _: tile_body(b, j, tab_j, tab_finite), 0
+        )
+
+    def tile_body(b, j, tab_j, tab_finite):
         code = code_ref[b, j].astype(jnp.int32)
         # Field bits through CODE_MASK: empty slots are sign-marked, and
         # int16→int32 sign extension would otherwise corrupt the window
@@ -434,20 +459,49 @@ def _tile_kernel(*refs, square, batch, chunk, unit):
         win = fields[:, 0:1] >> WIN_SHIFT                     # (A, 1)
         a = code.shape[0]
 
-        # Per-sublane tables by masked selection over the WINS windows —
-        # EXACT (pure selects, no arithmetic), and a non-finite vector
-        # entry stays localized to sublanes whose window actually holds
-        # it (a one-hot matmul would leak it everywhere via 0*inf=NaN).
-        # The selects overlap the slot-stream DMA; measured free.
-        def w_body(wi, acc):
-            row = tab_ref[j, pl.ds(wi, 1), :]                 # (1, 128)
-            return jnp.where(
-                win == wi, jnp.broadcast_to(row, (a, WIN)), acc
-            )
+        # Per-sublane tables: WINS masked selects (exact; a non-finite
+        # vector entry stays localized to sublanes whose window actually
+        # holds it — a bare one-hot matmul would leak it everywhere via
+        # 0*inf=NaN).  With PHOTON_PALLAS_MXU_GATHER the common all-
+        # finite case rides ONE (A,WINS)x(WINS,128) one-hot matmul on
+        # the MXU instead of the 16-pass select sweep; a per-chunk-tile
+        # finiteness reduce guards the exact select path for vectors
+        # carrying inf/nan, so the localization contract is unchanged.
+        def select_tables(_):
+            def w_body(wi, acc):
+                row = tab_ref[j, pl.ds(wi, 1), :]             # (1, 128)
+                return jnp.where(
+                    win == wi, jnp.broadcast_to(row, (a, WIN)), acc
+                )
 
-        tables = jax.lax.fori_loop(
-            0, WINS, w_body, jnp.zeros((a, WIN), jnp.float32)
-        )                                                     # (A, 128)
+            return jax.lax.fori_loop(
+                0, WINS, w_body, jnp.zeros((a, WIN), jnp.float32)
+            )                                                 # (A, 128)
+
+        if _MXU_GATHER:
+            def mxu_tables(_):
+                onehot = (
+                    win == jax.lax.broadcasted_iota(
+                        jnp.int32, (a, WINS), 1
+                    )
+                ).astype(jnp.float32)
+                # HIGHEST: default matmul precision feeds the MXU bf16
+                # inputs, and bf16(table) != f32 table — the one-hot
+                # product must return window entries exactly (the value
+                # path is f32 end-to-end; sole exception: -0.0 gathers
+                # as +0.0, numerically inert in the product-sum).
+                return jax.lax.dot_general(
+                    onehot, tab_j,
+                    (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32,
+                )
+
+            tables = jax.lax.cond(
+                tab_finite, mxu_tables, select_tables, 0
+            )
+        else:
+            tables = select_tables(0)
         g = jnp.take_along_axis(tables, lo, axis=1)           # (A, 128)
         if unit:
             # Unit values: v = v² = 1 for every real slot; empty slots
@@ -475,7 +529,9 @@ def _tile_kernel(*refs, square, batch, chunk, unit):
         jax.lax.fori_loop(0, WINS, h_body, 0)
         return 0
 
-    jax.lax.fori_loop(0, batch * chunk, tile_body, 0)
+    # j-outer / b-inner: per-(b, h) accumulation order over j is unchanged
+    # vs the old flat (b-major) loop, so outputs stay bit-identical.
+    jax.lax.fori_loop(0, chunk, chunk_tile_body, 0)
 
 
 def _pick_rect(nbo: int, nbg: int, a: int,
