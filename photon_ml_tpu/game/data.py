@@ -265,7 +265,13 @@ def build_random_effect_dataset(
         active = np.unique(sub.indices)
         groups.append((sorted_keys[start], ridx, passive, active, sub))
 
-    # Bucket by (padded row count, padded active-feature count).
+    # GROUP by the geometric (row count, active-feature count) grid, but
+    # PAD each block only to its members' actual maxima: the geometric
+    # key bounds the bucket COUNT (compile count per dataset), while the
+    # per-bucket entity count E already makes every block shape unique —
+    # so tight padding costs no extra compiles and cuts the padded bytes
+    # every objective evaluation touches (the zipf cap at 128 rows used
+    # to pad to the 256 grid point: 2x pure waste on the biggest block).
     buckets: dict[tuple[int, int], list[int]] = {}
     for i, (_, ridx, _passive, active, _sub) in enumerate(groups):
         key = (
@@ -278,8 +284,10 @@ def build_random_effect_dataset(
     passive_blocks: list[Optional[EntityBlock]] = []
     ids_per_block: list[list] = []
     entity_to_slot: dict = {}
-    for (R, D), members in sorted(buckets.items()):
+    for _key, members in sorted(buckets.items()):
         E = len(members)
+        R = max(len(groups[gi][1]) for gi in members)
+        D = max(1, max(len(groups[gi][3]) for gi in members))
         X = np.zeros((E, R, D), np.float32)
         lab = np.zeros((E, R), np.float32)
         wts = np.zeros((E, R), np.float32)
@@ -318,7 +326,7 @@ def build_random_effect_dataset(
         if max_passive == 0:
             passive_blocks.append(None)
             continue
-        Rp = _round_up_geometric(max_passive, bucket_growth)
+        Rp = max_passive  # tight, like the active block's R
         Xp = np.zeros((E, Rp, D), np.float32)
         labp = np.zeros((E, Rp), np.float32)
         wtsp = np.zeros((E, Rp), np.float32)

@@ -128,17 +128,47 @@ class CoordinateDescentCheckpointer:
                     "coordinates": list(scores),
                     "state_specs": specs,
                     "history": history,
+                    # Bucket-padding generation: tight per-bucket dims
+                    # (round 4) changed random-effect state SHAPES, so a
+                    # checkpoint from the geometric-grid era must not be
+                    # restored into tightly-padded rebuilt datasets (the
+                    # vmap would crash with an opaque shape mismatch).
+                    "padding_gen": 2,
                 }
             )
         )
         _atomic_savez(self.path, arrays)
 
     def load(self) -> Optional[dict]:
-        """Returns {iteration, total, scores, states, history} or None."""
+        """Returns {iteration, total, scores, states, history} or None.
+
+        A checkpoint from a different bucket-padding generation is
+        refused (None, with a warning): its random-effect state shapes
+        were padded to the OLD grid and would shape-crash deep inside
+        the rebuilt coordinates' vmapped solvers."""
         loaded = _load_npz_with_meta(self.path)
         if loaded is None:
             return None
         meta, arrays = loaded
+        if meta.get("padding_gen", 1) != 2:
+            # Only BUCKETED (list-structured) states carry padding-
+            # dependent shapes; bare-vector fixed-effect states are safe
+            # to restore from any generation.
+            specs = meta.get("state_specs") or {
+                name: ["array"] * n
+                for name, n in meta.get("list_states", {}).items()
+            }
+            if any(isinstance(s, list) for s in specs.values()):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "%s: checkpoint written under bucket-padding "
+                    "generation %s (current: 2) carries per-bucket "
+                    "states — shapes are incompatible with tightly-"
+                    "padded datasets; starting fresh",
+                    self.path, meta.get("padding_gen", 1),
+                )
+                return None
         scores = {
             name: arrays[f"score__{name}"] for name in meta["coordinates"]
         }

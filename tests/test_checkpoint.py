@@ -367,9 +367,15 @@ class TestGlmDriverResume:
 
 
 class TestCheckpointFormatCompat:
-    def test_loads_pre_nesting_list_states_format(self, tmp_path):
-        """Checkpoints written before nested-state support (meta carried
-        'list_states' lengths instead of 'state_specs') must still load."""
+    def test_old_bucketed_checkpoint_refused_vector_only_loads(
+        self, tmp_path
+    ):
+        """Round-4 tight bucket padding changed random-effect state
+        SHAPES: a pre-generation checkpoint carrying per-bucket (list)
+        states must be refused with a warning (restoring it would
+        shape-crash deep inside the rebuilt coordinates' vmapped
+        solvers), while a bare-vector-only checkpoint — whose shapes
+        are padding-independent — still loads."""
         import json
 
         import numpy as np
@@ -390,7 +396,7 @@ class TestCheckpointFormatCompat:
             "__meta__": np.asarray(json.dumps({
                 "iteration": 1,
                 "coordinates": ["fixed", "re"],
-                "list_states": {"re": 2},
+                "list_states": {"re": 2},  # pre-nesting format, gen 1
                 "history": [],
             })),
         }
@@ -398,13 +404,43 @@ class TestCheckpointFormatCompat:
 
         os.makedirs(str(tmp_path), exist_ok=True)
         _atomic_savez(ck.path, arrays)
+        assert ck.load() is None  # bucketed states from gen 1: refused
+
+        vec_only = {
+            "total": np.arange(4, dtype=np.float32),
+            "score__fixed": np.ones(4, np.float32),
+            "state__fixed": np.arange(3, dtype=np.float32),
+            "__meta__": np.asarray(json.dumps({
+                "iteration": 2,
+                "coordinates": ["fixed"],
+                "state_specs": {"fixed": "array"},
+                "history": [],
+            })),
+        }
+        _atomic_savez(ck.path, vec_only)
         loaded = ck.load()
-        assert loaded["iteration"] == 1
+        assert loaded is not None and loaded["iteration"] == 2
         np.testing.assert_array_equal(
             loaded["states"]["fixed"], np.arange(3, dtype=np.float32)
         )
-        assert len(loaded["states"]["re"]) == 2
-        assert loaded["states"]["re"][1].shape == (1, 2)
+
+    def test_current_roundtrip_carries_padding_gen(self, tmp_path):
+        import numpy as np
+
+        from photon_ml_tpu.io.checkpoint import (
+            CoordinateDescentCheckpointer,
+        )
+
+        ck = CoordinateDescentCheckpointer(str(tmp_path))
+        ck.save(
+            3, np.zeros(4, np.float32),
+            {"re": np.zeros(4, np.float32)},
+            {"re": [np.ones((2, 2), np.float32)]},
+            [],
+        )
+        loaded = ck.load()  # same generation: bucketed states load fine
+        assert loaded is not None and loaded["iteration"] == 3
+        assert loaded["states"]["re"][0].shape == (2, 2)
 
 
 class TestGameGridCheckpointer:

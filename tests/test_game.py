@@ -537,3 +537,27 @@ class TestRank1FastPath:
         ))
         m = float((np.asarray(block.X)[lane, 0] * w[lane]).sum())
         assert abs(np.exp(m) - 1000.0) / 1000.0 < 0.05, m
+
+
+class TestTightBucketPadding:
+    def test_blocks_pad_to_member_maxima_not_grid(self, rng):
+        """Round 4: the geometric grid only GROUPS; block dims are the
+        members' actual maxima (the zipf row cap used to pad to the next
+        grid point — 2x pure waste on the largest block)."""
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.game.data import build_random_effect_dataset
+
+        # One entity with 100 rows: growth=2 grid point is 128, tight is
+        # 100.  A second entity with 3 rows lands in a different bucket.
+        users = np.array(["a"] * 100 + ["b"] * 3, dtype=object)
+        n = len(users)
+        X = sp.csr_matrix(rng.normal(size=(n, 5)).astype(np.float32))
+        ds = build_random_effect_dataset(
+            users, X, np.zeros(n, np.float32), np.ones(n, np.float32),
+            bucket_growth=2.0,
+        )
+        dims = sorted(
+            (b.rows_per_entity, b.block_dim) for b in ds.blocks
+        )
+        assert dims == [(3, 5), (100, 5)], dims  # tight, not (4,8)/(128,8)
