@@ -119,6 +119,7 @@ def make_streaming_glm_data(
     use_pallas: bool | str = "auto",
     depth_cap: int = 128,
     n_shards: int = 1,
+    coo_budget: int | None = None,
 ) -> StreamingGlmData:
     """Cut already-materialized host data into uniform chunks.
 
@@ -144,6 +145,7 @@ def make_streaming_glm_data(
         use_pallas=use_pallas,
         depth_cap=depth_cap,
         n_shards=n_shards,
+        coo_budget=coo_budget,
     )
 
 
@@ -154,6 +156,7 @@ def streaming_from_blocks(
     use_pallas: bool | str = "auto",
     depth_cap: int = 128,
     n_shards: int = 1,
+    coo_budget: int | None = None,
 ) -> StreamingGlmData:
     """Build the chunk store from an iterator of ``(X, y[, w[, o]])``
     blocks (e.g. Avro ``iter_blocks`` output), re-cut to ``chunk_rows``
@@ -370,6 +373,16 @@ def streaming_from_blocks(
             1,
             max(len(r) for shards in finished for (r, _, _) in shards),
         )
+        if coo_budget is not None:
+            # Pod runs: every process must pad its COO chunks to ONE
+            # agreed budget or the global chunk shapes (and therefore
+            # the compiled SPMD programs) diverge across processes.
+            if coo_budget < budget:
+                raise ValueError(
+                    f"coo_budget={coo_budget} is below this store's "
+                    f"largest per-shard chunk nnz ({budget})"
+                )
+            budget = coo_budget
         for shards, (y, w, o) in zip(finished, vectors):
             padded = [pad_coo_triples(*t, budget) for t in shards]
             if n_shards == 1:

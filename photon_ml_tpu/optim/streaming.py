@@ -91,15 +91,28 @@ class StreamingObjective:
         self.mesh = mesh
         self.accumulate = accumulate
         self._sharding = None
+        # Multi-host (pod) mode: every process holds a chunk store over
+        # ITS host-local rows only (n_shards = local device count) and
+        # feeds just its own shards of each globally-sharded chunk — the
+        # streamed analogue of multihost.assemble_global, so no host ever
+        # materializes a global chunk.  Row order across hosts differs
+        # from the single-host layout, which is immaterial: every
+        # streamed reduction is a permutation-invariant sum over rows.
+        self._multihost = jax.process_count() > 1
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            if stream.n_shards != mesh.devices.size:
+            expect = (
+                jax.local_device_count() if self._multihost
+                else mesh.devices.size
+            )
+            if stream.n_shards != expect:
                 raise ValueError(
-                    f"stream has n_shards={stream.n_shards}, mesh has "
-                    f"{mesh.devices.size} devices"
+                    f"stream has n_shards={stream.n_shards}; this "
+                    f"{'process' if self._multihost else 'mesh'} needs "
+                    f"{expect}"
                 )
-            if stream.n_shards == 1:
+            if stream.n_shards == 1 and not self._multihost:
                 # Single-shard chunks carry NO shard axis (data/streaming
                 # builds the stacked layout only for n_shards > 1).  The
                 # mesh path's x[0] unstack would then strip a DATA axis
@@ -110,6 +123,14 @@ class StreamingObjective:
                     "path would silently compute over wrong data — pass "
                     "mesh=None for single-device streams"
                 )
+            if stream.n_shards == 1 and self._multihost:
+                raise ValueError(
+                    "multi-host streams need n_shards == "
+                    "jax.local_device_count() > 1 per process; a "
+                    "1-local-device pod member is unsupported"
+                )
+            if self._multihost:
+                self._align_multihost_chunks()
             self._axis = mesh.axis_names[0]
             self._sharding = NamedSharding(mesh, P(self._axis))
         elif stream.n_shards != 1:
@@ -261,8 +282,62 @@ class StreamingObjective:
     def n_features(self) -> int:
         return self.stream.n_features
 
+    def _align_multihost_chunks(self) -> None:
+        """Pod-wide agreement checks the streamed loop's collectives need.
+
+        Every process runs one psum per chunk, so (a) chunk COUNTS must
+        match — an uneven ``host_local_rows`` split is equalized by
+        appending all-padding (zero-weight) chunks locally, which add
+        exactly zero to every reduction; (b) chunk leaf SHAPES must match
+        — each process's store pads to its OWN nnz budget / layout, and a
+        mismatch would compile different SPMD executables per process
+        (hang or crash deep in XLA), so it is refused loudly here with
+        the fix spelled out."""
+        from jax.experimental import multihost_utils
+
+        chunks = self.stream.chunks
+        leaves = jax.tree.leaves(chunks[0])
+        sig = np.asarray(
+            [len(chunks), len(leaves)]
+            + [d for leaf in leaves for d in (len(leaf.shape), *leaf.shape)],
+            np.int64,
+        )
+        all_sigs = np.asarray(multihost_utils.process_allgather(sig))
+        if not (all_sigs[1:, 2:] == all_sigs[0, 2:]).all() or not (
+            all_sigs[1:, 1] == all_sigs[0, 1]
+        ).all():
+            raise ValueError(
+                "multi-host chunk stores have mismatched leaf shapes "
+                "across processes (per-process nnz budgets / layouts "
+                "differ) — build every process's store with the same "
+                "chunk_rows and a COMMON coo_budget "
+                "(make_streaming_glm_data(..., coo_budget=N)), and "
+                "use_pallas=False"
+            )
+        max_chunks = int(all_sigs[:, 0].max())
+        if len(chunks) < max_chunks:
+            blank = jax.tree.map(np.zeros_like, chunks[0])
+            self.stream.chunks = chunks + [blank] * (
+                max_chunks - len(chunks)
+            )
+
     def _put(self, chunk):
         if self._sharding is not None:
+            if self._multihost:
+                # Each process contributes ONLY its local shard block of
+                # the global chunk (multihost.assemble_global's contract,
+                # per chunk): global shard axis = processes x local
+                # shards, and this process's block slots in at its
+                # process index.
+                total = self.mesh.devices.size
+
+                def put_leaf(x):
+                    gshape = (total,) + tuple(x.shape[1:])
+                    return jax.make_array_from_process_local_data(
+                        self._sharding, np.asarray(x), gshape
+                    )
+
+                return jax.tree.map(put_leaf, chunk)
             return jax.device_put(chunk, self._sharding)
         return jax.device_put(chunk)
 
@@ -299,6 +374,13 @@ class StreamingObjective:
                 f"{self.stream.n_rows}"
             )
         if self.mesh is not None:
+            if self._multihost:
+                raise NotImplementedError(
+                    "per-row offsets (streamed GAME) are single-host for "
+                    "now: the CD score arrays are process-local, and "
+                    "slicing them onto the pod's global chunk layout is "
+                    "not wired up"
+                )
             # Streamed GAME × DP: each chunk's offset slice is reshaped to
             # the chunk's (shard, row) grid and placed SHARDED over the
             # mesh, so the per-chunk program adds it to the local rows with
@@ -397,6 +479,12 @@ class StreamingObjective:
 
     def scores(self, w: Array) -> np.ndarray:
         """Margins for every real row, streamed (validation scoring)."""
+        if self._multihost and self._sharding is not None:
+            raise NotImplementedError(
+                "streamed scoring over the pod mesh returns per-process "
+                "rows only; score host-locally with a mesh=None "
+                "StreamingObjective over this process's rows instead"
+            )
         outs = []
         for chunk in self.stream.chunks:
             m = self._score(w, self._put(chunk))
