@@ -202,6 +202,37 @@ def _make_block_solver(task: str, config: GlmOptimizationConfig):
         alpha = jax.lax.fori_loop(0, 30, body, alpha)
         return alpha[:, None] * X
 
+    def dim1_newton(block, offsets_block, w0, l2):
+        """Single-FEATURE entities (D == 1 — the reference's flagship
+        GAME shape: a per-entity bias/intercept random effect, e.g.
+        MovieLens per-user) are a 1-D problem in w regardless of row
+        count: damped scalar Newton replaces the vmapped L-BFGS
+        machinery, as rank1_newton does for R == 1.  Smooth objectives
+        only."""
+        X = block.X[:, :, 0]                       # (E, R)
+        y = block.labels
+        wt = block.weights
+        off = offsets_block.astype(X.dtype)
+        w = w0[:, 0]                               # (E,)
+        # Margin-change clamp: |Δw|·max|x| ≤ 20 per step (same damping
+        # rationale as rank1_newton's).
+        xmax = jnp.max(jnp.abs(X), axis=1)
+        clip = 20.0 / jnp.maximum(xmax, 1e-12)
+
+        def body(_, w):
+            m = w[:, None] * X + off
+            g = jnp.sum(wt * loss.d1(m, y) * X, axis=1) + l2 * w
+            h = jnp.sum(wt * loss.d2(m, y) * X * X, axis=1) + l2
+            # All-zero-feature lanes (padding, degenerate entities) need
+            # no special case: g = l2·w, h = l2 → one exact step to the
+            # regularized solution w = 0 (and with l2 = 0 the step is 0/ε
+            # = 0, leaving w unchanged — same stationary point the
+            # generic solver reports).
+            step = jnp.clip(g / jnp.maximum(h, 1e-12), -clip, clip)
+            return w - step
+
+        return jax.lax.fori_loop(0, 30, body, w)[:, None]
+
     def make_solve_one(history: int):
         def solve_one(X, y, wts, off, w0, l1, l2):
             def vg(w):
@@ -258,6 +289,8 @@ def _make_block_solver(task: str, config: GlmOptimizationConfig):
         # scalar-heavy LU loops on TPU.)
         if block.rows_per_entity == 1 and not use_owlqn:
             return rank1_newton(block, offsets_block, w0, l2)
+        if block.block_dim == 1 and not use_owlqn:
+            return dim1_newton(block, offsets_block, w0, l2)
         # History beyond the LOCAL problem dimension buys nothing (L-BFGS
         # with m >= d already behaves Newton-like) but every extra pair
     # adds two scan steps per iteration — sequential step count is what

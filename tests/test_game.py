@@ -561,3 +561,71 @@ class TestTightBucketPadding:
             (b.rows_per_entity, b.block_dim) for b in ds.blocks
         )
         assert dims == [(3, 5), (100, 5)], dims  # tight, not (4,8)/(128,8)
+
+
+class TestDim1Newton:
+    def test_bias_random_effect_matches_scalar_oracle(self, rng):
+        """D == 1 blocks (per-entity bias — the MovieLens shape) take the
+        scalar-Newton path; each entity's solution must match an
+        independent 1-D scipy solve of its own regularized objective."""
+        import scipy.optimize
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+        from photon_ml_tpu.game.data import build_random_effect_dataset
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        n_users, rows_each = 12, 7  # R > 1 so rank1 does NOT shadow dim1
+        n = n_users * rows_each
+        users = np.repeat(
+            np.array([f"u{i}" for i in range(n_users)], dtype=object),
+            rows_each,
+        )
+        x = rng.normal(size=n).astype(np.float32)  # single feature
+        offs = rng.normal(size=n).astype(np.float32) * 0.5
+        margins = 1.3 * x + offs
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margins))).astype(
+            np.float32
+        )
+        X = sp.csr_matrix(x[:, None])
+        ds = build_random_effect_dataset(
+            users, X, y, np.ones(n, np.float32)
+        )
+        assert all(b.block_dim == 1 for b in ds.blocks)
+        assert all(b.rows_per_entity > 1 for b in ds.blocks)
+        coord = RandomEffectCoordinate(
+            "per_user", ds, "logistic",
+            GlmOptimizationConfig(
+                optimizer=OptimizerConfig(max_iters=50, tolerance=1e-9),
+                regularization=RegularizationContext.l2(),
+            ),
+            reg_weight=0.7, entity_key="userId",
+        )
+        state = coord.train(jnp.asarray(offs))
+
+        def entity_obj(w, rows):
+            m = w * x[rows] + offs[rows]
+            return float(
+                np.sum(np.log1p(np.exp(-m)) * y[rows]
+                       + np.log1p(np.exp(m)) * (1 - y[rows]))
+                + 0.35 * w * w  # 0.5 * l2, l2 = 0.7
+            )
+
+        for bi, (block_ids, coefs) in enumerate(
+            zip(ds.entity_ids, state)
+        ):
+            for lane, key in enumerate(block_ids):
+                rows = np.flatnonzero(users == key)
+                res = scipy.optimize.minimize_scalar(
+                    lambda w: entity_obj(w, rows), bounds=(-20, 20),
+                    method="bounded",
+                    options={"xatol": 1e-10},
+                )
+                np.testing.assert_allclose(
+                    float(np.asarray(coefs)[lane, 0]), res.x, atol=2e-4,
+                    err_msg=f"entity {key}",
+                )

@@ -255,6 +255,40 @@ class TestGameDrivers:
         with pytest.raises(ValueError, match="index maps"):
             list(iter_game_avro(train, None))
 
+    def test_streamed_tron_fixed_effect_via_config(
+        self, game_files, tmp_path
+    ):
+        """JSON config composition: 'optimizer': 'tron' +
+        'streaming_chunk_rows' on the fixed effect trains out-of-core
+        through the streamed trust-region solver and matches the
+        resident-TRON run's metric."""
+        train, val, config = game_files
+        with open(config) as f:
+            cfg = json.load(f)
+        cfg["coordinates"][0].update(optimizer="tron")
+        resident_cfg = str(tmp_path / "tron.json")
+        with open(resident_cfg, "w") as f:
+            json.dump(cfg, f)
+        cfg["coordinates"][0]["streaming_chunk_rows"] = 200
+        streamed_cfg = str(tmp_path / "tron_streamed.json")
+        with open(streamed_cfg, "w") as f:
+            json.dump(cfg, f)
+
+        r = game_training_driver.run([
+            "--train-data", train, "--validate-data", val,
+            "--config", resident_cfg,
+            "--output-dir", str(tmp_path / "out_r"),
+        ])
+        s = game_training_driver.run([
+            "--train-data", train, "--validate-data", val,
+            "--config", streamed_cfg,
+            "--output-dir", str(tmp_path / "out_s"),
+        ])
+        assert s["validation_metric"] == pytest.approx(
+            r["validation_metric"], abs=1e-3
+        )
+        assert s["validation_metric"] > 0.65
+
     def test_model_store_roundtrip_preserves_scores(self, game_files, tmp_path):
         train, val, config = game_files
         out = str(tmp_path / "rt_out")

@@ -355,6 +355,45 @@ class TestStreamingGrid:
             )
 
 
+class TestStreamingNormalization:
+    def test_normalized_objective_matches_resident(self, rng):
+        """NormalizationContext composes with the streamed objective the
+        same way it does resident: value/grad/HVP parity under a
+        standardization context (the reference applies normalization
+        inside the optimizer against unscaled data — SURVEY.md §2)."""
+        from photon_ml_tpu.data.normalization import (
+            NormalizationContext,
+            NormalizationType,
+            build_normalization,
+        )
+        from photon_ml_tpu.data.stats import summarize
+
+        n, d = 600, 20
+        X, y = _logistic_problem(rng, n, d - 1, density=0.2)
+        data = make_glm_data(X, y)
+        norm = build_normalization(
+            NormalizationType.STANDARDIZATION, summarize(data),
+            intercept_index=0,
+        )
+        obj = GlmObjective(losses.logistic, norm)
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=200, use_pallas=False
+        )
+        sobj = StreamingObjective(obj, stream)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v_r, g_r = obj.value_and_grad(w, data, l2_weight=0.5)
+        v_s, g_s = sobj.value_and_grad(w, 0.5)
+        np.testing.assert_allclose(float(v_s), float(v_r), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(g_s), np.asarray(g_r),
+                                   atol=1e-3)
+        vv = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(sobj.hvp(w, vv, 0.5)),
+            np.asarray(obj.hvp(w, vv, data, l2_weight=0.5)),
+            atol=1e-3,
+        )
+
+
 class TestStreamingTRON:
     def test_hvp_matches_resident(self, rng):
         """One streamed HVP pass == the resident Hessian-vector product
