@@ -1,0 +1,61 @@
+"""Chip A/B: D=1 scalar-Newton path vs the generic vmapped L-BFGS
+(forced via a padded second feature column) on a MovieLens-shaped
+per-user bias random effect (100k zipf entities).
+
+Measured 2026-07-31 (round 4): scalar Newton 84 ms vs generic 204 ms = 2.4x.
+"""
+import sys, time
+import numpy as np
+import scipy.sparse as sp
+
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+from photon_ml_tpu.game.data import build_random_effect_dataset
+from photon_ml_tpu.optim.problem import GlmOptimizationConfig, OptimizerConfig
+from photon_ml_tpu.optim.regularization import RegularizationContext
+
+rng = np.random.default_rng(1)
+ENTITIES, ROW_CAP = 100_000, 128
+sizes = np.minimum(rng.zipf(1.8, ENTITIES), ROW_CAP)
+n = int(sizes.sum())
+users = np.repeat(
+    np.array([f"u{i}" for i in range(ENTITIES)], dtype=object), sizes
+)[rng.permutation(n)]
+y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+opt = GlmOptimizationConfig(
+    optimizer=OptimizerConfig(max_iters=10, tolerance=1e-6),
+    regularization=RegularizationContext.l2(),
+)
+offsets = jnp.zeros(n, jnp.float32)
+
+def run(label, X):
+    ds = build_random_effect_dataset(
+        users, X, y, np.ones(n, np.float32), bucket_growth=4.0
+    )
+    re = RandomEffectCoordinate("per_user", ds, "logistic", opt,
+                                reg_weight=1.0, entity_key="userId")
+    re.train(offsets)  # compile + warm
+    best = np.inf
+    for _ in range(4):
+        t0 = time.perf_counter()
+        st = re.train(offsets)
+        np.asarray(jax.tree.leaves(st)[0].ravel()[0:1])
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label}: {best*1e3:.0f} ms  dims="
+          f"{[(b.n_entities, b.rows_per_entity, b.block_dim) for b in ds.blocks]}")
+    return best, st
+
+bias = sp.csr_matrix(np.ones((n, 1), np.float32))
+t1, st1 = run("D=1 (scalar Newton)", bias)
+two = sp.csr_matrix(np.hstack([
+    np.ones((n, 1), np.float32),
+    np.full((n, 1), 1e-8, np.float32),  # forces D=2 -> generic L-BFGS
+]))
+t2, st2 = run("D=2 (generic vmapped L-BFGS)", two)
+print(f"speedup {t2/t1:.1f}x")
+# Same solutions (the dummy column contributes ~nothing)
+a = np.concatenate([np.asarray(b)[:, 0].ravel() for b in st1])
+b_ = np.concatenate([np.asarray(b)[:, 0].ravel() for b in st2])
+print("max |w_dim1 - w_generic| =", float(np.max(np.abs(np.sort(a) - np.sort(b_)))))

@@ -1,0 +1,60 @@
+"""Profile the GAME per_user coordinate on the chip: train vs score split,
+and sensitivity to max_iters / history (the L-BFGS sequential step count).
+Replicates the bench's zipf workload exactly.
+
+Measured 2026-07-31 (round 4): tight bucket padding cut train 575 -> 383 ms (max_iters=10).
+"""
+import sys, time
+import numpy as np
+import scipy.sparse as sp
+
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+from photon_ml_tpu.game.data import build_random_effect_dataset
+from photon_ml_tpu.optim.problem import GlmOptimizationConfig, OptimizerConfig
+from photon_ml_tpu.optim.regularization import RegularizationContext
+
+rng = np.random.default_rng(1)
+ENTITIES, ROW_CAP, RE_DIM = 100_000, 128, 8
+sizes = np.minimum(rng.zipf(1.8, ENTITIES), ROW_CAP)
+n = int(sizes.sum())
+users = np.repeat(
+    np.array([f"u{i}" for i in range(ENTITIES)], dtype=object), sizes
+)[rng.permutation(n)]
+Xu = sp.csr_matrix(rng.normal(size=(n, RE_DIM)).astype(np.float32))
+y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+re_ds = build_random_effect_dataset(
+    users, Xu, y, np.ones(n, np.float32), bucket_growth=4.0
+)
+print(f"{n} rows, buckets:",
+      [(b.n_entities, b.rows_per_entity) for b in re_ds.blocks])
+
+def timed(label, fn, sync, reps=4):
+    fn(); jax.block_until_ready(sync(fn()))
+    np.asarray(jax.tree.leaves(fn())[0]).ravel()[:1]
+    best = np.inf
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        np.asarray(jax.tree.leaves(out)[0].ravel()[0:1])
+        best = min(best, time.perf_counter() - t0)
+    print(f"  {label}: {best*1e3:.0f} ms")
+    return best
+
+offsets = jnp.zeros(n, jnp.float32)
+for mi in (10, 5):
+    opt = GlmOptimizationConfig(
+        optimizer=OptimizerConfig(max_iters=mi, tolerance=1e-6),
+        regularization=RegularizationContext.l2(),
+    )
+    re = RandomEffectCoordinate("per_user", re_ds, "logistic", opt,
+                                reg_weight=1.0, entity_key="userId")
+    print(f"max_iters={mi}:")
+    t_train = timed("train (all buckets, one jit)",
+                    lambda: re.train(offsets), lambda o: o[0])
+    state = re.train(offsets)
+    t_score = timed("score", lambda: re.score(state), lambda o: o)
+    warm = timed("train warm-started",
+                 lambda: re.train(offsets, warm_state=state), lambda o: o[0])

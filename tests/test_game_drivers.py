@@ -248,6 +248,32 @@ class TestGameDrivers:
             assert sr["predictionScore"] == rr["predictionScore"]
             assert sr["ids"] == rr["ids"]
 
+    def test_iter_game_avro_python_fallback_matches_native(
+        self, game_files, monkeypatch
+    ):
+        """PHOTON_NO_NATIVE=1 routes the block iterator through the pure-
+        Python payload decoder; blocks must be identical to the native
+        C++ session path."""
+        from photon_ml_tpu.data.game_reader import iter_game_avro
+
+        train, _, _ = game_files
+        *_, imaps = read_game_avro(train)
+        native = list(iter_game_avro(train, imaps, block_rows=100))
+        monkeypatch.setenv("PHOTON_NO_NATIVE", "1")
+        pyth = list(iter_game_avro(train, imaps, block_rows=100))
+        assert len(pyth) == len(native)
+        for (bn, bp) in zip(native, pyth):
+            np.testing.assert_array_equal(bp[2], bn[2])  # response
+            np.testing.assert_array_equal(bp[3], bn[3])  # weight
+            np.testing.assert_array_equal(bp[4], bn[4])  # offset
+            assert bp[5] == bn[5]                        # uids
+            for shard in bn[0]:
+                np.testing.assert_array_equal(
+                    bp[0][shard].toarray(), bn[0][shard].toarray()
+                )
+            for k in bn[1]:
+                np.testing.assert_array_equal(bp[1][k], bn[1][k])
+
     def test_iter_game_avro_requires_index_maps(self, game_files):
         from photon_ml_tpu.data.game_reader import iter_game_avro
 
