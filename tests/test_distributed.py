@@ -198,3 +198,100 @@ class TestDistributedGrid:
             assert r_dp["metrics"][k] == pytest.approx(
                 r_sd["metrics"][k], abs=1e-3
             )
+
+
+class TestDriverStreamedDataParallel:
+    def test_glm_driver_streaming_composes_with_data_parallel(
+        self, rng, tmp_path
+    ):
+        """--stream-chunk-rows + --data-parallel auto: out-of-core chunks
+        sharded over the 8-device mesh, same selection and metrics as the
+        plain single-device run (the streamed treeAggregate shape)."""
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.data import libsvm
+        from photon_ml_tpu.drivers import glm_driver
+
+        n, d = 320, 20
+        X = sp.random(n, d, density=0.25, random_state=5, format="csr")
+        w_true = rng.normal(size=d)
+        y = np.where(np.asarray(X @ w_true).ravel() > 0, 1.0, -1.0)
+        train = str(tmp_path / "t.libsvm")
+        libsvm.write_libsvm(train, X, y)
+        args = [
+            "--train-data", train, "--task", "logistic", "--reg-type", "l2",
+            "--reg-weights", "0.5,5.0", "--n-features", str(d),
+            "--max-iters", "40", "--output-dir",
+        ]
+        r_sdp = glm_driver.run(args + [
+            str(tmp_path / "sdp"),
+            "--stream-chunk-rows", "80", "--data-parallel", "auto",
+        ])
+        r_ref = glm_driver.run(args + [str(tmp_path / "ref")])
+        assert r_sdp["best_lambda"] == r_ref["best_lambda"]
+        for k in r_ref["metrics"]:
+            assert r_sdp["metrics"][k] == pytest.approx(
+                r_ref["metrics"][k], abs=2e-3
+            )
+
+    def test_game_driver_streaming_composes_with_data_parallel(
+        self, rng, tmp_path
+    ):
+        """GAME JSON config 'streaming_chunk_rows' + --data-parallel auto:
+        mesh-sharded streamed fixed effect + entity-sharded random effect
+        through the CLI, matching the plain run's validation metric."""
+        import json
+
+        from photon_ml_tpu.data.game_reader import write_game_avro
+        from photon_ml_tpu.drivers import game_training_driver
+
+        n, n_users = 400, 15
+        user_eff = {f"u{u}": rng.normal() for u in range(n_users)}
+        rows = []
+        for i in range(n):
+            u = f"u{rng.integers(n_users)}"
+            xg = rng.normal(size=3)
+            m = 1.2 * xg[0] - 0.9 * xg[1] + user_eff[u]
+            rows.append({
+                "uid": f"r{i}",
+                "response": float(rng.uniform() < 1 / (1 + np.exp(-m))),
+                "weight": None, "offset": None, "ids": {"userId": u},
+                "features": {
+                    "global": [
+                        {"name": f"g{j}", "term": "", "value": float(xg[j])}
+                        for j in range(3)
+                    ],
+                    "userFeatures": [
+                        {"name": "bias", "term": "", "value": 1.0}
+                    ],
+                },
+            })
+        train = str(tmp_path / "g.avro")
+        val = str(tmp_path / "v.avro")
+        write_game_avro(train, rows[:320])
+        write_game_avro(val, rows[320:])
+        cfg = {
+            "task": "logistic", "iterations": 2, "evaluator": "auc",
+            "coordinates": [
+                {"name": "fixed", "type": "fixed", "feature_shard": "global",
+                 "reg_type": "l2", "reg_weight": 0.5, "max_iters": 40,
+                 "streaming_chunk_rows": 100},
+                {"name": "per_user", "type": "random",
+                 "feature_shard": "userFeatures", "entity_key": "userId",
+                 "reg_type": "l2", "reg_weight": 1.0, "max_iters": 30},
+            ],
+        }
+        cfg_path = str(tmp_path / "cfg.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        base = [
+            "--train-data", train, "--validate-data", val,
+            "--config", cfg_path, "--output-dir",
+        ]
+        r_dp = game_training_driver.run(base + [
+            str(tmp_path / "dp"), "--data-parallel", "auto",
+        ])
+        r_sd = game_training_driver.run(base + [str(tmp_path / "sd")])
+        assert r_dp["validation_metric"] == pytest.approx(
+            r_sd["validation_metric"], abs=2e-3
+        )
