@@ -213,3 +213,78 @@ class TestGameConfigFuzz:
         loaded, _ = load_game_model(out)
         scores2 = GameTransformer(loaded).transform(shards, ids)
         np.testing.assert_allclose(scores2, scores, atol=1e-5)
+
+
+class TestStreamingFuzz:
+    """Seeded sweeps over the out-of-core surface: random chunk
+    geometry × optimizer × accumulation × layout, each fit pinned
+    against the resident solver on the same data."""
+
+    @pytest.mark.parametrize("seed", [11, 22, 33, 44])
+    def test_random_stream_fit_matches_resident(self, seed):
+        from photon_ml_tpu.data.dataset import make_glm_data
+        from photon_ml_tpu.data.streaming import make_streaming_glm_data
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            GlmOptimizationProblem,
+            OptimizerConfig,
+            OptimizerType,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+        from photon_ml_tpu.optim.streaming import streaming_run_grid
+
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(200, 1200))
+        d = int(rng.integers(8, 60))
+        density = float(rng.uniform(0.05, 0.4))
+        X = sp.random(n, d, density=density, random_state=seed,
+                      format="csr", dtype=np.float32)
+        w_true = rng.normal(size=d).astype(np.float32)
+        logits = np.asarray(X @ w_true).ravel()
+        task = rng.choice(["logistic", "linear", "poisson"])
+        if task == "logistic":
+            y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(
+                np.float32
+            )
+        elif task == "linear":
+            y = (logits + rng.normal(size=n) * 0.1).astype(np.float32)
+        else:
+            y = rng.poisson(np.exp(np.clip(logits, -4, 3))).astype(
+                np.float32
+            )
+        optimizer = rng.choice([
+            OptimizerType.LBFGS, OptimizerType.TRON, OptimizerType.OWLQN
+        ])
+        reg = (
+            RegularizationContext.l1()
+            if optimizer is OptimizerType.OWLQN
+            else RegularizationContext.l2()
+        )
+        problem = GlmOptimizationProblem(
+            task,
+            GlmOptimizationConfig(
+                optimizer=OptimizerConfig(
+                    optimizer=optimizer, max_iters=80, tolerance=1e-8
+                ),
+                regularization=reg,
+            ),
+        )
+        lam = float(rng.choice([0.3, 1.0, 4.0]))
+        grid_r = problem.run_grid(make_glm_data(X, y), [lam])
+        chunk_rows = int(rng.integers(50, n + 50))
+        stream = make_streaming_glm_data(
+            X, y, chunk_rows=chunk_rows,
+            use_pallas=bool(rng.integers(2)),
+            depth_cap=32,
+        )
+        grid_s = streaming_run_grid(
+            problem, stream, [lam],
+            accumulate=str(rng.choice(["f32", "kahan"])),
+        )
+        w_r = np.asarray(grid_r[0][1].coefficients.means)
+        w_s = np.asarray(grid_s[0][1].coefficients.means)
+        scale = max(1.0, float(np.abs(w_r).max()))
+        np.testing.assert_allclose(
+            w_s, w_r, atol=6e-3 * scale,
+            err_msg=f"task={task} opt={optimizer} chunk_rows={chunk_rows}",
+        )
