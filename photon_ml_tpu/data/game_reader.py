@@ -535,7 +535,12 @@ def iter_game_avro(
                 shape=(n, len(fwd)),
             )
         ids = {}
-        for k in set(acc.id_cols) | set(id_keys):
+        # Canonical (sorted) key order: block-local insertion order would
+        # differ from the resident reader's whole-file order, and a raw
+        # set union is hash-order nondeterministic run to run.  The
+        # scoring driver also sorts at the write point; both layers being
+        # canonical keeps streamed/resident outputs byte-identical.
+        for k in sorted(set(acc.id_cols) | set(id_keys)):
             lst = acc.id_cols.get(k, [])
             if len(lst) < n:
                 lst.extend([None] * (n - len(lst)))

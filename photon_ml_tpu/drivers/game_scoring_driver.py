@@ -79,8 +79,14 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             "uid": uid,
             "predictionScore": float(score),
             "label": float(label),
+            # Sorted keys: the upstream ids dict order is insertion order
+            # (whole-file for the resident reader, block-local for the
+            # streamed one), so a canonical order here is what actually
+            # makes the two output files byte-identical.
             "ids": {
-                k: str(v[i]) for k, v in ids.items() if v[i] is not None
+                k: str(ids[k][i])
+                for k in sorted(ids)
+                if ids[k][i] is not None
             },
         }
 

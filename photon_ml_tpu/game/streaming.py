@@ -70,6 +70,16 @@ class StreamingFixedEffectCoordinate(Coordinate):
         under shard_map with one fused psum, and the coordinate-descent
         offsets ride per-chunk as sharded row slices."""
         ensure_streamable(config)
+        if mesh is not None and jax.process_count() > 1:
+            # Fail BEFORE the (potentially long) chunk-store ingest and CD
+            # setup — train()/scores() would otherwise hit the same
+            # rejection only deep inside the first solve.
+            raise NotImplementedError(
+                "per-row offsets (streamed GAME) are single-host for "
+                "now: the CD score arrays are process-local, and "
+                "slicing them onto the pod's global chunk layout is "
+                "not wired up"
+            )
         if mesh is None and stream.n_shards != 1:
             raise ValueError(
                 f"stream has n_shards={stream.n_shards}; pass the mesh it "

@@ -293,19 +293,24 @@ class StreamingObjective:
         mismatch would compile different SPMD executables per process
         (hang or crash deep in XLA), so it is refused loudly here with
         the fix spelled out."""
+        import zlib
+
         from jax.experimental import multihost_utils
 
         chunks = self.stream.chunks
         leaves = jax.tree.leaves(chunks[0])
-        sig = np.asarray(
-            [len(chunks), len(leaves)]
-            + [d for leaf in leaves for d in (len(leaf.shape), *leaf.shape)],
-            np.int64,
+        # The structure signature is hashed to a SCALAR before the
+        # allgather: a raw per-leaf shape vector would have a
+        # process-dependent LENGTH exactly when structures mismatch, and
+        # process_allgather on ragged inputs dies (or hangs) deep in the
+        # collective instead of reaching the explanatory error below.
+        shape_sig = ",".join(
+            f"{len(leaf.shape)}:{leaf.shape}" for leaf in leaves
         )
+        crc = zlib.crc32(f"{len(leaves)}|{shape_sig}".encode())
+        sig = np.asarray([len(chunks), crc], np.int64)
         all_sigs = np.asarray(multihost_utils.process_allgather(sig))
-        if not (all_sigs[1:, 2:] == all_sigs[0, 2:]).all() or not (
-            all_sigs[1:, 1] == all_sigs[0, 1]
-        ).all():
+        if not (all_sigs[1:, 1] == all_sigs[0, 1]).all():
             raise ValueError(
                 "multi-host chunk stores have mismatched leaf shapes "
                 "across processes (per-process nnz budgets / layouts "

@@ -317,3 +317,51 @@ def test_two_process_streamed_dp_fit_matches_single_process(tmp_path):
     np.testing.assert_allclose(
         w0, np.asarray(oracle.w), atol=2e-3
     )
+
+
+def test_two_process_mismatched_stores_fail_loudly(tmp_path):
+    """Per-process stores with DIFFERENT coo budgets must die with the
+    explanatory ValueError, not an opaque collective shape error — the
+    structure signature is hashed to a scalar before the allgather
+    precisely so ragged structures still rendezvous."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = tmp_path / "worker_bad.py"
+    # Same worker, except each process pads to its OWN nnz budget.
+    worker.write_text(_WORKER_STREAM.replace(
+        "coo_budget=int(X.nnz),  # identical pod-wide pad budget",
+        "coo_budget=int(X.nnz) + 64 * pid,  # DELIBERATE mismatch",
+    ))
+    port = _free_port()
+    nproc = 2
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo_root + ":" + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(i), str(nproc)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("jax.distributed localhost rendezvous timed out here")
+    # Detection-success and unsupported-env BOTH exit nonzero here, so the
+    # skip must also require that the detection message never appeared.
+    if all(
+        "DISTRIBUTED" in err.upper() and rc != 0
+        and "mismatched leaf shapes" not in err
+        for rc, _, err in outs
+    ):
+        pytest.skip("jax.distributed unsupported here")
+    assert all(rc != 0 for rc, _, _ in outs), "mismatch was not detected"
+    assert any(
+        "mismatched leaf shapes" in err for _, _, err in outs
+    ), outs[0][2][-2000:]
