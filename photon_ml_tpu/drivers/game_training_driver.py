@@ -117,6 +117,12 @@ def parse_coordinate_config(spec: dict):
             reg_weight=float(spec.get("reg_weight", 0.0)),
             max_rows_per_entity=spec.get("max_rows_per_entity"),
             bucket_growth=float(spec.get("bucket_growth", 2.0)),
+            # >0: train this coordinate out-of-core (entity blocks stay in
+            # host RAM, streamed through HBM in pass groups bounded by this
+            # many megabytes — game/ooc_random.py).
+            device_budget_bytes=int(
+                float(spec.get("device_budget_mb", 0)) * 2**20
+            ),
         )
     if spec["type"] in ("factored_random", "factored"):
         proj_rw = spec.get("projection_reg_weight")

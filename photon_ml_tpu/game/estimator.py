@@ -72,6 +72,13 @@ class RandomEffectCoordinateConfig:
     #: geometric bucket grid for per-entity size bucketing (2.0 = pow2);
     #: larger values consolidate long tails into fewer compiled programs.
     bucket_growth: float = 2.0
+    #: >0 trains this coordinate OUT-OF-CORE: entity blocks stay in host
+    #: RAM and stream through HBM in double-buffered pass groups bounded
+    #: by this many bytes (game/ooc_random.py) — for random-effect
+    #: datasets larger than device memory.  Per-entity coefficients live
+    #: host-resident between passes.  Composes with a mesh (the budget
+    #: then bounds per-device bytes).
+    device_budget_bytes: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -251,6 +258,34 @@ class GameEstimator:
                 )
             else:
                 factored = isinstance(cfg, FactoredRandomEffectCoordinateConfig)
+                if not factored and cfg.device_budget_bytes > 0:
+                    from photon_ml_tpu.game.ooc_random import (
+                        OutOfCoreRandomEffectCoordinate,
+                    )
+
+                    # Host-resident dataset, cached separately from the
+                    # device-resident one the resident path builds.
+                    ooc_key = ("ooc_ds",) + key
+                    dataset = cache.get(ooc_key)
+                    if dataset is None:
+                        dataset = build_random_effect_dataset(
+                            ids[cfg.entity_key],
+                            shard,
+                            np.asarray(response, np.float32),
+                            weight,
+                            max_rows_per_entity=cfg.max_rows_per_entity,
+                            bucket_growth=cfg.bucket_growth,
+                            device=False,
+                        )
+                        cache[ooc_key] = dataset
+                    coordinates.append(OutOfCoreRandomEffectCoordinate(
+                        name, dataset, self.task, cfg.optimization,
+                        cfg.reg_weight, feature_shard=cfg.feature_shard,
+                        entity_key=cfg.entity_key,
+                        device_budget_bytes=cfg.device_budget_bytes,
+                        mesh=self.mesh,
+                    ))
+                    continue
                 if self.mesh is not None and not factored:
                     coordinates.append(
                         self._distributed_random(

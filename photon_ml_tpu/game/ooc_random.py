@@ -1,0 +1,408 @@
+"""Out-of-core random effects: entity blocks larger than device memory.
+
+The reference's ``RandomEffectDataset`` is an RDD — *cluster-memory*-scaled:
+entities hash-partitioned across executors, each executor training its
+partition's per-entity GLMs locally (SURVEY.md §2 RandomEffectDataset row,
+§3.2).  At BASELINE config 5's scale (1B rows, user+item+context random
+effects) the per-entity datasets collectively dwarf one chip's HBM, and
+entity-sharding only divides by ``n_devices`` — it never bounds the
+PER-DEVICE footprint.
+
+This module bounds it.  The per-entity solves are embarrassingly
+independent (no cross-block state beyond the shared per-row offsets), so
+the blocks stream the way the row-chunk store streams fixed-effect data:
+
+1. the dataset is built HOST-resident (``device=False``);
+2. oversized blocks are split along the ENTITY axis into uniform-shape
+   sub-slices (one compiled program per original block shape — the last
+   slice pads with zero-weight lanes, which solve to w=0 under any L2);
+3. slices are packed into PASS GROUPS whose device footprint fits half the
+   budget — half, because the next group's transfer is enqueued while the
+   current group solves (double buffering, the chunk-store discipline);
+4. per-entity coefficients live in host numpy between passes; only the
+   global offset/score row arrays stay device-resident.
+
+With a mesh, each slice's entity axis is additionally sharded over the
+mesh (the ``EntityShardedRandomEffectCoordinate`` layout) — the budget
+then bounds the PER-DEVICE bytes, and the vmap'd solver still partitions
+with zero communication.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.game.coordinates import (
+    RandomEffectCoordinate,
+    _gather_block_offsets,
+    _make_block_solver,
+)
+from photon_ml_tpu.game.data import EntityBlock, RandomEffectDataset
+from photon_ml_tpu.ops import losses as losses_lib
+from photon_ml_tpu.optim.problem import GlmOptimizationConfig
+from photon_ml_tpu.parallel.distributed import DATA_AXIS
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class _Slice:
+    """One schedulable unit: lanes [lane_lo, lane_hi) of block ``block_idx``,
+    padded to ``padded_e`` entities (uniform across the block's slices so
+    every slice of a block shares ONE compiled program)."""
+
+    block_idx: int
+    lane_lo: int
+    lane_hi: int
+    padded_e: int
+    bytes: int
+
+
+def _lane_bytes(block: EntityBlock, passive: Optional[EntityBlock]) -> int:
+    """Device bytes one entity lane costs in a pass: active leaves + the
+    gathered offsets + coefficients in and out, plus the lane's score-only
+    passive companion (score passes carry both; one conservative number
+    keeps train and score on a single plan)."""
+    r, d = block.rows_per_entity, block.block_dim
+    active = 4 * (r * d + 4 * r + 2 * d)  # X, labels/weights/row_index/off, cmap+w
+    out = 4 * d
+    psv = 0
+    if passive is not None:
+        rp = passive.rows_per_entity
+        psv = 4 * (rp * d + 3 * rp)  # Xp, labels/weights/row_index
+    return active + out + psv
+
+
+def _host_leaf(x) -> np.ndarray:
+    if isinstance(x, jax.Array):
+        raise ValueError(
+            "out-of-core random effects need a HOST-resident dataset — "
+            "build it with build_random_effect_dataset(..., device=False)"
+        )
+    return np.asarray(x)
+
+
+def _slice_block(
+    block: EntityBlock, lo: int, hi: int, padded_e: int, sentinel: int
+) -> EntityBlock:
+    """Host-side entity-axis slice [lo, hi), padded to ``padded_e`` lanes.
+    Padding lanes carry zero weights (solve to 0), col_map -1, and sentinel
+    row indices (scatter into the discarded trailing slot)."""
+    pad = padded_e - (hi - lo)
+
+    def cut(x, fill):
+        x = x[lo:hi]
+        if pad == 0:
+            return x
+        width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(x, width, constant_values=fill)
+
+    return EntityBlock(
+        X=cut(block.X, 0),
+        labels=cut(block.labels, 0),
+        weights=cut(block.weights, 0),
+        col_map=cut(block.col_map, -1),
+        row_index=cut(block.row_index, sentinel),
+        n_entities=padded_e,
+        rows_per_entity=block.rows_per_entity,
+        block_dim=block.block_dim,
+    )
+
+
+class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
+    """RandomEffectCoordinate whose dataset exceeds device memory.
+
+    Same ``train(offsets, warm) → state`` / ``score(state)`` surface as the
+    resident coordinate; identical numerics (the very same memoized block
+    solver runs on each slice, and entity-axis slicing/padding never changes
+    a lane's math).  State is a list of HOST (E, D) numpy arrays.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        dataset: RandomEffectDataset,
+        task: str,
+        config: GlmOptimizationConfig,
+        reg_weight: float = 0.0,
+        feature_shard: str = "global",
+        entity_key: str = "",
+        device_budget_bytes: int = 256 * 2**20,
+        mesh=None,
+    ):
+        # Deliberately NOT calling super().__init__: the resident
+        # constructor jits one whole-dataset program, which is exactly what
+        # a larger-than-HBM dataset cannot do.
+        self.name = name
+        self.dataset = dataset
+        self.task = losses_lib.get(task).name
+        self.config = config
+        self.reg_weight = reg_weight
+        self.feature_shard = feature_shard
+        self.entity_key = entity_key or name
+        self.device_budget_bytes = int(device_budget_bytes)
+        if mesh is not None and jax.process_count() > 1:
+            # Same early rejection as StreamingFixedEffectCoordinate:
+            # _put would device_put per-process host numpy onto a
+            # pod-spanning sharding — unsupported/undefined — and only
+            # deep inside the first train pass.
+            raise NotImplementedError(
+                "out-of-core random effects are single-host for now: "
+                "entity blocks live in one process's RAM, and slicing "
+                "them onto a multi-process pod mesh is not wired up"
+            )
+        self.mesh = mesh
+        self._solver = _make_block_solver(task, config)
+        self._sharding = (
+            None if mesh is None else NamedSharding(mesh, P(DATA_AXIS))
+        )
+        self._quantum = 1 if mesh is None else int(mesh.devices.size)
+
+        for b in dataset.blocks:
+            jax.tree.map(_host_leaf, b)
+
+        self.pass_plan = self._build_plan()
+        #: high-water mark of pass groups with live device buffers —
+        #: the structural "bounded memory" witness the tests pin (≤2:
+        #: the solving group plus the prefetched next one).
+        self.live_groups_high_water = 0
+
+        n_rows = dataset.n_global_rows
+        solver = self._solver
+
+        def _solve_slice(block, offsets, w0, l1, l2):
+            return solver(
+                block, _gather_block_offsets(offsets, block), w0, l1, l2
+            )
+
+        def _score_slice(total, block, coefs):
+            s = jnp.einsum("erd,ed->er", block.X, coefs)
+            return total.at[block.row_index.ravel()].add(s.ravel())
+
+        loss = losses_lib.get(self.task)
+
+        def _var_slice(block, coefs, offsets, l2):
+            off_b = _gather_block_offsets(offsets, block)
+            m = jnp.einsum("erd,ed->er", block.X, coefs) + off_b
+            d2w = block.weights * loss.d2(m, block.labels)
+            diag = jnp.einsum("er,erd->ed", d2w, block.X * block.X) + l2
+            return 1.0 / jnp.maximum(diag, 1e-12)
+
+        self._solve_jit = jax.jit(_solve_slice)
+        self._var_jit = jax.jit(_var_slice)
+        # total is donated: each pass group's scatter reuses the buffer
+        # instead of allocating a second (n_rows+1) array per step.
+        self._score_jit = jax.jit(_score_slice, donate_argnums=0)
+        self._zeros_jit = jax.jit(
+            lambda: jnp.zeros((n_rows + 1,), jnp.float32)
+        )
+
+    # -- pass planning -----------------------------------------------------
+
+    def _build_plan(self) -> list[list[_Slice]]:
+        """Split blocks along the entity axis and pack slices into groups.
+
+        Each original block is cut into ``n_parts`` uniform sub-slices
+        (ceil division, padded to the mesh quantum) so the whole block
+        contributes ONE compiled shape; groups then fill greedily to the
+        per-pass budget (= budget/2, the double-buffering reserve).
+        """
+        budget = self.device_budget_bytes // 2
+        q = self._quantum
+        plan: list[list[_Slice]] = []
+        group: list[_Slice] = []
+        group_bytes = 0
+        for bi, block in enumerate(self.dataset.blocks):
+            passive = (
+                self.dataset.passive_blocks[bi]
+                if self.dataset.passive_blocks else None
+            )
+            per_lane = _lane_bytes(block, passive)
+            e = block.n_entities
+            if per_lane * q > budget:
+                raise ValueError(
+                    f"random-effect coordinate {self.name!r}: one "
+                    f"{q}-entity slice of block {bi} "
+                    f"(R={block.rows_per_entity}, D={block.block_dim}) "
+                    f"needs {per_lane * q} bytes, over the "
+                    f"per-pass budget {budget} (= device_budget_bytes/2). "
+                    "Raise device_budget_bytes or lower "
+                    "max_rows_per_entity / bucket_growth"
+                )
+            # Quantum-multiple lane cap, so the final round-up below can
+            # never push a slice past the budget.
+            lanes_per_pass = max(q, (budget // per_lane) // q * q)
+            n_parts = max(1, -(-e // lanes_per_pass))  # ceil
+            sub_e = -(-e // n_parts)
+            sub_e = ((sub_e + q - 1) // q) * q  # quantum-aligned
+            for lo in range(0, e, sub_e):
+                hi = min(lo + sub_e, e)
+                s = _Slice(bi, lo, hi, sub_e, per_lane * sub_e)
+                if group and group_bytes + s.bytes > budget:
+                    plan.append(group)
+                    group, group_bytes = [], 0
+                group.append(s)
+                group_bytes += s.bytes
+        if group:
+            plan.append(group)
+        return plan
+
+    def _put(self, tree):
+        if self._sharding is None:
+            return jax.device_put(tree)
+        return jax.tree.map(
+            lambda x: jax.device_put(x, self._sharding), tree
+        )
+
+    def _iter_groups(self, make_host_group):
+        """Double-buffered group iterator: yields each group's device
+        pytrees, with group g+1's transfer enqueued before g's results are
+        consumed.  ``make_host_group(group) → host pytree list``."""
+        plan = self.pass_plan
+        self.live_groups_high_water = 0
+        live = 0
+        nxt = self._put(make_host_group(plan[0])) if plan else None
+        live += 1
+        for gi, group in enumerate(plan):
+            cur = nxt
+            if gi + 1 < len(plan):
+                nxt = self._put(make_host_group(plan[gi + 1]))
+                live += 1
+            else:
+                nxt = None
+            self.live_groups_high_water = max(
+                self.live_groups_high_water, live
+            )
+            yield group, cur
+            live -= 1  # cur's buffers die with the loop body's references
+
+    # -- coordinate surface ------------------------------------------------
+
+    def train(self, offsets: Array, warm_state=None) -> list[np.ndarray]:
+        l1 = jnp.asarray(
+            self.config.regularization.l1_weight(1.0) * self.reg_weight,
+            jnp.float32,
+        )
+        l2 = jnp.asarray(
+            self.config.regularization.l2_weight(1.0) * self.reg_weight,
+            jnp.float32,
+        )
+        offsets = jnp.asarray(offsets, jnp.float32)
+        sentinel = self.dataset.n_global_rows
+        state = [
+            (
+                np.zeros((b.n_entities, b.block_dim), np.float32)
+                if warm_state is None
+                # copy: np.asarray of a jax array (checkpoint resume) is a
+                # read-only zero-copy view, and this buffer is written into.
+                else np.array(warm_state[bi], np.float32)
+            )
+            for bi, b in enumerate(self.dataset.blocks)
+        ]
+
+        def host_group(group):
+            out = []
+            for s in group:
+                block = self.dataset.blocks[s.block_idx]
+                w0 = state[s.block_idx][s.lane_lo:s.lane_hi]
+                pad = s.padded_e - w0.shape[0]
+                if pad:
+                    w0 = np.pad(w0, ((0, pad), (0, 0)))
+                out.append((
+                    _slice_block(
+                        block, s.lane_lo, s.lane_hi, s.padded_e, sentinel
+                    ),
+                    w0,
+                ))
+            return out
+
+        for group, dev in self._iter_groups(host_group):
+            # Dispatch every solve in the group first (async), then pull —
+            # the pulls overlap the NEXT group's host slicing + transfer.
+            results = [
+                self._solve_jit(blk, offsets, w0, l1, l2)
+                for blk, w0 in dev
+            ]
+            for s, res in zip(group, results):
+                state[s.block_idx][s.lane_lo:s.lane_hi] = np.asarray(
+                    res
+                )[: s.lane_hi - s.lane_lo]
+        return state
+
+    def score(self, state) -> Array:
+        sentinel = self.dataset.n_global_rows
+        total = self._zeros_jit()
+
+        def host_group(group):
+            out = []
+            for s in group:
+                coefs = np.asarray(
+                    state[s.block_idx], np.float32
+                )[s.lane_lo:s.lane_hi]
+                pad = s.padded_e - coefs.shape[0]
+                if pad:
+                    coefs = np.pad(coefs, ((0, pad), (0, 0)))
+                active = _slice_block(
+                    self.dataset.blocks[s.block_idx],
+                    s.lane_lo, s.lane_hi, s.padded_e, sentinel,
+                )
+                passive = None
+                if self.dataset.passive_blocks:
+                    pb = self.dataset.passive_blocks[s.block_idx]
+                    if pb is not None:
+                        passive = _slice_block(
+                            pb, s.lane_lo, s.lane_hi, s.padded_e, sentinel
+                        )
+                out.append((active, passive, coefs))
+            return out
+
+        for _group, dev in self._iter_groups(host_group):
+            for active, passive, coefs in dev:
+                total = self._score_jit(total, active, coefs)
+                if passive is not None:
+                    # Active/passive split: capped-out rows are never
+                    # trained on but MUST be scored (coordinates train
+                    # against each other's full contributions).
+                    total = self._score_jit(total, passive, coefs)
+        return total[: self.dataset.n_global_rows]
+
+    def _block_variances(self, block: EntityBlock, coefs, offsets):
+        """Budget-bounded override: the inherited version moves the WHOLE
+        block to device for the variance Hessian — exactly the transfer
+        this coordinate exists to avoid.  Reuse the pass plan's slice
+        shape for this block instead."""
+        bi = next(
+            i for i, b in enumerate(self.dataset.blocks) if b is block
+        )
+        sub_e = next(
+            s.padded_e
+            for group in self.pass_plan
+            for s in group
+            if s.block_idx == bi
+        )
+        sentinel = self.dataset.n_global_rows
+        offsets = jnp.asarray(offsets, jnp.float32)
+        l2 = jnp.asarray(
+            self.config.regularization.l2_weight(1.0) * self.reg_weight,
+            jnp.float32,
+        )
+        coefs = np.asarray(coefs, np.float32)
+        out = np.empty((block.n_entities, block.block_dim), np.float32)
+        for lo in range(0, block.n_entities, sub_e):
+            hi = min(lo + sub_e, block.n_entities)
+            c = coefs[lo:hi]
+            pad = sub_e - c.shape[0]
+            if pad:
+                c = np.pad(c, ((0, pad), (0, 0)))
+            v = self._var_jit(
+                self._put(_slice_block(block, lo, hi, sub_e, sentinel)),
+                self._put(c), offsets, l2,
+            )
+            out[lo:hi] = np.asarray(v)[: hi - lo]
+        return out

