@@ -233,6 +233,94 @@ def _make_block_solver(task: str, config: GlmOptimizationConfig):
 
         return jax.lax.fori_loop(0, 30, body, w)[:, None]
 
+    _HI = jax.lax.Precision.HIGHEST
+
+    def spd_solve_cg(H, g, n_steps):
+        """Batched (E, D, D) SPD solve by ``n_steps`` unrolled CG
+        iterations (exact at n_steps = D in exact arithmetic) — NO
+        lax.linalg: batched ``jnp.linalg.solve`` lowers to scalar-heavy
+        LU loops on TPU (measured 4.5x slower than the vmapped L-BFGS it
+        was meant to replace), and a Gauss-Jordan inverse's (E, D, 2D)
+        row ops are bandwidth-heavy at large E; CG touches only
+        (E, D)-vectors plus one (E, D, D) matvec per step.  Zero lanes
+        (H = 0, g = 0 — bucket padding) stay exactly zero."""
+        x = jnp.zeros_like(g)
+        r = g
+        p = r
+        rs = jnp.sum(r * r, axis=1)
+        for _ in range(n_steps):
+            Hp = jnp.einsum("edk,ek->ed", H, p, precision=_HI)
+            alpha = rs / jnp.maximum(
+                jnp.sum(p * Hp, axis=1), 1e-30
+            )
+            x = x + alpha[:, None] * p
+            r = r - alpha[:, None] * Hp
+            rs_new = jnp.sum(r * r, axis=1)
+            beta = rs_new / jnp.maximum(rs, 1e-30)
+            rs = rs_new
+            p = r + beta[:, None] * p
+        return x
+
+    def newton_block(block, offsets_block, w0, l2, max_iters, tol):
+        """Batched damped Newton for smooth objectives on small-D blocks:
+        an exact (E, D, D) Hessian CG solve replaces the vmapped L-BFGS
+        machinery.  The win is SEQUENTIAL structure — the chip profile
+        showed the (E=27k, R=4) bucket costing 2x the (E=13k, R=16) one
+        despite HALF the lane-rows, i.e. these buckets are bound by the
+        while-loop body's launch/overhead count, not FLOPs.  One Newton
+        body is a single fusable chain (grad, one batched-matmul Hessian
+        build, D unrolled CG steps, damp) vs L-BFGS's nested scan + zoom
+        while_loop per iteration, and quadratic convergence needs fewer
+        outer trips — warm-started CD iterations exit in 1-2.  Per-lane
+        freezing + the Breeze-style relative gradient test match the
+        L-BFGS convergence semantics.  Small einsums run at HIGHEST
+        precision: default MXU bf16 puts a noise floor above the 1e-6
+        gradient tolerance, which silently disables the early exit."""
+        X, yb, wt = block.X, block.labels, block.weights
+        off = offsets_block.astype(X.dtype)
+        d = block.block_dim
+        eye = jnp.eye(d, dtype=X.dtype)
+
+        def grad_at(w):
+            m = jnp.einsum("erd,ed->er", X, w, precision=_HI) + off
+            g = jnp.einsum(
+                "er,erd->ed", wt * loss.d1(m, yb), X, precision=_HI
+            ) + l2 * w
+            return m, g
+
+        _, g0 = grad_at(w0)
+        gtol = tol * jnp.maximum(1.0, jnp.linalg.norm(g0, axis=1))
+
+        def cond(carry):
+            i, _w, done = carry
+            return (i < max_iters) & ~jnp.all(done)
+
+        def body(carry):
+            i, w, done = carry
+            m, g = grad_at(w)
+            newly = jnp.linalg.norm(g, axis=1) <= gtol
+            d2 = wt * loss.d2(m, yb)
+            H = jnp.einsum(
+                "erd,erk->edk", X * d2[:, :, None], X, precision=_HI
+            ) + l2 * eye
+            step = spd_solve_cg(H, g, d)
+            # Margin-change damp (the rank1/dim1 clamp, per lane): one
+            # step moves no row's margin by more than 20.
+            dm = jnp.einsum("erd,ed->er", X, step, precision=_HI)
+            scale = jnp.minimum(
+                1.0,
+                20.0 / jnp.maximum(jnp.max(jnp.abs(dm), axis=1), 1e-12),
+            )
+            keep = done | newly
+            w = jnp.where(keep[:, None], w, w - scale[:, None] * step)
+            return i + 1, w, keep
+
+        _, w, _ = jax.lax.while_loop(
+            cond, body, (jnp.zeros((), jnp.int32), w0,
+                         jnp.zeros((X.shape[0],), bool))
+        )
+        return w
+
     def make_solve_one(history: int):
         def solve_one(X, y, wts, off, w0, l1, l2):
             def vg(w):
@@ -291,6 +379,14 @@ def _make_block_solver(task: str, config: GlmOptimizationConfig):
             return rank1_newton(block, offsets_block, w0, l2)
         if block.block_dim == 1 and not use_owlqn:
             return dim1_newton(block, offsets_block, w0, l2)
+        if block.block_dim <= 32 and not use_owlqn:
+            # Small-D smooth blocks: exact batched Newton (D unrolled CG
+            # steps per Hessian solve stay cheap; the Hessian build is
+            # one MXU-friendly (E, D, R) x (E, R, D) batched matmul).
+            return newton_block(
+                block, offsets_block, w0, l2,
+                opt.max_iters, opt.tolerance,
+            )
         # History beyond the LOCAL problem dimension buys nothing (L-BFGS
         # with m >= d already behaves Newton-like) but every extra pair
     # adds two scan steps per iteration — sequential step count is what

@@ -260,27 +260,33 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
             lambda x: jax.device_put(x, self._sharding), tree
         )
 
-    def _iter_groups(self, make_host_group):
-        """Double-buffered group iterator: yields each group's device
-        pytrees, with group g+1's transfer enqueued before g's results are
-        consumed.  ``make_host_group(group) → host pytree list``."""
+    def _run_groups(self, make_host_group, consume):
+        """Double-buffered group runner: group g+1's transfer is enqueued
+        BEFORE ``consume(group, dev)`` blocks on group g's results, so the
+        next transfer rides under the current solve.  A callback (not a
+        generator) so group g's device references provably die before
+        group g+2's transfer is enqueued — a yield-based version kept
+        three groups alive at the put (the consumer's loop variable is
+        rebound only after the generator resumes), silently making peak
+        memory 1.5x the budget.  ``make_host_group(group) → host pytree
+        list``."""
         plan = self.pass_plan
         self.live_groups_high_water = 0
-        live = 0
-        nxt = self._put(make_host_group(plan[0])) if plan else None
-        live += 1
+        if not plan:
+            return
+        live = 1
+        nxt = self._put(make_host_group(plan[0]))
         for gi, group in enumerate(plan):
-            cur = nxt
+            cur, nxt = nxt, None
             if gi + 1 < len(plan):
                 nxt = self._put(make_host_group(plan[gi + 1]))
                 live += 1
-            else:
-                nxt = None
             self.live_groups_high_water = max(
                 self.live_groups_high_water, live
             )
-            yield group, cur
-            live -= 1  # cur's buffers die with the loop body's references
+            consume(group, cur)
+            del cur
+            live -= 1
 
     # -- coordinate surface ------------------------------------------------
 
@@ -322,7 +328,7 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
                 ))
             return out
 
-        for group, dev in self._iter_groups(host_group):
+        def consume(group, dev):
             # Dispatch every solve in the group first (async), then pull —
             # the pulls overlap the NEXT group's host slicing + transfer.
             results = [
@@ -333,6 +339,8 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
                 state[s.block_idx][s.lane_lo:s.lane_hi] = np.asarray(
                     res
                 )[: s.lane_hi - s.lane_lo]
+
+        self._run_groups(host_group, consume)
         return state
 
     def score(self, state) -> Array:
@@ -362,7 +370,8 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
                 out.append((active, passive, coefs))
             return out
 
-        for _group, dev in self._iter_groups(host_group):
+        def consume(_group, dev):
+            nonlocal total
             for active, passive, coefs in dev:
                 total = self._score_jit(total, active, coefs)
                 if passive is not None:
@@ -370,6 +379,8 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
                     # trained on but MUST be scored (coordinates train
                     # against each other's full contributions).
                     total = self._score_jit(total, passive, coefs)
+
+        self._run_groups(host_group, consume)
         return total[: self.dataset.n_global_rows]
 
     def _block_variances(self, block: EntityBlock, coefs, offsets):

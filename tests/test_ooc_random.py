@@ -111,10 +111,9 @@ class TestParity:
         budget = max(total // 6, 6000)
         res, ooc = _coords("logistic", _config(), resident, host, budget)
         assert len(ooc.pass_plan) >= 3
-        assert any(len(g) > 0 and g[0].lane_lo > 0 or len(g) > 1
-                   for g in ooc.pass_plan) or sum(
-            len(g) for g in ooc.pass_plan
-        ) > len(host.blocks), "expected at least one entity-axis split"
+        assert any(
+            s.lane_lo > 0 for g in ooc.pass_plan for s in g
+        ), "expected at least one entity-axis split"
         offsets = jnp.zeros(len(y), jnp.float32)
         st_res = res.train(offsets)
         st_ooc = ooc.train(offsets)
@@ -257,6 +256,28 @@ class TestBoundedMemory:
         assert ooc.live_groups_high_water == 2
         ooc.score(ooc.train(jnp.zeros(host.n_global_rows, jnp.float32)))
         assert ooc.live_groups_high_water == 2
+
+    def test_transfer_ordering_never_holds_three_groups(self):
+        """Group g+2's transfer must be enqueued only AFTER group g was
+        consumed (its refs dropped) — the yield-based runner this
+        replaced kept three groups alive at the put, making peak memory
+        1.5x the budget."""
+        keys, X, y, w = _zipf_data(seed=31)
+        _, host = _datasets(keys, X, y, w)
+        ooc = OutOfCoreRandomEffectCoordinate(
+            "re", host, "logistic", _config(), device_budget_bytes=8_000,
+        )
+        assert len(ooc.pass_plan) >= 3
+        events = []
+        orig_put = ooc._put
+        ooc._put = lambda tree: (events.append("put"), orig_put(tree))[1]
+        ooc._run_groups(
+            lambda group: [], lambda group, dev: events.append("consume")
+        )
+        assert events[:2] == ["put", "put"]
+        for i, ev in enumerate(events):
+            if ev == "put" and i >= 2:
+                assert events[i - 1] == "consume", events
 
     def test_budget_too_small_fails_loudly(self):
         keys, X, y, w = _zipf_data(seed=21)
