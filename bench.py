@@ -295,6 +295,137 @@ def bench_game_cd() -> dict:
     }
 
 
+def bench_game_multi_re() -> dict:
+    """BASELINE config 5's shape at chip scale: coordinate descent over
+    fixed + THREE random effects (user + item + context, MovieLens-like
+    geometry — zipf-tailed users and items, few heavy contexts with the
+    active-set cap exercising the active/passive split).  This is the
+    flagship multi-random-effect number the north star cares about;
+    until round 5 it only ran in CPU tests and the dryrun."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data.dataset import make_glm_data
+    from photon_ml_tpu.game.coordinates import (
+        FixedEffectCoordinate,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.game.data import (
+        FixedEffectDataset,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.game.descent import CoordinateDescent
+    from photon_ml_tpu.optim.problem import (
+        GlmOptimizationConfig,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.optim.regularization import RegularizationContext
+
+    rng = np.random.default_rng(3)
+    sizes = np.minimum(rng.zipf(1.8, GAME_ENTITIES), GAME_ROW_CAP)
+    n = int(sizes.sum())
+    users = np.repeat(
+        np.array([f"u{i}" for i in range(GAME_ENTITIES)], dtype=object),
+        sizes,
+    )[rng.permutation(n)]
+    n_items = max(2, GAME_ENTITIES // 5)
+    item_sizes = np.minimum(rng.zipf(1.5, n_items), 4 * GAME_ROW_CAP)
+    # Each row draws its item from the zipf-weighted pool (with
+    # replacement), giving items a matching long-tailed row distribution.
+    item_pool = np.repeat(
+        np.array([f"i{i}" for i in range(n_items)], dtype=object),
+        item_sizes,
+    )
+    items = item_pool[rng.integers(0, len(item_pool), size=n)]
+    n_ctx = 200
+    contexts = np.array(
+        [f"c{rng.integers(n_ctx)}" for _ in range(n)], dtype=object
+    )
+
+    nnzf = n * GAME_FIXED_NNZ
+    Xg = sp.csr_matrix(
+        (rng.normal(size=nnzf).astype(np.float32),
+         (np.repeat(np.arange(n, dtype=np.int64), GAME_FIXED_NNZ),
+          rng.integers(0, GAME_FIXED_FEATURES, size=nnzf))),
+        shape=(n, GAME_FIXED_FEATURES),
+    )
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    weights = np.ones(n, np.float32)
+    opt = GlmOptimizationConfig(
+        optimizer=OptimizerConfig(max_iters=10, tolerance=1e-6),
+        regularization=RegularizationContext.l2(),
+    )
+
+    fixed = FixedEffectCoordinate(
+        "fixed",
+        FixedEffectDataset(data=make_glm_data(Xg, y), n_global_rows=n),
+        "logistic", opt, reg_weight=1.0,
+    )
+    coords = [fixed]
+    _log(f"multire: {n} rows; grouping user/item/context...")
+    for name, keys, cap in (
+        ("per_user", users, None),
+        ("per_item", items, None),
+        # Few heavy contexts: the active-set cap bounds training rows,
+        # the passive remainder still scores (the reference's split).
+        ("per_context", contexts, 256),
+    ):
+        Xe = sp.csr_matrix(
+            rng.normal(size=(n, GAME_RE_DIM)).astype(np.float32)
+        )
+        ds = build_random_effect_dataset(
+            keys, Xe, y, weights,
+            max_rows_per_entity=cap, bucket_growth=GAME_BUCKET_GROWTH,
+        )
+        _log(f"multire: {name}: {len(ds.blocks)} buckets "
+             f"{[(b.n_entities, b.rows_per_entity) for b in ds.blocks]}")
+        coords.append(RandomEffectCoordinate(
+            name, ds, "logistic", opt, reg_weight=1.0, entity_key=name,
+        ))
+    cd = CoordinateDescent(coords)
+
+    import jax.numpy as jnp
+
+    base = jnp.zeros(n, jnp.float32)
+    _log("multire: warmup iteration (compiles every bucket shape)...")
+    warm = cd.run(base, n_iterations=1)
+    _read_sync(warm.scores["per_context"])
+    _log("multire: warmup done; timing...")
+    per_iter = []
+    for _ in range(GAME_TIMED_RUNS):
+        t0 = time.perf_counter()
+        result = cd.run(base, n_iterations=GAME_TIMED_ITERS)
+        _read_sync(result.scores["per_context"])
+        per_iter.append((time.perf_counter() - t0) / GAME_TIMED_ITERS)
+    med = float(np.median(per_iter))
+    spread_pct = 100.0 * (max(per_iter) - min(per_iter)) / med
+    _log(f"multire: median {med:.3f}s/iter over {GAME_TIMED_RUNS}x"
+         f"{GAME_TIMED_ITERS} iters (spread {spread_pct:.1f}%)")
+
+    states = {c.name: warm.states[c.name] for c in cd.coordinates}
+    scores = dict(warm.scores)
+    total = base
+    for s in scores.values():
+        total = total + s
+    breakdown = {}
+    for coord in cd.coordinates:
+        best_c = np.inf
+        for _ in range(2):
+            offsets = total - scores[coord.name]
+            t0 = time.perf_counter()
+            st = coord.train(offsets, warm_state=states[coord.name])
+            sc = coord.score(st)
+            _read_sync(sc)
+            best_c = min(best_c, time.perf_counter() - t0)
+        breakdown[coord.name] = round(best_c, 3)
+    _log(f"multire: per-coordinate seconds {breakdown}")
+    return {
+        "iters_per_sec": 1.0 / med,
+        "spread_pct": round(spread_pct, 1),
+        "coordinate_seconds": breakdown,
+        "rows": n,
+    }
+
+
 def bench_glm_driver() -> tuple[float, float]:
     """Wall-clock of the full legacy GLM driver on an a1a-shaped dataset
     (1605 train / 2000 validate rows, 123 binary features, 3-point λ grid)."""
@@ -536,6 +667,22 @@ def main() -> None:
             extra["game_cd_vs_baseline_normalized"] = round(
                 (g["iters_per_sec"] / chip_gbps) / base_cd_per_gbps, 4
             )
+    if ONLY in ("", "game", "multire"):
+        try:
+            m = bench_game_multi_re()
+            extra["game_multi_re_iters_per_sec"] = round(
+                m["iters_per_sec"], 3
+            )
+            extra["game_multi_re_spread_pct"] = m["spread_pct"]
+            extra["game_multi_re_coordinate_seconds"] = (
+                m["coordinate_seconds"]
+            )
+            extra["game_multi_re_rows"] = m["rows"]
+            extra["game_multi_re_vs_baseline"] = ratio(
+                m["iters_per_sec"], "game_multi_re_iters_per_sec"
+            )
+        except Exception as e:  # new section: never sink the headline
+            extra["game_multi_re_iters_per_sec"] = f"failed: {e}"
     if ONLY in ("", "driver"):
         cold, warm = bench_glm_driver()
         extra["glm_driver_wall_seconds_cold"] = round(cold, 2)

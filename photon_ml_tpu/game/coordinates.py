@@ -150,10 +150,17 @@ class FixedEffectCoordinate(Coordinate):
 def _make_block_solver(task: str, config: GlmOptimizationConfig):
     """Build a jitted (block, offsets, w0, l1, l2) → (E, D) batched solver.
 
-    Optimizer dispatch matches GlmOptimizationProblem.solve: any L1
-    component (static on the regularization TYPE) routes to OWL-QN; else the
-    configured smooth optimizer (L-BFGS or TRON) runs.  l1/l2 are traced
-    scalars so tuning sweeps don't recompile.  Memoized on (task, config) —
+    Optimizer dispatch: any L1 component (static on the regularization
+    TYPE) routes to OWL-QN.  SMOOTH problems prefer an exact fast path
+    when one exists for the block shape — rank-1 Newton (R == 1), scalar
+    Newton (D == 1), or batched damped Newton (D <= 32) — regardless of
+    whether the config names L-BFGS or TRON: these solve the identical
+    regularized objective to the identical stationary point, the config's
+    optimizer choice only governs HOW, and the fast paths are 2-13x
+    cheaper on TPU (per-entity problems this small are sequential-step-
+    bound).  Only blocks with no fast path (D > 32) run the configured
+    L-BFGS/TRON machinery.  l1/l2 are traced scalars so tuning sweeps
+    don't recompile.  Memoized on (task, config) —
     both hashable — so every coordinate/grid point with the same optimizer
     setup shares ONE jit cache (one compile per block shape process-wide).
     """

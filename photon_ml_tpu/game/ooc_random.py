@@ -166,6 +166,9 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
 
         for b in dataset.blocks:
             jax.tree.map(_host_leaf, b)
+        for b in dataset.passive_blocks:
+            if b is not None:
+                jax.tree.map(_host_leaf, b)
 
         self.pass_plan = self._build_plan()
         #: high-water mark of pass groups with live device buffers —
@@ -181,9 +184,9 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
                 block, _gather_block_offsets(offsets, block), w0, l1, l2
             )
 
-        def _score_slice(total, block, coefs):
-            s = jnp.einsum("erd,ed->er", block.X, coefs)
-            return total.at[block.row_index.ravel()].add(s.ravel())
+        def _score_slice(total, X, row_index, coefs):
+            s = jnp.einsum("erd,ed->er", X, coefs)
+            return total.at[row_index.ravel()].add(s.ravel())
 
         loss = losses_lib.get(self.task)
 
@@ -347,25 +350,39 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         sentinel = self.dataset.n_global_rows
         total = self._zeros_jit()
 
+        def cut(x, lo, hi, padded_e, fill):
+            x = x[lo:hi]
+            pad = padded_e - x.shape[0]
+            if pad == 0:
+                return x
+            width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+            return np.pad(x, width, constant_values=fill)
+
         def host_group(group):
+            # Score-only slices: just X + row_index (+ coefs) cross the
+            # wire — labels/weights/col_map are ~30% of the lane bytes
+            # and the score einsum/scatter never reads them (h2d is the
+            # scarce resource on the tunneled chip).
             out = []
             for s in group:
-                coefs = np.asarray(
-                    state[s.block_idx], np.float32
-                )[s.lane_lo:s.lane_hi]
-                pad = s.padded_e - coefs.shape[0]
-                if pad:
-                    coefs = np.pad(coefs, ((0, pad), (0, 0)))
-                active = _slice_block(
-                    self.dataset.blocks[s.block_idx],
-                    s.lane_lo, s.lane_hi, s.padded_e, sentinel,
+                coefs = cut(
+                    np.asarray(state[s.block_idx], np.float32),
+                    s.lane_lo, s.lane_hi, s.padded_e, 0,
+                )
+                block = self.dataset.blocks[s.block_idx]
+                active = (
+                    cut(block.X, s.lane_lo, s.lane_hi, s.padded_e, 0),
+                    cut(block.row_index, s.lane_lo, s.lane_hi,
+                        s.padded_e, sentinel),
                 )
                 passive = None
                 if self.dataset.passive_blocks:
                     pb = self.dataset.passive_blocks[s.block_idx]
                     if pb is not None:
-                        passive = _slice_block(
-                            pb, s.lane_lo, s.lane_hi, s.padded_e, sentinel
+                        passive = (
+                            cut(pb.X, s.lane_lo, s.lane_hi, s.padded_e, 0),
+                            cut(pb.row_index, s.lane_lo, s.lane_hi,
+                                s.padded_e, sentinel),
                         )
                 out.append((active, passive, coefs))
             return out
@@ -373,12 +390,12 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         def consume(_group, dev):
             nonlocal total
             for active, passive, coefs in dev:
-                total = self._score_jit(total, active, coefs)
+                total = self._score_jit(total, *active, coefs)
                 if passive is not None:
                     # Active/passive split: capped-out rows are never
                     # trained on but MUST be scored (coordinates train
                     # against each other's full contributions).
-                    total = self._score_jit(total, passive, coefs)
+                    total = self._score_jit(total, *passive, coefs)
 
         self._run_groups(host_group, consume)
         return total[: self.dataset.n_global_rows]
