@@ -15,6 +15,7 @@ import os
 import sys
 from typing import Optional, Sequence
 
+import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.game_reader import read_game_avro
@@ -43,6 +44,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="emit mean responses (inverse link) instead of raw margins",
     )
     p.add_argument("--evaluator", help="also compute a metric if labels present")
+    p.add_argument(
+        "--device-metrics",
+        action="store_true",
+        help="compute the metric ON DEVICE; with --stream-block-rows and "
+        "a pointwise evaluator (rmse/logistic_loss/poisson_loss/"
+        "squared_loss) the metric accumulates as two scalars per block — "
+        "NO per-row columns are retained, so memory stays one block even "
+        "with a metric (AUC still needs the full column: global sort)",
+    )
     p.add_argument(
         "--stream-block-rows",
         type=int,
@@ -98,7 +108,16 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         from photon_ml_tpu.data.game_reader import iter_game_avro
         from photon_ml_tpu.game.model import RandomEffectModel
 
-        keep_columns = bool(args.evaluator)
+        stream_kind = None
+        if args.evaluator and args.device_metrics:
+            from photon_ml_tpu.evaluation.device import pointwise_kind_for
+
+            stream_kind = pointwise_kind_for(get_evaluator(args.evaluator))
+        # Pointwise device metrics accumulate as (num, den) scalars per
+        # block — no O(n_rows) column retention for the metric at all.
+        keep_columns = bool(args.evaluator) and stream_kind is None
+        partial_num = [0.0]
+        partial_den = [0.0]
         all_scores: list[np.ndarray] = []
         all_labels: list[np.ndarray] = []
         all_weights: list[np.ndarray] = []
@@ -127,6 +146,19 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     all_scores.append(np.asarray(blk, np.float32))
                     all_labels.append(response)
                     all_weights.append(weight)
+                elif stream_kind is not None and len(blk):
+                    from photon_ml_tpu.evaluation.device import (
+                        device_pointwise_partial,
+                    )
+
+                    num, den = device_pointwise_partial(
+                        jnp.asarray(np.asarray(blk, np.float32)),
+                        jnp.asarray(response),
+                        jnp.asarray(weight),
+                        kind=stream_kind,
+                    )
+                    partial_num[0] += float(num)
+                    partial_den[0] += float(den)
                 logger.info("scored block of %d rows", len(blk))
                 for i in range(len(blk)):
                     yield score_record(uids[i], blk[i], response[i], ids, i)
@@ -166,7 +198,30 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     result = {"n_rows": int(n_rows), "wall_seconds": timer.stop()}
     if args.evaluator:
         ev = get_evaluator(args.evaluator)
-        result["metric"] = ev.evaluate(scores, response, weight)
+        if scores is None and args.stream_block_rows > 0:
+            # Streamed + pointwise device metric: the per-block scalar
+            # accumulation already holds the whole answer.
+            from photon_ml_tpu.evaluation.device import (
+                finish_pointwise_partial, pointwise_kind_for,
+            )
+
+            result["metric"] = finish_pointwise_partial(
+                partial_num[0], partial_den[0], pointwise_kind_for(ev)
+            )
+        elif args.device_metrics:
+            from photon_ml_tpu.evaluation.device import device_evaluator_fn
+
+            fn = device_evaluator_fn(ev)
+            result["metric"] = (
+                float(fn(
+                    jnp.asarray(scores), jnp.asarray(response),
+                    None if weight is None else jnp.asarray(weight),
+                ))
+                if fn is not None
+                else ev.evaluate(scores, response, weight)
+            )
+        else:
+            result["metric"] = ev.evaluate(scores, response, weight)
         result["evaluator"] = type(ev).__name__
         logger.info("%s = %.6f", type(ev).__name__, result["metric"])
     with open(os.path.join(args.output_dir, "scoring_result.json"), "w") as f:

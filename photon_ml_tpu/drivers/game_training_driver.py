@@ -170,6 +170,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "reference's Spark-cluster layout on ICI",
     )
     p.add_argument(
+        "--device-metrics",
+        action="store_true",
+        help="compute per-update train/validation metrics ON DEVICE "
+        "(only metric scalars cross to host — the at-scale validation "
+        "path). Requires an ungrouped evaluation suite",
+    )
+    p.add_argument(
         "--max-retries",
         type=int,
         default=0,
@@ -321,7 +328,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             for nm, cfg in coordinate_configs.items()
         }
         tuning_est = GameEstimator(
-            task, tuning_configs, n_cd_iterations, mesh=mesh
+            task, tuning_configs, n_cd_iterations, mesh=mesh,
+            device_metrics=args.device_metrics,
         )
         tuning_coords = tuning_est.build_coordinates(
             shards, ids, response, weight, offset
@@ -406,7 +414,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
     estimator = GameEstimator(
         task, coordinate_configs, n_iterations=n_cd_iterations, logger=logger,
-        mesh=mesh,
+        mesh=mesh, device_metrics=args.device_metrics,
     )
     from photon_ml_tpu.utils.watchdog import RetryPolicy, run_with_retries
 

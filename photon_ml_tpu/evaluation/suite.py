@@ -89,6 +89,46 @@ class EvaluationSuite:
             for name, ev in self.evaluators
         }
 
+    def evaluate_device(
+        self,
+        scores,
+        labels,
+        weights=None,
+    ) -> dict:
+        """name → metric value with the computation ON DEVICE: scores /
+        labels / weights are (possibly sharded) device arrays, and only
+        the metric SCALARS cross back to host — the validation-pass
+        contract at 1B rows (the reference computes metrics where the
+        data lives, Spark-side; SURVEY.md §2 Evaluation row).
+
+        Evaluators with no device implementation (precision@k) fall back
+        to the host path with ONE array pullback, shared across all of
+        them.  Grouped suites (``group_column`` set) must use
+        :meth:`evaluate` — per-group metrics are host-side.
+        """
+        if self.group_column is not None:
+            raise ValueError(
+                "evaluate_device computes GLOBAL metrics; this suite has "
+                f"group_column={self.group_column!r} — use evaluate()"
+            )
+        from photon_ml_tpu.evaluation.device import device_evaluator_fn
+
+        out = {}
+        host_pull = None
+        for name, ev in self.evaluators:
+            fn = device_evaluator_fn(ev)
+            if fn is not None:
+                out[name] = float(fn(scores, labels, weights))
+                continue
+            if host_pull is None:
+                host_pull = (
+                    np.asarray(scores),
+                    np.asarray(labels),
+                    None if weights is None else np.asarray(weights),
+                )
+            out[name] = ev.evaluate(*host_pull)
+        return out
+
     def better_than(self, a: Optional[float], b: Optional[float]) -> bool:
         """Compare two PRIMARY metric values; None/NaN always loses."""
         if a is None or np.isnan(a):

@@ -120,14 +120,23 @@ class GameEstimator:
         n_iterations: int = 1,
         logger=None,
         mesh=None,
+        device_metrics: bool = False,
     ):
         """``mesh``: a ``jax.sharding.Mesh`` with a ``"data"`` axis enables
         the multi-chip path — rows sharded for fixed effects (whole solver
         inside shard_map, one fused psum per objective evaluation) and the
         entity axis sharded for random effects (the reference's Spark
-        executor-parallel layout — SURVEY.md §2 parallelism table)."""
+        executor-parallel layout — SURVEY.md §2 parallelism table).
+
+        ``device_metrics``: per-update train/validation metrics compute ON
+        DEVICE (evaluation/device.py) — score arrays never cross to host,
+        only metric scalars do (the 1B-row validation contract; the
+        reference computes metrics where the data lives).  Requires an
+        ungrouped suite; evaluators with no device implementation fall
+        back to one host pullback."""
         self.task = losses_lib.get(task).name  # canonicalize aliases
         self.coordinate_configs = dict(coordinate_configs)
+        self.device_metrics = device_metrics
         self.n_iterations = n_iterations
         self.logger = logger
         self.mesh = mesh
@@ -598,48 +607,100 @@ class GameEstimator:
 
         primed = [False]  # becomes True once every live state has scored
 
-        def eval_fn(it, cname, scores, states):
-            total = base_offsets + np.sum(
-                [np.asarray(s) for s in scores.values()], axis=0
+        device_metrics = self.device_metrics
+        if device_metrics and suite.group_column is not None:
+            raise ValueError(
+                "device_metrics computes GLOBAL metrics; this suite has "
+                f"group_column={suite.group_column!r} — per-group metrics "
+                "are host-side"
             )
-            # With a grouped suite, the train metric is grouped too (else
-            # history entries would mix global and per-group semantics); a
-            # per-group-only primary without train group ids records None
-            # rather than crashing training.
-            if suite.group_column is not None and train_group_ids is None:
-                train_metric = None
-            else:
-                train_metric = primary.evaluate(
-                    total, response, w_host, group_ids=train_group_ids
+        if device_metrics:
+            from photon_ml_tpu.evaluation.device import device_evaluator_fn
+
+            # Labels/weights/offsets go to device ONCE; every per-update
+            # evaluation then stays device-side and pulls back scalars
+            # only — no O(n_rows) transfer per coordinate update.
+            resp_dev = jnp.asarray(response)
+            w_dev = None if w_host is None else jnp.asarray(w_host)
+            base_dev = jnp.asarray(base_offsets)
+            primary_dev = device_evaluator_fn(primary)
+            if val_ctx is not None:
+                val_ctx["resp_dev"] = jnp.asarray(val_ctx["resp"])
+                val_ctx["weight_dev"] = (
+                    None if val_ctx["weight"] is None
+                    else jnp.asarray(val_ctx["weight"])
                 )
+                val_ctx["base_dev"] = jnp.asarray(val_ctx["base"])
+                val_ctx["scores"] = {
+                    c.name: jnp.zeros(n_val, jnp.float32)
+                    for c in coordinates
+                }
+
+        def eval_fn(it, cname, scores, states):
+            if device_metrics:
+                # CD scores are already device arrays — sum them there.
+                total = base_dev + sum(scores.values())
+                train_metric = (
+                    float(primary_dev(total, resp_dev, w_dev))
+                    if primary_dev is not None
+                    else primary.evaluate(
+                        np.asarray(total), response, w_host
+                    )
+                )
+            else:
+                total = base_offsets + np.sum(
+                    [np.asarray(s) for s in scores.values()], axis=0
+                )
+                # With a grouped suite, the train metric is grouped too
+                # (else history entries would mix global and per-group
+                # semantics); a per-group-only primary without train group
+                # ids records None rather than crashing training.
+                if suite.group_column is not None and train_group_ids is None:
+                    train_metric = None
+                else:
+                    train_metric = primary.evaluate(
+                        total, response, w_host, group_ids=train_group_ids
+                    )
             entry = {
                 "train_metric": train_metric,
                 "evaluator": type(primary).__name__,
             }
             if val_ctx is not None:
+                keep = (
+                    (lambda a: jnp.asarray(a)) if device_metrics
+                    else (lambda a: np.asarray(a))
+                )
                 if not primed[0]:
                     # First evaluation: warm starts / resumed runs carry
                     # live states for coordinates that haven't updated yet
                     # this run — score them all once.
                     for c in coordinates:
                         if states[c.name] is not None:
-                            val_ctx["scores"][c.name] = np.asarray(
+                            val_ctx["scores"][c.name] = keep(
                                 val_ctx["scorers"][c.name].score(
                                     states[c.name]
                                 )
                             )
                     primed[0] = True
                 else:
-                    val_ctx["scores"][cname] = np.asarray(
+                    val_ctx["scores"][cname] = keep(
                         val_ctx["scorers"][cname].score(states[cname])
                     )
-                v_total = val_ctx["base"] + np.sum(
-                    list(val_ctx["scores"].values()), axis=0
-                )
-                metrics = suite.evaluate(
-                    v_total, val_ctx["resp"], val_ctx["weight"],
-                    group_ids=val_ctx["groups"],
-                )
+                if device_metrics:
+                    v_total = val_ctx["base_dev"] + sum(
+                        val_ctx["scores"].values()
+                    )
+                    metrics = suite.evaluate_device(
+                        v_total, val_ctx["resp_dev"], val_ctx["weight_dev"]
+                    )
+                else:
+                    v_total = val_ctx["base"] + np.sum(
+                        list(val_ctx["scores"].values()), axis=0
+                    )
+                    metrics = suite.evaluate(
+                        v_total, val_ctx["resp"], val_ctx["weight"],
+                        group_ids=val_ctx["groups"],
+                    )
                 entry["validation"] = metrics
                 entry["validation_metric"] = metrics[suite.primary]
             return entry

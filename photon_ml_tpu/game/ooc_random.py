@@ -88,27 +88,30 @@ def _host_leaf(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def _cut(x, lo: int, hi: int, padded_e: int, fill):
+    """Entity-axis slice [lo, hi) padded to ``padded_e`` lanes with
+    ``fill`` — the one pad-and-slice implementation for both the full
+    block slicer and the score path's slimmed (X, row_index) slices."""
+    x = x[lo:hi]
+    pad = padded_e - x.shape[0]
+    if pad == 0:
+        return x
+    width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, width, constant_values=fill)
+
+
 def _slice_block(
     block: EntityBlock, lo: int, hi: int, padded_e: int, sentinel: int
 ) -> EntityBlock:
     """Host-side entity-axis slice [lo, hi), padded to ``padded_e`` lanes.
     Padding lanes carry zero weights (solve to 0), col_map -1, and sentinel
     row indices (scatter into the discarded trailing slot)."""
-    pad = padded_e - (hi - lo)
-
-    def cut(x, fill):
-        x = x[lo:hi]
-        if pad == 0:
-            return x
-        width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
-        return np.pad(x, width, constant_values=fill)
-
     return EntityBlock(
-        X=cut(block.X, 0),
-        labels=cut(block.labels, 0),
-        weights=cut(block.weights, 0),
-        col_map=cut(block.col_map, -1),
-        row_index=cut(block.row_index, sentinel),
+        X=_cut(block.X, lo, hi, padded_e, 0),
+        labels=_cut(block.labels, lo, hi, padded_e, 0),
+        weights=_cut(block.weights, lo, hi, padded_e, 0),
+        col_map=_cut(block.col_map, lo, hi, padded_e, -1),
+        row_index=_cut(block.row_index, lo, hi, padded_e, sentinel),
         n_entities=padded_e,
         rows_per_entity=block.rows_per_entity,
         block_dim=block.block_dim,
@@ -350,14 +353,6 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         sentinel = self.dataset.n_global_rows
         total = self._zeros_jit()
 
-        def cut(x, lo, hi, padded_e, fill):
-            x = x[lo:hi]
-            pad = padded_e - x.shape[0]
-            if pad == 0:
-                return x
-            width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
-            return np.pad(x, width, constant_values=fill)
-
         def host_group(group):
             # Score-only slices: just X + row_index (+ coefs) cross the
             # wire — labels/weights/col_map are ~30% of the lane bytes
@@ -365,14 +360,14 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
             # scarce resource on the tunneled chip).
             out = []
             for s in group:
-                coefs = cut(
+                coefs = _cut(
                     np.asarray(state[s.block_idx], np.float32),
                     s.lane_lo, s.lane_hi, s.padded_e, 0,
                 )
                 block = self.dataset.blocks[s.block_idx]
                 active = (
-                    cut(block.X, s.lane_lo, s.lane_hi, s.padded_e, 0),
-                    cut(block.row_index, s.lane_lo, s.lane_hi,
+                    _cut(block.X, s.lane_lo, s.lane_hi, s.padded_e, 0),
+                    _cut(block.row_index, s.lane_lo, s.lane_hi,
                         s.padded_e, sentinel),
                 )
                 passive = None
@@ -380,8 +375,8 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
                     pb = self.dataset.passive_blocks[s.block_idx]
                     if pb is not None:
                         passive = (
-                            cut(pb.X, s.lane_lo, s.lane_hi, s.padded_e, 0),
-                            cut(pb.row_index, s.lane_lo, s.lane_hi,
+                            _cut(pb.X, s.lane_lo, s.lane_hi, s.padded_e, 0),
+                            _cut(pb.row_index, s.lane_lo, s.lane_hi,
                                 s.padded_e, sentinel),
                         )
                 out.append((active, passive, coefs))

@@ -181,6 +181,60 @@ class TestGameDrivers:
             assert sr["ids"] == rr["ids"]
             assert sr["predictionScore"] == rr["predictionScore"]  # bit-for-bit
 
+    def test_device_metrics_scoring_and_training(self, game_files, tmp_path):
+        """--device-metrics end to end: the streamed pointwise metric
+        accumulates as two scalars per block (NO column retention) and
+        matches the host evaluator; resident device AUC matches the host
+        AUC; the training driver's per-iteration validation metrics match
+        the host path."""
+        from photon_ml_tpu.data.game_reader import GAME_EXAMPLE_SCHEMA
+        from photon_ml_tpu.io import avro as avro_io
+
+        train, val, config = game_files
+        out = str(tmp_path / "train_out")
+        host_run = game_training_driver.run([
+            "--train-data", train, "--validate-data", val,
+            "--config", config, "--output-dir", out,
+        ])
+        dev_out = str(tmp_path / "train_out_dev")
+        dev_run = game_training_driver.run([
+            "--train-data", train, "--validate-data", val,
+            "--config", config, "--output-dir", dev_out,
+            "--device-metrics",
+        ])
+        assert dev_run["validation_metric"] == pytest.approx(
+            host_run["validation_metric"], abs=1e-5
+        )
+
+        _, recs = avro_io.read_container(val)
+        val_mb = str(tmp_path / "val_mb.avro")
+        avro_io.write_container(
+            val_mb, GAME_EXAMPLE_SCHEMA, recs, records_per_block=32
+        )
+        host = game_scoring_driver.run([
+            "--data", val_mb, "--model-dir", out, "--output-dir",
+            str(tmp_path / "s_host"), "--evaluator", "logistic_loss",
+            "--stream-block-rows", "64",
+        ])
+        dev = game_scoring_driver.run([
+            "--data", val_mb, "--model-dir", out, "--output-dir",
+            str(tmp_path / "s_dev"), "--evaluator", "logistic_loss",
+            "--stream-block-rows", "64", "--device-metrics",
+        ])
+        assert dev["metric"] == pytest.approx(host["metric"], abs=1e-5)
+        dev_auc = game_scoring_driver.run([
+            "--data", val_mb, "--model-dir", out, "--output-dir",
+            str(tmp_path / "s_dev_auc"), "--evaluator", "auc",
+            "--device-metrics",
+        ])
+        host_auc = game_scoring_driver.run([
+            "--data", val_mb, "--model-dir", out, "--output-dir",
+            str(tmp_path / "s_host_auc"), "--evaluator", "auc",
+        ])
+        assert dev_auc["metric"] == pytest.approx(
+            host_auc["metric"], abs=1e-6
+        )
+
     def test_iter_game_avro_blocks_concatenate_to_full_read(self, game_files):
         from photon_ml_tpu.data.game_reader import iter_game_avro
 
