@@ -608,11 +608,14 @@ class GameEstimator:
         primed = [False]  # becomes True once every live state has scored
 
         device_metrics = self.device_metrics
-        if device_metrics and suite.group_column is not None:
+        if device_metrics and (
+            suite.group_column is not None or train_group_ids is not None
+        ):
             raise ValueError(
-                "device_metrics computes GLOBAL metrics; this suite has "
-                f"group_column={suite.group_column!r} — per-group metrics "
-                "are host-side"
+                "device_metrics computes GLOBAL metrics; grouped "
+                "evaluation (suite group_column="
+                f"{suite.group_column!r} / explicit train_group_ids) is "
+                "host-side"
             )
         if device_metrics:
             from photon_ml_tpu.evaluation.device import device_evaluator_fn

@@ -68,18 +68,20 @@ class StreamingFixedEffectCoordinate(Coordinate):
         (chunks must be built with ``n_shards == mesh size``) — streamed
         data parallelism composed with GAME: the per-chunk reduction runs
         under shard_map with one fused psum, and the coordinate-descent
-        offsets ride per-chunk as sharded row slices."""
+        offsets ride per-chunk as sharded row slices.
+
+        On a multi-process POD, per-row CD state is PROCESS-LOCAL: this
+        coordinate's ``train`` offsets and ``score`` output cover THIS
+        process's rows (the rows its chunk store holds, built with
+        ``n_shards == jax.local_device_count()``), the reference's layout
+        of score RDDs partitioned next to the data.  The solve itself is
+        global — every objective pass psums over the whole pod — so all
+        processes converge on one identical model; compose only with
+        coordinates whose per-row surface is also process-local (e.g.
+        per-entity random effects whose entities are partitioned to the
+        process holding their rows, the reference's hash-partitioner
+        invariant), and reduce metrics with a psum or allgather."""
         ensure_streamable(config)
-        if mesh is not None and jax.process_count() > 1:
-            # Fail BEFORE the (potentially long) chunk-store ingest and CD
-            # setup — train()/scores() would otherwise hit the same
-            # rejection only deep inside the first solve.
-            raise NotImplementedError(
-                "per-row offsets (streamed GAME) are single-host for "
-                "now: the CD score arrays are process-local, and "
-                "slicing them onto the pod's global chunk layout is "
-                "not wired up"
-            )
         if mesh is None and stream.n_shards != 1:
             raise ValueError(
                 f"stream has n_shards={stream.n_shards}; pass the mesh it "
