@@ -23,7 +23,7 @@ from photon_ml_tpu.evaluation.evaluators import get_evaluator
 from photon_ml_tpu.game.estimator import GameTransformer
 from photon_ml_tpu.io import avro
 from photon_ml_tpu.io.game_store import load_game_model
-from photon_ml_tpu.io.schemas import SCORING_RESULT
+
 from photon_ml_tpu.utils.compile_cache import (
     add_compile_cache_arg,
     enable_from_args,
@@ -82,23 +82,20 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     transformer = GameTransformer(model, logger=logger)
     out_path = os.path.join(args.output_dir, "scores.avro")
 
-    def score_record(uid, score, label, ids, i):
-        # ONE record shape for both paths — the streamed/resident parity
-        # tests assert bit-for-bit identical output files.
-        return {
-            "uid": uid,
-            "predictionScore": float(score),
-            "label": float(label),
-            # Sorted keys: the upstream ids dict order is insertion order
-            # (whole-file for the resident reader, block-local for the
-            # streamed one), so a canonical order here is what actually
-            # makes the two output files byte-identical.
-            "ids": {
-                k: str(ids[k][i])
-                for k in sorted(ids)
-                if ids[k][i] is not None
-            },
-        }
+    def score_block(uids, scores, labels, ids):
+        # ONE columnar block shape for both paths — the streamed/resident
+        # parity tests assert bit-for-bit identical output files.  Sorted
+        # keys: the upstream ids dict order is insertion order
+        # (whole-file for the resident reader, block-local for the
+        # streamed one), so a canonical order here is what actually
+        # makes the two output files byte-identical.  The writer
+        # serializes natively (native/score_encoder.cpp) when available.
+        return (
+            uids,
+            np.asarray(scores, np.float32),
+            np.asarray(labels, np.float32),
+            {k: ids[k] for k in sorted(ids)},
+        )
 
     if args.stream_block_rows > 0:
         # Out-of-core: decode → score → write per bounded block.  The
@@ -160,12 +157,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     partial_num[0] += float(num)
                     partial_den[0] += float(den)
                 logger.info("scored block of %d rows", len(blk))
-                for i in range(len(blk)):
-                    yield score_record(uids[i], blk[i], response[i], ids, i)
+                yield score_block(uids, blk, response, ids)
 
-        # write_container consumes the generator block-by-block: records
-        # stream to disk as they are produced, never as one list.
-        avro.write_container(out_path, SCORING_RESULT, block_records())
+        # The columnar writer consumes the generator block-by-block:
+        # rows stream to disk as they are produced, never as one list.
+        avro.write_scoring_container(out_path, block_records())
         n_rows = n_streamed[0]
         if keep_columns:
             scores = np.concatenate(all_scores) if all_scores else (
@@ -188,11 +184,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             if args.mean
             else transformer.transform(shards, ids, offset)
         )
-        records = [
-            score_record(uids[i], scores[i], response[i], ids, i)
-            for i in range(len(scores))
-        ]
-        avro.write_container(out_path, SCORING_RESULT, records)
+        avro.write_scoring_container(
+            out_path, [score_block(uids, scores, response, ids)]
+        )
         n_rows = len(scores)
 
     result = {"n_rows": int(n_rows), "wall_seconds": timer.stop()}

@@ -377,6 +377,29 @@ _SYNC = bytes(
 )  # deterministic marker ("photon-tpu-sync!") — valid per spec
 
 
+def _write_container_header(f: BinaryIO, schema: Any, codec: str) -> None:
+    """Container magic + metadata + sync — ONE implementation; the
+    columnar scoring writer's byte-parity contract with
+    :func:`write_container` depends on them sharing this framing."""
+    f.write(MAGIC)
+    write_datum(f, _META_SCHEMA, {
+        "avro.schema": json.dumps(schema).encode("utf-8"),
+        "avro.codec": codec.encode("utf-8"),
+    })
+    f.write(_SYNC)
+
+
+def _write_block(f: BinaryIO, count: int, payload: bytes, codec: str) -> None:
+    """One container block: codec framing + count + payload + sync."""
+    if codec == "deflate":
+        payload = zlib.compress(payload)[2:-4]  # raw deflate per spec
+    elif codec == "snappy":
+        payload = _snappy_frame_avro(payload)
+    write_long(f, count)
+    write_bytes(f, payload)
+    f.write(_SYNC)
+
+
 def write_container(
     path: str,
     schema: Any,
@@ -387,13 +410,7 @@ def write_container(
     assert codec in ("null", "deflate", "snappy")
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, "wb") as f:
-        f.write(MAGIC)
-        meta = {
-            "avro.schema": json.dumps(schema).encode("utf-8"),
-            "avro.codec": codec.encode("utf-8"),
-        }
-        write_datum(f, _META_SCHEMA, meta)
-        f.write(_SYNC)
+        _write_container_header(f, schema, codec)
 
         block: list[Any] = []
 
@@ -403,14 +420,7 @@ def write_container(
             body = _io.BytesIO()
             for rec in block:
                 write_datum(body, schema, rec)
-            payload = body.getvalue()
-            if codec == "deflate":
-                payload = zlib.compress(payload)[2:-4]  # raw deflate per spec
-            elif codec == "snappy":
-                payload = _snappy_frame_avro(payload)
-            write_long(f, len(block))
-            write_bytes(f, payload)
-            f.write(_SYNC)
+            _write_block(f, len(block), body.getvalue(), codec)
             block.clear()
 
         for rec in records:
@@ -418,6 +428,187 @@ def write_container(
             if len(block) >= records_per_block:
                 flush()
         flush()
+
+
+def _encode_scoring_block_native(lib, uids, scores, labels, ids_cols):
+    """One columnar ScoringResultAvro block body via the native encoder
+    (native/score_encoder.cpp); None when the call cannot proceed."""
+    import ctypes
+
+    import numpy as np
+
+    n = len(scores)
+    uid_b = [b"" if u is None else str(u).encode("utf-8") for u in uids]
+    uid_blob = b"".join(uid_b)
+    uid_off = np.zeros(n + 1, np.int64)
+    np.cumsum([len(b) for b in uid_b], out=uid_off[1:])
+    uid_null = np.frombuffer(
+        bytes(1 if u is None else 0 for u in uids), np.uint8
+    )
+    scores64 = np.ascontiguousarray(scores, np.float64)
+    label_null = np.frombuffer(
+        bytes(1 if v is None else 0 for v in labels), np.uint8
+    )
+    labels64 = np.asarray(
+        [0.0 if v is None else float(v) for v in labels], np.float64
+    )
+    keys = list(ids_cols)
+    key_b = [k.encode("utf-8") for k in keys]
+    keys_blob = b"".join(key_b)
+    keys_off = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum([len(b) for b in key_b], out=keys_off[1:])
+    # Column-major (matching se_encode), one comprehension per column —
+    # the per-cell work stays in C-driven list machinery, not an
+    # interpreted index loop.
+    val_b: list[bytes] = []
+    null_cols: list[bytes] = []
+    for k in keys:
+        col = ids_cols[k]
+        val_b.extend(
+            b"" if v is None else str(v).encode("utf-8") for v in col
+        )
+        null_cols.append(bytes(1 if v is None else 0 for v in col))
+    val_null = np.frombuffer(b"".join(null_cols) or b"", np.uint8)
+    vals_blob = b"".join(val_b)
+    vals_off = np.zeros(len(val_b) + 1, np.int64)
+    np.cumsum([len(b) for b in val_b], out=vals_off[1:])
+
+    p_i64 = ctypes.POINTER(ctypes.c_int64)
+    p_u8 = ctypes.POINTER(ctypes.c_uint8)
+    p_f64 = ctypes.POINTER(ctypes.c_double)
+    cap = int(
+        uid_off[-1] + vals_off[-1] + (keys_off[-1] + 40) * n + 60 * n + 64
+    )
+    for _ in range(2):
+        out = ctypes.create_string_buffer(cap)
+        wrote = lib.se_encode(
+            n,
+            uid_blob,
+            uid_off.ctypes.data_as(p_i64),
+            uid_null.ctypes.data_as(p_u8),
+            scores64.ctypes.data_as(p_f64),
+            labels64.ctypes.data_as(p_f64),
+            label_null.ctypes.data_as(p_u8),
+            len(keys),
+            vals_blob,
+            vals_off.ctypes.data_as(p_i64),
+            val_null.ctypes.data_as(p_u8),
+            keys_blob,
+            keys_off.ctypes.data_as(p_i64),
+            out, cap,
+        )
+        if wrote >= 0:
+            return out.raw[:wrote]
+        cap = -int(wrote)
+    return None
+
+
+def write_scoring_container(
+    path: str,
+    blocks: Iterable[tuple],
+    codec: str = "deflate",
+    records_per_block: int = 4096,
+) -> int:
+    """COLUMNAR writer for ScoringResultAvro — the write-side mirror of
+    the native block decoder.  ``blocks`` yields ``(uids, scores, labels,
+    ids)`` where ``uids`` is a sequence of str-or-None, ``scores`` /
+    ``labels`` are float sequences (entries may be None for a null
+    label), and ``ids`` maps column name → per-row values (None entries
+    are omitted from that row's map, the join-miss contract).  Map keys
+    are written in the ITERATION ORDER of ``ids`` — callers wanting the
+    canonical layout pass sorted dicts.
+
+    Output is byte-for-byte what :func:`write_container` produces for the
+    equivalent record dicts (parity-tested); the per-record Python
+    serialization loop — measured ~130k rec/s, an order of magnitude
+    under the scoring rate — runs natively instead when the encoder
+    library is available.  Returns the number of rows written.
+    """
+    import numpy as np
+
+    from photon_ml_tpu.io.schemas import SCORING_RESULT
+    from photon_ml_tpu.native import load_score_encoder
+
+    assert codec in ("null", "deflate", "snappy")
+    lib = load_score_encoder()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    pend_u: list = []
+    pend_s: list = []
+    pend_l: list = []
+    pend_ids: Optional[dict] = None
+    total = 0
+
+    def body_bytes(u, s, l, ids) -> bytes:
+        if lib is not None:
+            enc = _encode_scoring_block_native(lib, u, s, l, ids)
+            if enc is not None:
+                return enc
+        out = _io.BytesIO()
+        for i in range(len(s)):
+            write_datum(out, SCORING_RESULT, {
+                "uid": u[i],
+                "predictionScore": float(s[i]),
+                "label": None if l[i] is None else float(l[i]),
+                "ids": {
+                    k: str(ids[k][i])
+                    for k in ids
+                    if ids[k][i] is not None
+                },
+            })
+        return out.getvalue()
+
+    with open(path, "wb") as f:
+        _write_container_header(f, SCORING_RESULT, codec)
+
+        def flush(count):
+            nonlocal pend_u, pend_s, pend_l, total
+            u, pend_u = pend_u[:count], pend_u[count:]
+            s, pend_s = pend_s[:count], pend_s[count:]
+            l, pend_l = pend_l[:count], pend_l[count:]
+            ids = {k: v[:count] for k, v in pend_ids.items()}
+            for k in pend_ids:
+                pend_ids[k] = pend_ids[k][count:]
+            _write_block(f, count, body_bytes(u, s, l, ids), codec)
+            total += count
+
+        for uids, scores, labels, ids in blocks:
+            def tolist(col):
+                return (
+                    col.tolist() if isinstance(col, np.ndarray)
+                    else list(col)
+                )
+
+            n_blk = len(scores)
+            bad = [
+                name for name, col in (
+                    ("uids", uids), ("labels", labels),
+                    *((f"ids[{k!r}]", v) for k, v in ids.items()),
+                )
+                if len(col) != n_blk
+            ]
+            if bad:
+                # A misaligned column would silently SHIFT values into
+                # the wrong rows (or die deep in the offset math).
+                raise ValueError(
+                    f"columns {bad} do not match len(scores)={n_blk}"
+                )
+            if pend_ids is None:
+                pend_ids = {k: [] for k in ids}
+            elif set(pend_ids) != set(ids):
+                raise ValueError(
+                    f"id columns changed across blocks: "
+                    f"{sorted(pend_ids)} vs {sorted(ids)}"
+                )
+            pend_u.extend(tolist(uids))
+            pend_s.extend(tolist(scores))
+            pend_l.extend(tolist(labels))
+            for k in pend_ids:
+                pend_ids[k].extend(tolist(ids[k]))
+            while len(pend_s) >= records_per_block:
+                flush(records_per_block)
+        if pend_s:
+            flush(len(pend_s))
+    return total
 
 
 def _read_header(f: BinaryIO, path: str) -> tuple[Any, str, bytes]:

@@ -188,3 +188,53 @@ def load_layout_sorter() -> Optional[ctypes.CDLL]:
                 logger.warning("native layout sorter load failed: %s", e)
         _CACHE["sorter"] = lib
         return lib
+
+
+# ---------------------------------------------------------------------------
+# Scoring-result Avro encoder (the write-side mirror of the decoder)
+# ---------------------------------------------------------------------------
+
+_ENC_SRC = os.path.join(_DIR, "score_encoder.cpp")
+
+
+def _build_encoder() -> Optional[str]:
+    return _compile_cached(_ENC_SRC, "_score_encoder", "score encoder")
+
+
+def _bind_encoder(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64 = ctypes.c_int64
+    p_i64 = ctypes.POINTER(i64)
+    p_u8 = ctypes.POINTER(ctypes.c_uint8)
+    p_f64 = ctypes.POINTER(ctypes.c_double)
+    lib.se_encode.argtypes = [
+        i64,
+        ctypes.c_char_p, p_i64, p_u8,
+        p_f64,
+        p_f64, p_u8,
+        i64,
+        ctypes.c_char_p, p_i64, p_u8,
+        ctypes.c_char_p, p_i64,
+        ctypes.c_char_p, i64,
+    ]
+    lib.se_encode.restype = i64
+    return lib
+
+
+def load_score_encoder() -> Optional[ctypes.CDLL]:
+    """The scoring-result encoder library, building it if needed; None on
+    failure or when ``PHOTON_NO_NATIVE=1`` (pure-Python fallback —
+    bit-identical output, parity-tested)."""
+    if os.environ.get("PHOTON_NO_NATIVE") == "1":
+        return None
+    with _LOCK:
+        if "encoder" in _CACHE:
+            return _CACHE["encoder"]
+        so_path = _build_encoder()
+        lib = None
+        if so_path is not None:
+            try:
+                lib = _bind_encoder(ctypes.CDLL(so_path))
+            except OSError as e:
+                logger.warning("native score encoder load failed: %s", e)
+        _CACHE["encoder"] = lib
+        return lib
