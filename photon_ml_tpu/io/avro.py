@@ -594,16 +594,31 @@ def write_scoring_container(
                 )
             if pend_ids is None:
                 pend_ids = {k: [] for k in ids}
-            elif set(pend_ids) != set(ids):
-                raise ValueError(
-                    f"id columns changed across blocks: "
-                    f"{sorted(pend_ids)} vs {sorted(ids)}"
-                )
+            else:
+                # Columns may come and go across streamed blocks (each
+                # block's id set is what its rows carried): a column new
+                # to this block backfills pending rows with None, a
+                # column absent from it pads with None below — None
+                # entries are omitted from that row's map, exactly the
+                # old per-record writer's semantics.
+                new = [k for k in ids if k not in pend_ids]
+                for k in new:
+                    pend_ids[k] = [None] * len(pend_s)
+                if new:
+                    # Canonical (sorted) column order regardless of when
+                    # a column first appeared — the resident path sees
+                    # the whole-file union up front, and map-entry order
+                    # is part of the byte-parity contract.
+                    pend_ids = {
+                        k: pend_ids[k] for k in sorted(pend_ids)
+                    }
             pend_u.extend(tolist(uids))
             pend_s.extend(tolist(scores))
             pend_l.extend(tolist(labels))
             for k in pend_ids:
-                pend_ids[k].extend(tolist(ids[k]))
+                pend_ids[k].extend(
+                    tolist(ids[k]) if k in ids else [None] * n_blk
+                )
             while len(pend_s) >= records_per_block:
                 flush(records_per_block)
         if pend_s:
