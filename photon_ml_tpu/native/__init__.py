@@ -167,6 +167,8 @@ def _bind_sorter(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p, p_f32, p_i64,
     ]
     lib.pl_scatter.restype = i64
+    lib.pl_observed_team.argtypes = []
+    lib.pl_observed_team.restype = i64
     return lib
 
 

@@ -262,4 +262,10 @@ int64_t pl_scatter(
   return spill_base[n_threads];
 }
 
+// Test introspection: the ACTUAL deliverable team size.  The multi-thread
+// partition paths only execute when this exceeds 1 (a single-CPU host
+// still delivers a >1 team under OMP_NUM_THREADS), and the team-coverage
+// test asserts it rather than passing vacuously at team=1.
+int64_t pl_observed_team() { return observed_team(); }
+
 }  // extern "C"
