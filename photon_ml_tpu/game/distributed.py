@@ -221,6 +221,31 @@ def _pad_block_entities(block: EntityBlock, multiple: int, sentinel: int):
     )
 
 
+def shard_dataset_entities(
+    dataset: RandomEffectDataset, mesh
+) -> RandomEffectDataset:
+    """The dataset with every block's ENTITY axis padded to the mesh size
+    and placed sharded over it — the one placement both the plain and the
+    factored entity-sharded coordinates build on."""
+    n_dev = mesh.devices.size
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    sentinel = dataset.n_global_rows
+
+    def place(block):
+        if block is None:
+            return None
+        padded = _pad_block_entities(block, n_dev, sentinel)
+        return jax.tree.map(
+            lambda x: jax.device_put(x, sharding), padded
+        )
+
+    return dataclasses.replace(
+        dataset,
+        blocks=[place(b) for b in dataset.blocks],
+        passive_blocks=[place(b) for b in dataset.passive_blocks],
+    )
+
+
 class EntityShardedRandomEffectCoordinate(RandomEffectCoordinate):
     """Random-effect coordinate with entity-axis sharding over a mesh."""
 
@@ -235,23 +260,7 @@ class EntityShardedRandomEffectCoordinate(RandomEffectCoordinate):
         feature_shard: str = "global",
         entity_key: str = "",
     ):
-        n_dev = mesh.devices.size
-        sharding = NamedSharding(mesh, P(DATA_AXIS))
-        sentinel = dataset.n_global_rows
-
-        def place(block):
-            if block is None:
-                return None
-            padded = _pad_block_entities(block, n_dev, sentinel)
-            return jax.tree.map(
-                lambda x: jax.device_put(x, sharding), padded
-            )
-
-        dataset = dataclasses.replace(
-            dataset,
-            blocks=[place(b) for b in dataset.blocks],
-            passive_blocks=[place(b) for b in dataset.passive_blocks],
-        )
+        dataset = shard_dataset_entities(dataset, mesh)
         super().__init__(
             name, dataset, task, config, reg_weight,
             feature_shard=feature_shard, entity_key=entity_key,
@@ -263,3 +272,35 @@ class EntityShardedRandomEffectCoordinate(RandomEffectCoordinate):
         # the base implementation iterates entity_ids, so padding lanes are
         # skipped naturally.
         return super().finalize(state, offsets=offsets)
+
+
+def entity_sharded_factored_coordinate(
+    name: str,
+    dataset: RandomEffectDataset,
+    mesh,
+    task: str,
+    config: GlmOptimizationConfig,
+    rank: int,
+    **kwargs,
+):
+    """Factored random effect with entity-axis sharding over a mesh.
+
+    The factored coordinate's training program is ONE jitted alternation
+    over block pytrees, so sharded placement is all the distribution it
+    needs: the latent step's vmapped per-entity solves are elementwise
+    across lanes (XLA partitions them with zero communication — the
+    ``mapPartitions`` property), and the projection step's gradient
+    scatter from sharded ``(E, D, rank)`` contributions into the
+    REPLICATED ``V`` gradient is exactly the cross-shard psum the shared
+    projection fit needs — GSPMD inserts it; no hand-written collective.
+    A factory (placement + delegation), not a subclass: the factored
+    constructor's jit closures must see only ready-sharded blocks.
+    """
+    from photon_ml_tpu.game.factored import FactoredRandomEffectCoordinate
+
+    coord = FactoredRandomEffectCoordinate(
+        name, shard_dataset_entities(dataset, mesh), task, config,
+        rank, **kwargs,
+    )
+    coord.mesh = mesh
+    return coord

@@ -295,11 +295,11 @@ class GameEstimator:
                         mesh=self.mesh,
                     ))
                     continue
-                if self.mesh is not None and not factored:
+                if self.mesh is not None:
                     coordinates.append(
                         self._distributed_random(
                             name, cfg, shard, ids, response, weight,
-                            cache, key,
+                            cache, key, factored=factored,
                         )
                     )
                     continue
@@ -315,10 +315,6 @@ class GameEstimator:
                     )
                     cache[key] = dataset
                 if factored:
-                    # No entity-sharded variant yet: the shared projection V
-                    # would need a psum'd fit across shards.  The single-
-                    # device coordinate composes fine with distributed
-                    # coordinates in one descent (scores are global arrays).
                     from photon_ml_tpu.game.factored import (
                         FactoredRandomEffectCoordinate,
                     )
@@ -389,22 +385,34 @@ class GameEstimator:
         return coord
 
     def _distributed_random(
-        self, name, cfg, shard, ids, response, weight, cache, key
+        self, name, cfg, shard, ids, response, weight, cache, key,
+        factored: bool = False,
     ):
-        """Entity-sharded random effect (mesh path); same reuse rules as
-        :meth:`_distributed_fixed`."""
+        """Entity-sharded random effect — plain or factored (mesh path);
+        same reuse rules as :meth:`_distributed_fixed`."""
         import copy
 
         from photon_ml_tpu.game.distributed import (
+            entity_sharded_factored_coordinate,
             EntityShardedRandomEffectCoordinate,
         )
 
-        cache_key = ("dist",) + key
+        cfg_sig = (
+            (cfg.optimization, cfg.rank, cfg.alternations)
+            if factored else (cfg.optimization,)
+        )
+        cache_key = ("dist", factored) + key
         cached = cache.get(cache_key)
-        if cached is not None and cached[0] == cfg.optimization:
+        if cached is not None and cached[0] == cfg_sig:
             coord = copy.copy(cached[1])
             coord.name = name
             coord.reg_weight = cfg.reg_weight
+            if factored:
+                coord.projection_reg_weight = (
+                    cfg.reg_weight
+                    if cfg.projection_reg_weight is None
+                    else cfg.projection_reg_weight
+                )
             return coord
         # The expensive entity re-grouping is cached independently of the
         # optimizer config; a config change only re-places blocks on the
@@ -422,12 +430,22 @@ class GameEstimator:
                 device=False,  # EntitySharded places blocks on the mesh
             )
             cache[ds_key] = dataset
-        coord = EntityShardedRandomEffectCoordinate(
-            name, dataset, self.mesh, self.task, cfg.optimization,
-            cfg.reg_weight, feature_shard=cfg.feature_shard,
-            entity_key=cfg.entity_key,
-        )
-        cache[cache_key] = (cfg.optimization, coord)
+        if factored:
+            coord = entity_sharded_factored_coordinate(
+                name, dataset, self.mesh, self.task, cfg.optimization,
+                rank=cfg.rank, reg_weight=cfg.reg_weight,
+                projection_reg_weight=cfg.projection_reg_weight,
+                alternations=cfg.alternations,
+                feature_shard=cfg.feature_shard,
+                entity_key=cfg.entity_key,
+            )
+        else:
+            coord = EntityShardedRandomEffectCoordinate(
+                name, dataset, self.mesh, self.task, cfg.optimization,
+                cfg.reg_weight, feature_shard=cfg.feature_shard,
+                entity_key=cfg.entity_key,
+            )
+        cache[cache_key] = (cfg_sig, coord)
         return coord
 
     def fit(
