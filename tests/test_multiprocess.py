@@ -380,14 +380,22 @@ stream = make_streaming_glm_data(
 fixed = StreamingFixedEffectCoordinate(
     "fixed", stream, "logistic", opt, reg_weight=1.0, mesh=mesh,
 )
-re = RandomEffectCoordinate(
+# The random effect is OUT-OF-CORE per process (mesh=None: under the
+# pod's process-local contract each process trains ITS entities on ITS
+# devices; only the fixed effect's passes psum pod-wide) — out-of-core
+# random effects compose with pods through locality, not pod-sharding.
+from photon_ml_tpu.game.ooc_random import OutOfCoreRandomEffectCoordinate
+
+re = OutOfCoreRandomEffectCoordinate(
     "pu",
     build_random_effect_dataset(
         [f"u{u}" for u in ul], sp.csr_matrix(np.ones((n_local, 1), np.float32)),
-        yl, np.ones(n_local, np.float32),
+        yl, np.ones(n_local, np.float32), device=False,
     ),
     "logistic", opt, reg_weight=1.0, entity_key="userId",
+    device_budget_bytes=1600,
 )
+assert len(re.pass_plan) >= 2, "budget too big to exercise multi-group"
 result = CoordinateDescent([fixed, re]).run(
     jnp.zeros(n_local, jnp.float32), n_iterations=2
 )
