@@ -138,6 +138,9 @@ def parse_coordinate_config(spec: dict):
             alternations=int(spec.get("alternations", 2)),
             max_rows_per_entity=spec.get("max_rows_per_entity"),
             bucket_growth=float(spec.get("bucket_growth", 2.0)),
+            device_budget_bytes=int(
+                float(spec.get("device_budget_mb", 0)) * 2**20
+            ),
         )
     raise ValueError(f"unknown coordinate type {spec['type']!r}")
 

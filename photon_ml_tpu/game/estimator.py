@@ -97,6 +97,11 @@ class FactoredRandomEffectCoordinateConfig:
     alternations: int = 2
     max_rows_per_entity: Optional[int] = None
     bucket_growth: float = 2.0
+    #: >0 trains this coordinate OUT-OF-CORE (game/ooc_factored.py):
+    #: entity blocks stream in budget-bounded pass groups, latent vectors
+    #: host-resident between passes, and the shared projection V fits by
+    #: host-loop L-BFGS with one streamed pass per evaluation.
+    device_budget_bytes: int = 0
 
 
 CoordinateConfig = (
@@ -267,11 +272,7 @@ class GameEstimator:
                 )
             else:
                 factored = isinstance(cfg, FactoredRandomEffectCoordinateConfig)
-                if not factored and cfg.device_budget_bytes > 0:
-                    from photon_ml_tpu.game.ooc_random import (
-                        OutOfCoreRandomEffectCoordinate,
-                    )
-
+                if cfg.device_budget_bytes > 0:
                     # Host-resident dataset, cached separately from the
                     # device-resident one the resident path builds.
                     ooc_key = ("ooc_ds",) + key
@@ -287,6 +288,30 @@ class GameEstimator:
                             device=False,
                         )
                         cache[ooc_key] = dataset
+                    if factored:
+                        from photon_ml_tpu.game.ooc_factored import (
+                            OutOfCoreFactoredRandomEffectCoordinate,
+                        )
+
+                        coordinates.append(
+                            OutOfCoreFactoredRandomEffectCoordinate(
+                                name, dataset, self.task, cfg.optimization,
+                                rank=cfg.rank, reg_weight=cfg.reg_weight,
+                                projection_reg_weight=(
+                                    cfg.projection_reg_weight
+                                ),
+                                alternations=cfg.alternations,
+                                feature_shard=cfg.feature_shard,
+                                entity_key=cfg.entity_key,
+                                device_budget_bytes=cfg.device_budget_bytes,
+                                mesh=self.mesh,
+                            )
+                        )
+                        continue
+                    from photon_ml_tpu.game.ooc_random import (
+                        OutOfCoreRandomEffectCoordinate,
+                    )
+
                     coordinates.append(OutOfCoreRandomEffectCoordinate(
                         name, dataset, self.task, cfg.optimization,
                         cfg.reg_weight, feature_shard=cfg.feature_shard,

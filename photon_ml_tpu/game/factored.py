@@ -259,36 +259,7 @@ class FactoredRandomEffectCoordinate(Coordinate):
         return self._materialize_jit(self.dataset.blocks, u_list, V)
 
     def finalize(self, state, offsets=None) -> RandomEffectModel:
-        # Identical storage shape to a plain random effect: scoring driver,
-        # transformer, and Avro store need no factored-specific handling.
-        # Coefficient variances are not defined through the factorization
-        # (w_e is a deterministic function of the joint (U, V) fit), so
-        # none are produced — matching the reference, which computes
-        # variances only for unfactored coordinates.
-        table: dict = {}
-        for block, ids, coefs in zip(
-            self.dataset.blocks, self.dataset.entity_ids,
-            self.materialize(state),
-        ):
-            cmap = np.asarray(block.col_map)
-            w = np.asarray(coefs)
-            for lane, key in enumerate(ids):
-                keep = cmap[lane] >= 0
-                cols = cmap[lane][keep]
-                vals = w[lane][keep]
-                nz = vals != 0
-                table[key] = (
-                    cols[nz].astype(np.int32),
-                    vals[nz].astype(np.float32),
-                )
-        return RandomEffectModel(
-            coefficients=table,
-            feature_shard=self.feature_shard,
-            entity_key=self.entity_key,
-            task=self.task,
-            n_features=self.dataset.n_features,
-            variances=None,
-        )
+        return finalize_factored_model(self, state)
 
     def make_validation_scorer(self, shards: dict, ids: dict):
         from photon_ml_tpu.game.validation import RandomEffectValidationScorer
@@ -297,6 +268,40 @@ class FactoredRandomEffectCoordinate(Coordinate):
             self.dataset, ids[self.entity_key], shards[self.feature_shard]
         )
         return _FactoredValidationScorer(self, inner)
+
+
+def finalize_factored_model(coord, state) -> RandomEffectModel:
+    """The one materialized-table builder both the resident and the
+    out-of-core factored coordinates share.  Identical storage shape to a
+    plain random effect: scoring driver, transformer, and Avro store need
+    no factored-specific handling.  Coefficient variances are not defined
+    through the factorization (w_e is a deterministic function of the
+    joint (U, V) fit), so none are produced — matching the reference,
+    which computes variances only for unfactored coordinates."""
+    table: dict = {}
+    for block, ids, coefs in zip(
+        coord.dataset.blocks, coord.dataset.entity_ids,
+        coord.materialize(state),
+    ):
+        cmap = np.asarray(block.col_map)
+        w = np.asarray(coefs)
+        for lane, key in enumerate(ids):
+            keep = cmap[lane] >= 0
+            cols = cmap[lane][keep]
+            vals = w[lane][keep]
+            nz = vals != 0
+            table[key] = (
+                cols[nz].astype(np.int32),
+                vals[nz].astype(np.float32),
+            )
+    return RandomEffectModel(
+        coefficients=table,
+        feature_shard=coord.feature_shard,
+        entity_key=coord.entity_key,
+        task=coord.task,
+        n_features=coord.dataset.n_features,
+        variances=None,
+    )
 
 
 class _FactoredValidationScorer:

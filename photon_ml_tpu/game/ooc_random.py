@@ -219,7 +219,16 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         contributes ONE compiled shape; groups then fill greedily to the
         per-pass budget (= budget/2, the double-buffering reserve).
         """
-        budget = self.device_budget_bytes // 2
+        budget = (
+            self.device_budget_bytes - self._budget_overhead_bytes()
+        ) // 2
+        if budget <= 0:
+            raise ValueError(
+                f"random-effect coordinate {self.name!r}: "
+                f"device_budget_bytes={self.device_budget_bytes} does not "
+                f"cover the {self._budget_overhead_bytes()}-byte "
+                "whole-pass-resident overhead"
+            )
         q = self._quantum
         plan: list[list[_Slice]] = []
         group: list[_Slice] = []
@@ -229,7 +238,9 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
                 self.dataset.passive_blocks[bi]
                 if self.dataset.passive_blocks else None
             )
-            per_lane = _lane_bytes(block, passive)
+            per_lane = _lane_bytes(block, passive) + self._extra_lane_bytes(
+                block
+            )
             e = block.n_entities
             if per_lane * q > budget:
                 raise ValueError(
@@ -237,7 +248,8 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
                     f"{q}-entity slice of block {bi} "
                     f"(R={block.rows_per_entity}, D={block.block_dim}) "
                     f"needs {per_lane * q} bytes, over the "
-                    f"per-pass budget {budget} (= device_budget_bytes/2). "
+                    f"per-pass budget {budget} (= (device_budget_bytes "
+                    f"- {self._budget_overhead_bytes()} overhead) / 2). "
                     "Raise device_budget_bytes or lower "
                     "max_rows_per_entity / bucket_growth"
                 )
@@ -258,6 +270,18 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         if group:
             plan.append(group)
         return plan
+
+    def _extra_lane_bytes(self, block: EntityBlock) -> int:
+        """Subclass hook: additional device bytes one lane costs beyond
+        the raw block leaves (e.g. the factored variant's projected
+        features and latent vectors)."""
+        return 0
+
+    def _budget_overhead_bytes(self) -> int:
+        """Subclass hook: device bytes resident for the WHOLE pass
+        (shared state like the factored projection + its gradient),
+        carved out of the budget before groups are sized."""
+        return 0
 
     def _put(self, tree):
         if self._sharding is None:

@@ -275,3 +275,123 @@ class TestEntityShardedFactored:
         model, history = est.fit(shards, ids, y)
         assert "fre" in model.models
         assert np.isfinite(history[-1]["score_norm"])
+
+
+class TestOutOfCoreFactored:
+    """Out-of-core factored random effects (game/ooc_factored.py): the
+    last coordinate-type x residency cell.  Entity blocks stream in
+    budget-bounded groups; latent vectors host-resident between passes;
+    the shared V fits by host-loop L-BFGS over streamed passes — so the
+    trajectory must match the resident coordinate's alternation to float
+    tolerance (same solvers, same math, different residency)."""
+
+    def _coords(self, rng, opt_config, budget, **kw):
+        from photon_ml_tpu.game.ooc_factored import (
+            OutOfCoreFactoredRandomEffectCoordinate,
+        )
+
+        users, X, y, _v = _rank1_problem(rng, n_entities=50, rows=5)
+        w = np.ones(len(y), np.float32)
+        resident = FactoredRandomEffectCoordinate(
+            "fre",
+            build_random_effect_dataset(users, sp.csr_matrix(X), y, w),
+            "logistic", opt_config, rank=2, reg_weight=0.3,
+            alternations=2, entity_key="userId", **kw,
+        )
+        ooc = OutOfCoreFactoredRandomEffectCoordinate(
+            "fre",
+            build_random_effect_dataset(
+                users, sp.csr_matrix(X), y, w, device=False
+            ),
+            "logistic", opt_config, rank=2, reg_weight=0.3,
+            alternations=2, entity_key="userId",
+            device_budget_bytes=budget, **kw,
+        )
+        return resident, ooc, y
+
+    def test_parity_with_resident_across_budgets(self, rng, opt_config):
+        resident, ooc, y = self._coords(rng, opt_config, 40_000)
+        assert len(ooc.pass_plan) >= 2
+        offsets = jnp.zeros(len(y), jnp.float32)
+        st_r = resident.train(offsets)
+        st_o = ooc.train(offsets)
+        u_r, V_r = st_r
+        u_o, V_o = st_o
+        # Host-loop vs in-jit L-BFGS rounding compounds over the
+        # alternations; same tolerance class as the other streamed-vs-
+        # resident trajectory parity tests.
+        np.testing.assert_allclose(
+            np.asarray(V_r), np.asarray(V_o), rtol=1e-2, atol=3e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(resident.score(st_r)), np.asarray(ooc.score(st_o)),
+            rtol=1e-2, atol=5e-3,
+        )
+        # Warm restart round-trips host/device state shapes.
+        st_o2 = ooc.train(offsets, warm_state=st_o)
+        np.testing.assert_allclose(
+            np.asarray(ooc.score(st_o2)),
+            np.asarray(resident.score(resident.train(
+                offsets, warm_state=st_r
+            ))),
+            rtol=1e-2, atol=5e-3,
+        )
+
+    def test_finalize_tables_match(self, rng, opt_config):
+        resident, ooc, y = self._coords(rng, opt_config, 40_000)
+        offsets = jnp.zeros(len(y), jnp.float32)
+        t_r = resident.finalize(resident.train(offsets)).coefficients
+        t_o = ooc.finalize(ooc.train(offsets)).coefficients
+        assert set(t_r) == set(t_o)
+        for k, (cols, vals) in t_r.items():
+            np.testing.assert_array_equal(cols, t_o[k][0])
+            np.testing.assert_allclose(vals, t_o[k][1], atol=5e-3)
+
+    def test_budget_and_overlap_discipline(self, rng, opt_config):
+        _, ooc, y = self._coords(rng, opt_config, 40_000)
+        per_pass = (
+            ooc.device_budget_bytes - ooc._budget_overhead_bytes()
+        ) // 2
+        for group in ooc.pass_plan:
+            assert sum(s.bytes for s in group) <= per_pass
+        ooc.train(jnp.zeros(len(y), jnp.float32))
+        assert ooc.live_groups_high_water == 2
+
+    def test_estimator_routes_ooc_factored(self, rng, opt_config):
+        from photon_ml_tpu.game.estimator import (
+            FactoredRandomEffectCoordinateConfig,
+            FixedEffectCoordinateConfig,
+            GameEstimator,
+        )
+        from photon_ml_tpu.game.ooc_factored import (
+            OutOfCoreFactoredRandomEffectCoordinate,
+        )
+
+        users, X, y, _v = _rank1_problem(rng, n_entities=30, rows=4)
+        shards = {
+            "global": sp.csr_matrix(
+                rng.normal(size=(len(y), 3)).astype(np.float32)
+            ),
+            "uf": sp.csr_matrix(X),
+        }
+        ids = {"userId": users}
+        est = GameEstimator(
+            "logistic",
+            {
+                "fixed": FixedEffectCoordinateConfig(
+                    "global", opt_config, reg_weight=0.5
+                ),
+                "fre": FactoredRandomEffectCoordinateConfig(
+                    "uf", "userId", rank=2, optimization=opt_config,
+                    reg_weight=0.3, device_budget_bytes=60_000,
+                ),
+            },
+            n_iterations=1,
+        )
+        coords = est.build_coordinates(shards, ids, y)
+        assert isinstance(
+            coords[1], OutOfCoreFactoredRandomEffectCoordinate
+        )
+        model, history = est.fit(shards, ids, y)
+        assert "fre" in model.models
+        assert np.isfinite(history[-1]["score_norm"])
