@@ -110,6 +110,56 @@ class StreamingGlmData:
         return self._has_nonzero_offsets
 
 
+def spill_tree(tree, dir_: str, tag: str, skip_memmaps: bool = False):
+    """Replace a pytree's numpy leaves with disk-backed memmaps (one
+    ``.npy`` per leaf under ``dir_``).  Downstream code is agnostic:
+    ``np.memmap`` is an ndarray, ``device_put`` pages it straight from
+    disk, and ``np.asarray`` materializes transiently.  The spill step of
+    the MEMORY_AND_DISK residency ladder (the reference persists its
+    RDDs exactly so — SURVEY.md §2).
+
+    ``skip_memmaps``: leave already-disk-backed leaves untouched instead
+    of re-saving them — ONLY safe when their backing files live in a
+    directory that outlives this store (the dense chunks' finish-time
+    spill); re-spilling is the default because pallas/coo finalize leaves
+    may still reference the transient ``raw/`` spill."""
+    import os
+
+    os.makedirs(dir_, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if skip_memmaps and isinstance(leaf, np.memmap):
+            out.append(leaf)
+        elif isinstance(leaf, np.ndarray) and leaf.size > 0:
+            path = os.path.join(dir_, f"{tag}_{i}.npy")
+            np.save(path, np.ascontiguousarray(leaf))
+            out.append(np.load(path, mmap_mode="r"))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def spill_random_effect_dataset(dataset, dir_: str):
+    """A host random-effect dataset with every block's leaves on disk —
+    feeds the out-of-core coordinates when even the HOST copy exceeds
+    RAM (the blocks page through the OS cache as pass groups slice
+    them)."""
+    import dataclasses as _dc
+
+    return _dc.replace(
+        dataset,
+        blocks=[
+            spill_tree(b, dir_, f"re_block{i}")
+            for i, b in enumerate(dataset.blocks)
+        ],
+        passive_blocks=[
+            None if b is None else spill_tree(b, dir_, f"re_passive{i}")
+            for i, b in enumerate(dataset.passive_blocks)
+        ],
+    )
+
+
 def make_streaming_glm_data(
     features,
     labels,
@@ -120,6 +170,7 @@ def make_streaming_glm_data(
     depth_cap: int = 128,
     n_shards: int = 1,
     coo_budget: int | None = None,
+    storage_dir: str | None = None,
 ) -> StreamingGlmData:
     """Cut already-materialized host data into uniform chunks.
 
@@ -146,6 +197,7 @@ def make_streaming_glm_data(
         depth_cap=depth_cap,
         n_shards=n_shards,
         coo_budget=coo_budget,
+        storage_dir=storage_dir,
     )
 
 
@@ -157,6 +209,7 @@ def streaming_from_blocks(
     depth_cap: int = 128,
     n_shards: int = 1,
     coo_budget: int | None = None,
+    storage_dir: str | None = None,
 ) -> StreamingGlmData:
     """Build the chunk store from an iterator of ``(X, y[, w[, o]])``
     blocks (e.g. Avro ``iter_blocks`` output), re-cut to ``chunk_rows``
@@ -175,10 +228,32 @@ def streaming_from_blocks(
     uniformized across chunks × shards and stacked leaf-wise, so the
     streamed-DP shard_map program runs the Pallas kernels per shard.
     """
+    import os
+    import shutil
+
     import scipy.sparse as sp
 
     if chunk_rows <= 0:
         raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
+    # storage_dir: DISK-backed store.  Each chunk's leaves spill to .npy
+    # as the chunk finishes (ingest RAM stays ~one chunk + the raw
+    # buffer) and again after cross-chunk uniformization (one padded
+    # chunk in RAM at a time); the returned chunks hold memmap leaves
+    # that page through the OS cache as training streams them — host
+    # RAM stops bounding the trainable size, disk does (the reference's
+    # MEMORY_AND_DISK RDD persistence).
+    raw_dir = None
+    if storage_dir is not None:
+        os.makedirs(storage_dir, exist_ok=True)
+        if os.listdir(storage_dir):
+            # A reused directory would leave a prior (possibly larger)
+            # build's chunk files alongside this one — a silent disk leak
+            # in the directory whose purpose is bounding disk footprint.
+            raise ValueError(
+                f"storage_dir {storage_dir!r} is not empty; point each "
+                "build at a fresh directory (or clear it first)"
+            )
+        raw_dir = os.path.join(storage_dir, "raw")
     if n_shards > 1 and chunk_rows % n_shards:
         chunk_rows = -(-chunk_rows // n_shards) * n_shards
     per_shard = chunk_rows // max(n_shards, 1)
@@ -235,6 +310,11 @@ def streaming_from_blocks(
                         depth_cap=depth_cap, col_permutation=False,
                     )
                 shard_mats.append(layout_to_host(P))
+            if raw_dir is not None:
+                shard_mats = [
+                    spill_tree(m, raw_dir, f"c{len(finished)}_s{s}")
+                    for s, m in enumerate(shard_mats)
+                ]
             finished.append(shard_mats)
         elif mode == "coo":
             shards = []
@@ -245,15 +325,25 @@ def streaming_from_blocks(
                     coo.row, coo.col, coo.data.astype(np.float32),
                     per_shard, d,
                 ))
+            if raw_dir is not None:
+                shards = [
+                    spill_tree(t, raw_dir, f"c{len(finished)}_s{s}")
+                    for s, t in enumerate(shards)
+                ]
             finished.append(shards)
         else:
             dense = np.asarray(X, np.float32)
-            if n_shards == 1:
-                finished.append(DenseMatrix(dense))
-            else:
-                finished.append(
-                    DenseMatrix(dense.reshape(n_shards, per_shard, d))
+            feat = DenseMatrix(
+                dense if n_shards == 1
+                else dense.reshape(n_shards, per_shard, d)
+            )
+            if storage_dir is not None:
+                # Dense needs no cross-chunk uniformization: spill the
+                # FINAL leaves directly, no raw copy.
+                feat = spill_tree(
+                    feat, storage_dir, f"chunk{len(finished)}_X"
                 )
+            finished.append(feat)
 
     buf_off = 0  # rows of buf_X[0] already consumed by earlier cuts
 
@@ -343,31 +433,48 @@ def streaming_from_blocks(
         raise ValueError("no blocks")
     _drain(final=True)
 
+    def _maybe_spill_chunk(
+        gd: GlmData, k: int, skip_memmaps: bool = False
+    ) -> GlmData:
+        if storage_dir is None:
+            return gd
+        return spill_tree(
+            gd, storage_dir, f"chunk{k}", skip_memmaps=skip_memmaps
+        )
+
     # Finalize: uniform shapes across chunks.
     chunks = []
     if mode == "pallas":
-        from photon_ml_tpu.ops.sparse_pallas import uniformize_pallas_layouts
+        from photon_ml_tpu.ops.sparse_pallas import (
+            uniformize_one,
+            uniformize_targets,
+        )
 
         n_sh = max(n_shards, 1)
-        # Uniformize across chunks AND shards in one pass: every layout
-        # shares one pytree structure/shape set, so the per-chunk program
-        # compiles once and the stacked shard leaves carry one common
-        # leading axis for the mesh sharding.
-        flat = uniformize_pallas_layouts(
+        # Uniformize across chunks AND shards: every layout shares one
+        # pytree structure/shape set, so the per-chunk program compiles
+        # once and the stacked shard leaves carry one common leading axis
+        # for the mesh sharding.  Targets come from a metadata-only pass;
+        # each chunk then pads (and, with storage_dir, respills) ONE at a
+        # time — on a disk-backed build, RAM never holds more than one
+        # padded chunk.
+        targets = uniformize_targets(
             [m for shard_mats in finished for m in shard_mats]
         )
         for k, (y, w, o) in enumerate(vectors):
-            ms = flat[k * n_sh:(k + 1) * n_sh]
+            ms = [uniformize_one(m, targets) for m in finished[k]]
             if n_shards == 1:
-                chunks.append(GlmData(ms[0], y, w, o))
+                gd = GlmData(ms[0], y, w, o)
             else:
                 feat = jax.tree.map(lambda *xs: np.stack(xs), *ms)
-                chunks.append(GlmData(
+                gd = GlmData(
                     feat,
                     y.reshape(n_shards, per_shard),
                     w.reshape(n_shards, per_shard),
                     o.reshape(n_shards, per_shard),
-                ))
+                )
+            chunks.append(_maybe_spill_chunk(gd, k))
+            finished[k] = None  # drop the pre-pad layouts as we go
     elif mode == "coo":
         budget = max(
             1,
@@ -383,12 +490,12 @@ def streaming_from_blocks(
                     f"largest per-shard chunk nnz ({budget})"
                 )
             budget = coo_budget
-        for shards, (y, w, o) in zip(finished, vectors):
+        for k, (shards, (y, w, o)) in enumerate(zip(finished, vectors)):
             padded = [pad_coo_triples(*t, budget) for t in shards]
             if n_shards == 1:
                 r, c, v = padded[0]
                 feat = SparseMatrix(r, c, v, chunk_rows, d)
-                chunks.append(GlmData(feat, y, w, o))
+                gd = GlmData(feat, y, w, o)
             else:
                 feat = SparseMatrix(
                     np.stack([p[0] for p in padded]),
@@ -396,23 +503,34 @@ def streaming_from_blocks(
                     np.stack([p[2] for p in padded]),
                     per_shard, d,
                 )
-                chunks.append(GlmData(
+                gd = GlmData(
                     feat,
                     y.reshape(n_shards, per_shard),
                     w.reshape(n_shards, per_shard),
                     o.reshape(n_shards, per_shard),
-                ))
+                )
+            chunks.append(_maybe_spill_chunk(gd, k))
+            finished[k] = None
     else:
-        for feat, (y, w, o) in zip(finished, vectors):
+        for k, (feat, (y, w, o)) in enumerate(zip(finished, vectors)):
             if n_shards == 1:
-                chunks.append(GlmData(feat, y, w, o))
+                gd = GlmData(feat, y, w, o)
             else:
-                chunks.append(GlmData(
+                gd = GlmData(
                     feat,
                     y.reshape(n_shards, per_shard),
                     w.reshape(n_shards, per_shard),
                     o.reshape(n_shards, per_shard),
-                ))
+                )
+            # Dense feature leaves were spilled at finish (into files
+            # that OUTLIVE the store — not raw/); only the row vectors
+            # still need the disk trip.
+            chunks.append(_maybe_spill_chunk(gd, k, skip_memmaps=True))
+
+    if raw_dir is not None:
+        # The pre-uniformization spill is dead weight once the padded
+        # chunks are on disk.
+        shutil.rmtree(raw_dir, ignore_errors=True)
 
     return StreamingGlmData(
         chunks=chunks,

@@ -168,6 +168,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "Datasets larger than HBM train this way; L-BFGS, OWL-QN "
         "(L1/elastic-net) and smooth TRON all stream",
     )
+    p.add_argument(
+        "--stream-storage-dir",
+        help="with --stream-chunk-rows: spill the chunk store to .npy "
+        "files in this directory and train from disk-backed (memmap) "
+        "leaves — host RAM stops bounding the trainable size, disk does "
+        "(the reference's MEMORY_AND_DISK RDD persistence)",
+    )
     add_compile_cache_arg(p)
     return p
 
@@ -214,6 +221,13 @@ def _run(args) -> dict:
 
     # Stage 2: summarize + normalization ------------------------------------
     data_parallel = args.data_parallel == "auto" and len(jax.devices()) > 1
+    if args.stream_storage_dir and args.stream_chunk_rows <= 0:
+        # Silently ignoring the flag would hand the user a fully
+        # RAM-resident run on exactly the oversized dataset the flag
+        # exists for.
+        raise ValueError(
+            "--stream-storage-dir requires --stream-chunk-rows > 0"
+        )
     streaming = args.stream_chunk_rows > 0
     if data_parallel or streaming:
         # The sharded path uploads the matrix across the mesh (and the
@@ -320,6 +334,7 @@ def _run(args) -> dict:
             X_train, y_train, chunk_rows=args.stream_chunk_rows,
             use_pallas=False if n_shards > 1 else "auto",
             n_shards=n_shards,
+            storage_dir=args.stream_storage_dir,
         )
         logger.info(
             "streaming: %d chunks x %d rows (%.1f MB host), %d shard(s)",

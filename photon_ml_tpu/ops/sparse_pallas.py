@@ -1264,6 +1264,16 @@ def uniformize_pallas_layouts(
     """
     if not mats:
         return []
+    targets = uniformize_targets(mats)
+    return [uniformize_one(m, targets, drop_host_coo) for m in mats]
+
+
+def uniformize_targets(mats: list[PallasSparseMatrix]) -> dict:
+    """The cross-chunk max shapes/flags :func:`uniformize_one` pads to.
+    Reads only metadata and (for the mixed unit-vals case, inside
+    uniformize_one) codes — cheap on disk-backed (memmap) leaves, which
+    is what lets a spilling chunk store pad-and-respill ONE chunk at a
+    time instead of materializing every padded layout at once."""
     m0 = mats[0]
     for m in mats[1:]:
         if (m.n_rows, m.n_cols) != (m0.n_rows, m0.n_cols):
@@ -1275,75 +1285,78 @@ def uniformize_pallas_layouts(
         raise ValueError(
             "streaming chunks must be built with col_permutation=False"
         )
-    a_f = max(m.a_f for m in mats)
-    a_b = max(m.a_b for m in mats)
-    kc = max(m.dense_col_ids.shape[0] for m in mats)
-    kr = max(m.dense_row_ids.shape[0] for m in mats)
-    any_spill = any(m.spill.has_spill for m in mats)
-    spill_budget = max(max(m.spill.spill_coo.nnz for m in mats), 1)
-    depth_f = max(m.depth_f for m in mats)
-    depth_b = max(m.depth_b for m in mats)
+    return {
+        "a_f": max(m.a_f for m in mats),
+        "a_b": max(m.a_b for m in mats),
+        "kc": max(m.dense_col_ids.shape[0] for m in mats),
+        "kr": max(m.dense_row_ids.shape[0] for m in mats),
+        "any_spill": any(m.spill.has_spill for m in mats),
+        "spill_budget": max(max(m.spill.spill_coo.nnz for m in mats), 1),
+        "depth_f": max(m.depth_f for m in mats),
+        "depth_b": max(m.depth_b for m in mats),
+        # unit_vals must be uniform (it is pytree meta).  A mixed set
+        # keeps the valued layout: unit chunks materialize val = 1.0 at
+        # valid slots.
+        "all_unit": all(m.unit_vals for m in mats),
+    }
 
-    # unit_vals must be uniform (it is pytree meta).  A mixed set keeps the
-    # valued layout: unit chunks materialize val = 1.0 at valid slots.
-    all_unit = all(m.unit_vals for m in mats)
-    if not all_unit:
-        mats = [
-            dataclasses.replace(
-                m,
-                f_val=(np.asarray(m.f_code) >= 0).astype(np.float32),
-                b_val=(np.asarray(m.b_code) >= 0).astype(np.float32),
-                unit_vals=False,
-            ) if m.unit_vals else m
-            for m in mats
-        ]
 
-    out = []
-    for m in mats:
-        from photon_ml_tpu.ops.sparse import pad_coo_triples
+def uniformize_one(
+    m: PallasSparseMatrix, t: dict, drop_host_coo: bool = True
+) -> PallasSparseMatrix:
+    """Pad ONE layout to the :func:`uniformize_targets` shapes."""
+    from photon_ml_tpu.ops.sparse import pad_coo_triples
 
-        sc = m.spill.spill_coo
-        rows, cols, vals = pad_coo_triples(
-            np.asarray(sc.row_ids), np.asarray(sc.col_ids),
-            np.asarray(sc.values), spill_budget,
-        )
-        spill = SpillData(
-            spill_coo=SparseMatrix(
-                row_ids=rows, col_ids=cols, values=vals,
-                n_rows=m.n_rows, n_cols=m.n_cols,
-            ),
-            has_spill=any_spill,
-        )
-        host_coo = (
-            DroppedHostCoo(m.n_rows, m.n_cols) if drop_host_coo
-            else m.host_coo
-        )
-        out.append(dataclasses.replace(
+    all_unit = t["all_unit"]
+    if m.unit_vals and not all_unit:
+        m = dataclasses.replace(
             m,
-            f_code=_pad_axis(np.asarray(m.f_code), 2, a_f,
-                             constant_values=EMPTY_MARK),
-            f_val=(
-                np.asarray(m.f_val) if all_unit
-                else _pad_axis(np.asarray(m.f_val), 2, a_f)
-            ),
-            b_code=_pad_axis(np.asarray(m.b_code), 2, a_b,
-                             constant_values=EMPTY_MARK),
-            b_val=(
-                np.asarray(m.b_val) if all_unit
-                else _pad_axis(np.asarray(m.b_val), 2, a_b)
-            ),
-            spill=spill,
-            dense_cols=_pad_axis(np.asarray(m.dense_cols), 0, kc),
-            dense_col_ids=_pad_axis(
-                np.asarray(m.dense_col_ids), 0, kc
-            ),
-            dense_rows=_pad_axis(np.asarray(m.dense_rows), 0, kr),
-            dense_row_ids=_pad_axis(
-                np.asarray(m.dense_row_ids), 0, kr
-            ),
-            host_coo=host_coo,
-            a_f=a_f, a_b=a_b, depth_f=depth_f, depth_b=depth_b,
-            has_dense_cols=kc > 0,
-            has_dense_rows=kr > 0,
-        ))
-    return out
+            f_val=(np.asarray(m.f_code) >= 0).astype(np.float32),
+            b_val=(np.asarray(m.b_code) >= 0).astype(np.float32),
+            unit_vals=False,
+        )
+    sc = m.spill.spill_coo
+    rows, cols, vals = pad_coo_triples(
+        np.asarray(sc.row_ids), np.asarray(sc.col_ids),
+        np.asarray(sc.values), t["spill_budget"],
+    )
+    spill = SpillData(
+        spill_coo=SparseMatrix(
+            row_ids=rows, col_ids=cols, values=vals,
+            n_rows=m.n_rows, n_cols=m.n_cols,
+        ),
+        has_spill=t["any_spill"],
+    )
+    host_coo = (
+        DroppedHostCoo(m.n_rows, m.n_cols) if drop_host_coo
+        else m.host_coo
+    )
+    return dataclasses.replace(
+        m,
+        f_code=_pad_axis(np.asarray(m.f_code), 2, t["a_f"],
+                         constant_values=EMPTY_MARK),
+        f_val=(
+            np.asarray(m.f_val) if all_unit
+            else _pad_axis(np.asarray(m.f_val), 2, t["a_f"])
+        ),
+        b_code=_pad_axis(np.asarray(m.b_code), 2, t["a_b"],
+                         constant_values=EMPTY_MARK),
+        b_val=(
+            np.asarray(m.b_val) if all_unit
+            else _pad_axis(np.asarray(m.b_val), 2, t["a_b"])
+        ),
+        spill=spill,
+        dense_cols=_pad_axis(np.asarray(m.dense_cols), 0, t["kc"]),
+        dense_col_ids=_pad_axis(
+            np.asarray(m.dense_col_ids), 0, t["kc"]
+        ),
+        dense_rows=_pad_axis(np.asarray(m.dense_rows), 0, t["kr"]),
+        dense_row_ids=_pad_axis(
+            np.asarray(m.dense_row_ids), 0, t["kr"]
+        ),
+        host_coo=host_coo,
+        a_f=t["a_f"], a_b=t["a_b"],
+        depth_f=t["depth_f"], depth_b=t["depth_b"],
+        has_dense_cols=t["kc"] > 0,
+        has_dense_rows=t["kr"] > 0,
+    )

@@ -1117,3 +1117,120 @@ class TestDoubleBufferStructure:
                 f"transfer {k + 1} was not enqueued before the host "
                 f"blocked on compute {k}: {events}"
             )
+
+
+class TestDiskBackedStore:
+    """storage_dir: the chunk store spills to .npy and trains from
+    memmap leaves — the MEMORY_AND_DISK rung of the residency ladder
+    (host RAM stops bounding trainable size, disk does).  Parity is
+    bit-for-bit: the spill is a pure re-residency of the same arrays."""
+
+    @staticmethod
+    def _data(seed=0, n=700, d=12):
+        rng = np.random.default_rng(seed)
+        X = sp.random(n, d, density=0.4, random_state=seed, format="csr",
+                      dtype=np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        return X, y
+
+    @pytest.mark.parametrize("mode,n_shards", [
+        ("coo", 1), ("coo", 4), ("pallas", 1), ("dense", 1), ("dense", 4),
+    ])
+    def test_bit_identical_to_ram_store(self, tmp_path, mode, n_shards):
+        X, y = self._data()
+        if mode == "dense":
+            X = np.asarray(X.toarray(), np.float32)
+        kw = dict(
+            chunk_rows=256, use_pallas=(mode == "pallas"),
+            n_shards=n_shards,
+        )
+        ram = make_streaming_glm_data(X, y, **kw)
+        disk = make_streaming_glm_data(
+            X, y, storage_dir=str(tmp_path / "store"), **kw
+        )
+        assert disk.n_chunks == ram.n_chunks
+        for cr, cd in zip(ram.chunks, disk.chunks):
+            leaves_r = jax.tree_util.tree_leaves(cr)
+            leaves_d = jax.tree_util.tree_leaves(cd)
+            assert any(
+                isinstance(l, np.memmap) for l in leaves_d
+            ), "no leaf is disk-backed"
+            for a, b in zip(leaves_r, leaves_d):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_training_from_disk_matches_ram(self, tmp_path):
+        X, y = self._data(seed=3)
+        ram = make_streaming_glm_data(X, y, chunk_rows=256, use_pallas=False)
+        disk = make_streaming_glm_data(
+            X, y, chunk_rows=256, use_pallas=False,
+            storage_dir=str(tmp_path / "store"),
+        )
+        cfg = LBFGSConfig(max_iters=30, tolerance=1e-9)
+        w_ram = streaming_lbfgs_solve(
+            lambda w: StreamingObjective("logistic", ram).value_and_grad(
+                w, 1.0
+            ),
+            jnp.zeros(X.shape[1], jnp.float32), cfg,
+        ).w
+        w_disk = streaming_lbfgs_solve(
+            lambda w: StreamingObjective("logistic", disk).value_and_grad(
+                w, 1.0
+            ),
+            jnp.zeros(X.shape[1], jnp.float32), cfg,
+        ).w
+        np.testing.assert_array_equal(np.asarray(w_ram), np.asarray(w_disk))
+
+    def test_spilled_random_effect_dataset_trains(self, tmp_path):
+        from photon_ml_tpu.data.streaming import spill_random_effect_dataset
+        from photon_ml_tpu.game.data import build_random_effect_dataset
+        from photon_ml_tpu.game.ooc_random import (
+            OutOfCoreRandomEffectCoordinate,
+        )
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig, OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        rng = np.random.default_rng(5)
+        n_ent, rows, d = 40, 4, 5
+        n = n_ent * rows
+        users = np.repeat([f"u{i}" for i in range(n_ent)], rows)
+        Xe = sp.csr_matrix(rng.normal(size=(n, d)).astype(np.float32))
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        w = np.ones(n, np.float32)
+        host = build_random_effect_dataset(users, Xe, y, w, device=False)
+        spilled = spill_random_effect_dataset(
+            build_random_effect_dataset(users, Xe, y, w, device=False),
+            str(tmp_path / "re"),
+        )
+        assert any(
+            isinstance(l, np.memmap)
+            for b in spilled.blocks
+            for l in jax.tree_util.tree_leaves(b)
+        )
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=20, tolerance=1e-7),
+            regularization=RegularizationContext.l2(),
+        )
+        offsets = jnp.zeros(n, jnp.float32)
+        st_ram = OutOfCoreRandomEffectCoordinate(
+            "re", host, "logistic", opt, reg_weight=0.5,
+            device_budget_bytes=20_000,
+        ).train(offsets)
+        st_disk = OutOfCoreRandomEffectCoordinate(
+            "re", spilled, "logistic", opt, reg_weight=0.5,
+            device_budget_bytes=20_000,
+        ).train(offsets)
+        for a, b in zip(st_ram, st_disk):
+            np.testing.assert_array_equal(a, b)
+
+    def test_nonempty_storage_dir_refused(self, tmp_path):
+        X, y = self._data()
+        store = tmp_path / "store"
+        store.mkdir()
+        (store / "stale.npy").write_bytes(b"x")
+        with pytest.raises(ValueError, match="not empty"):
+            make_streaming_glm_data(
+                X, y, chunk_rows=256, use_pallas=False,
+                storage_dir=str(store),
+            )
