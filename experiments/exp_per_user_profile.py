@@ -3,6 +3,11 @@ and sensitivity to max_iters / history (the L-BFGS sequential step count).
 Replicates the bench's zipf workload exactly.
 
 Measured 2026-07-31 (round 4): tight bucket padding cut train 575 -> 383 ms (max_iters=10).
+Round 5 outcome: the per-bucket breakdown this experiment led to showed the
+small-R buckets launch-bound, not FLOPs-bound (E=27k R=4 cost 2x E=13k
+R=16) — landed as the batched damped-Newton block solver
+(game/coordinates.py newton_block; CG Hessian solves, HIGHEST-precision
+small einsums): train 290 -> 75 ms, GAME CD 2.25 -> 4.7 it/s.
 """
 import sys, time
 import numpy as np
