@@ -221,7 +221,7 @@ class TestStreamingFuzz:
     against the resident solver on the same data."""
 
     @pytest.mark.parametrize("seed", [11, 22, 33, 44])
-    def test_random_stream_fit_matches_resident(self, seed):
+    def test_random_stream_fit_matches_resident(self, seed, tmp_path):
         from photon_ml_tpu.data.dataset import make_glm_data
         from photon_ml_tpu.data.streaming import make_streaming_glm_data
         from photon_ml_tpu.optim.problem import (
@@ -276,6 +276,11 @@ class TestStreamingFuzz:
             X, y, chunk_rows=chunk_rows,
             use_pallas=bool(rng.integers(2)),
             depth_cap=32,
+            # Disk-backed residency is a pure re-residency of the same
+            # arrays — same tolerances, coin-flipped into the sweep.
+            storage_dir=(
+                str(tmp_path / "spill") if rng.integers(2) else None
+            ),
         )
         grid_s = streaming_run_grid(
             problem, stream, [lam],
@@ -287,4 +292,100 @@ class TestStreamingFuzz:
         np.testing.assert_allclose(
             w_s, w_r, atol=6e-3 * scale,
             err_msg=f"task={task} opt={optimizer} chunk_rows={chunk_rows}",
+        )
+
+
+class TestOutOfCoreRandomEffectFuzz:
+    """Seeded sweeps over the OOC random-effect surface: random entity
+    geometry × budget × plain/factored, each trained against the
+    resident coordinate on the same data (same solvers, different
+    residency — parity is the whole contract)."""
+
+    @pytest.mark.parametrize("seed", [5, 17, 29])
+    def test_random_geometry_matches_resident(self, seed, tmp_path):
+        from photon_ml_tpu.data.streaming import spill_random_effect_dataset
+        from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+        from photon_ml_tpu.game.data import build_random_effect_dataset
+        from photon_ml_tpu.game.factored import (
+            FactoredRandomEffectCoordinate,
+        )
+        from photon_ml_tpu.game.ooc_factored import (
+            OutOfCoreFactoredRandomEffectCoordinate,
+        )
+        from photon_ml_tpu.game.ooc_random import (
+            OutOfCoreRandomEffectCoordinate,
+        )
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        rng = np.random.default_rng(seed)
+        n_entities = int(rng.integers(20, 80))
+        d = int(rng.integers(2, 10))
+        cap = int(rng.integers(6, 40)) if rng.integers(2) else None
+        keys, rows_l, y_l = [], [], []
+        for e in range(n_entities):
+            n_e = int(np.clip(rng.zipf(1.6), 1, 60))
+            Xe = rng.normal(size=(n_e, d)).astype(np.float32)
+            m = Xe @ rng.normal(size=d).astype(np.float32)
+            keys.extend([f"e{e}"] * n_e)
+            rows_l.append(Xe)
+            y_l.append(
+                (rng.uniform(size=n_e) < 1 / (1 + np.exp(-m))).astype(
+                    np.float32
+                )
+            )
+        X = sp.csr_matrix(np.concatenate(rows_l))
+        y = np.concatenate(y_l)
+        w = np.ones_like(y)
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=20, tolerance=1e-7),
+            regularization=RegularizationContext.l2(),
+        )
+        kw = dict(max_rows_per_entity=cap, bucket_growth=2.0)
+        resident_ds = build_random_effect_dataset(keys, X, y, w, **kw)
+        host_ds = build_random_effect_dataset(
+            keys, X, y, w, device=False, **kw
+        )
+        if rng.integers(2):  # coin-flip the disk rung into the sweep
+            host_ds = spill_random_effect_dataset(
+                host_ds, str(tmp_path / "re")
+            )
+        budget = int(rng.integers(6_000, 60_000))
+        offsets = jnp.asarray(
+            rng.normal(size=len(y)).astype(np.float32) * 0.3
+        )
+        factored = bool(rng.integers(2))
+        if factored:
+            rank = int(rng.integers(1, min(d, 3) + 1))
+            res = FactoredRandomEffectCoordinate(
+                "re", resident_ds, "logistic", opt, rank=rank,
+                reg_weight=0.5, alternations=2, entity_key="k",
+            )
+            ooc = OutOfCoreFactoredRandomEffectCoordinate(
+                "re", host_ds, "logistic", opt, rank=rank,
+                reg_weight=0.5, alternations=2, entity_key="k",
+                device_budget_bytes=budget,
+            )
+            tol = dict(rtol=1e-2, atol=1e-2)
+        else:
+            res = RandomEffectCoordinate(
+                "re", resident_ds, "logistic", opt, reg_weight=0.5,
+            )
+            ooc = OutOfCoreRandomEffectCoordinate(
+                "re", host_ds, "logistic", opt, reg_weight=0.5,
+                device_budget_bytes=budget,
+            )
+            tol = dict(atol=1e-4)
+        st_r = res.train(offsets)
+        st_o = ooc.train(offsets)
+        np.testing.assert_allclose(
+            np.asarray(res.score(st_r)), np.asarray(ooc.score(st_o)),
+            err_msg=(
+                f"factored={factored} budget={budget} cap={cap} "
+                f"entities={n_entities} d={d}"
+            ),
+            **tol,
         )
