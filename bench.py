@@ -269,9 +269,11 @@ def bench_game_cd() -> dict:
     base = jnp.zeros(n, jnp.float32)
     _log("game: warmup iteration (compiles every bucket shape)...")
     warm = cd.run(base, n_iterations=1)  # warmup: compiles every bucket shape
-    # The CD loop's per-update float(score_norm) already forces readbacks,
-    # but sync explicitly anyway — same discipline as the GLM bench.
     _read_sync(warm.scores["per_user"])
+    # One untimed run at the TIMED shape: the first multi-iteration run
+    # after compile pays allocator/pipeline warm-in (~2x a steady rep —
+    # it alone put >100% spread on the 5-rep sample), steady state after.
+    _read_sync(cd.run(base, n_iterations=GAME_TIMED_ITERS).scores["per_user"])
     _log("game: warmup done; timing...")
 
     # Median over GAME_TIMED_RUNS runs of GAME_TIMED_ITERS iterations each,
@@ -410,6 +412,10 @@ def bench_game_multi_re() -> dict:
     _log("multire: warmup iteration (compiles every bucket shape)...")
     warm = cd.run(base, n_iterations=1)
     _read_sync(warm.scores["per_context"])
+    # Untimed run at the timed shape — same warm-in discipline as game_cd.
+    _read_sync(
+        cd.run(base, n_iterations=GAME_TIMED_ITERS).scores["per_context"]
+    )
     _log("multire: warmup done; timing...")
     per_iter = []
     for _ in range(GAME_TIMED_RUNS):

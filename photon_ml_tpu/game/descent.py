@@ -113,9 +113,35 @@ class CoordinateDescent:
                 scores[coord.name] = s
                 total = total + s
 
+        # score_norm stays a DEVICE scalar as long as possible: a host
+        # readback costs a full transport round trip (~0.1-0.4 s on a
+        # tunneled chip — it dominated the CD iteration when taken per
+        # update).  Entries and their norm scalars accumulate in
+        # ``pending`` and are flushed in ONE batched readback — per
+        # iteration when a logger/checkpointer needs values then (logs
+        # must carry the norm; checkpoints persist history), otherwise
+        # once at the END of the run, so the whole multi-iteration loop
+        # pipelines on the device with a single host sync.
+        pending: list[tuple[dict, Array]] = []
+
+        def flush():
+            if not pending:
+                return
+            norms = np.asarray(jnp.stack([n for _, n in pending]))
+            for (entry, _), norm in zip(pending, norms):
+                entry["score_norm"] = float(norm)
+                history.append(entry)
+                if logger is not None:
+                    logger.info(
+                        "CD iter %d coordinate %s: %s", entry["iteration"],
+                        entry["coordinate"],
+                        {k: v for k, v in entry.items()
+                         if k not in ("iteration", "coordinate")},
+                    )
+            pending.clear()
+
+        flush_per_iteration = logger is not None or checkpointer is not None
         for it in range(start_it, n_iterations):
-            iter_entries: list[dict] = []
-            iter_norms: list[Array] = []
             for coord in self.coordinates:
                 offsets = total - scores[coord.name]
                 state = coord.train(offsets, warm_state=states[coord.name])
@@ -124,27 +150,13 @@ class CoordinateDescent:
                 total = offsets + new_score
                 scores[coord.name] = new_score
 
-                # score_norm stays a DEVICE scalar here: a host readback per
-                # coordinate update costs a full transport round trip (~0.4 s
-                # on a tunneled chip — it dominated the CD iteration).  One
-                # batched readback per iteration amortizes it.
-                iter_norms.append(jnp.linalg.norm(new_score))
                 entry = {"iteration": it, "coordinate": coord.name}
                 if eval_fn is not None:
                     entry.update(eval_fn(it, coord.name, scores, states))
-                iter_entries.append(entry)
-            for entry, norm in zip(
-                iter_entries, np.asarray(jnp.stack(iter_norms))
-            ):
-                entry["score_norm"] = float(norm)
-                history.append(entry)
-                if logger is not None:
-                    logger.info(
-                        "CD iter %d coordinate %s: %s", it,
-                        entry["coordinate"],
-                        {k: v for k, v in entry.items()
-                         if k not in ("iteration", "coordinate")},
-                    )
+                pending.append((entry, jnp.linalg.norm(new_score)))
+            if flush_per_iteration:
+                flush()
             if checkpointer is not None:
                 checkpointer.save(it, total, scores, states, history)
+        flush()
         return CoordinateDescentResult(states=states, scores=scores, history=history)
