@@ -94,6 +94,7 @@ class EvaluationSuite:
         scores,
         labels,
         weights=None,
+        materialize: bool = True,
     ) -> dict:
         """name → metric value with the computation ON DEVICE: scores /
         labels / weights are (possibly sharded) device arrays, and only
@@ -105,6 +106,11 @@ class EvaluationSuite:
         to the host path with ONE array pullback, shared across all of
         them.  Grouped suites (``group_column`` set) must use
         :meth:`evaluate` — per-group metrics are host-side.
+
+        ``materialize=False`` leaves device-computed metrics as 0-d
+        device arrays (no readback here at all, unless a host-fallback
+        evaluator forces its pullback) — callers that batch readbacks,
+        like the CD loop's history flush, pull them later in one sync.
         """
         if self.group_column is not None:
             raise ValueError(
@@ -118,7 +124,8 @@ class EvaluationSuite:
         for name, ev in self.evaluators:
             fn = device_evaluator_fn(ev)
             if fn is not None:
-                out[name] = float(fn(scores, labels, weights))
+                m = fn(scores, labels, weights)
+                out[name] = float(m) if materialize else m
                 continue
             if host_pull is None:
                 host_pull = (

@@ -36,6 +36,27 @@ def _state_to_device(st):
 Array = jax.Array
 
 
+@dataclasses.dataclass(frozen=True)
+class _Deferred:
+    """Placeholder for a device scalar awaiting the batched flush."""
+
+    index: int
+
+
+def _walk_scalars(obj, pred, fn):
+    """Map ``fn`` over every leaf matching ``pred`` in nested dicts/lists
+    (history entries are plain JSON-ish data plus metric scalars; anything
+    else passes through untouched)."""
+    if pred(obj):
+        return fn(obj)
+    if isinstance(obj, dict):
+        return {k: _walk_scalars(v, pred, fn) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        vals = [_walk_scalars(v, pred, fn) for v in obj]
+        return tuple(vals) if isinstance(obj, tuple) else vals
+    return obj
+
+
 @dataclasses.dataclass
 class CoordinateDescentResult:
     states: dict  # coordinate name -> device state
@@ -113,23 +134,53 @@ class CoordinateDescent:
                 scores[coord.name] = s
                 total = total + s
 
-        # score_norm stays a DEVICE scalar as long as possible: a host
-        # readback costs a full transport round trip (~0.1-0.4 s on a
-        # tunneled chip — it dominated the CD iteration when taken per
-        # update).  Entries and their norm scalars accumulate in
+        # score_norm — and any DEVICE scalar an eval_fn left in its entry
+        # (the estimator's device-metrics path returns them unmaterialized
+        # for exactly this reason) — stays on device as long as possible:
+        # a host readback costs a full transport round trip (~0.1-0.4 s
+        # on a tunneled chip — it dominated the CD iteration when taken
+        # per update).  Entries and their scalars accumulate in
         # ``pending`` and are flushed in ONE batched readback — per
         # iteration when a logger/checkpointer needs values then (logs
-        # must carry the norm; checkpoints persist history), otherwise
-        # once at the END of the run, so the whole multi-iteration loop
+        # must carry them; checkpoints persist history), otherwise once
+        # at the END of the run, so the whole multi-iteration loop
         # pipelines on the device with a single host sync.
         pending: list[tuple[dict, Array]] = []
 
         def flush():
             if not pending:
                 return
-            norms = np.asarray(jnp.stack([n for _, n in pending]))
-            for (entry, _), norm in zip(pending, norms):
-                entry["score_norm"] = float(norm)
+            dev: list[Array] = []
+            staged: list[dict] = []
+            norm_at: list[int] = []
+            for entry, norm in pending:
+                staged.append(_walk_scalars(
+                    entry,
+                    # Floating 0-d scalars only: int/bool leaves (a user
+                    # eval_fn recording counts) would corrupt through a
+                    # float stack — they pass through untouched instead.
+                    lambda o: isinstance(o, jax.Array) and o.ndim == 0
+                    and jnp.issubdtype(o.dtype, jnp.floating),
+                    lambda a: (dev.append(a), _Deferred(len(dev) - 1))[1],
+                ))
+                norm_at.append(len(dev))
+                dev.append(norm)
+            # One stacked readback; stack at f64 under x64 so fp64 device
+            # metrics (device_auc computes in f64 there) keep full
+            # precision — f32→f64 casts are exact, and a per-leaf
+            # device_get would pay one transport round trip per scalar,
+            # the very cost this flush exists to amortize.
+            dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            vals = np.asarray(jnp.stack([jnp.asarray(v, dt) for v in dev]))
+            for (entry, _), filled, ni in zip(pending, staged, norm_at):
+                done = _walk_scalars(
+                    filled,
+                    lambda o: isinstance(o, _Deferred),
+                    lambda m: float(vals[m.index]),
+                )
+                entry.clear()
+                entry.update(done)
+                entry["score_norm"] = float(vals[ni])
                 history.append(entry)
                 if logger is not None:
                     logger.info(

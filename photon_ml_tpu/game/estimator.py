@@ -685,9 +685,13 @@ class GameEstimator:
         def eval_fn(it, cname, scores, states):
             if device_metrics:
                 # CD scores are already device arrays — sum them there.
+                # Device metrics stay 0-d DEVICE scalars in the entry:
+                # the CD history flush materializes them in its one
+                # batched readback (game/descent.py), so an evaluated
+                # update costs no extra host round trip here.
                 total = base_dev + sum(scores.values())
                 train_metric = (
-                    float(primary_dev(total, resp_dev, w_dev))
+                    primary_dev(total, resp_dev, w_dev)
                     if primary_dev is not None
                     else primary.evaluate(
                         np.asarray(total), response, w_host
@@ -737,7 +741,8 @@ class GameEstimator:
                         val_ctx["scores"].values()
                     )
                     metrics = suite.evaluate_device(
-                        v_total, val_ctx["resp_dev"], val_ctx["weight_dev"]
+                        v_total, val_ctx["resp_dev"], val_ctx["weight_dev"],
+                        materialize=False,
                     )
                 else:
                     v_total = val_ctx["base"] + np.sum(

@@ -167,6 +167,17 @@ class TestDeviceValidationWiring:
                 b["validation_metric"], abs=1e-5
             )
 
+    def test_history_metrics_materialized_to_floats(self):
+        """Device metrics ride the CD flush as 0-d device scalars
+        (estimator passes materialize=False) — but by the time fit()
+        returns, every history value must be a plain host float, nested
+        validation dicts included."""
+        for entry in self._fit(True):
+            for key in ("train_metric", "validation_metric", "score_norm"):
+                assert type(entry[key]) is float, (key, type(entry[key]))
+            for name, val in entry["validation"].items():
+                assert type(val) is float, (name, type(val))
+
     def test_mixed_suite_host_fallback(self):
         """Evaluators WITHOUT a device implementation still evaluate via
         one shared host pullback, alongside device ones.  Every built-in

@@ -629,3 +629,83 @@ class TestDim1Newton:
                     float(np.asarray(coefs)[lane, 0]), res.x, atol=2e-4,
                     err_msg=f"entity {key}",
                 )
+
+
+class TestDeferredNormFlush:
+    """The CD loop defers score_norm readbacks to ONE end-of-run sync when
+    nothing needs per-iteration values (game/descent.py flush) — history
+    must come out identical to the logger-driven per-iteration path."""
+
+    def _cd(self, rng):
+        from photon_ml_tpu.data.dataset import make_glm_data
+        from photon_ml_tpu.game.coordinates import (
+            FixedEffectCoordinate,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.game.data import FixedEffectDataset
+        from photon_ml_tpu.game.descent import CoordinateDescent
+
+        prob = _mixed_effects_problem(rng, n_users=12)
+        n = len(prob["response"])
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=15),
+            regularization=RegularizationContext.l2(),
+        )
+        fixed = FixedEffectCoordinate(
+            "fixed",
+            FixedEffectDataset(
+                data=make_glm_data(
+                    prob["shards"]["global"], prob["response"]
+                ),
+                n_global_rows=n,
+            ),
+            "logistic", opt, reg_weight=1.0,
+        )
+        re = RandomEffectCoordinate(
+            "per_user",
+            build_random_effect_dataset(
+                prob["ids"]["userId"], prob["shards"]["per_user"],
+                prob["response"], np.ones(n, np.float32),
+            ),
+            "logistic", opt, reg_weight=1.0, entity_key="userId",
+        )
+        return CoordinateDescent([fixed, re]), n
+
+    def test_history_matches_logger_path(self, rng, tmp_path):
+        from photon_ml_tpu.utils.logging import PhotonLogger
+
+        cd, n = self._cd(rng)
+        base = jnp.zeros(n, jnp.float32)
+        quiet = cd.run(base, n_iterations=3)
+        logged = cd.run(
+            base, n_iterations=3, logger=PhotonLogger(str(tmp_path))
+        )
+        assert len(quiet.history) == len(logged.history) == 6
+        for a, b in zip(quiet.history, logged.history):
+            assert (a["iteration"], a["coordinate"]) == (
+                b["iteration"], b["coordinate"],
+            )
+            assert a["score_norm"] == pytest.approx(
+                b["score_norm"], rel=1e-6
+            )
+            assert np.isfinite(a["score_norm"])
+        # The logger path logged one line per coordinate update.
+        log_text = (tmp_path / "photon.log").read_text()
+        assert log_text.count("score_norm") == 6
+
+    def test_history_ordered_per_update(self, rng):
+        cd, n = self._cd(rng)
+        result = cd.run(jnp.zeros(n, jnp.float32), n_iterations=2)
+        assert [
+            (h["iteration"], h["coordinate"]) for h in result.history
+        ] == [
+            (0, "fixed"), (0, "per_user"), (1, "fixed"), (1, "per_user"),
+        ]
+
+    def test_empty_coordinate_list(self):
+        from photon_ml_tpu.game.descent import CoordinateDescent
+
+        result = CoordinateDescent([]).run(
+            jnp.zeros(7, jnp.float32), n_iterations=2
+        )
+        assert result.history == [] and result.scores == {}
