@@ -41,6 +41,7 @@ class _Deferred:
     """Placeholder for a device scalar awaiting the batched flush."""
 
     index: int
+    kind: str  # "f" float, "i" int, "b" bool — per-dtype readback stacks
 
 
 def _walk_scalars(obj, pred, fn):
@@ -145,42 +146,58 @@ class CoordinateDescent:
         # must carry them; checkpoints persist history), otherwise once
         # at the END of the run, so the whole multi-iteration loop
         # pipelines on the device with a single host sync.
-        pending: list[tuple[dict, Array]] = []
+        pending: list[dict] = []
 
         def flush():
             if not pending:
                 return
-            dev: list[Array] = []
-            staged: list[dict] = []
-            norm_at: list[int] = []
-            for entry, norm in pending:
-                staged.append(_walk_scalars(
-                    entry,
-                    # Floating 0-d scalars only: int/bool leaves (a user
-                    # eval_fn recording counts) would corrupt through a
-                    # float stack — they pass through untouched instead.
-                    lambda o: isinstance(o, jax.Array) and o.ndim == 0
-                    and jnp.issubdtype(o.dtype, jnp.floating),
-                    lambda a: (dev.append(a), _Deferred(len(dev) - 1))[1],
-                ))
-                norm_at.append(len(dev))
-                dev.append(norm)
-            # One stacked readback; stack at f64 under x64 so fp64 device
+            # Floating scalars stack at f64 under x64 so fp64 device
             # metrics (device_auc computes in f64 there) keep full
-            # precision — f32→f64 casts are exact, and a per-leaf
-            # device_get would pay one transport round trip per scalar,
-            # the very cost this flush exists to amortize.
-            dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-            vals = np.asarray(jnp.stack([jnp.asarray(v, dt) for v in dev]))
-            for (entry, _), filled, ni in zip(pending, staged, norm_at):
+            # precision — f32→f64 casts are exact.  Int/bool scalars (a
+            # user eval_fn recording counts/flags) would corrupt through
+            # a float stack, so they ride a second int64 stack — paid
+            # only when one exists.  A per-leaf device_get would pay one
+            # transport round trip per scalar, the very cost this flush
+            # amortizes.
+            x64 = jax.config.jax_enable_x64
+            fdt = jnp.float64 if x64 else jnp.float32
+            idt = jnp.int64 if x64 else jnp.int32  # widest available
+            stacks = {"f": [], "i": [], "b": []}
+
+            def grab(a):
+                kind = (
+                    "f" if jnp.issubdtype(a.dtype, jnp.floating)
+                    else "b" if a.dtype == jnp.bool_
+                    else "i"
+                )
+                stack = stacks[kind]
+                stack.append(a)
+                return _Deferred(len(stack) - 1, kind)
+
+            staged = [
+                _walk_scalars(
+                    entry,
+                    lambda o: isinstance(o, jax.Array) and o.ndim == 0,
+                    grab,
+                )
+                for entry in pending
+            ]
+            vals = {
+                k: np.asarray(jnp.stack([
+                    jnp.asarray(v, fdt if k == "f" else idt)
+                    for v in stack
+                ]))
+                for k, stack in stacks.items() if stack
+            }
+            cast = {"f": float, "i": int, "b": bool}
+            for entry, filled in zip(pending, staged):
                 done = _walk_scalars(
                     filled,
                     lambda o: isinstance(o, _Deferred),
-                    lambda m: float(vals[m.index]),
+                    lambda m: cast[m.kind](vals[m.kind][m.index]),
                 )
                 entry.clear()
                 entry.update(done)
-                entry["score_norm"] = float(vals[ni])
                 history.append(entry)
                 if logger is not None:
                     logger.info(
@@ -204,7 +221,10 @@ class CoordinateDescent:
                 entry = {"iteration": it, "coordinate": coord.name}
                 if eval_fn is not None:
                     entry.update(eval_fn(it, coord.name, scores, states))
-                pending.append((entry, jnp.linalg.norm(new_score)))
+                # The norm is just another deferred floating scalar —
+                # the flush walk materializes it with the metrics.
+                entry["score_norm"] = jnp.linalg.norm(new_score)
+                pending.append(entry)
             if flush_per_iteration:
                 flush()
             if checkpointer is not None:
