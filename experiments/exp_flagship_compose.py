@@ -1,0 +1,124 @@
+"""Flagship composed-shape chip drive: BASELINE config 5 through the API.
+
+One `GameEstimator.fit` over fixed + per_user + per_item + per_context
+coordinates at the bench's chip-scale geometry (646k rows, zipf users and
+items, few heavy capped contexts), with the context coordinate trained
+OUT-OF-CORE under a deliberately small device budget and per-update
+train/validation metrics computed ON DEVICE (scalars-only pullback,
+riding the CD flush's single batched readback).  This is the shape the
+north star cares about, driven end-to-end through the public estimator
+API on the real chip — not a hand-assembled CoordinateDescent.
+
+Round-5 continuation session result (chip 25-27 GB/s, RT ~105 ms):
+see the printout recorded in ROUND5.md.
+"""
+
+import sys
+import time
+
+import numpy as np
+import scipy.sparse as sp
+
+sys.path.insert(0, "/root/repo")
+
+from photon_ml_tpu.game.estimator import (  # noqa: E402
+    FixedEffectCoordinateConfig,
+    GameEstimator,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.optim.problem import (  # noqa: E402
+    GlmOptimizationConfig,
+    OptimizerConfig,
+)
+from photon_ml_tpu.optim.regularization import RegularizationContext  # noqa: E402
+
+rng = np.random.default_rng(3)
+ENTITIES, ROW_CAP, RE_DIM = 100_000, 128, 8
+FIXED_FEATURES, FIXED_NNZ = 512, 8
+
+sizes = np.minimum(rng.zipf(1.8, ENTITIES), ROW_CAP)
+n = int(sizes.sum())
+users = np.repeat(
+    np.array([f"u{i}" for i in range(ENTITIES)], dtype=object), sizes
+)[rng.permutation(n)]
+n_items = ENTITIES // 5
+item_pool = np.repeat(
+    np.array([f"i{i}" for i in range(n_items)], dtype=object),
+    np.minimum(rng.zipf(1.5, n_items), 4 * ROW_CAP),
+)
+items = item_pool[rng.integers(0, len(item_pool), size=n)]
+contexts = np.array([f"c{rng.integers(200)}" for _ in range(n)], dtype=object)
+
+nnzf = n * FIXED_NNZ
+Xg = sp.csr_matrix(
+    (rng.normal(size=nnzf).astype(np.float32),
+     (np.repeat(np.arange(n, dtype=np.int64), FIXED_NNZ),
+      rng.integers(0, FIXED_FEATURES, size=nnzf))),
+    shape=(n, FIXED_FEATURES),
+)
+y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+shards = {
+    "global": Xg,
+    "user": sp.csr_matrix(rng.normal(size=(n, RE_DIM)).astype(np.float32)),
+    "item": sp.csr_matrix(rng.normal(size=(n, RE_DIM)).astype(np.float32)),
+    "ctx": sp.csr_matrix(rng.normal(size=(n, RE_DIM)).astype(np.float32)),
+}
+ids = {"userId": users, "itemId": items, "ctxId": contexts}
+
+opt = GlmOptimizationConfig(
+    optimizer=OptimizerConfig(max_iters=10, tolerance=1e-6),
+    regularization=RegularizationContext.l2(),
+)
+configs = {
+    "fixed": FixedEffectCoordinateConfig("global", opt, reg_weight=1.0),
+    "per_user": RandomEffectCoordinateConfig(
+        "user", "userId", opt, reg_weight=1.0
+    ),
+    "per_item": RandomEffectCoordinateConfig(
+        "item", "itemId", opt, reg_weight=1.0
+    ),
+    # The context coordinate trains OUT-OF-CORE: 8 MiB budget forces
+    # multiple budget-bounded pass groups through HBM.
+    "per_context": RandomEffectCoordinateConfig(
+        "ctx", "ctxId", opt, reg_weight=1.0, max_rows_per_entity=256,
+        device_budget_bytes=8 << 20,
+    ),
+}
+
+def one_fit(cfgs, n_iter):
+    est = GameEstimator(
+        "logistic", cfgs, n_iterations=n_iter, device_metrics=True
+    )
+    t0 = time.perf_counter()
+    model, history = est.fit(
+        shards, ids, y, validation=(shards, ids, y)
+    )
+    return time.perf_counter() - t0, model, history
+
+
+resident = dict(configs)
+resident["per_context"] = RandomEffectCoordinateConfig(
+    "ctx", "ctxId", opt, reg_weight=1.0, max_rows_per_entity=256,
+)
+
+print(f"{n} rows; fixed {FIXED_FEATURES}f/{FIXED_NNZ}nnz; "
+      f"user/item/ctx REs; device metrics on; 3 CD iterations, "
+      "validated per update")
+for label, cfgs in (("resident ctx", resident), ("OOC ctx (8 MiB)", configs)):
+    one_fit(cfgs, 3)  # compile + warm-in
+    walls = []
+    for _ in range(3):
+        wall, model, hist = one_fit(cfgs, 3)
+        walls.append(wall)
+    # The whole-fit wall is what an API user experiences: host grouping
+    # + h2d + 12 validated coordinate updates.  Transfer rates through
+    # the tunnel swing minute-to-minute, hence the median of 3; the
+    # OOC-vs-resident gap is the context dataset re-crossing h2d every
+    # pass (~100x cheaper on PCIe-attached production hosts).
+    per_update = [h for h in hist if "validation_metric" in h]
+    print(f"{label}: fit wall median {np.median(walls):.1f}s "
+          f"(runs {', '.join(f'{w:.1f}' for w in walls)}); "
+          f"train/val AUC {hist[-1]['train_metric']:.4f}/"
+          f"{hist[-1]['validation_metric']:.4f}")
+    assert len(hist) == 3 * 4
+    assert all(type(h["validation_metric"]) is float for h in per_update)

@@ -71,6 +71,36 @@ class Coordinate:
         raise NotImplementedError
 
 
+@functools.lru_cache(maxsize=None)
+def _fixed_effect_jits(
+    task: str, config: GlmOptimizationConfig, axis_name: Optional[str]
+):
+    """Jitted (train, score) programs for a fixed-effect coordinate,
+    memoized PROCESS-WIDE on (task, config, axis_name) like
+    ``_make_block_solver``: per-instance ``jax.jit`` closures meant every
+    new coordinate object — a second ``fit``, every ``fit_grid`` point, a
+    fresh estimator in the same process — re-traced and re-COMPILED
+    identical programs (~3 s each on the chip, 41 of 72 s of a repeat
+    flagship fit)."""
+    from photon_ml_tpu.optim.problem import GlmOptimizationProblem
+
+    problem = GlmOptimizationProblem(task, config)
+
+    # Dataset AND reg_weight are jit ARGUMENTS (not closure constants):
+    # closures bake them into the HLO, forcing recompiles per dataset /
+    # per tuning point and oversized programs.  Hyperparameter tuning
+    # mutates reg_weight between runs at zero recompile cost.
+    def _train(data: GlmData, offsets: Array, w0: Array, reg_weight: Array):
+        data = dataclasses.replace(data, offsets=offsets)
+        return problem.solve(data, reg_weight, w0, axis_name=axis_name).w
+
+    def _score(data: GlmData, w: Array) -> Array:
+        # Margin WITHOUT offsets: coordinate scores are additive pieces.
+        return data.features.matvec(w)
+
+    return jax.jit(_train), jax.jit(_score)
+
+
 class FixedEffectCoordinate(Coordinate):
     """Reference: ``FixedEffectCoordinate`` — DistributedOptimizationProblem
     over the full dataset (SURVEY.md §3.2)."""
@@ -94,23 +124,9 @@ class FixedEffectCoordinate(Coordinate):
         self.reg_weight = reg_weight
         self.feature_shard = feature_shard
         self.axis_name = axis_name
-
-        # Dataset AND reg_weight are jit ARGUMENTS (not closure constants):
-        # closures bake them into the HLO, forcing recompiles per dataset /
-        # per tuning point and oversized programs.  Hyperparameter tuning
-        # mutates self.reg_weight between runs at zero recompile cost.
-        def _train(data: GlmData, offsets: Array, w0: Array, reg_weight: Array):
-            data = dataclasses.replace(data, offsets=offsets)
-            return self.problem.solve(
-                data, reg_weight, w0, axis_name=self.axis_name
-            ).w
-
-        def _score(data: GlmData, w: Array) -> Array:
-            # Margin WITHOUT offsets: coordinate scores are additive pieces.
-            return data.features.matvec(w)
-
-        self._train_jit = jax.jit(_train)
-        self._score_jit = jax.jit(_score)
+        self._train_jit, self._score_jit = _fixed_effect_jits(
+            self.task, config, axis_name
+        )
 
     def train(self, offsets: Array, warm_state: Optional[Array] = None) -> Array:
         w0 = (
@@ -146,8 +162,15 @@ class FixedEffectCoordinate(Coordinate):
         return FixedEffectValidationScorer(shards[self.feature_shard])
 
 
-@functools.lru_cache(maxsize=None)
 def _make_block_solver(task: str, config: GlmOptimizationConfig):
+    """Canonicalize the task name before the cache lookup: raw aliases
+    ("logistic_regression") and the canonical name ("logistic") must hit
+    ONE cache entry, or every bucket shape compiles twice."""
+    return _make_block_solver_cached(losses_lib.get(task).name, config)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_block_solver_cached(task: str, config: GlmOptimizationConfig):
     """Build a jitted (block, offsets, w0, l1, l2) → (E, D) batched solver.
 
     Optimizer dispatch: any L1 component (static on the regularization
@@ -450,6 +473,55 @@ def _gather_block_offsets(offsets: Array, block: EntityBlock) -> Array:
     return jnp.take(padded, block.row_index, axis=0)
 
 
+@functools.lru_cache(maxsize=None)
+def _re_train_all_jit(task: str, config: GlmOptimizationConfig):
+    """ONE jitted program for ALL buckets: per-bucket dispatches each pay
+    a host→device round trip, which on a tunneled chip (~0.1-0.2 s each)
+    dominated the whole coordinate update for long-tailed datasets with
+    many buckets.  Bucket shapes differ but are static, so a single trace
+    inlines every bucket's solver into one HLO.  Memoized PROCESS-WIDE on
+    (task, config) like ``_make_block_solver`` — per-instance jits meant
+    every new coordinate object (a second fit, a grid point, a fresh
+    estimator) re-traced and re-compiled identical programs."""
+    solver = _make_block_solver(task, config)
+
+    def _train_all(blocks, offsets, w0s, l1, l2):
+        return [
+            solver(b, _gather_block_offsets(offsets, b), w0, l1, l2)
+            for b, w0 in zip(blocks, w0s)
+        ]
+
+    return jax.jit(_train_all)
+
+
+@functools.lru_cache(maxsize=32)
+def _re_score_all_jit(n_rows: int):
+    """One jitted scoring scatter over all buckets (active + passive),
+    memoized on the global row count.  BOUNDED (unlike the
+    (task, config)-keyed caches, whose key space is small): row counts
+    vary per dataset/fold, and an unbounded cache would pin one compiled
+    program per distinct size for process lifetime."""
+
+    def _score_all(blocks, passive_blocks, coefs_list):
+        total = jnp.zeros((n_rows + 1,), jnp.float32)
+        passive = passive_blocks or [None] * len(blocks)
+        for block, passive_block, coefs in zip(blocks, passive, coefs_list):
+            s = jnp.einsum("erd,ed->er", block.X, coefs)
+            # Padding rows (sentinel index) scatter into the trailing slot.
+            total = total.at[block.row_index.ravel()].add(s.ravel())
+            if passive_block is not None:
+                # Active/passive split: capped-out rows are never trained
+                # on but MUST be scored, or other coordinates would see
+                # offsets missing this coordinate's contribution there.
+                sp_ = jnp.einsum("erd,ed->er", passive_block.X, coefs)
+                total = total.at[passive_block.row_index.ravel()].add(
+                    sp_.ravel()
+                )
+        return total[:n_rows]
+
+    return jax.jit(_score_all)
+
+
 class RandomEffectCoordinate(Coordinate):
     """Reference: ``RandomEffectCoordinate`` — per-entity solves, batched.
 
@@ -475,44 +547,8 @@ class RandomEffectCoordinate(Coordinate):
         self.feature_shard = feature_shard
         self.entity_key = entity_key or name
         self._solver = _make_block_solver(task, config)
-
-        # ONE jitted program for ALL buckets (and one for scoring): per-
-        # bucket dispatches each pay a host→device round trip, which on a
-        # tunneled chip (~0.1-0.2 s each) dominated the whole coordinate
-        # update for long-tailed datasets with many buckets.  Bucket shapes
-        # differ but are static, so a single trace inlines every bucket's
-        # solver into one HLO.
-        solver = self._solver
-
-        def _train_all(blocks, offsets, w0s, l1, l2):
-            return [
-                solver(b, _gather_block_offsets(offsets, b), w0, l1, l2)
-                for b, w0 in zip(blocks, w0s)
-            ]
-
-        n_rows = dataset.n_global_rows
-
-        def _score_all(blocks, passive_blocks, coefs_list):
-            total = jnp.zeros((n_rows + 1,), jnp.float32)
-            passive = passive_blocks or [None] * len(blocks)
-            for block, passive_block, coefs in zip(blocks, passive, coefs_list):
-                s = jnp.einsum("erd,ed->er", block.X, coefs)
-                # Padding rows (sentinel index) scatter into the trailing slot.
-                total = total.at[block.row_index.ravel()].add(s.ravel())
-                if passive_block is not None:
-                    # Active/passive split: capped-out rows are never trained
-                    # on but MUST be scored, or other coordinates would see
-                    # offsets missing this coordinate for those rows.
-                    sp_ = jnp.einsum(
-                        "erd,ed->er", passive_block.X, coefs
-                    )
-                    total = total.at[passive_block.row_index.ravel()].add(
-                        sp_.ravel()
-                    )
-            return total[:n_rows]
-
-        self._train_all_jit = jax.jit(_train_all)
-        self._score_all_jit = jax.jit(_score_all)
+        self._train_all_jit = _re_train_all_jit(self.task, config)
+        self._score_all_jit = _re_score_all_jit(dataset.n_global_rows)
 
     def train(self, offsets: Array, warm_state=None) -> list[Array]:
         l1 = jnp.asarray(

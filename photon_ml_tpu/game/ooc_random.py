@@ -31,6 +31,7 @@ with zero communication.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
@@ -77,6 +78,42 @@ def _lane_bytes(block: EntityBlock, passive: Optional[EntityBlock]) -> int:
         rp = passive.rows_per_entity
         psv = 4 * (rp * d + 3 * rp)  # Xp, labels/weights/row_index
     return active + out + psv
+
+
+@functools.lru_cache(maxsize=None)
+def _ooc_slice_jits(task: str, config: GlmOptimizationConfig):
+    solver = _make_block_solver(task, config)
+    loss = losses_lib.get(task)
+
+    def _solve_slice(block, offsets, w0, l1, l2):
+        return solver(
+            block, _gather_block_offsets(offsets, block), w0, l1, l2
+        )
+
+    def _var_slice(block, coefs, offsets, l2):
+        off_b = _gather_block_offsets(offsets, block)
+        m = jnp.einsum("erd,ed->er", block.X, coefs) + off_b
+        d2w = block.weights * loss.d2(m, block.labels)
+        diag = jnp.einsum("er,erd->ed", d2w, block.X * block.X) + l2
+        return 1.0 / jnp.maximum(diag, 1e-12)
+
+    return jax.jit(_solve_slice), jax.jit(_var_slice)
+
+
+@functools.lru_cache(maxsize=None)
+def _ooc_score_jit():
+    def _score_slice(total, X, row_index, coefs):
+        s = jnp.einsum("erd,ed->er", X, coefs)
+        return total.at[row_index.ravel()].add(s.ravel())
+
+    # total is donated: each pass group's scatter reuses the buffer
+    # instead of allocating a second (n_rows+1) array per step.
+    return jax.jit(_score_slice, donate_argnums=0)
+
+
+@functools.lru_cache(maxsize=32)  # size-keyed: bounded (see coordinates.py)
+def _ooc_zeros_jit(n_rows: int):
+    return jax.jit(lambda: jnp.zeros((n_rows + 1,), jnp.float32))
 
 
 def _host_leaf(x) -> np.ndarray:
@@ -179,35 +216,12 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         #: the solving group plus the prefetched next one).
         self.live_groups_high_water = 0
 
-        n_rows = dataset.n_global_rows
-        solver = self._solver
-
-        def _solve_slice(block, offsets, w0, l1, l2):
-            return solver(
-                block, _gather_block_offsets(offsets, block), w0, l1, l2
-            )
-
-        def _score_slice(total, X, row_index, coefs):
-            s = jnp.einsum("erd,ed->er", X, coefs)
-            return total.at[row_index.ravel()].add(s.ravel())
-
-        loss = losses_lib.get(self.task)
-
-        def _var_slice(block, coefs, offsets, l2):
-            off_b = _gather_block_offsets(offsets, block)
-            m = jnp.einsum("erd,ed->er", block.X, coefs) + off_b
-            d2w = block.weights * loss.d2(m, block.labels)
-            diag = jnp.einsum("er,erd->ed", d2w, block.X * block.X) + l2
-            return 1.0 / jnp.maximum(diag, 1e-12)
-
-        self._solve_jit = jax.jit(_solve_slice)
-        self._var_jit = jax.jit(_var_slice)
-        # total is donated: each pass group's scatter reuses the buffer
-        # instead of allocating a second (n_rows+1) array per step.
-        self._score_jit = jax.jit(_score_slice, donate_argnums=0)
-        self._zeros_jit = jax.jit(
-            lambda: jnp.zeros((n_rows + 1,), jnp.float32)
-        )
+        # Process-wide memoized programs (per-instance jits re-compiled
+        # identical HLO for every new coordinate — each fit, grid point,
+        # or fresh estimator).
+        self._solve_jit, self._var_jit = _ooc_slice_jits(self.task, config)
+        self._score_jit = _ooc_score_jit()
+        self._zeros_jit = _ooc_zeros_jit(dataset.n_global_rows)
 
     # -- pass planning -----------------------------------------------------
 

@@ -26,6 +26,7 @@ dataset's entity layout, not on the coefficients).
 
 from __future__ import annotations
 
+import functools
 from typing import Sequence
 
 import jax
@@ -38,6 +39,33 @@ from photon_ml_tpu.game.data import (
 )
 
 Array = jax.Array
+
+
+@jax.jit
+def _fixed_matvec(features, w):
+    return features.matvec(w)
+
+
+@functools.lru_cache(maxsize=32)  # size-keyed: bounded (see coordinates.py)
+def _re_val_score_jit(n_val: int):
+    """Jitted static-gather validation scorer, memoized on the
+    validation row count (per-instance jits re-compiled identical
+    programs for every scorer — one per coordinate per fit)."""
+
+    def _score(state, blocks, gidxs):
+        flat = jnp.concatenate(
+            [s.ravel() for s in state] + [jnp.zeros((1,), jnp.float32)]
+        )
+        total_scores = jnp.zeros((n_val + 1,), jnp.float32)
+        for vb, gidx in zip(blocks, gidxs):
+            coefs = jnp.take(flat, gidx, axis=0)  # (E_v, D_v)
+            s = jnp.einsum("erd,ed->er", vb.X, coefs)
+            total_scores = total_scores.at[vb.row_index.ravel()].add(
+                s.ravel()
+            )
+        return total_scores[:n_val]
+
+    return jax.jit(_score)
 
 
 class FixedEffectValidationScorer:
@@ -59,10 +87,9 @@ class FixedEffectValidationScorer:
             self._features = DenseMatrix(
                 jnp.asarray(np.asarray(val_shard), jnp.float32)
             )
-        self._matvec = jax.jit(lambda f, w: f.matvec(w))
 
     def score(self, state: Array) -> Array:
-        return self._matvec(self._features, state)
+        return _fixed_matvec(self._features, state)
 
 
 def _flat_layout(state_shapes: Sequence[tuple[int, int]]):
@@ -134,21 +161,7 @@ class RandomEffectValidationScorer:
 
         self._val_blocks = val_ds.blocks
         self._gather_idxs = gather_idxs
-
-        def _score(state, blocks, gidxs):
-            flat = jnp.concatenate(
-                [s.ravel() for s in state] + [jnp.zeros((1,), jnp.float32)]
-            )
-            total_scores = jnp.zeros((n_val + 1,), jnp.float32)
-            for vb, gidx in zip(blocks, gidxs):
-                coefs = jnp.take(flat, gidx, axis=0)  # (E_v, D_v)
-                s = jnp.einsum("erd,ed->er", vb.X, coefs)
-                total_scores = total_scores.at[vb.row_index.ravel()].add(
-                    s.ravel()
-                )
-            return total_scores[:n_val]
-
-        self._score_jit = jax.jit(_score)
+        self._score_jit = _re_val_score_jit(n_val)
 
     def score(self, state: list[Array]) -> Array:
         return self._score_jit(state, self._val_blocks, self._gather_idxs)

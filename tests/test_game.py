@@ -709,3 +709,38 @@ class TestDeferredNormFlush:
             jnp.zeros(7, jnp.float32), n_iterations=2
         )
         assert result.history == [] and result.scores == {}
+
+
+class TestBuilderDegenerateInputs:
+    def test_all_zero_kept_rows_with_passive_features(self):
+        """Capped entity whose KEPT (linspace) rows are all-zero while its
+        passive rows carry features: the active-pair table is empty, every
+        passive feature drops (projection onto an empty active subspace),
+        and the build must not crash."""
+        import scipy.sparse as sp
+
+        X = np.zeros((5, 3), np.float32)
+        X[1:4] = 1.0  # rows 0 and 4 (the linspace keeps for cap=2) empty
+        ds = build_random_effect_dataset(
+            np.array(["e"] * 5, dtype=object), sp.csr_matrix(X),
+            np.zeros(5, np.float32), np.ones(5, np.float32),
+            max_rows_per_entity=2, device=False,
+        )
+        assert len(ds.blocks) == 1
+        assert np.all(np.asarray(ds.blocks[0].col_map) == -1)
+        pb = ds.passive_blocks[0]
+        assert pb is not None
+        # Passive rows are present (scored) but their features dropped.
+        assert np.all(np.asarray(pb.X) == 0)
+        assert sorted(np.asarray(pb.row_index).ravel()[:3].tolist()) == [1, 2, 3]
+
+    def test_task_alias_shares_solver_cache(self):
+        from photon_ml_tpu.game.coordinates import _make_block_solver
+
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=5),
+            regularization=RegularizationContext.l2(),
+        )
+        assert _make_block_solver("logistic_regression", opt) is (
+            _make_block_solver("logistic", opt)
+        )
