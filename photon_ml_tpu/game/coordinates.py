@@ -71,17 +71,31 @@ class Coordinate:
         raise NotImplementedError
 
 
-@functools.lru_cache(maxsize=None)
+def _layout_sig(tree) -> tuple:
+    """Hashable shape/dtype signature of a pytree of arrays.  Program
+    caches key on it purely as an EVICTION GRANULE: ``jax.jit`` retraces
+    per shape signature anyway, but without the sig in the lru key one
+    shared wrapper would accumulate an executable per distinct dataset
+    layout for process lifetime — keying (and bounding) on the layout
+    lets old layouts' compiled programs be dropped with their entry."""
+    return tuple(
+        (tuple(leaf.shape), str(leaf.dtype))
+        for leaf in jax.tree.leaves(tree)
+    )
+
+
+@functools.lru_cache(maxsize=64)
 def _fixed_effect_jits(
-    task: str, config: GlmOptimizationConfig, axis_name: Optional[str]
+    task: str, config: GlmOptimizationConfig, axis_name: Optional[str],
+    data_sig: tuple,
 ):
     """Jitted (train, score) programs for a fixed-effect coordinate,
-    memoized PROCESS-WIDE on (task, config, axis_name) like
-    ``_make_block_solver``: per-instance ``jax.jit`` closures meant every
-    new coordinate object — a second ``fit``, every ``fit_grid`` point, a
-    fresh estimator in the same process — re-traced and re-COMPILED
-    identical programs (~3 s each on the chip, 41 of 72 s of a repeat
-    flagship fit)."""
+    memoized PROCESS-WIDE on (task, config, axis_name) plus the
+    dataset's layout signature, like ``_make_block_solver``: per-instance
+    ``jax.jit`` closures meant every new coordinate object — a second
+    ``fit``, every ``fit_grid`` point, a fresh estimator in the same
+    process — re-traced and re-COMPILED identical programs (~3 s each on
+    the chip, 41 of 72 s of a repeat flagship fit)."""
     from photon_ml_tpu.optim.problem import GlmOptimizationProblem
 
     problem = GlmOptimizationProblem(task, config)
@@ -125,7 +139,7 @@ class FixedEffectCoordinate(Coordinate):
         self.feature_shard = feature_shard
         self.axis_name = axis_name
         self._train_jit, self._score_jit = _fixed_effect_jits(
-            self.task, config, axis_name
+            self.task, config, axis_name, _layout_sig(dataset.data)
         )
 
     def train(self, offsets: Array, warm_state: Optional[Array] = None) -> Array:
@@ -473,16 +487,20 @@ def _gather_block_offsets(offsets: Array, block: EntityBlock) -> Array:
     return jnp.take(padded, block.row_index, axis=0)
 
 
-@functools.lru_cache(maxsize=None)
-def _re_train_all_jit(task: str, config: GlmOptimizationConfig):
+@functools.lru_cache(maxsize=64)
+def _re_train_all_jit(
+    task: str, config: GlmOptimizationConfig, layout_sig: tuple
+):
     """ONE jitted program for ALL buckets: per-bucket dispatches each pay
     a host→device round trip, which on a tunneled chip (~0.1-0.2 s each)
     dominated the whole coordinate update for long-tailed datasets with
     many buckets.  Bucket shapes differ but are static, so a single trace
     inlines every bucket's solver into one HLO.  Memoized PROCESS-WIDE on
-    (task, config) like ``_make_block_solver`` — per-instance jits meant
-    every new coordinate object (a second fit, a grid point, a fresh
-    estimator) re-traced and re-compiled identical programs."""
+    (task, config, dataset layout) like ``_make_block_solver`` —
+    per-instance jits meant every new coordinate object (a second fit, a
+    grid point, a fresh estimator) re-traced and re-compiled identical
+    programs.  ``layout_sig`` is unused inside: it is the eviction
+    granule (see ``_layout_sig``)."""
     solver = _make_block_solver(task, config)
 
     def _train_all(blocks, offsets, w0s, l1, l2):
@@ -494,13 +512,12 @@ def _re_train_all_jit(task: str, config: GlmOptimizationConfig):
     return jax.jit(_train_all)
 
 
-@functools.lru_cache(maxsize=32)
-def _re_score_all_jit(n_rows: int):
+@functools.lru_cache(maxsize=64)
+def _re_score_all_jit(n_rows: int, layout_sig: tuple):
     """One jitted scoring scatter over all buckets (active + passive),
-    memoized on the global row count.  BOUNDED (unlike the
-    (task, config)-keyed caches, whose key space is small): row counts
+    memoized on (global row count, dataset layout).  BOUNDED: layouts
     vary per dataset/fold, and an unbounded cache would pin one compiled
-    program per distinct size for process lifetime."""
+    program per distinct layout for process lifetime."""
 
     def _score_all(blocks, passive_blocks, coefs_list):
         total = jnp.zeros((n_rows + 1,), jnp.float32)
@@ -547,8 +564,9 @@ class RandomEffectCoordinate(Coordinate):
         self.feature_shard = feature_shard
         self.entity_key = entity_key or name
         self._solver = _make_block_solver(task, config)
-        self._train_all_jit = _re_train_all_jit(self.task, config)
-        self._score_all_jit = _re_score_all_jit(dataset.n_global_rows)
+        sig = _layout_sig((dataset.blocks, dataset.passive_blocks))
+        self._train_all_jit = _re_train_all_jit(self.task, config, sig)
+        self._score_all_jit = _re_score_all_jit(dataset.n_global_rows, sig)
 
     def train(self, offsets: Array, warm_state=None) -> list[Array]:
         l1 = jnp.asarray(

@@ -80,8 +80,14 @@ def _lane_bytes(block: EntityBlock, passive: Optional[EntityBlock]) -> int:
     return active + out + psv
 
 
-@functools.lru_cache(maxsize=None)
-def _ooc_slice_jits(task: str, config: GlmOptimizationConfig):
+@functools.lru_cache(maxsize=64)
+def _ooc_slice_jits(
+    task: str, config: GlmOptimizationConfig, slice_sig: tuple
+):
+    # slice_sig is unused inside — it is the cache's eviction granule
+    # (see coordinates._layout_sig): slice shapes vary per dataset/plan,
+    # and one shared wrapper would otherwise pin an executable per
+    # distinct layout for process lifetime.
     solver = _make_block_solver(task, config)
     loss = losses_lib.get(task)
 
@@ -219,7 +225,15 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         # Process-wide memoized programs (per-instance jits re-compiled
         # identical HLO for every new coordinate — each fit, grid point,
         # or fresh estimator).
-        self._solve_jit, self._var_jit = _ooc_slice_jits(self.task, config)
+        slice_sig = tuple(sorted({
+            (s.padded_e,
+             dataset.blocks[s.block_idx].rows_per_entity,
+             dataset.blocks[s.block_idx].block_dim)
+            for group in self.pass_plan for s in group
+        }))
+        self._solve_jit, self._var_jit = _ooc_slice_jits(
+            self.task, config, slice_sig
+        )
         self._score_jit = _ooc_score_jit()
         self._zeros_jit = _ooc_zeros_jit(dataset.n_global_rows)
 
