@@ -480,6 +480,23 @@ def _make_block_solver_cached(task: str, config: GlmOptimizationConfig):
     return solve_block
 
 
+def pack_entity_tables(cmap: np.ndarray, w: np.ndarray, var=None):
+    """Per-lane (cols, vals[, variances]) lists for the host model table:
+    one bulk mask + ``np.split`` instead of several numpy calls per lane
+    (which cost ~4 s at 100k entities, once per coordinate per fit).
+    Keeps real columns whose coefficient is nonzero — the same
+    keep-then-nonzero filter the per-lane loop applied."""
+    valid = (cmap >= 0) & (w != 0)
+    bounds = np.cumsum(valid.sum(axis=1))[:-1]
+    col_parts = np.split(cmap[valid].astype(np.int32), bounds)
+    val_parts = np.split(w[valid].astype(np.float32), bounds)
+    var_parts = (
+        np.split(np.asarray(var)[valid].astype(np.float32), bounds)
+        if var is not None else None
+    )
+    return col_parts, val_parts, var_parts
+
+
 def _gather_block_offsets(offsets: Array, block: EntityBlock) -> Array:
     """Per-row offsets for one entity block; padding rows (sentinel index)
     read the appended zero slot."""
@@ -629,14 +646,13 @@ class RandomEffectCoordinate(Coordinate):
                 if compute_var
                 else None
             )
+            col_parts, val_parts, var_parts = pack_entity_tables(
+                cmap, w, var
+            )
             for lane, key in enumerate(ids):
-                keep = cmap[lane] >= 0
-                cols = cmap[lane][keep]
-                vals = w[lane][keep]
-                nz = vals != 0
-                table[key] = (cols[nz].astype(np.int32), vals[nz].astype(np.float32))
-                if var is not None:
-                    var_table[key] = var[lane][keep][nz].astype(np.float32)
+                table[key] = (col_parts[lane], val_parts[lane])
+                if var_parts is not None:
+                    var_table[key] = var_parts[lane]
         return RandomEffectModel(
             coefficients=table,
             feature_shard=self.feature_shard,

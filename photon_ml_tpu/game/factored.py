@@ -278,22 +278,18 @@ def finalize_factored_model(coord, state) -> RandomEffectModel:
     through the factorization (w_e is a deterministic function of the
     joint (U, V) fit), so none are produced — matching the reference,
     which computes variances only for unfactored coordinates."""
+    from photon_ml_tpu.game.coordinates import pack_entity_tables
+
     table: dict = {}
     for block, ids, coefs in zip(
         coord.dataset.blocks, coord.dataset.entity_ids,
         coord.materialize(state),
     ):
-        cmap = np.asarray(block.col_map)
-        w = np.asarray(coefs)
+        col_parts, val_parts, _ = pack_entity_tables(
+            np.asarray(block.col_map), np.asarray(coefs)
+        )
         for lane, key in enumerate(ids):
-            keep = cmap[lane] >= 0
-            cols = cmap[lane][keep]
-            vals = w[lane][keep]
-            nz = vals != 0
-            table[key] = (
-                cols[nz].astype(np.int32),
-                vals[nz].astype(np.float32),
-            )
+            table[key] = (col_parts[lane], val_parts[lane])
     return RandomEffectModel(
         coefficients=table,
         feature_shard=coord.feature_shard,
