@@ -47,6 +47,7 @@ from photon_ml_tpu.game.coordinates import (
     Coordinate,
     _gather_block_offsets,
     _make_block_solver,
+    pack_entity_tables,
 )
 from photon_ml_tpu.game.data import EntityBlock, RandomEffectDataset
 from photon_ml_tpu.game.model import RandomEffectModel
@@ -278,8 +279,6 @@ def finalize_factored_model(coord, state) -> RandomEffectModel:
     through the factorization (w_e is a deterministic function of the
     joint (U, V) fit), so none are produced — matching the reference,
     which computes variances only for unfactored coordinates."""
-    from photon_ml_tpu.game.coordinates import pack_entity_tables
-
     table: dict = {}
     for block, ids, coefs in zip(
         coord.dataset.blocks, coord.dataset.entity_ids,

@@ -744,3 +744,114 @@ class TestBuilderDegenerateInputs:
         assert _make_block_solver("logistic_regression", opt) is (
             _make_block_solver("logistic", opt)
         )
+
+
+def _reference_group_build(entity_keys, rows_csr, labels, weights,
+                           max_rows_per_entity=None):
+    """Obviously-correct per-entity reference of the flat-array builder:
+    the pre-vectorization algorithm (scipy slice per entity), kept as the
+    differential oracle for the grouping/projection/bucket-fill pipeline."""
+    from photon_ml_tpu.game.data import _round_up_geometric
+
+    rows_csr = sp.csr_matrix(rows_csr)
+    rows_csr.sum_duplicates()
+    n_rows = rows_csr.shape[0]
+    keys = np.asarray(entity_keys).astype(str)
+    order = np.argsort(keys, kind="stable")
+    sk = keys[order]
+    starts = np.flatnonzero(np.concatenate([[True], sk[1:] != sk[:-1]]))
+    groups = []
+    for gi, start in enumerate(starts):
+        end = starts[gi + 1] if gi + 1 < len(starts) else len(order)
+        ridx = order[start:end]
+        passive = np.empty(0, ridx.dtype)
+        if max_rows_per_entity is not None and len(ridx) > max_rows_per_entity:
+            keep = np.linspace(0, len(ridx) - 1, max_rows_per_entity).astype(int)
+            mask = np.zeros(len(ridx), bool)
+            mask[keep] = True
+            passive = ridx[~mask]
+            ridx = ridx[mask]
+        sub = rows_csr[ridx]
+        groups.append((sk[start], ridx, passive, np.unique(sub.indices), sub))
+    buckets = {}
+    for i, (_, ridx, _p, active, _s) in enumerate(groups):
+        key = (_round_up_geometric(len(ridx), 2.0),
+               _round_up_geometric(len(active), 2.0))
+        buckets.setdefault(key, []).append(i)
+    out = []
+    for _key, members in sorted(buckets.items()):
+        E = len(members)
+        R = max(len(groups[gi][1]) for gi in members)
+        D = max(1, max(len(groups[gi][3]) for gi in members))
+        X = np.zeros((E, R, D), np.float32)
+        lab = np.zeros((E, R), np.float32)
+        wts = np.zeros((E, R), np.float32)
+        cmap = np.full((E, D), -1, np.int32)
+        rindex = np.full((E, R), n_rows, np.int32)
+        ids = []
+        maxp = max(len(groups[gi][2]) for gi in members)
+        Xp = np.zeros((E, maxp, D), np.float32) if maxp else None
+        rindexp = np.full((E, maxp), n_rows, np.int32) if maxp else None
+        for lane, gi in enumerate(members):
+            key, ridx, passive, active, sub = groups[gi]
+            ids.append(key)
+            cmap[lane, : len(active)] = active
+            X[lane, : len(ridx), : len(active)] = sub[:, active].toarray()
+            lab[lane, : len(ridx)] = labels[ridx]
+            wts[lane, : len(ridx)] = weights[ridx]
+            rindex[lane, : len(ridx)] = ridx
+            if maxp and len(passive):
+                Xp[lane, : len(passive), : len(active)] = (
+                    rows_csr[passive][:, active].toarray()
+                )
+                rindexp[lane, : len(passive)] = passive
+        out.append((ids, X, lab, wts, cmap, rindex, Xp, rindexp))
+    return out
+
+
+class TestBuilderDifferential:
+    """Randomized differential test of the flat-array dataset builder
+    against the per-entity reference algorithm it replaced."""
+
+    @pytest.mark.parametrize("trial", range(6))
+    def test_matches_per_entity_reference(self, trial):
+        rng = np.random.default_rng(100 + trial)
+        n = int(rng.integers(30, 400))
+        d = int(rng.integers(1, 12))
+        n_ent = int(rng.integers(1, 40))
+        density = float(rng.uniform(0.05, 0.9))
+        X = sp.random(n, d, density, "csr", dtype=np.float32,
+                      random_state=int(rng.integers(1 << 30)))
+        keys = np.array(
+            [f"e{rng.integers(n_ent)}" for _ in range(n)], dtype=object
+        )
+        labels = rng.normal(size=n).astype(np.float32)
+        weights = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+        cap = (
+            None if trial % 2 == 0
+            else int(rng.integers(1, max(2, n // max(1, n_ent))))
+        )
+        ds = build_random_effect_dataset(
+            keys, X, labels, weights, max_rows_per_entity=cap, device=False,
+        )
+        ref = _reference_group_build(
+            keys, X, labels, weights, max_rows_per_entity=cap
+        )
+        assert len(ds.blocks) == len(ref)
+        for b, pb, ids, (rids, rX, rlab, rwts, rcmap, rrindex, rXp,
+                         rrindexp) in zip(
+            ds.blocks, ds.passive_blocks, ds.entity_ids, ref
+        ):
+            assert list(ids) == list(rids)
+            np.testing.assert_array_equal(np.asarray(b.col_map), rcmap)
+            np.testing.assert_array_equal(np.asarray(b.row_index), rrindex)
+            np.testing.assert_array_equal(np.asarray(b.X), rX)
+            np.testing.assert_array_equal(np.asarray(b.labels), rlab)
+            np.testing.assert_array_equal(np.asarray(b.weights), rwts)
+            if rXp is None:
+                assert pb is None
+            else:
+                np.testing.assert_array_equal(np.asarray(pb.X), rXp)
+                np.testing.assert_array_equal(
+                    np.asarray(pb.row_index), rrindexp
+                )
