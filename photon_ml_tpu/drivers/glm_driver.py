@@ -92,6 +92,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--max-iters", type=int, default=100)
     p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument(
+        "--coefficient-bounds",
+        help="JSON file mapping feature key -> [lower, upper] box "
+        "constraints (the reference's constraint map); unlisted features "
+        "are unconstrained",
+    )
     p.add_argument("--intercept", action="store_true", default=True)
     p.add_argument("--no-intercept", dest="intercept", action="store_false")
     p.add_argument("--compute-variances", action="store_true")
@@ -286,6 +292,55 @@ def _run(args) -> dict:
     if args.intercept and index_map.intercept_index is not None:
         l1_mask = jnp.ones((d,), jnp.float32).at[index_map.intercept_index].set(0.0)
 
+    bounds = None
+    if args.coefficient_bounds:
+        # Box constraints apply to the coefficients the solver actually
+        # optimizes; under normalization those live in scaled space where
+        # a per-feature box does not map back to the user's box — reject
+        # rather than silently constrain the wrong quantity.  Streamed /
+        # data-parallel composition is not wired up.
+        if normalization is not None:
+            raise SystemExit(
+                "--coefficient-bounds requires --normalization none"
+            )
+        if streaming or data_parallel:
+            raise SystemExit(
+                "--coefficient-bounds is single-device resident-data only"
+            )
+        if args.compute_variances:
+            # The diag-inverse-Hessian variance assumes an interior
+            # optimum; it is wrong for coefficients pinned at an active
+            # bound (nonzero gradient there).
+            raise SystemExit(
+                "--coefficient-bounds is incompatible with "
+                "--compute-variances"
+            )
+        with open(args.coefficient_bounds) as f:
+            bounds_map = json.load(f)
+        lower = np.full((d,), -np.inf, np.float32)
+        upper = np.full((d,), np.inf, np.float32)
+        unknown = [k for k in bounds_map if index_map.get_index(k) < 0]
+        if unknown:
+            raise SystemExit(
+                f"--coefficient-bounds names unknown features: {unknown[:5]}"
+            )
+        for key, (lo, hi) in bounds_map.items():
+            lo, hi = float(lo), float(hi)
+            if np.isnan(lo) or np.isnan(hi) or lo > hi:
+                # json.load accepts NaN literals, and jnp.clip with
+                # lower > upper silently returns upper — both would
+                # train a wrong model without a word.
+                raise SystemExit(
+                    f"--coefficient-bounds: invalid bounds for {key!r}: "
+                    f"[{lo}, {hi}]"
+                )
+            idx = index_map.get_index(key)
+            lower[idx], upper[idx] = lo, hi
+        bounds = (jnp.asarray(lower), jnp.asarray(upper))
+        logger.info(
+            "box constraints on %d of %d coefficients", len(bounds_map), d
+        )
+
     # Checkpoint/resume + incremental training (SURVEY.md §5.3/§5.4): each
     # solved λ is persisted; --resume skips finished λs bit-exactly;
     # --initial-model seeds the warm-start chain from a saved model.
@@ -389,7 +444,7 @@ def _run(args) -> dict:
         )
         return problem.run_grid(
             data, reg_weights, w0=w0, l1_mask=l1_mask,
-            solved=solved_now, on_solved=on_solved,
+            solved=solved_now, on_solved=on_solved, bounds=bounds,
         )
 
     from photon_ml_tpu.utils.watchdog import RetryPolicy, run_with_retries

@@ -88,8 +88,8 @@ class GlmOptimizationProblem:
         # repeated fits never re-trace (the GAME coordinates already did
         # this; the legacy-driver path goes through here).
         self._solve_jit = jax.jit(
-            lambda data, reg_weight, w0, l1_mask: self.solve(
-                data, reg_weight, w0, None, l1_mask
+            lambda data, reg_weight, w0, l1_mask, bounds: self.solve(
+                data, reg_weight, w0, None, l1_mask, bounds
             )
         )
 
@@ -99,12 +99,13 @@ class GlmOptimizationProblem:
         reg_weight: Array | float = 0.0,
         w0: Optional[Array] = None,
         l1_mask: Optional[Array] = None,
+        bounds: Optional[tuple[Array, Array]] = None,
     ) -> SolveResult:
         """Jit-cached single-device :meth:`solve` (axis_name=None)."""
         if w0 is None:
             w0 = jnp.zeros((data.n_features,), jnp.float32)
         return self._solve_jit(
-            data, jnp.asarray(reg_weight, jnp.float32), w0, l1_mask
+            data, jnp.asarray(reg_weight, jnp.float32), w0, l1_mask, bounds
         )
 
     # -- core solve (jit/shard_map-safe) -----------------------------------
@@ -115,11 +116,16 @@ class GlmOptimizationProblem:
         w0: Optional[Array] = None,
         axis_name: Optional[str] = None,
         l1_mask: Optional[Array] = None,
+        bounds: Optional[tuple[Array, Array]] = None,
     ) -> SolveResult:
         """One optimization run at one regularization weight.
 
         ``reg_weight`` may be a traced scalar: the split into L1/L2 uses only
         the (static) regularization type.
+
+        ``bounds`` = (lower, upper) per-coefficient arrays (±inf entries
+        unconstrained) routes the solve to the box-constrained SPG path —
+        the reference's constraint-map support on its optimizer layer.
         """
         obj = self.objective
         cfg = self.config
@@ -133,6 +139,29 @@ class GlmOptimizationProblem:
         l2 = cfg.regularization.l2_weight(1.0) * reg_weight
         opt = cfg.optimizer
 
+        if bounds is not None:
+            # Box constraints route to SPG for any smooth config (the
+            # constraint set, not the configured optimizer, decides the
+            # machinery — same policy as the L1→OWL-QN routing below).
+            if l1_frac > 0.0:
+                raise NotImplementedError(
+                    "box constraints combined with L1 regularization are "
+                    "not supported: the orthant-wise and projection "
+                    "machineries conflict (drop the L1 component or the "
+                    "bounds)"
+                )
+            from photon_ml_tpu.optim.projected import SPGConfig, spg_solve
+
+            return spg_solve(
+                lambda w: obj.value_and_grad(
+                    w, data, l2_weight=l2, axis_name=axis_name
+                ),
+                w0,
+                bounds[0],
+                bounds[1],
+                SPGConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
+                w_axis=None,
+            )
         # L1 is only representable by OWL-QN's orthant machinery; any config
         # carrying an L1 component routes there regardless of the configured
         # smooth optimizer (as the reference does — L-BFGS/TRON have no
@@ -261,15 +290,16 @@ class GlmOptimizationProblem:
         warm_start: bool = True,
         solved: Optional[dict] = None,
         on_solved=None,
+        bounds: Optional[tuple[Array, Array]] = None,
     ) -> list[tuple[float, GeneralizedLinearModel, Optional[SolveResult]]]:
         """Train one model per regularization weight (see :meth:`grid_loop`
         for the warm-start/checkpoint semantics)."""
 
         def solve_fn(lam, w_prev):
             return (
-                self.solve_single_device(data, lam, w_prev, l1_mask)
+                self.solve_single_device(data, lam, w_prev, l1_mask, bounds)
                 if axis_name is None
-                else self.solve(data, lam, w_prev, axis_name, l1_mask)
+                else self.solve(data, lam, w_prev, axis_name, l1_mask, bounds)
             )
 
         variance_fn = None

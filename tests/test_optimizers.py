@@ -249,3 +249,117 @@ class TestTRON:
         fd = (np.asarray(g_plus) - np.asarray(g_minus)) / (2 * eps)
         hvp = np.asarray(obj.hvp(w, v, data))
         np.testing.assert_allclose(hvp, fd, rtol=1e-5, atol=1e-5)
+
+
+class TestSPGBoxConstraints:
+    """Box-constrained solves (the reference's optimizer-layer constraint
+    map) via spectral projected gradient, vs scipy L-BFGS-B oracles."""
+
+    def _bounded_oracle(self, X, y, l2, bounds):
+        def f(w):
+            m = X @ w
+            val = np.sum(np.logaddexp(0, m) - y * m) + 0.5 * l2 * w @ w
+            g = X.T @ (1 / (1 + np.exp(-m)) - y) + l2 * w
+            return val, g
+
+        res = scipy.optimize.minimize(
+            f, np.zeros(X.shape[1]), jac=True, method="L-BFGS-B",
+            bounds=bounds, options={"maxiter": 500, "ftol": 1e-14,
+                                    "gtol": 1e-10},
+        )
+        return res.x
+
+    def test_matches_scipy_lbfgsb(self, rng):
+        from photon_ml_tpu.optim.projected import SPGConfig, spg_solve
+
+        X, y, data, obj = _logistic_problem(rng)
+        l2 = 0.3
+        d = X.shape[1]
+        lower = np.full(d, -0.25)
+        upper = np.full(d, 0.25)
+        # Leave a couple of coefficients unconstrained on one side.
+        lower[0], upper[1] = -np.inf, np.inf
+        res = spg_solve(
+            lambda w: obj.value_and_grad(w, data, l2_weight=l2),
+            jnp.zeros(d, jnp.float64),
+            jnp.asarray(lower), jnp.asarray(upper),
+            SPGConfig(max_iters=300, tolerance=1e-10),
+        )
+        oracle = self._bounded_oracle(
+            X, y, l2, list(zip(lower, upper))
+        )
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.w), oracle, atol=2e-5)
+        assert np.all(np.asarray(res.w) >= lower - 1e-12)
+        assert np.all(np.asarray(res.w) <= upper + 1e-12)
+        # The box must actually bind somewhere for this to test anything.
+        assert np.any(np.isclose(np.abs(oracle[2:]), 0.25, atol=1e-8))
+
+    def test_inactive_bounds_match_unconstrained(self, rng):
+        from photon_ml_tpu.optim.projected import SPGConfig, spg_solve
+
+        X, y, data, obj = _logistic_problem(rng)
+        l2 = 0.5
+        d = X.shape[1]
+        vg = lambda w: obj.value_and_grad(w, data, l2_weight=l2)
+        free = lbfgs_solve(
+            vg, jnp.zeros(d, jnp.float64),
+            LBFGSConfig(max_iters=300, tolerance=1e-10),
+        )
+        boxed = spg_solve(
+            vg, jnp.zeros(d, jnp.float64),
+            jnp.full(d, -np.inf), jnp.full(d, np.inf),
+            SPGConfig(max_iters=300, tolerance=1e-10),
+        )
+        np.testing.assert_allclose(
+            np.asarray(boxed.w), np.asarray(free.w), atol=1e-6
+        )
+
+    def test_problem_routes_bounds_and_rejects_l1(self, rng):
+        from photon_ml_tpu.optim.problem import (
+            GlmOptimizationConfig,
+            GlmOptimizationProblem,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.optim.regularization import RegularizationContext
+
+        X, y, data, obj = _logistic_problem(rng)
+        d = X.shape[1]
+        bounds = (jnp.full(d, -0.2), jnp.full(d, 0.2))
+        prob = GlmOptimizationProblem(
+            "logistic",
+            GlmOptimizationConfig(
+                optimizer=OptimizerConfig(max_iters=300, tolerance=1e-10),
+                regularization=RegularizationContext.l2(),
+            ),
+        )
+        res = prob.solve_single_device(data, 0.3, bounds=bounds)
+        oracle = self._bounded_oracle(X, y, 0.3, [(-0.2, 0.2)] * d)
+        np.testing.assert_allclose(np.asarray(res.w), oracle, atol=2e-5)
+
+        l1_prob = GlmOptimizationProblem(
+            "logistic",
+            GlmOptimizationConfig(
+                regularization=RegularizationContext.l1(),
+            ),
+        )
+        with pytest.raises(NotImplementedError, match="box constraints"):
+            l1_prob.solve(data, 0.1, bounds=bounds)
+
+    def test_nan_trial_backtracks_poisson(self, rng):
+        """An overflowing Poisson trial (exp of a huge margin -> NaN)
+        must be rejected by the Armijo backtrack, not adopted."""
+        from photon_ml_tpu.optim.projected import SPGConfig, spg_solve
+
+        X = rng.normal(size=(100, 5)) * 30.0  # big features: easy overflow
+        yc = rng.poisson(1.0, size=100).astype(np.float64)
+        data = make_glm_data(X, yc, dtype=jnp.float64)
+        obj = GlmObjective(losses.poisson)
+        res = spg_solve(
+            lambda w: obj.value_and_grad(w, data, l2_weight=1.0),
+            jnp.zeros(5, jnp.float64),
+            jnp.full(5, -2.0), jnp.full(5, 2.0),
+            SPGConfig(max_iters=200, tolerance=1e-8),
+        )
+        assert np.all(np.isfinite(np.asarray(res.w)))
+        assert np.isfinite(float(res.value))
