@@ -165,6 +165,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "incremental training); its index maps are used to read the data",
     )
     p.add_argument(
+        "--locked-coordinates",
+        help="comma-separated coordinate names held at --initial-model "
+        "instead of retrained (the reference's partial retraining)",
+    )
+    p.add_argument(
         "--data-parallel",
         choices=["off", "auto"],
         default="off",
@@ -301,8 +306,23 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 len(jax.devices()),
             )
 
+    locked = tuple(
+        s.strip() for s in (args.locked_coordinates or "").split(",")
+        if s.strip()
+    )
+    if locked and not args.initial_model:
+        raise SystemExit("--locked-coordinates requires --initial-model")
+
     tuning = config.get("tuning")
     if tuning:
+        if locked:
+            # Tuning sweeps every coordinate's reg weight and refits all
+            # of them per evaluation — a locked coordinate would be
+            # silently retrained during the search, then locked only in
+            # the final fit (inconsistent selection).
+            raise SystemExit(
+                "--locked-coordinates is incompatible with tuning mode"
+            )
         if validation is None:
             raise ValueError("hyperparameter tuning requires --validate-data")
         import dataclasses as _dc
@@ -425,6 +445,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         max_retries=args.max_retries, backoff_seconds=args.retry_backoff
     )
     if len(config_grid) > 1:
+        if locked:
+            raise SystemExit(
+                "--locked-coordinates is single-config only (a locked "
+                "coordinate has nothing to sweep)"
+            )
         # Config-grid fit with validation-driven selection (SURVEY.md §3.2).
         model, grid_results = run_with_retries(
             lambda attempt: estimator.fit_grid(
@@ -462,6 +487,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 shards, ids, response, weight=weight, offset=offset,
                 validation=val_tuple, suite=suite,
                 initial_model=initial_model, checkpointer=checkpointer,
+                locked_coordinates=locked,
             ),
             retry_policy, logger,
         )

@@ -81,6 +81,7 @@ class CoordinateDescent:
         logger=None,
         checkpointer=None,
         initial_states: Optional[dict] = None,
+        locked: Sequence[str] = (),
     ) -> CoordinateDescentResult:
         """``eval_fn(iteration, coordinate_name, scores_by_coordinate,
         states_by_coordinate)`` is called after each coordinate update (the
@@ -93,12 +94,25 @@ class CoordinateDescent:
         §5.4): each coordinate's scores are seeded from its initial state so
         the first update already trains against the prior model's residuals.
 
+        ``locked`` names coordinates that are PARTIAL-RETRAIN locked (the
+        reference's partial retraining: retrain some coordinates against a
+        prior model's others): a locked coordinate contributes its initial
+        state's scores to every offset but is never retrained — so it must
+        appear in ``initial_states`` (or the resumed checkpoint).
+
         ``checkpointer`` (io/checkpoint.CoordinateDescentCheckpointer)
         persists the loop state after every iteration; when it holds a saved
         state, the run RESUMES from the last completed iteration and
         reproduces the uninterrupted result bit-for-bit (the accumulated
         ``total``/scores are restored, not recomputed)."""
         base_offsets = jnp.asarray(base_offsets, jnp.float32)
+        locked = set(locked)
+        names = {c.name for c in self.coordinates}
+        if not locked <= names:
+            raise ValueError(
+                f"locked coordinates {sorted(locked - names)} are not in "
+                f"this descent's coordinate list {sorted(names)}"
+            )
         scores: dict[str, Array] = {
             c.name: jnp.zeros_like(base_offsets) for c in self.coordinates
         }
@@ -109,6 +123,17 @@ class CoordinateDescent:
 
         saved = checkpointer.load() if checkpointer is not None else None
         if saved is not None:
+            saved_locked = set(saved.get("locked", []))
+            if saved_locked != locked:
+                # A resume must train the same coordinates the
+                # checkpointed run did — otherwise the finalized model's
+                # coordinates were never trained against each other.
+                raise ValueError(
+                    "checkpoint was written with locked coordinates "
+                    f"{sorted(saved_locked)} but this run locks "
+                    f"{sorted(locked)}; clear the checkpoint or match "
+                    "the locked set"
+                )
             # A checkpoint supersedes initial states entirely (it already
             # includes any warm start the original run began from), so don't
             # waste a full scoring pass on states about to be overwritten.
@@ -208,9 +233,19 @@ class CoordinateDescent:
                     )
             pending.clear()
 
+        for name in locked:
+            if states[name] is None:
+                raise ValueError(
+                    f"locked coordinate {name!r} has no state to hold: "
+                    "supply it via initial_states (a prior model) or a "
+                    "resumed checkpoint"
+                )
+
         flush_per_iteration = logger is not None or checkpointer is not None
         for it in range(start_it, n_iterations):
             for coord in self.coordinates:
+                if coord.name in locked:
+                    continue  # partial retrain: contributes scores only
                 offsets = total - scores[coord.name]
                 state = coord.train(offsets, warm_state=states[coord.name])
                 new_score = coord.score(state)
@@ -228,6 +263,9 @@ class CoordinateDescent:
             if flush_per_iteration:
                 flush()
             if checkpointer is not None:
-                checkpointer.save(it, total, scores, states, history)
+                checkpointer.save(
+                    it, total, scores, states, history,
+                    locked=sorted(locked),
+                )
         flush()
         return CoordinateDescentResult(states=states, scores=scores, history=history)

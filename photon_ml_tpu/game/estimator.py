@@ -485,6 +485,7 @@ class GameEstimator:
         suite=None,
         initial_model: Optional[GameModel] = None,
         checkpointer=None,
+        locked_coordinates: Sequence[str] = (),
     ) -> tuple[GameModel, list]:
         """Train; returns (model, per-coordinate-update history).
 
@@ -495,6 +496,8 @@ class GameEstimator:
 
         ``initial_model`` warm-starts coordinate descent from a previously
         trained GameModel (the reference's incremental training);
+        ``locked_coordinates`` holds named coordinates at that model
+        instead of retraining them (the reference's partial retraining);
         ``checkpointer`` enables per-iteration checkpoint + resume (see
         game/descent.py)."""
         coordinates = self._build_coordinates(
@@ -508,6 +511,7 @@ class GameEstimator:
             validation=validation, suite=suite,
             initial_model=initial_model, checkpointer=checkpointer,
             train_group_ids=train_groups,
+            locked_coordinates=locked_coordinates,
         )
 
     @staticmethod
@@ -594,12 +598,19 @@ class GameEstimator:
         initial_model: Optional[GameModel] = None,
         checkpointer=None,
         train_group_ids=None,
+        locked_coordinates: Sequence[str] = (),
     ) -> tuple[GameModel, list]:
         """Run coordinate descent over pre-built coordinates (see
         :meth:`build_coordinates`) and finalize the GameModel.
 
         ``validation_scorers`` (name → scorer, see game/validation.py) lets
-        grid/tuning loops reuse scorers built once per shared dataset."""
+        grid/tuning loops reuse scorers built once per shared dataset.
+
+        ``locked_coordinates`` (partial retraining, the reference's locked
+        coordinate list): each named coordinate takes its coefficients from
+        ``initial_model`` and is never retrained — its scores still enter
+        every other coordinate's offsets, and its sub-model is carried into
+        the returned GameModel unchanged."""
         from photon_ml_tpu.evaluation.suite import EvaluationSuite
 
         n = len(response)
@@ -756,6 +767,21 @@ class GameEstimator:
                 entry["validation_metric"] = metrics[suite.primary]
             return entry
 
+        locked = tuple(locked_coordinates)
+        if locked and initial_model is None:
+            raise ValueError(
+                "locked_coordinates requires initial_model (partial "
+                "retraining holds those coordinates at the prior model)"
+            )
+        if locked:
+            missing = [
+                n_ for n_ in locked if n_ not in (initial_model.models or {})
+            ]
+            if missing:
+                raise ValueError(
+                    f"locked coordinates {missing} are not in the initial "
+                    "model"
+                )
         initial_states = (
             self.initial_states_from_model(coordinates, initial_model)
             if initial_model is not None
@@ -769,6 +795,7 @@ class GameEstimator:
             logger=self.logger,
             checkpointer=checkpointer,
             initial_states=initial_states,
+            locked=locked,
         )
         # Finalize with each coordinate's residual offsets (base + the
         # OTHER coordinates' scores) so coefficient variances — when a
@@ -788,6 +815,12 @@ class GameEstimator:
             )
         models = {}
         for c in coordinates:
+            if c.name in locked:
+                # Partial retraining: the locked sub-model passes through
+                # VERBATIM (re-deriving it from the reconstructed device
+                # state would drop variances and any stored detail).
+                models[c.name] = initial_model.models[c.name]
+                continue
             off_c = (
                 total_np - np.asarray(result.scores[c.name])
                 if total_np is not None

@@ -113,6 +113,7 @@ class CoordinateDescentCheckpointer:
         scores: dict,
         states: dict,
         history: list,
+        locked: list | tuple = (),
     ) -> None:
         os.makedirs(self.directory, exist_ok=True)
         arrays = {"total": np.asarray(total)}
@@ -128,6 +129,11 @@ class CoordinateDescentCheckpointer:
                     "coordinates": list(scores),
                     "state_specs": specs,
                     "history": history,
+                    # Partial-retraining locked set: a resume must train
+                    # the SAME coordinates the checkpointed run did, or
+                    # the output model's coordinates were never trained
+                    # against each other.
+                    "locked": sorted(locked),
                     # Bucket-padding generation: tight per-bucket dims
                     # (round 4) changed random-effect state SHAPES, so a
                     # checkpoint from the geometric-grid era must not be
@@ -194,6 +200,7 @@ class CoordinateDescentCheckpointer:
             "scores": scores,
             "states": states,
             "history": meta["history"],
+            "locked": meta.get("locked", []),
         }
 
 

@@ -855,3 +855,112 @@ class TestBuilderDifferential:
                 np.testing.assert_array_equal(
                     np.asarray(pb.row_index), rrindexp
                 )
+
+
+class TestPartialRetraining:
+    """Locked coordinates (the reference's partial retraining): held at
+    the prior model, contributing scores but never retrained."""
+
+    def _fit(self, prob, **kw):
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=40),
+            regularization=RegularizationContext.l2(),
+        )
+        est = GameEstimator(
+            "logistic",
+            {
+                "fixed": FixedEffectCoordinateConfig(
+                    "global", opt, reg_weight=1.0
+                ),
+                "per_user": RandomEffectCoordinateConfig(
+                    "per_user", "userId", opt, reg_weight=1.0
+                ),
+            },
+            n_iterations=2,
+        )
+        model, hist = est.fit(
+            prob["shards"], prob["ids"], prob["response"], **kw
+        )
+        return est, model, hist
+
+    def test_locked_submodel_passes_through_verbatim(self, rng):
+        prob = _mixed_effects_problem(rng, n_users=15)
+        est, base_model, _ = self._fit(prob)
+        _, model2, hist2 = self._fit(
+            prob, initial_model=base_model,
+            locked_coordinates=("per_user",),
+        )
+        # Identical per-entity tables, the SAME object carried through.
+        assert model2.models["per_user"] is base_model.models["per_user"]
+        # Only the fixed coordinate produced history entries.
+        assert {h["coordinate"] for h in hist2} == {"fixed"}
+        assert len(hist2) == 2
+
+    def test_locked_matches_manual_offsets(self, rng):
+        """Training fixed against a locked per_user must equal training
+        fixed alone with per_user's scores as base offsets."""
+        prob = _mixed_effects_problem(rng, n_users=15)
+        est, base_model, _ = self._fit(prob)
+        _, model_locked, _ = self._fit(
+            prob, initial_model=base_model,
+            locked_coordinates=("per_user",),
+        )
+        from photon_ml_tpu.game.model import GameModel
+
+        user_scores = np.asarray(
+            GameTransformer(
+                GameModel(
+                    models={"per_user": base_model.models["per_user"]},
+                    task="logistic",
+                )
+            ).transform(prob["shards"], prob["ids"])
+        )
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=40),
+            regularization=RegularizationContext.l2(),
+        )
+        fixed_only = GameEstimator(
+            "logistic",
+            {"fixed": FixedEffectCoordinateConfig("global", opt, reg_weight=1.0)},
+            n_iterations=2,
+        )
+        model_manual, _ = fixed_only.fit(
+            prob["shards"], prob["ids"], prob["response"],
+            offset=user_scores,
+        )
+        w_locked = np.asarray(
+            model_locked.models["fixed"].model.coefficients.means
+        )
+        w_manual = np.asarray(
+            model_manual.models["fixed"].model.coefficients.means
+        )
+        np.testing.assert_allclose(w_locked, w_manual, rtol=2e-4, atol=2e-5)
+
+    def test_locked_requires_initial_model(self, rng):
+        prob = _mixed_effects_problem(rng, n_users=15)
+        with pytest.raises(ValueError, match="initial_model"):
+            self._fit(prob, locked_coordinates=("per_user",))
+
+    def test_locked_unknown_coordinate_rejected(self, rng):
+        prob = _mixed_effects_problem(rng, n_users=15)
+        _, base_model, _ = self._fit(prob)
+        with pytest.raises(ValueError, match="not in the initial model"):
+            self._fit(
+                prob, initial_model=base_model,
+                locked_coordinates=("nope",),
+            )
+
+    def test_resume_with_changed_locked_set_rejected(self, rng, tmp_path):
+        from photon_ml_tpu.io.checkpoint import CoordinateDescentCheckpointer
+
+        prob = _mixed_effects_problem(rng, n_users=15)
+        est, base_model, _ = self._fit(prob)
+        ckpt = CoordinateDescentCheckpointer(str(tmp_path / "cd"))
+        # Checkpoint a run that trained everything...
+        self._fit(prob, checkpointer=ckpt)
+        # ...then resuming with a locked coordinate must refuse.
+        with pytest.raises(ValueError, match="locked coordinates"):
+            self._fit(
+                prob, initial_model=base_model,
+                locked_coordinates=("per_user",), checkpointer=ckpt,
+            )

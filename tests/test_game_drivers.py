@@ -586,3 +586,54 @@ class TestStreamingGameDriver:
             np.asarray(m_r["fixed"].model.coefficients.means),
             atol=5e-3,
         )
+
+
+class TestPartialRetrainingDriver:
+    def test_locked_coordinate_held_at_initial_model(
+        self, game_files, tmp_path
+    ):
+        """--locked-coordinates holds the named coordinate at
+        --initial-model: its saved per-entity coefficients come through
+        byte-identical while the other coordinate retrains."""
+        train, val, config = game_files
+        out1 = str(tmp_path / "base")
+        game_training_driver.run([
+            "--train-data", train,
+            "--validate-data", val,
+            "--config", config,
+            "--output-dir", out1,
+        ])
+        out2 = str(tmp_path / "partial")
+        result = game_training_driver.run([
+            "--train-data", train,
+            "--validate-data", val,
+            "--config", config,
+            "--output-dir", out2,
+            "--initial-model", os.path.join(out1, "models"),
+            "--locked-coordinates", "per_user",
+        ])
+        from photon_ml_tpu.io.game_store import load_game_model
+
+        m1, _ = load_game_model(os.path.join(out1, "models"))
+        m2, _ = load_game_model(os.path.join(out2, "models"))
+        re1, re2 = m1.models["per_user"], m2.models["per_user"]
+        assert set(re1.coefficients) == set(re2.coefficients)
+        for k, (c1, v1) in re1.coefficients.items():
+            c2, v2 = re2.coefficients[k]
+            np.testing.assert_array_equal(c1, c2)
+            np.testing.assert_array_equal(v1, v2)
+        assert result["validation_metric"] > 0.65
+        # Only the fixed coordinate appears in the history.
+        assert {h["coordinate"] for h in result["history"]} == {"fixed"}
+
+    def test_locked_without_initial_model_rejected(
+        self, game_files, tmp_path
+    ):
+        train, val, config = game_files
+        with pytest.raises(SystemExit, match="initial-model"):
+            game_training_driver.run([
+                "--train-data", train,
+                "--config", config,
+                "--output-dir", str(tmp_path / "x"),
+                "--locked-coordinates", "per_user",
+            ])
