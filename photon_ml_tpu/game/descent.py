@@ -113,6 +113,11 @@ class CoordinateDescent:
                 f"locked coordinates {sorted(locked - names)} are not in "
                 f"this descent's coordinate list {sorted(names)}"
             )
+        if names and locked >= names:
+            raise ValueError(
+                "every coordinate is locked — nothing to train (a fully "
+                "locked run would just re-emit the initial model)"
+            )
         scores: dict[str, Array] = {
             c.name: jnp.zeros_like(base_offsets) for c in self.coordinates
         }

@@ -787,6 +787,21 @@ class GameEstimator:
             if initial_model is not None
             else None
         )
+        unlockable = [
+            n_ for n_ in locked
+            if initial_states is None or initial_states.get(n_) is None
+        ]
+        if unlockable:
+            # Accurate up-front rejection: a factored coordinate's saved
+            # sub-model holds materialized w_e only, so its (u, V) device
+            # state is not reconstructible — descent's generic "supply a
+            # prior model" message would gaslight a user who already did.
+            raise ValueError(
+                f"coordinates {unlockable} cannot be locked: their prior "
+                "state is not reconstructible from the initial model "
+                "(factored coordinates save materialized coefficients "
+                "only)"
+            )
         cd = CoordinateDescent(coordinates)
         result = cd.run(
             jnp.asarray(base_offsets),

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from photon_ml_tpu.evaluation.evaluators import AreaUnderROCCurveEvaluator
 from photon_ml_tpu.game.data import build_random_effect_dataset
 from photon_ml_tpu.game.estimator import (
+    FactoredRandomEffectCoordinateConfig,
     FixedEffectCoordinateConfig,
     GameEstimator,
     GameTransformer,
@@ -963,4 +964,42 @@ class TestPartialRetraining:
             self._fit(
                 prob, initial_model=base_model,
                 locked_coordinates=("per_user",), checkpointer=ckpt,
+            )
+
+    def test_all_locked_rejected(self, rng):
+        prob = _mixed_effects_problem(rng, n_users=15)
+        _, base_model, _ = self._fit(prob)
+        with pytest.raises(ValueError, match="nothing to train"):
+            self._fit(
+                prob, initial_model=base_model,
+                locked_coordinates=("fixed", "per_user"),
+            )
+
+    def test_locked_factored_rejected_up_front(self, rng):
+        """A factored coordinate's saved sub-model can't be locked (its
+        (u, V) state is not reconstructible) — the estimator must say so
+        accurately instead of descent's generic message."""
+        prob = _mixed_effects_problem(rng, n_users=15)
+        opt = GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=20),
+            regularization=RegularizationContext.l2(),
+        )
+        est = GameEstimator(
+            "logistic",
+            {
+                "fixed": FixedEffectCoordinateConfig(
+                    "global", opt, reg_weight=1.0
+                ),
+                "per_user": FactoredRandomEffectCoordinateConfig(
+                    "per_user", "userId", rank=2, optimization=opt,
+                    reg_weight=1.0,
+                ),
+            },
+            n_iterations=1,
+        )
+        model, _ = est.fit(prob["shards"], prob["ids"], prob["response"])
+        with pytest.raises(ValueError, match="not reconstructible"):
+            est.fit(
+                prob["shards"], prob["ids"], prob["response"],
+                initial_model=model, locked_coordinates=("per_user",),
             )

@@ -254,16 +254,15 @@ class TestGameDriverRecovery:
         orig_run = descent_mod.CoordinateDescent.run
         state = {"calls": 0, "resumed_from": None}
 
-        def flaky_run(self, base_offsets, n_iterations=1, eval_fn=None,
-                      logger=None, checkpointer=None, initial_states=None):
+        def flaky_run(self, base_offsets, n_iterations=1, checkpointer=None,
+                      **kw):
             state["calls"] += 1
             if state["calls"] == 1:
                 # First attempt: run ONE iteration (checkpointing), then
                 # die as the transport would.
                 orig_run(
-                    self, base_offsets, n_iterations=1, eval_fn=eval_fn,
-                    logger=logger, checkpointer=checkpointer,
-                    initial_states=initial_states,
+                    self, base_offsets, n_iterations=1,
+                    checkpointer=checkpointer, **kw
                 )
                 raise RuntimeError("UNAVAILABLE: device lost (induced)")
             saved = checkpointer.load() if checkpointer else None
@@ -272,8 +271,7 @@ class TestGameDriverRecovery:
             )
             return orig_run(
                 self, base_offsets, n_iterations=n_iterations,
-                eval_fn=eval_fn, logger=logger, checkpointer=checkpointer,
-                initial_states=initial_states,
+                checkpointer=checkpointer, **kw
             )
 
         monkeypatch.setattr(
