@@ -638,17 +638,40 @@ def bench_streaming() -> dict:
         return best
 
     t_res = timed(lambda: res_fn(w, data))
+    # Transfer observability over the TIMED streamed passes only (the
+    # warmup pass above would pollute the per-chunk numbers with
+    # compile-time noise).
+    sobj.transfer_stats.reset()
     t_str = timed(lambda: sobj.value_and_grad(w, 1.0))
+    st = sobj.transfer_stats
 
     _log(f"stream: resident {n / t_res / 1e6:.1f} M rows/s, "
          f"streamed {n / t_str / 1e6:.1f} M rows/s "
          f"(ratio {t_res / t_str:.3f}, h2d {h2d_gbps:.3f} GB/s)")
+    _log(f"stream: per-chunk h2d {st.gbps:.3f} GB/s "
+         f"({st.chunk_seconds * 1e3:.1f} ms/chunk, "
+         f"{len(stream.staged[0]) if stream.staged else 'unstaged'} "
+         f"coalesced buffers), stalls: consumer {st.consumer_stalls} "
+         f"({st.consumer_stall_seconds:.2f}s) / producer "
+         f"{st.producer_stalls} ({st.producer_stall_seconds:.2f}s), "
+         f"max {st.max_live} chunks live")
     return {
         "stream_rows_per_sec": round(n / t_str, 1),
         "stream_rows": n,
         "resident_rows_per_sec": round(n / t_res, 1),
         "stream_vs_resident": round(t_res / t_str, 4),
         "h2d_gbps": round(h2d_gbps, 3),
+        # Per-chunk ingest pipeline metrics (ops/README.md "Reading the
+        # streamed-ingest h2d metrics"): achieved staging-buffer rate,
+        # mean per-chunk transfer time, and queue-stall counters over
+        # the timed passes.
+        "stream_h2d_gbps": round(st.gbps, 3),
+        "stream_h2d_chunk_ms": round(st.chunk_seconds * 1e3, 2),
+        "stream_consumer_stalls": st.consumer_stalls,
+        "stream_producer_stalls": st.producer_stalls,
+        "stream_consumer_stall_s": round(st.consumer_stall_seconds, 3),
+        "stream_producer_stall_s": round(st.producer_stall_seconds, 3),
+        "stream_prefetch_max_live": st.max_live,
     }
 
 

@@ -1,0 +1,203 @@
+"""Bounded-depth background prefetch for host→device chunk streams.
+
+The synchronous pattern (``device_put`` then step, inline in the consume
+loop) leaves every host-side cost — packing, slicing, dispatch syscalls,
+multihost local-block assembly — on the critical path between two device
+programs.  This module moves all of it onto a producer thread:
+
+    producer thread:  get_item(k) → put(item) → [transfer timed] → queue
+    caller thread:    queue → consume(k, dev) → release permit
+
+A semaphore of ``depth`` permits bounds how many device items are live
+(transferred or transferring, not yet consumed): ``depth=2`` is the
+classic double buffer (chunk k+1 moves while chunk k computes, ≤2 chunks
+in HBM), ``depth=1`` degrades to fully-serial transfer/compute (the
+measurement baseline), larger depths absorb jittery transports.  A
+permit is released only after ``consume`` returns — consumers that sync
+on their result (the streamed accumulators block on the carry) therefore
+bound actual HBM residency, not just Python references.
+
+Every transfer is timed to completion on the producer thread, so
+:class:`TransferStats` reports ACHIEVED bytes/second, not dispatch rate
+— the distinction that made round 1's throughput numbers wrong (see
+ops/README.md "Measurement discipline").  Stall counters tell the two
+failure stories apart: ``consumer_stalls`` (compute waited on the
+queue: the stream is ingest-bound — the 150× gap's signature) vs
+``producer_stalls`` (transfers waited on compute: the link is keeping
+up and further h2d work is pointless).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Callable
+
+import jax
+
+
+@dataclasses.dataclass
+class TransferStats:
+    """Cumulative host→device transfer observability for one stream.
+
+    Aggregated across passes (``reset()`` between measurement windows);
+    ``gbps``/``chunk_seconds`` derive the headline rates.
+    """
+
+    chunks: int = 0  # transfers completed
+    bytes: int = 0  # host bytes moved
+    h2d_seconds: float = 0.0  # summed per-transfer wall time (to completion)
+    producer_stalls: int = 0  # transfer waited for a free permit (healthy)
+    producer_stall_seconds: float = 0.0
+    consumer_stalls: int = 0  # compute waited for a transfer (ingest-bound)
+    consumer_stall_seconds: float = 0.0
+    passes: int = 0  # completed pipeline runs
+    max_live: int = 0  # high-water of concurrently-live device items
+
+    @property
+    def gbps(self) -> float:
+        """Achieved h2d rate over everything recorded, GB/s."""
+        return (
+            self.bytes / self.h2d_seconds / 1e9 if self.h2d_seconds else 0.0
+        )
+
+    @property
+    def chunk_seconds(self) -> float:
+        """Mean per-chunk transfer wall time."""
+        return self.h2d_seconds / self.chunks if self.chunks else 0.0
+
+    def snapshot(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["gbps"] = self.gbps
+        d["chunk_seconds"] = self.chunk_seconds
+        return d
+
+    def reset(self) -> None:
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, f.default)
+
+
+class _ProducerFailure:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def run_prefetched(
+    n_items: int,
+    get_item: Callable[[int], object],
+    put: Callable[[object], object],
+    consume: Callable[[int, object], None],
+    depth: int = 2,
+    stats: TransferStats | None = None,
+) -> int:
+    """Stream ``n_items`` through a bounded-depth transfer pipeline.
+
+    ``get_item(k)`` (producer thread) materializes the host item — any
+    packing/slicing cost overlaps device compute here.  ``put(item)``
+    (producer thread) dispatches it to the device; the pipeline blocks
+    the producer until the transfer completes, both for honest timing
+    and so ``depth`` bounds bytes in flight.  ``consume(k, dev)``
+    (caller thread) runs the item's compute; items arrive strictly in
+    order.  Returns this run's high-water of live device items (≤
+    ``depth`` by construction).
+
+    Producer exceptions re-raise on the caller thread at the failed
+    item's position; a consumer exception aborts the producer promptly
+    (its permit wait polls an abort flag).
+    """
+    if depth < 1:
+        raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+    if stats is None:
+        stats = TransferStats()
+    if n_items == 0:
+        stats.passes += 1
+        return 0
+
+    q: queue.Queue = queue.Queue()
+    permits = threading.Semaphore(depth)
+    abort = threading.Event()
+    live_lock = threading.Lock()
+    live = 0
+    run_max = 0
+
+    def _bump(delta: int) -> None:
+        nonlocal live, run_max
+        with live_lock:
+            live += delta
+            run_max = max(run_max, live)
+
+    def _producer() -> None:
+        try:
+            for k in range(n_items):
+                if not permits.acquire(blocking=False):
+                    t0 = time.perf_counter()
+                    while not permits.acquire(timeout=0.05):
+                        if abort.is_set():
+                            return
+                    stats.producer_stalls += 1
+                    stats.producer_stall_seconds += (
+                        time.perf_counter() - t0
+                    )
+                if abort.is_set():
+                    return
+                host = get_item(k)
+                nbytes = sum(
+                    leaf.nbytes
+                    for leaf in jax.tree_util.tree_leaves(host)
+                    if hasattr(leaf, "nbytes")
+                )
+                t0 = time.perf_counter()
+                dev = put(host)
+                for leaf in jax.tree_util.tree_leaves(dev):
+                    if hasattr(leaf, "block_until_ready"):
+                        leaf.block_until_ready()
+                stats.h2d_seconds += time.perf_counter() - t0
+                stats.bytes += nbytes
+                stats.chunks += 1
+                _bump(+1)
+                q.put((k, dev))
+                del dev, host
+        except BaseException as exc:  # surfaced on the caller thread
+            q.put(_ProducerFailure(exc))
+
+    producer = threading.Thread(
+        target=_producer, name="h2d-prefetch", daemon=True
+    )
+    producer.start()
+    try:
+        for _ in range(n_items):
+            if q.empty():
+                t0 = time.perf_counter()
+                item = q.get()
+                stats.consumer_stalls += 1
+                stats.consumer_stall_seconds += time.perf_counter() - t0
+            else:
+                item = q.get()
+            if isinstance(item, _ProducerFailure):
+                raise item.exc
+            k, dev = item
+            consume(k, dev)
+            # Drop the device reference BEFORE releasing the permit: the
+            # permit accounting is the HBM bound, and a live reference
+            # here would let a freed permit admit chunk k+depth while
+            # chunk k's buffer still cannot be collected.
+            del dev, item
+            _bump(-1)
+            permits.release()
+    except BaseException:
+        abort.set()
+        raise
+    finally:
+        producer.join(timeout=30.0)
+        while True:  # drop any queued device refs deterministically
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+    stats.passes += 1
+    stats.max_live = max(stats.max_live, run_max)
+    return run_max

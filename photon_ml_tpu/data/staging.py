@@ -1,0 +1,216 @@
+"""Leaf coalescing: one staging buffer per dtype instead of a pytree of
+small transfers.
+
+A chunk of streamed GLM data is a pytree of dozens of numpy leaves (the
+tiled Pallas layout alone carries slot codes, values, spill triples,
+dense stripes and permutation maps).  Moving it with one ``device_put``
+per leaf pays the transport's fixed per-transfer cost dozens of times per
+chunk — on a tunneled dev chip that fixed cost is the whole bill, and
+even on PCIe hosts small transfers run far below the link rate.  Snap ML
+(arXiv:1803.06333) gets its out-of-core GLM throughput from exactly one
+discipline: chunks cross tiers as large contiguous staging buffers.
+
+This module is that discipline for the chunk store:
+
+- :func:`plan_staging` maps a chunk's leaves onto a few dtype-segregated
+  contiguous buffers (one per distinct leaf dtype, each shaped
+  ``(n_shards, elems)`` so mesh placement shards the buffer exactly like
+  the leaves it carries);
+- :func:`pack_chunk` fills those buffers from a chunk's leaves (host
+  side, at store-build time);
+- :func:`chunk_view` rebuilds the chunk as ZERO-COPY numpy views into
+  the buffers, so the host-resident store costs no extra RAM and every
+  existing host-side consumer (weight sums, offset scans, tests) keeps
+  reading plain leaf arrays;
+- :func:`unpack_device` is the compiled on-device inverse — pure
+  slice + reshape, traced INTO the per-chunk program so the restored
+  ``GlmData`` view costs no extra dispatch and no host round trip.
+
+The transfer layer then moves a chunk as ``len(buffers)`` large
+``device_put`` calls (typically 1-3) instead of ``len(leaves)`` small
+ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class _LeafSlot:
+    """Where one pytree leaf lives inside the staging buffers."""
+
+    buffer: int  # index into the dtype-segregated buffer list
+    offset: int  # element offset within one shard's row of that buffer
+    size: int  # elements per shard row
+    shape: tuple  # full host leaf shape
+    shard_shape: tuple  # per-shard shape (== shape when n_shards == 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkStaging:
+    """The staging-buffer layout shared by every chunk of one store.
+
+    Buffers are dtype-segregated: mixing dtypes in one byte buffer would
+    either force per-leaf bitcasts on device or break alignment for
+    sub-word dtypes (the Pallas int16 slot codes).  A chunk store has a
+    handful of distinct dtypes, so the transfer count stays O(1).
+    """
+
+    treedef: Any  # pytree structure (meta fields ride along untransferred)
+    dtypes: tuple  # per-buffer numpy dtype
+    row_elems: tuple  # per-buffer elements per shard row
+    slots: tuple  # _LeafSlot per leaf, in tree_flatten order
+    n_shards: int
+
+    @property
+    def n_buffers(self) -> int:
+        return len(self.dtypes)
+
+    @property
+    def nbytes(self) -> int:
+        """Staged bytes one chunk occupies (= bytes per chunk transfer)."""
+        return sum(
+            self.n_shards * r * np.dtype(dt).itemsize
+            for r, dt in zip(self.row_elems, self.dtypes)
+        )
+
+    def pack(self, chunk) -> tuple:
+        return pack_chunk(self, chunk)
+
+    def view(self, buffers: Sequence[np.ndarray], treedef=None):
+        return chunk_view(self, buffers, treedef)
+
+    def unpack_device(self, buffers):
+        return unpack_device(self, buffers)
+
+
+def _shard_split(shape: tuple, n_shards: int) -> tuple:
+    """Per-shard shape of a leaf.  With ``n_shards > 1`` every chunk leaf
+    carries the leading shard axis (data/streaming.py's stacked layout)."""
+    if n_shards == 1:
+        return shape
+    if not shape or shape[0] != n_shards:
+        raise ValueError(
+            f"sharded chunk leaf has shape {shape}; expected leading "
+            f"shard axis of {n_shards}"
+        )
+    return shape[1:]
+
+
+def plan_staging(chunk, n_shards: int = 1) -> ChunkStaging:
+    """Lay the chunk's leaves out over dtype-segregated staging buffers.
+
+    Every chunk of a store shares one plan (the store uniformizes shapes
+    at build time); :func:`pack_chunk` enforces that.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(chunk)
+    dtypes: list = []
+    row_elems: list = []
+    slots: list = []
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        shard_shape = _shard_split(arr.shape, n_shards)
+        size = int(math.prod(shard_shape))
+        dt = arr.dtype
+        if dt not in dtypes:
+            dtypes.append(dt)
+            row_elems.append(0)
+        b = dtypes.index(dt)
+        slots.append(
+            _LeafSlot(
+                buffer=b,
+                offset=row_elems[b],
+                size=size,
+                shape=tuple(arr.shape),
+                shard_shape=tuple(shard_shape),
+            )
+        )
+        row_elems[b] += size
+    return ChunkStaging(
+        treedef=treedef,
+        dtypes=tuple(dtypes),
+        row_elems=tuple(row_elems),
+        slots=tuple(slots),
+        n_shards=n_shards,
+    )
+
+
+def pack_chunk(staging: ChunkStaging, chunk) -> tuple:
+    """Copy a chunk's leaves into freshly-allocated staging buffers.
+
+    Returns one contiguous ``(n_shards, row_elems)`` array per dtype.
+    Memmap leaves are paged in transiently (one chunk of RAM), which is
+    exactly the disk-backed build's stated peak.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(chunk)
+    if treedef != staging.treedef:
+        raise ValueError(
+            "chunk pytree structure does not match the staging plan "
+            f"({treedef} vs {staging.treedef})"
+        )
+    bufs = [
+        np.empty((staging.n_shards, r), dt)
+        for r, dt in zip(staging.row_elems, staging.dtypes)
+    ]
+    for leaf, slot in zip(leaves, staging.slots):
+        arr = np.asarray(leaf)
+        if tuple(arr.shape) != slot.shape or arr.dtype != staging.dtypes[slot.buffer]:
+            raise ValueError(
+                f"chunk leaf {arr.shape}/{arr.dtype} does not match the "
+                f"staging plan's {slot.shape}/"
+                f"{staging.dtypes[slot.buffer]} — chunks must be "
+                "uniformized before staging"
+            )
+        dst = bufs[slot.buffer][:, slot.offset : slot.offset + slot.size]
+        dst[...] = np.ascontiguousarray(arr).reshape(
+            staging.n_shards, slot.size
+        )
+    return tuple(bufs)
+
+
+def chunk_view(staging: ChunkStaging, buffers: Sequence[np.ndarray],
+               treedef=None):
+    """Rebuild the chunk as zero-copy views into the staging buffers.
+
+    ``treedef`` defaults to the plan's; pass the chunk's OWN treedef when
+    per-chunk metadata must survive (the Pallas ``host_coo`` cold-path
+    triples are pytree META — structurally equal across chunks but
+    content-distinct, and the host-side view must keep each chunk's own).
+    """
+    leaves = []
+    for slot in staging.slots:
+        seg = buffers[slot.buffer][:, slot.offset : slot.offset + slot.size]
+        leaves.append(seg.reshape(slot.shape))
+    return jax.tree_util.tree_unflatten(
+        staging.treedef if treedef is None else treedef, leaves
+    )
+
+
+def unpack_device(staging: ChunkStaging, buffers):
+    """The compiled on-device unpack: slice + reshape only, traced into
+    the per-chunk program.
+
+    Works on the full ``(n_shards, row)`` buffers AND on the ``(1, row)``
+    per-device blocks seen inside ``shard_map`` — the leading dim is read
+    off the traced buffer, so one definition serves both contexts.
+    """
+    import jax.numpy as jnp  # noqa: F401  (kept local: host module import)
+    from jax import lax
+
+    leaves = []
+    for slot in staging.slots:
+        buf = buffers[slot.buffer]
+        seg = lax.slice_in_dim(
+            buf, slot.offset, slot.offset + slot.size, axis=1
+        )
+        if staging.n_shards == 1:
+            leaves.append(seg.reshape(slot.shape))
+        else:
+            leaves.append(seg.reshape((buf.shape[0],) + slot.shard_shape))
+    return jax.tree_util.tree_unflatten(staging.treedef, leaves)

@@ -16,6 +16,21 @@ Design constraints that shape this module:
   nnz budget, :func:`~photon_ml_tpu.ops.sparse_pallas.uniformize_pallas_layouts`
   for the tiled layouts).  A retrace per chunk would dwarf the transfer
   cost.
+- **Chunks move as coalesced staging buffers, and live there too.**  A
+  chunk's pytree has dozens of small leaves (slot codes, spill triples,
+  dense stripes...), and one ``device_put`` per leaf pays the
+  transport's fixed per-transfer cost per LEAF instead of per CHUNK —
+  the dominant term in the round-5 150× streamed-vs-resident gap.  At
+  build time each finished chunk is therefore packed into a few
+  dtype-segregated contiguous staging buffers (data/staging.py), shaped
+  ``(n_shards, elems)`` so mesh placement shards a buffer exactly like
+  the leaves it carries.  ``chunks[k]`` stays the familiar
+  :class:`GlmData` pytree, but its numpy leaves are ZERO-COPY VIEWS
+  into ``staged[k]`` — host consumers read leaves, the transfer layer
+  moves buffers, and the store pays no second copy.  A transfer is
+  1-3 large ``device_put`` calls + a compiled slice/reshape unpack
+  fused into the per-chunk program (Snap ML's pinned-staging-buffer
+  discipline, arXiv:1803.06333).
 - **Chunks hold numpy leaves**, never device arrays: the whole point is
   that the resident set exceeds HBM.
 - **Ingest is incremental**: :func:`streaming_from_blocks` re-cuts an
@@ -23,7 +38,12 @@ Design constraints that shape this module:
   boundaries as blocks arrive, building each chunk's device layout the
   moment it fills and dropping the raw rows — peak host memory is the
   finished chunk store plus ~one chunk of raw buffer, never a second full
-  copy of the dataset.
+  copy of the dataset.  Staging packs one chunk at a time, so the peak
+  gains only ~one transient chunk copy.
+- **Disk-backed stores spill the STAGING buffers** (1-3 ``.npy`` files
+  per chunk, memmapped back; leaf views slice the memmap), so a
+  disk-resident chunk still reaches the device as a few large paged
+  reads, not dozens of small ones.
 - **Padding discipline**: rows added to fill the last chunk carry weight 0
   (exactly like the mesh row-padding in parallel/distributed.py), so every
   objective/metric reduction is unchanged.
@@ -39,6 +59,12 @@ import jax
 import numpy as np
 
 from photon_ml_tpu.data.dataset import GlmData
+from photon_ml_tpu.data.staging import (
+    ChunkStaging,
+    chunk_view,
+    pack_chunk,
+    plan_staging,
+)
 from photon_ml_tpu.ops.sparse import (
     DenseMatrix,
     SparseMatrix,
@@ -73,13 +99,24 @@ class StreamingGlmData:
     0).  With ``n_shards > 1`` every array additionally carries a leading
     shard axis for data-parallel placement (the streamed analogue of
     parallel/distributed.DistributedGlmData).
+
+    ``staged``/``staging``: the coalesced transfer representation — per
+    chunk, a tuple of dtype-segregated contiguous staging buffers whose
+    layout :class:`~photon_ml_tpu.data.staging.ChunkStaging` records.
+    When present, ``chunks[k]``'s leaves are zero-copy views into
+    ``staged[k]`` (no second host copy) and consumers transfer the
+    buffers instead of the leaf pytree.  Builder-produced stores are
+    always staged; :meth:`ensure_staged` retrofits hand-built RAM
+    stores.
     """
 
-    chunks: list  # list[GlmData], numpy leaves
+    chunks: list  # list[GlmData], numpy leaves (views into staged[k])
     n_rows: int  # real (unpadded) row count over all chunks
     n_features: int
     chunk_rows: int  # rows per chunk (uniform, incl. padding)
     n_shards: int = 1
+    staging: ChunkStaging | None = None
+    staged: list | None = None  # per chunk: tuple of staging buffers
 
     @property
     def n_chunks(self) -> int:
@@ -109,29 +146,56 @@ class StreamingGlmData:
         the same cached stream)."""
         return self._has_nonzero_offsets
 
+    def ensure_staged(self) -> bool:
+        """Pack the chunks into coalesced staging buffers if they are not
+        already (hand-built stores; builder output is pre-staged).
 
-def spill_tree(tree, dir_: str, tag: str, skip_memmaps: bool = False):
+        Returns whether the store is staged afterwards.  Disk-backed
+        (memmap-leaf) stores that were not staged at build time are left
+        alone — packing them here would materialize the whole store in
+        RAM, the exact bound the memmaps exist to avoid."""
+        if self.staged is not None:
+            return True
+        if not self.chunks:
+            return False
+        if any(
+            isinstance(leaf, np.memmap)
+            for leaf in jax.tree_util.tree_leaves(self.chunks[0])
+        ):
+            return False
+        staging = plan_staging(self.chunks[0], self.n_shards)
+        staged, views = [], []
+        for c in self.chunks:
+            bufs = pack_chunk(staging, c)
+            treedef = jax.tree_util.tree_structure(c)
+            staged.append(bufs)
+            # Replace the originals with views so the buffers hold the
+            # only copy (packing is a re-residency, not a duplication).
+            views.append(chunk_view(staging, bufs, treedef))
+        self.staging = staging
+        self.staged = staged
+        self.chunks = views
+        return True
+
+
+def spill_tree(tree, dir_: str, tag: str):
     """Replace a pytree's numpy leaves with disk-backed memmaps (one
     ``.npy`` per leaf under ``dir_``).  Downstream code is agnostic:
     ``np.memmap`` is an ndarray, ``device_put`` pages it straight from
     disk, and ``np.asarray`` materializes transiently.  The spill step of
     the MEMORY_AND_DISK residency ladder (the reference persists its
-    RDDs exactly so — SURVEY.md §2).
-
-    ``skip_memmaps``: leave already-disk-backed leaves untouched instead
-    of re-saving them — ONLY safe when their backing files live in a
-    directory that outlives this store (the dense chunks' finish-time
-    spill); re-spilling is the default because pallas/coo finalize leaves
-    may still reference the transient ``raw/`` spill."""
+    RDDs exactly so — SURVEY.md §2).  The chunk store itself no longer
+    spills per-leaf — its final chunks go to disk as packed staging
+    buffers (see the module docstring); this helper serves the
+    random-effect datasets and the builder's transient pre-uniformization
+    spill."""
     import os
 
     os.makedirs(dir_, exist_ok=True)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     out = []
     for i, leaf in enumerate(leaves):
-        if skip_memmaps and isinstance(leaf, np.memmap):
-            out.append(leaf)
-        elif isinstance(leaf, np.ndarray) and leaf.size > 0:
+        if isinstance(leaf, np.ndarray) and leaf.size > 0:
             path = os.path.join(dir_, f"{tag}_{i}.npy")
             np.save(path, np.ascontiguousarray(leaf))
             out.append(np.load(path, mmap_mode="r"))
@@ -299,10 +363,15 @@ def streaming_from_blocks(
             # layouts are uniformized together at the end, so one
             # shard_map program serves every chunk (streamed DP at the
             # kernel rate, not the COO rate).
-            ctx = jax.default_device(cpu) if cpu is not None else _nullctx()
             shard_mats = []
             for s in range(max(n_shards, 1)):
                 coo = X[s * per_shard:(s + 1) * per_shard].tocoo()
+                # A fresh context per entry: jax.default_device returns a
+                # single-use context manager on older jax releases.
+                ctx = (
+                    jax.default_device(cpu) if cpu is not None
+                    else _nullctx()
+                )
                 with ctx:
                     P = build_pallas_matrix(
                         coo.row.astype(np.int64), coo.col.astype(np.int64),
@@ -433,16 +502,49 @@ def streaming_from_blocks(
         raise ValueError("no blocks")
     _drain(final=True)
 
-    def _maybe_spill_chunk(
-        gd: GlmData, k: int, skip_memmaps: bool = False
-    ) -> GlmData:
-        if storage_dir is None:
-            return gd
-        return spill_tree(
-            gd, storage_dir, f"chunk{k}", skip_memmaps=skip_memmaps
-        )
+    staging_box: list = [None]  # ChunkStaging, planned on the first chunk
+    staged: list = []
 
-    # Finalize: uniform shapes across chunks.
+    def _finalize_chunk(gd: GlmData, k: int) -> GlmData:
+        """Stage one finished uniform chunk: pack its leaves into the
+        dtype-segregated coalesced buffers (RAM: the buffers become the
+        only copy, leaves turn into views; disk: the BUFFERS are what
+        spills — 1-3 memmapped ``.npy`` per chunk instead of one per
+        leaf).  One chunk is transiently duplicated during the pack,
+        matching the build's stated peak-memory discipline."""
+        if staging_box[0] is None:
+            staging_box[0] = plan_staging(gd, n_shards)
+        plan = staging_box[0]
+        old_files = [
+            leaf.filename
+            for leaf in jax.tree_util.tree_leaves(gd)
+            if isinstance(leaf, np.memmap)
+            and getattr(leaf, "filename", None)
+        ]
+        bufs = pack_chunk(plan, gd)
+        if storage_dir is not None:
+            spilled = []
+            for b, buf in enumerate(bufs):
+                path = os.path.join(storage_dir, f"chunk{k}_stage{b}.npy")
+                np.save(path, buf)
+                spilled.append(np.load(path, mmap_mode="r"))
+            bufs = tuple(spilled)
+            for path in old_files:
+                # Finish-time per-leaf spills (the dense path) are
+                # superseded by the packed buffers; removing them keeps
+                # the directory's footprint at ~one staged store.
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+        treedef = jax.tree_util.tree_structure(gd)
+        staged.append(bufs)
+        # The view keeps this chunk's OWN metadata (host_coo cold-path
+        # triples differ per chunk even though their shape class — and
+        # so the staging plan — is uniform).
+        return chunk_view(plan, bufs, treedef)
+
+    # Finalize: uniform shapes across chunks, then stage.
     chunks = []
     if mode == "pallas":
         from photon_ml_tpu.ops.sparse_pallas import (
@@ -473,7 +575,7 @@ def streaming_from_blocks(
                     w.reshape(n_shards, per_shard),
                     o.reshape(n_shards, per_shard),
                 )
-            chunks.append(_maybe_spill_chunk(gd, k))
+            chunks.append(_finalize_chunk(gd, k))
             finished[k] = None  # drop the pre-pad layouts as we go
     elif mode == "coo":
         budget = max(
@@ -509,7 +611,7 @@ def streaming_from_blocks(
                     w.reshape(n_shards, per_shard),
                     o.reshape(n_shards, per_shard),
                 )
-            chunks.append(_maybe_spill_chunk(gd, k))
+            chunks.append(_finalize_chunk(gd, k))
             finished[k] = None
     else:
         for k, (feat, (y, w, o)) in enumerate(zip(finished, vectors)):
@@ -522,10 +624,10 @@ def streaming_from_blocks(
                     w.reshape(n_shards, per_shard),
                     o.reshape(n_shards, per_shard),
                 )
-            # Dense feature leaves were spilled at finish (into files
-            # that OUTLIVE the store — not raw/); only the row vectors
-            # still need the disk trip.
-            chunks.append(_maybe_spill_chunk(gd, k, skip_memmaps=True))
+            # Dense feature leaves spilled at finish time are packed
+            # into the staging buffers here (and their per-leaf files
+            # removed — the buffers supersede them).
+            chunks.append(_finalize_chunk(gd, k))
 
     if raw_dir is not None:
         # The pre-uniformization spill is dead weight once the padded
@@ -538,4 +640,6 @@ def streaming_from_blocks(
         n_features=d,
         chunk_rows=chunk_rows,
         n_shards=n_shards,
+        staging=staging_box[0],
+        staged=staged,
     )

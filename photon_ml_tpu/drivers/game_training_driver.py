@@ -108,6 +108,8 @@ def parse_coordinate_config(spec: dict):
             # >0: train this coordinate out-of-core (host-RAM chunks of
             # this many rows streamed through HBM — game/streaming.py).
             streaming_chunk_rows=int(spec.get("streaming_chunk_rows", 0)),
+            # chunks the ingest pipeline keeps in flight when streaming.
+            prefetch_depth=int(spec.get("prefetch_depth", 2)),
         )
     if spec["type"] == "random":
         return name, RandomEffectCoordinateConfig(
@@ -123,6 +125,7 @@ def parse_coordinate_config(spec: dict):
             device_budget_bytes=int(
                 float(spec.get("device_budget_mb", 0)) * 2**20
             ),
+            prefetch_depth=int(spec.get("prefetch_depth", 2)),
         )
     if spec["type"] in ("factored_random", "factored"):
         proj_rw = spec.get("projection_reg_weight")
@@ -141,6 +144,7 @@ def parse_coordinate_config(spec: dict):
             device_budget_bytes=int(
                 float(spec.get("device_budget_mb", 0)) * 2**20
             ),
+            prefetch_depth=int(spec.get("prefetch_depth", 2)),
         )
     raise ValueError(f"unknown coordinate type {spec['type']!r}")
 

@@ -181,6 +181,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "leaves — host RAM stops bounding the trainable size, disk does "
         "(the reference's MEMORY_AND_DISK RDD persistence)",
     )
+    p.add_argument(
+        "--stream-prefetch-depth",
+        type=int,
+        default=2,
+        help="with --stream-chunk-rows: how many chunks the background "
+        "ingest pipeline keeps in flight (HBM holds at most this many). "
+        "2 = the classic double buffer; 1 serializes transfer and "
+        "compute (measurement baseline)",
+    )
     add_compile_cache_arg(p)
     return p
 
@@ -347,8 +356,30 @@ def _run(args) -> dict:
     from photon_ml_tpu.io.checkpoint import GridCheckpointer
     from photon_ml_tpu.io.model_store import load_glm_model
 
+    # Fingerprint the RESOLVED box constraints (the arrays the solver
+    # actually sees): a --resume against a checkpoint written under
+    # different bounds would warm-start the remaining λs from
+    # incompatibly-constrained coefficients and silently blend two
+    # models (the CD locked-set guard's failure mode, ADVICE r5).
+    bounds_fp = None
+    if bounds is not None:
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(np.asarray(bounds[0])).tobytes())
+        h.update(np.ascontiguousarray(np.asarray(bounds[1])).tobytes())
+        bounds_fp = h.hexdigest()
+
     ckpt = GridCheckpointer(os.path.join(args.output_dir, "checkpoints"))
     if args.resume:
+        saved_fp = ckpt.load_meta().get("bounds_fingerprint")
+        if ckpt.exists() and saved_fp != bounds_fp:
+            raise SystemExit(
+                "--resume: the grid checkpoint was written under "
+                f"different --coefficient-bounds (saved fingerprint "
+                f"{saved_fp}, this run {bounds_fp}); clear "
+                f"{ckpt.path} or rerun with the matching bounds"
+            )
         solved = ckpt.load()
     else:
         # A stale checkpoint (possibly from a run on different data or
@@ -418,7 +449,9 @@ def _run(args) -> dict:
 
         def on_solved(lam, w):
             solved_acc[lam] = np.asarray(w)
-            ckpt.save(solved_acc)
+            ckpt.save(
+                solved_acc, extra_meta={"bounds_fingerprint": bounds_fp}
+            )
 
         if streaming:
             from photon_ml_tpu.optim.streaming import streaming_run_grid
@@ -427,6 +460,7 @@ def _run(args) -> dict:
             return streaming_run_grid(
                 problem, stream, reg_weights, w0=w0, mesh=mesh,
                 solved=solved_now, on_solved=on_solved, l1_mask=l1_mask,
+                prefetch_depth=args.stream_prefetch_depth,
             )
         if data_parallel:
             from photon_ml_tpu.parallel.distributed import (

@@ -185,13 +185,15 @@ class CoordinateDescent:
             # metrics (device_auc computes in f64 there) keep full
             # precision — f32→f64 casts are exact.  Int/bool scalars (a
             # user eval_fn recording counts/flags) would corrupt through
-            # a float stack, so they ride a second int64 stack — paid
-            # only when one exists.  A per-leaf device_get would pay one
-            # transport round trip per scalar, the very cost this flush
-            # amortizes.
+            # a float stack; they materialize via HOST-side numpy
+            # stacking instead: with x64 off, a device jnp.stack would
+            # funnel them through int32 and silently wrap counts above
+            # 2^31, while numpy preserves each scalar's own dtype
+            # (uint32 counts to 4e9 included).  That costs one readback
+            # per int/bool scalar — paid only when one exists; the big
+            # float stack keeps the single batched readback.
             x64 = jax.config.jax_enable_x64
             fdt = jnp.float64 if x64 else jnp.float32
-            idt = jnp.int64 if x64 else jnp.int32  # widest available
             stacks = {"f": [], "i": [], "b": []}
 
             def grab(a):
@@ -213,10 +215,13 @@ class CoordinateDescent:
                 for entry in pending
             ]
             vals = {
-                k: np.asarray(jnp.stack([
-                    jnp.asarray(v, fdt if k == "f" else idt)
-                    for v in stack
-                ]))
+                k: (
+                    np.asarray(
+                        jnp.stack([jnp.asarray(v, fdt) for v in stack])
+                    )
+                    if k == "f"
+                    else np.stack([np.asarray(v) for v in stack])
+                )
                 for k, stack in stacks.items() if stack
             }
             cast = {"f": float, "i": int, "b": bool}

@@ -28,6 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from photon_ml_tpu.parallel.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.game.coordinates import (
@@ -104,7 +105,7 @@ class DistributedFixedEffectCoordinate(FixedEffectCoordinate):
             return dd.local().features.matvec(w)[None, :]
 
         self._train_sm = jax.jit(
-            jax.shard_map(
+            shard_map(
                 _train,
                 mesh=mesh,
                 in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P()),
@@ -113,7 +114,7 @@ class DistributedFixedEffectCoordinate(FixedEffectCoordinate):
             )
         )
         self._score_sm = jax.jit(
-            jax.shard_map(
+            shard_map(
                 _score,
                 mesh=mesh,
                 in_specs=(P(DATA_AXIS), P()),
@@ -135,7 +136,7 @@ class DistributedFixedEffectCoordinate(FixedEffectCoordinate):
             )
 
         self._var_sm = jax.jit(
-            jax.shard_map(
+            shard_map(
                 _variances,
                 mesh=mesh,
                 in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P()),

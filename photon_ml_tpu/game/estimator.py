@@ -51,11 +51,14 @@ class FixedEffectCoordinateConfig:
     #: rows get training weight 0, not removal, so shapes stay static.
     down_sampling_rate: float = 1.0
     #: >0 trains this coordinate OUT-OF-CORE: the shard lives in host RAM
-    #: as chunks of this many rows, double-buffered through HBM per
-    #: objective pass (game/streaming.py) — for fixed-effect datasets
-    #: larger than device memory.  Single-device; all three optimizers
-    #: stream (L-BFGS, OWL-QN for L1/elastic-net, smooth TRON).
+    #: as chunks of this many rows, streamed through HBM per objective
+    #: pass (game/streaming.py) — for fixed-effect datasets larger than
+    #: device memory.  All three optimizers stream (L-BFGS, OWL-QN for
+    #: L1/elastic-net, smooth TRON).
     streaming_chunk_rows: int = 0
+    #: chunks the ingest pipeline keeps in flight when streaming (HBM
+    #: holds at most this many; 2 = the classic double buffer).
+    prefetch_depth: int = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +82,9 @@ class RandomEffectCoordinateConfig:
     #: host-resident between passes.  Composes with a mesh (the budget
     #: then bounds per-device bytes).
     device_budget_bytes: int = 0
+    #: pass groups the ingest pipeline keeps in flight when out-of-core
+    #: (each group sized to device_budget_bytes / prefetch_depth).
+    prefetch_depth: int = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,6 +108,8 @@ class FactoredRandomEffectCoordinateConfig:
     #: host-resident between passes, and the shared projection V fits by
     #: host-loop L-BFGS with one streamed pass per evaluation.
     device_budget_bytes: int = 0
+    #: pass groups the ingest pipeline keeps in flight when out-of-core.
+    prefetch_depth: int = 2
 
 
 CoordinateConfig = (
@@ -243,6 +251,7 @@ class GameEstimator:
                         name, stream, self.task, cfg.optimization,
                         cfg.reg_weight, feature_shard=cfg.feature_shard,
                         mesh=self.mesh,
+                        prefetch_depth=cfg.prefetch_depth,
                     ))
                     continue
                 if self.mesh is not None:
@@ -305,6 +314,7 @@ class GameEstimator:
                                 entity_key=cfg.entity_key,
                                 device_budget_bytes=cfg.device_budget_bytes,
                                 mesh=self.mesh,
+                                prefetch_depth=cfg.prefetch_depth,
                             )
                         )
                         continue
@@ -318,6 +328,7 @@ class GameEstimator:
                         entity_key=cfg.entity_key,
                         device_budget_bytes=cfg.device_budget_bytes,
                         mesh=self.mesh,
+                        prefetch_depth=cfg.prefetch_depth,
                     ))
                     continue
                 if self.mesh is not None:

@@ -70,6 +70,7 @@ class OutOfCoreFactoredRandomEffectCoordinate(OutOfCoreRandomEffectCoordinate):
         device_budget_bytes: int = 256 * 2**20,
         mesh=None,
         seed: int = 0,
+        prefetch_depth: int = 2,
     ):
         if rank < 1:
             raise ValueError(f"rank must be >= 1, got {rank}")
@@ -80,6 +81,7 @@ class OutOfCoreFactoredRandomEffectCoordinate(OutOfCoreRandomEffectCoordinate):
             name, dataset, task, config, reg_weight=reg_weight,
             feature_shard=feature_shard, entity_key=entity_key,
             device_budget_bytes=device_budget_bytes, mesh=mesh,
+            prefetch_depth=prefetch_depth,
         )
         self.projection_reg_weight = (
             reg_weight if projection_reg_weight is None

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from photon_ml_tpu.data.prefetch import TransferStats, run_prefetched
 from photon_ml_tpu.game.coordinates import (
     RandomEffectCoordinate,
     _gather_block_offsets,
@@ -181,6 +182,7 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         entity_key: str = "",
         device_budget_bytes: int = 256 * 2**20,
         mesh=None,
+        prefetch_depth: int = 2,
     ):
         # Deliberately NOT calling super().__init__: the resident
         # constructor jits one whole-dataset program, which is exactly what
@@ -193,6 +195,14 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         self.feature_shard = feature_shard
         self.entity_key = entity_key or name
         self.device_budget_bytes = int(device_budget_bytes)
+        if prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {prefetch_depth}"
+            )
+        self.prefetch_depth = int(prefetch_depth)
+        #: h2d observability for this coordinate's group transfers — the
+        #: same TransferStats the streamed fixed effect exposes.
+        self.transfer_stats = TransferStats()
         if mesh is not None and jax.process_count() > 1:
             # Same early rejection as StreamingFixedEffectCoordinate:
             # _put would device_put per-process host numpy onto a
@@ -218,8 +228,9 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
 
         self.pass_plan = self._build_plan()
         #: high-water mark of pass groups with live device buffers —
-        #: the structural "bounded memory" witness the tests pin (≤2:
-        #: the solving group plus the prefetched next one).
+        #: the structural "bounded memory" witness the tests pin
+        #: (≤ prefetch_depth; 2 by default: the solving group plus the
+        #: prefetched next one).
         self.live_groups_high_water = 0
 
         # Process-wide memoized programs (per-instance jits re-compiled
@@ -245,11 +256,13 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         Each original block is cut into ``n_parts`` uniform sub-slices
         (ceil division, padded to the mesh quantum) so the whole block
         contributes ONE compiled shape; groups then fill greedily to the
-        per-pass budget (= budget/2, the double-buffering reserve).
+        per-pass budget (= budget/prefetch_depth — the pipeline keeps up
+        to that many groups live on the device; depth 2 is the classic
+        double-buffering reserve).
         """
         budget = (
             self.device_budget_bytes - self._budget_overhead_bytes()
-        ) // 2
+        ) // self.prefetch_depth
         if budget <= 0:
             raise ValueError(
                 f"random-effect coordinate {self.name!r}: "
@@ -277,7 +290,8 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
                     f"(R={block.rows_per_entity}, D={block.block_dim}) "
                     f"needs {per_lane * q} bytes, over the "
                     f"per-pass budget {budget} (= (device_budget_bytes "
-                    f"- {self._budget_overhead_bytes()} overhead) / 2). "
+                    f"- {self._budget_overhead_bytes()} overhead) / "
+                    f"prefetch_depth={self.prefetch_depth}). "
                     "Raise device_budget_bytes or lower "
                     "max_rows_per_entity / bucket_growth"
                 )
@@ -319,32 +333,26 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         )
 
     def _run_groups(self, make_host_group, consume):
-        """Double-buffered group runner: group g+1's transfer is enqueued
-        BEFORE ``consume(group, dev)`` blocks on group g's results, so the
-        next transfer rides under the current solve.  A callback (not a
-        generator) so group g's device references provably die before
-        group g+2's transfer is enqueued — a yield-based version kept
-        three groups alive at the put (the consumer's loop variable is
-        rebound only after the generator resumes), silently making peak
-        memory 1.5x the budget.  ``make_host_group(group) → host pytree
-        list``."""
+        """Prefetch-pipelined group runner (the chunk store's ingest
+        pipeline, data/prefetch.py): a producer thread slices the NEXT
+        group on the host and dispatches its transfer while the caller
+        thread consumes the current one, with at most ``prefetch_depth``
+        groups live on the device (the permit accounting replaces the
+        old hand-rolled double buffer — and its reference-lifetime
+        subtleties — outright).  ``make_host_group(group) → host pytree
+        list``; host slicing cost now overlaps device compute too."""
         plan = self.pass_plan
         self.live_groups_high_water = 0
         if not plan:
             return
-        live = 1
-        nxt = self._put(make_host_group(plan[0]))
-        for gi, group in enumerate(plan):
-            cur, nxt = nxt, None
-            if gi + 1 < len(plan):
-                nxt = self._put(make_host_group(plan[gi + 1]))
-                live += 1
-            self.live_groups_high_water = max(
-                self.live_groups_high_water, live
-            )
-            consume(group, cur)
-            del cur
-            live -= 1
+        self.live_groups_high_water = run_prefetched(
+            len(plan),
+            lambda gi: make_host_group(plan[gi]),
+            self._put,
+            lambda gi, dev: consume(plan[gi], dev),
+            depth=self.prefetch_depth,
+            stats=self.transfer_stats,
+        )
 
     # -- coordinate surface ------------------------------------------------
 

@@ -63,6 +63,7 @@ class StreamingFixedEffectCoordinate(Coordinate):
         feature_shard: str = "global",
         accumulate: str = "f32",
         mesh=None,
+        prefetch_depth: int = 2,
     ):
         """``mesh``: streams each chunk SHARDED over the mesh's first axis
         (chunks must be built with ``n_shards == mesh size``) — streamed
@@ -99,7 +100,8 @@ class StreamingFixedEffectCoordinate(Coordinate):
         self.reg_weight = reg_weight
         self.feature_shard = feature_shard
         self._sobj = StreamingObjective(
-            self.task, stream, accumulate=accumulate, mesh=mesh
+            self.task, stream, accumulate=accumulate, mesh=mesh,
+            prefetch_depth=prefetch_depth,
         )
         opt = config.optimizer
         self._lbfgs = LBFGSConfig(
@@ -112,6 +114,12 @@ class StreamingFixedEffectCoordinate(Coordinate):
             tolerance=opt.tolerance,
             history=opt.history,
         )
+
+    @property
+    def transfer_stats(self):
+        """The underlying stream's h2d observability (data/prefetch.py's
+        TransferStats) — per-chunk timing, GB/s, stall counters."""
+        return self._sobj.transfer_stats
 
     @property
     def _l1_frac(self) -> float:

@@ -222,15 +222,21 @@ class GridCheckpointer:
         if self.exists():
             os.remove(self.path)
 
-    def save(self, solved: dict) -> None:
-        """``solved``: λ (float) → coefficient vector, in solve order."""
+    def save(self, solved: dict, extra_meta: Optional[dict] = None) -> None:
+        """``solved``: λ (float) → coefficient vector, in solve order.
+
+        ``extra_meta``: JSON-able run-configuration metadata persisted
+        alongside (e.g. the driver's ``--coefficient-bounds``
+        fingerprint) so a ``--resume`` can refuse a checkpoint written
+        under a different configuration."""
         os.makedirs(self.directory, exist_ok=True)
         arrays = {
             f"w__{i}": np.asarray(w) for i, w in enumerate(solved.values())
         }
-        arrays["__meta__"] = np.asarray(
-            json.dumps({"lambdas": [float(lam) for lam in solved]})
-        )
+        meta = {"lambdas": [float(lam) for lam in solved]}
+        if extra_meta:
+            meta.update(extra_meta)
+        arrays["__meta__"] = np.asarray(json.dumps(meta))
         _atomic_savez(self.path, arrays)
 
     def load(self) -> dict:
@@ -240,6 +246,12 @@ class GridCheckpointer:
             return {}
         meta, arrays = loaded
         return {lam: arrays[f"w__{i}"] for i, lam in enumerate(meta["lambdas"])}
+
+    def load_meta(self) -> dict:
+        """The checkpoint's metadata dict ({} when no checkpoint exists):
+        ``lambdas`` plus whatever ``extra_meta`` the writer recorded."""
+        loaded = _load_npz_with_meta(self.path)
+        return {} if loaded is None else loaded[0]
 
 
 class GameGridCheckpointer:

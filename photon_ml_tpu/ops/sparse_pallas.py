@@ -567,6 +567,13 @@ def _tiled_apply(code, val, vec_padded, *, nbo, nbg, square, unit=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # jax renamed pltpu.TPUCompilerParams → pltpu.CompilerParams; accept
+    # both so the kernels (and interpret-mode CPU tests) run on either
+    # side of the rename.
+    compiler_params_cls = getattr(
+        pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+    )
+
     a = code.shape[2]
     batch, chunk = _pick_rect(nbo, nbg, a, unit=unit)
     tab = vec_padded.reshape(nbg, WINS, WIN)
@@ -593,7 +600,7 @@ def _tiled_apply(code, val, vec_padded, *, nbo, nbg, square, unit=False):
         in_specs=in_specs,
         out_specs=pl.BlockSpec((batch, WINS, WIN), lambda i, j: (i, 0, 0),
                                memory_space=pltpu.VMEM),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params_cls(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(*operands)

@@ -61,6 +61,13 @@ class SolveResult(NamedTuple):
     converged: Array  # bool
     values: Array  # (max_iters+1,) objective per iteration (nan-padded)
     grad_norms: Array  # (max_iters+1,)
+    # True when the solve EXITED without meeting the gradient-norm
+    # tolerance (objective-plateau or failed-line-search exit) —
+    # distinct from ``converged`` so callers can tell a constrained
+    # stationary point from a stall.  None for solvers that fold the
+    # plateau exit into ``converged`` (the historical contract); SPG
+    # reports it.
+    stalled: Array | None = None
 
 
 class _LBFGSState(NamedTuple):

@@ -130,6 +130,18 @@ class GlmOptimizationProblem:
         obj = self.objective
         cfg = self.config
         d = data.n_features
+        if bounds is not None and cfg.compute_variances:
+            # The diag-inverse-Hessian variance (coefficient_variances)
+            # assumes an interior optimum; a coefficient pinned at an
+            # active bound has a nonzero gradient there and its reported
+            # variance would be meaningless.  Static config check, so it
+            # raises at trace time, before any compute is spent.
+            raise ValueError(
+                "bounds are incompatible with compute_variances=True: "
+                "diag-inverse-Hessian variances assume an interior "
+                "optimum and are wrong for coefficients at an active "
+                "bound — drop the bounds or the variance request"
+            )
         if w0 is None:
             w0 = jnp.zeros((d,), jnp.float32)
         reg_weight = jnp.asarray(reg_weight, w0.dtype)
@@ -294,6 +306,14 @@ class GlmOptimizationProblem:
     ) -> list[tuple[float, GeneralizedLinearModel, Optional[SolveResult]]]:
         """Train one model per regularization weight (see :meth:`grid_loop`
         for the warm-start/checkpoint semantics)."""
+        if bounds is not None and self.config.compute_variances:
+            # Mirrors solve()'s guard, but raised eagerly here — before
+            # the grid loop touches the device at all.
+            raise ValueError(
+                "run_grid with bounds is incompatible with "
+                "compute_variances=True: diag-inverse-Hessian variances "
+                "assume an interior optimum (see solve())"
+            )
 
         def solve_fn(lam, w_prev):
             return (

@@ -52,7 +52,14 @@ def spg_solve(
     """Minimize subject to ``lower <= w <= upper`` (±inf entries leave a
     coefficient unconstrained).  Returns the same :class:`SolveResult`
     as the unconstrained solvers; ``grad_norms`` tracks the
-    projected-gradient norm (the constrained optimality measure)."""
+    projected-gradient norm (the constrained optimality measure).
+
+    ``converged`` is True ONLY when the projected-gradient norm met the
+    tolerance — the constrained stationarity test.  An
+    objective-plateau (ftol) or failed-backtrack exit that never met it
+    ends the loop with ``converged=False`` and ``stalled=True``
+    instead: reporting a plateau as convergence hid genuinely stuck
+    solves behind a green flag (ADVICE r5)."""
     f0, g0 = value_and_grad(jnp.clip(w0, lower, upper))
     # The objective's gradient dtype governs the whole carry (a f32 w0
     # against a f64 objective would otherwise promote mid-loop and break
@@ -139,21 +146,27 @@ def spg_solve(
         k = k + 1
         pg = pnorm(w_next - project(w_next - g_next), w_axis)
         rel_impr = jnp.abs(f - f_next) / jnp.maximum(jnp.abs(f), 1e-12)
-        converged = jnp.logical_or(
-            pg <= config.tolerance * tol_scale,
-            jnp.logical_and(~stalled, rel_impr <= config.tolerance * 1e-2),
+        # ``converged`` is the stationarity test alone; an ftol plateau
+        # (or a stalled backtrack) ends the loop WITHOUT claiming it.
+        converged = pg <= config.tolerance * tol_scale
+        plateau = jnp.logical_and(
+            ~stalled, rel_impr <= config.tolerance * 1e-2
         )
+        done = jnp.logical_or(converged, jnp.logical_or(plateau, stalled))
         return (
             w_next, f_next, g_next, alpha_next, k,
-            jnp.logical_or(converged, stalled), converged,
+            done, converged,
             values.at[k].set(f_next.astype(dtype)),
             gnorms.at[k].set(pg),
         )
 
-    w, f, g, _a, k, _done, converged, values, gnorms = lax.while_loop(
+    w, f, g, _a, k, done, converged, values, gnorms = lax.while_loop(
         cond, body, init
     )
     return SolveResult(
         w=w, value=f, grad=g, iterations=k, converged=converged,
         values=values, grad_norms=gnorms,
+        # Exited early without stationarity (plateau / failed backtrack);
+        # False on a max_iters exit, which claims neither.
+        stalled=jnp.logical_and(done, ~converged),
     )

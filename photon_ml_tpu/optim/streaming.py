@@ -7,15 +7,20 @@ hundreds of GB of slot data), the structure inverts to the reference's own
 shape: the OUTER loop runs on the host (the reference's driver-side Breeze
 L-BFGS — SURVEY.md §2 Optimizers), and each objective evaluation is one
 full pass over the data (the ``treeAggregate`` analogue, SURVEY.md §3.1) —
-here a double-buffered ``device_put`` stream of host chunks, value/grad
-accumulated on device:
+here a pipelined stream of host chunks, value/grad accumulated on device:
 
-    host chunk k+1  ──transfer──►  HBM buffer B     (overlaps)
-    HBM buffer A (chunk k)  ──Pallas/XLA──►  (value, grad) += chunk k
+    producer thread: pack/fetch chunk k+1 ──one coalesced transfer──► HBM
+    caller thread:   HBM chunk k ──unpack+Pallas/XLA──► (value, grad) +=
 
-HBM holds ~2 chunks regardless of dataset size.  The inner per-chunk
-program is ONE jitted function for all chunks (uniform shapes — see
-data/streaming.py), so there is exactly one compile per solve.
+Each chunk crosses as a few large dtype-segregated staging buffers
+(data/staging.py) rather than a pytree of small per-leaf transfers, a
+producer thread keeps ``prefetch_depth`` (default 2) chunks in flight
+(data/prefetch.py), and HBM holds ≤ ``prefetch_depth`` chunks regardless
+of dataset size.  The inner per-chunk program is ONE jitted function for
+all chunks (uniform shapes — see data/streaming.py) with the staging
+unpack traced in, so there is exactly one compile per solve; per-chunk
+transfer timing and stall counters accumulate on
+``StreamingObjective.transfer_stats``.
 
 Host-loop math mirrors lbfgs_solve step-for-step (same two-loop recursion
 and history via the SAME jitted helpers, same weak-Wolfe bracketing, same
@@ -34,7 +39,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from photon_ml_tpu.data.prefetch import TransferStats, run_prefetched
 from photon_ml_tpu.data.streaming import StreamingGlmData
+from photon_ml_tpu.parallel.compat import shard_map
 from photon_ml_tpu.optim.lbfgs import (
     LBFGSConfig,
     SolveResult,
@@ -67,6 +74,16 @@ class StreamingObjective:
     chunk is placed sharded over the mesh's first axis and the per-chunk
     reduction runs under ``shard_map`` with one fused psum — streamed data
     parallelism.
+
+    Transfers ride the coalesced ingest pipeline: each chunk moves as a
+    few large dtype-segregated staging buffers (data/staging.py) whose
+    compiled unpack is traced into the per-chunk program, and a
+    background producer thread keeps ``prefetch_depth`` chunks in flight
+    (data/prefetch.py; depth 2 = the classic double buffer, preserving
+    the ≤2-chunks-in-HBM invariant).  ``transfer_stats`` accumulates
+    per-chunk h2d timing, achieved GB/s, and queue-stall counters across
+    passes — reset it around a measurement window (bench_streaming
+    does).
     """
 
     def __init__(
@@ -76,6 +93,7 @@ class StreamingObjective:
         normalization=None,
         mesh=None,
         accumulate: str = "f32",
+        prefetch_depth: int = 2,
     ):
         from photon_ml_tpu.ops import losses as losses_lib
 
@@ -87,9 +105,20 @@ class StreamingObjective:
             )
         if accumulate not in ("f32", "kahan"):
             raise ValueError(f"accumulate must be f32|kahan, got {accumulate}")
+        if prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {prefetch_depth}"
+            )
         self.stream = stream
         self.mesh = mesh
         self.accumulate = accumulate
+        self.prefetch_depth = int(prefetch_depth)
+        self.transfer_stats = TransferStats()
+        # Coalesce to staging buffers (no-op when the builder already
+        # did); falls back to per-leaf pytree transfers only for
+        # hand-built disk-backed stores, which cannot pack in RAM.
+        stream.ensure_staged()
+        self._staging = stream.staging
         self._sharding = None
         # Multi-host (pod) mode: every process holds a chunk store over
         # ITS host-local rows only (n_shards = local device count) and
@@ -137,6 +166,18 @@ class StreamingObjective:
             raise ValueError("sharded chunks need a mesh")
 
         obj = self.objective
+        staging = self._staging
+
+        def unpack(chunk_in):
+            # The compiled on-device unpack (slice + reshape) restoring
+            # the GlmData view from the coalesced staging buffers —
+            # traced INTO each per-chunk program, so coalescing costs no
+            # extra dispatch.  Identity for unstaged (fallback) streams.
+            # Under shard_map the buffers arrive as per-device blocks;
+            # unpack_device reads the local leading dim off the trace.
+            if staging is None:
+                return chunk_in
+            return staging.unpack_device(chunk_in)
 
         def chunk_vg(w, off, chunk):
             # ``off``: extra per-row margin offsets (coordinate descent —
@@ -145,6 +186,7 @@ class StreamingObjective:
             # Under a mesh, a non-scalar ``off`` arrives SHARDED like the
             # chunk (leading shard axis) — the streamed-GAME × DP
             # composition.
+            chunk = unpack(chunk)
             if mesh is not None:
                 local = jax.tree.map(lambda x: x[0], chunk)
                 off_local = off if off.ndim == 0 else off[0]
@@ -180,6 +222,7 @@ class StreamingObjective:
             # luxury the chunk store deliberately forgoes: caching would
             # mean either holding n_rows of d2 weights in HBM (not
             # out-of-core) or round-tripping them host↔device per CG step.
+            chunk = unpack(chunk)
             if mesh is not None:
                 local = jax.tree.map(lambda x: x[0], chunk)
                 off_local = off if off.ndim == 0 else off[0]
@@ -200,6 +243,7 @@ class StreamingObjective:
             return (th, (th - hacc) - yh)
 
         def chunk_diag(w, off, chunk):
+            chunk = unpack(chunk)
             if mesh is not None:
                 local = jax.tree.map(lambda x: x[0], chunk)
                 off_local = off if off.ndim == 0 else off[0]
@@ -218,6 +262,7 @@ class StreamingObjective:
             return diag + chunk_diag(w, off, chunk)
 
         def score_step(w, chunk):
+            chunk = unpack(chunk)
             if mesh is not None:
                 local = jax.tree.map(lambda x: x[0], chunk)
                 return obj.margins(w, local)
@@ -236,17 +281,17 @@ class StreamingObjective:
             # GAME × data parallelism, the other coordinates' scores).
             self._mesh_progs: dict = {}
             builders = {
-                "acc": lambda off_spec: jax.shard_map(
+                "acc": lambda off_spec: shard_map(
                     acc_step, mesh=mesh,
                     in_specs=(acc_carry, P(), off_spec, spec),
                     out_specs=acc_carry, check_vma=False,
                 ),
-                "diag": lambda off_spec: jax.shard_map(
+                "diag": lambda off_spec: shard_map(
                     diag_step, mesh=mesh,
                     in_specs=(P(), P(), off_spec, spec), out_specs=P(),
                     check_vma=False,
                 ),
-                "hvp": lambda off_spec: jax.shard_map(
+                "hvp": lambda off_spec: shard_map(
                     hvp_step, mesh=mesh,
                     in_specs=(hvp_carry, P(), P(), off_spec, spec),
                     out_specs=hvp_carry, check_vma=False,
@@ -262,7 +307,7 @@ class StreamingObjective:
                 return self._mesh_progs[key]
 
             self._mesh_program = _program
-            self._score = jax.jit(jax.shard_map(
+            self._score = jax.jit(shard_map(
                 score_step, mesh=mesh, in_specs=(P(), spec), out_specs=spec,
                 check_vma=False,
             ))
@@ -321,10 +366,22 @@ class StreamingObjective:
             )
         max_chunks = int(all_sigs[:, 0].max())
         if len(chunks) < max_chunks:
-            blank = jax.tree.map(np.zeros_like, chunks[0])
-            self.stream.chunks = chunks + [blank] * (
-                max_chunks - len(chunks)
-            )
+            pad = max_chunks - len(chunks)
+            if self.stream.staged is not None:
+                # Equalization chunks ride the staged representation
+                # too: one shared all-zero buffer set (read-only) and a
+                # view over it, so every transfer path stays coalesced.
+                blank_bufs = tuple(
+                    np.zeros_like(np.asarray(b))
+                    for b in self.stream.staged[0]
+                )
+                blank = self.stream.staging.view(blank_bufs)
+                self.stream.staged = (
+                    list(self.stream.staged) + [blank_bufs] * pad
+                )
+            else:
+                blank = jax.tree.map(np.zeros_like, chunks[0])
+            self.stream.chunks = chunks + [blank] * pad
 
     def _put_local_block(self, x) -> Array:
         """Assemble one globally-sharded array from THIS process's local
@@ -413,27 +470,37 @@ class StreamingObjective:
             off = jnp.pad(off, (0, pad))
         return [off[k * cr:(k + 1) * cr] for k in range(n_chunks)]
 
+    def _host_item(self, k: int):
+        """What crosses the wire for chunk ``k``: the coalesced staging
+        buffers when the store is staged, the leaf pytree otherwise."""
+        if self.stream.staged is not None:
+            return self.stream.staged[k]
+        return self.stream.chunks[k]
+
     def _stream_accumulate(self, step: Callable, init, args=(),
                            per_chunk=None):
-        """Run ``carry = step(carry, *args, per_chunk[k], chunk)`` over all
-        chunks with double-buffered transfers: chunk k+1 moves host→HBM
-        while chunk k computes; a sync per chunk keeps at most 2 chunks in
-        HBM."""
-        chunks = self.stream.chunks
-        carry = init
-        nxt = self._put(chunks[0])
-        for k in range(len(chunks)):
-            cur = nxt
-            if k + 1 < len(chunks):
-                nxt = self._put(chunks[k + 1])
+        """Run ``carry = step(carry, *args, per_chunk[k], chunk)`` over
+        all chunks through the prefetch pipeline: a producer thread
+        dispatches transfers up to ``prefetch_depth`` chunks ahead
+        (depth 2 = chunk k+1 moving while chunk k computes), so host-side
+        packing/dispatch overhead overlaps device compute.  The per-chunk
+        sync on the (tiny) carry is the backpressure that makes the
+        pipeline's depth bound actual HBM residency — without it the host
+        would enqueue every chunk's compute and HBM would hold the whole
+        dataset again."""
+        n = self.stream.n_chunks
+        carry_box = [init]
+
+        def consume(k, dev):
             extra = (per_chunk[k],) if per_chunk is not None else ()
-            carry = step(carry, *args, *extra, cur)
-            # Backpressure: without this the host loop would enqueue every
-            # chunk's transfer ahead of compute and HBM would hold the whole
-            # dataset again.  Blocking on the (tiny) carry leaves transfer
-            # k+1 overlapping compute k, which is the whole double buffer.
-            jax.block_until_ready(jax.tree.leaves(carry)[0])
-        return carry
+            carry_box[0] = step(carry_box[0], *args, *extra, dev)
+            jax.block_until_ready(jax.tree.leaves(carry_box[0])[0])
+
+        run_prefetched(
+            n, self._host_item, self._put, consume,
+            depth=self.prefetch_depth, stats=self.transfer_stats,
+        )
+        return carry_box[0]
 
     def value_and_grad(
         self, w: Array, l2_weight=0.0, offsets=None
@@ -498,9 +565,10 @@ class StreamingObjective:
         metrics over these scores reduce with one psum
         (evaluation/device.py) or an explicit allgather, never by
         materializing global rows on one host."""
-        outs = []
-        for chunk in self.stream.chunks:
-            m = self._score(w, self._put(chunk))
+        outs: list = [None] * self.stream.n_chunks
+
+        def consume(k, dev):
+            m = self._score(w, dev)
             if self._multihost:
                 # Local shard blocks, in global (= process-major) order:
                 # together they are exactly this process's contiguous
@@ -508,13 +576,17 @@ class StreamingObjective:
                 shards = sorted(
                     m.addressable_shards, key=lambda s: s.index[0].start
                 )
-                outs.append(
-                    np.concatenate(
-                        [np.asarray(s.data).reshape(-1) for s in shards]
-                    )
+                outs[k] = np.concatenate(
+                    [np.asarray(s.data).reshape(-1) for s in shards]
                 )
             else:
-                outs.append(np.asarray(m).reshape(-1))
+                # The readback is the per-chunk sync (backpressure).
+                outs[k] = np.asarray(m).reshape(-1)
+
+        run_prefetched(
+            self.stream.n_chunks, self._host_item, self._put, consume,
+            depth=self.prefetch_depth, stats=self.transfer_stats,
+        )
         return np.concatenate(outs)[: self.stream.n_rows]
 
 
@@ -997,6 +1069,7 @@ def streaming_run_grid(
     on_solved=None,
     accumulate: str = "f32",
     l1_mask: Optional[Array] = None,
+    prefetch_depth: int = 2,
 ):
     """The λ-grid warm-start chain (optim.problem.grid_loop) over a
     streamed dataset.  L1/elastic-net routes to the streamed OWL-QN and
@@ -1009,7 +1082,8 @@ def streaming_run_grid(
     cfg = problem.config
     ensure_streamable(cfg)
     sobj = StreamingObjective(
-        problem.objective, stream, mesh=mesh, accumulate=accumulate
+        problem.objective, stream, mesh=mesh, accumulate=accumulate,
+        prefetch_depth=prefetch_depth,
     )
     opt = cfg.optimizer
     lbfgs_cfg = LBFGSConfig(

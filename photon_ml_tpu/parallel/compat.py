@@ -1,0 +1,30 @@
+"""Version-compatibility shims for the jax APIs this codebase tracks.
+
+The library is written against the current ``jax.shard_map`` surface
+(``check_vma``); older jax releases ship the same functionality as
+``jax.experimental.shard_map.shard_map`` with the flag spelled
+``check_rep``.  Every library call site imports :func:`shard_map` from
+here so the whole mesh layer (streamed DP, tensor parallel, distributed
+GAME) runs unchanged on either API generation.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+
+else:  # pre-jax.shard_map releases: experimental module, check_rep flag
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )

@@ -32,6 +32,7 @@ from typing import Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from photon_ml_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.data.dataset import GlmData
@@ -180,7 +181,7 @@ def run_grid_distributed(
         )
 
     solve_sm = jax.jit(
-        jax.shard_map(
+        shard_map(
             spmd,
             mesh=mesh,
             in_specs=(P(DATA_AXIS), P(), P(), P()),
@@ -202,7 +203,7 @@ def run_grid_distributed(
             )
 
         var_sm = jax.jit(
-            jax.shard_map(
+            shard_map(
                 var_spmd,
                 mesh=mesh,
                 in_specs=(P(DATA_AXIS), P(), P()),
@@ -236,7 +237,7 @@ def distributed_solve(
         return solve_fn(dd.local(), w0)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             spmd,
             mesh=mesh,
             in_specs=(P(DATA_AXIS), P()),

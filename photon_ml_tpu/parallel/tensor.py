@@ -35,6 +35,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from photon_ml_tpu.parallel.compat import shard_map
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -209,7 +210,7 @@ def _make_tp_solver(task: str, mesh: Mesh, config: LBFGSConfig):
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             spmd,
             mesh=mesh,
             in_specs=_TP_IN_SPECS,
@@ -260,7 +261,7 @@ def _make_tp_owlqn_solver(task: str, mesh: Mesh, config: OWLQNConfig):
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             spmd,
             mesh=mesh,
             in_specs=_TP_IN_SPECS[:5] + (P(), P(), P(FEATURE_AXIS)),
@@ -332,7 +333,7 @@ def _make_tp_tron_solver(task: str, mesh: Mesh, config: TRONConfig):
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             spmd,
             mesh=mesh,
             in_specs=_TP_IN_SPECS,

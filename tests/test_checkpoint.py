@@ -93,6 +93,22 @@ class TestCheckpointerRoundTrip:
         assert list(got) == [10.0, 0.1]  # solve order preserved
         np.testing.assert_array_equal(got[10.0], solved[10.0])
 
+    def test_grid_checkpointer_extra_meta(self, tmp_path):
+        """Run-configuration metadata (the driver's bounds fingerprint)
+        rides the checkpoint and is absent-not-crashing on old files."""
+        ck = GridCheckpointer(str(tmp_path / "g"))
+        assert ck.load_meta() == {}  # no checkpoint yet
+        solved = {1.0: np.ones(3, np.float32)}
+        ck.save(solved, extra_meta={"bounds_fingerprint": "abc123"})
+        meta = ck.load_meta()
+        assert meta["bounds_fingerprint"] == "abc123"
+        assert meta["lambdas"] == [1.0]
+        # A save without extra_meta (pre-fingerprint writer) reads back
+        # with the key simply missing.
+        ck.save(solved)
+        assert ck.load_meta().get("bounds_fingerprint") is None
+        np.testing.assert_array_equal(ck.load()[1.0], solved[1.0])
+
 
 class TestKillAndResume:
     def test_cd_resume_bit_for_bit(self, tmp_path):

@@ -3,6 +3,8 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from photon_ml_tpu.parallel.compat import shard_map
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -65,7 +67,7 @@ class TestPointwiseParity:
             )
 
         sharded = jax.jit(
-            jax.shard_map(
+            shard_map(
                 spmd, mesh=mesh,
                 in_specs=(P("data"), P("data"), P("data")),
                 out_specs=P(),

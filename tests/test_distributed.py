@@ -9,6 +9,8 @@ the sharded objective and solver must agree with the single-device ones.
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from photon_ml_tpu.parallel.compat import shard_map
 import pytest
 import scipy.sparse as sp
 
@@ -52,7 +54,7 @@ class TestShardedObjectiveParity:
             )
 
         val_8, grad_8 = jax.jit(
-            jax.shard_map(
+            shard_map(
                 spmd,
                 mesh=mesh,
                 in_specs=(jax.sharding.PartitionSpec(DATA_AXIS),
@@ -79,7 +81,7 @@ class TestShardedObjectiveParity:
                 return obj.value_and_grad(w, dd.local(), axis_name=DATA_AXIS)
 
             return jax.jit(
-                jax.shard_map(
+                shard_map(
                     spmd,
                     mesh=mesh,
                     in_specs=(jax.sharding.PartitionSpec(DATA_AXIS),

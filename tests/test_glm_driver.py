@@ -209,6 +209,44 @@ class TestStreamingDriver:
 
 
 class TestCoefficientBounds:
+    def test_resume_refused_on_bounds_mismatch(self, a1a_like, tmp_path):
+        """A λ-grid checkpoint records a fingerprint of the resolved
+        --coefficient-bounds arrays; --resume under different bounds
+        must refuse instead of warm-starting the remaining grid from
+        incompatibly-constrained coefficients (the CD locked-set
+        guard's discipline, ADVICE r5)."""
+        import pytest
+
+        train, test, d = a1a_like
+        bounds_file = str(tmp_path / "bounds.json")
+        with open(bounds_file, "w") as f:
+            json.dump({"f0": [-0.05, 0.05]}, f)
+        out = str(tmp_path / "out")
+        base = [
+            "--train-data", train,
+            "--output-dir", out,
+            "--task", "LOGISTIC_REGRESSION",
+            "--reg-type", "l2",
+            "--reg-weights", "1.0",
+            "--n-features", str(d),
+            "--max-iters", "5",
+        ]
+        glm_driver.run(base + ["--coefficient-bounds", bounds_file])
+        # Same bounds: resume is allowed (and skips the solved λ).
+        res = glm_driver.run(
+            base + ["--coefficient-bounds", bounds_file, "--resume"]
+        )
+        assert res["best_lambda"] == 1.0
+        # Dropping the bounds on resume must refuse...
+        with pytest.raises(SystemExit, match="coefficient-bounds"):
+            glm_driver.run(base + ["--resume"])
+        # ...as must resuming a bound-less checkpoint WITH bounds.
+        glm_driver.run(base)  # fresh run, no bounds (clears checkpoint)
+        with pytest.raises(SystemExit, match="coefficient-bounds"):
+            glm_driver.run(
+                base + ["--coefficient-bounds", bounds_file, "--resume"]
+            )
+
     def test_box_constrained_driver_end_to_end(self, a1a_like, tmp_path):
         """--coefficient-bounds clamps named coefficients into their box
         and matches a scipy L-BFGS-B oracle on the same objective."""

@@ -4,6 +4,8 @@ True multi-process runs need a pod; everything testable single-process is
 tested here (the compute paths themselves are host-count-agnostic SPMD)."""
 
 import numpy as np
+
+from photon_ml_tpu.parallel.compat import shard_map
 import pytest
 
 import jax
@@ -95,7 +97,7 @@ class TestAssembleGlobal:
         def f(block):
             return jax.lax.psum(jnp.sum(block), multihost.DATA_AXIS)
 
-        total = jax.jit(jax.shard_map(
+        total = jax.jit(shard_map(
             f, mesh=mesh, in_specs=P(multihost.DATA_AXIS),
             out_specs=P(),
         ))(arr)

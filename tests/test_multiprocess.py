@@ -16,6 +16,8 @@ import subprocess
 import sys
 
 import numpy as np
+
+from photon_ml_tpu.parallel.compat import shard_map
 import pytest
 
 _WORKER = r"""
@@ -72,7 +74,7 @@ def spmd(Xl, yl):
     )
 
 
-res = jax.jit(jax.shard_map(
+res = jax.jit(shard_map(
     spmd, mesh=mesh,
     in_specs=(P(DATA_AXIS), P(DATA_AXIS)), out_specs=P(),
     check_vma=False,
@@ -174,7 +176,7 @@ def test_two_process_dp_fit_matches_single_process(tmp_path):
             LBFGSConfig(max_iters=50, tolerance=1e-9),
         )
 
-    res = jax.jit(jax.shard_map(
+    res = jax.jit(shard_map(
         spmd, mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS)), out_specs=P(),
         check_vma=False,

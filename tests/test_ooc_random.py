@@ -253,31 +253,48 @@ class TestBoundedMemory:
         )
         assert len(ooc.pass_plan) >= 3
         ooc.train(jnp.zeros(host.n_global_rows, jnp.float32))
-        assert ooc.live_groups_high_water == 2
+        # ≤ prefetch_depth is the hard bound (the permit accounting);
+        # hitting exactly 2 needs the producer thread to win the
+        # dispatch race, which a loaded box does not guarantee.
+        assert 1 <= ooc.live_groups_high_water <= 2
         ooc.score(ooc.train(jnp.zeros(host.n_global_rows, jnp.float32)))
-        assert ooc.live_groups_high_water == 2
+        assert 1 <= ooc.live_groups_high_water <= 2
 
     def test_transfer_ordering_never_holds_three_groups(self):
-        """Group g+2's transfer must be enqueued only AFTER group g was
-        consumed (its refs dropped) — the yield-based runner this
-        replaced kept three groups alive at the put, making peak memory
-        1.5x the budget."""
+        """Group g+2's transfer may be dispatched only AFTER group g was
+        consumed: at every put, the number of dispatched-but-unconsumed
+        groups must stay ≤ prefetch_depth (=2).  The prefetch pipeline's
+        permit is acquired before the put and released only after
+        consume returns, so this count is exact even though the put runs
+        on the producer thread (a pre-pipeline yield-based runner kept
+        three groups alive at the put, making peak memory 1.5x the
+        budget)."""
         keys, X, y, w = _zipf_data(seed=31)
         _, host = _datasets(keys, X, y, w)
         ooc = OutOfCoreRandomEffectCoordinate(
             "re", host, "logistic", _config(), device_budget_bytes=8_000,
         )
         assert len(ooc.pass_plan) >= 3
-        events = []
+        counts = {"put": 0, "consume": 0}
+        violations = []
         orig_put = ooc._put
-        ooc._put = lambda tree: (events.append("put"), orig_put(tree))[1]
-        ooc._run_groups(
-            lambda group: [], lambda group, dev: events.append("consume")
-        )
-        assert events[:2] == ["put", "put"]
-        for i, ev in enumerate(events):
-            if ev == "put" and i >= 2:
-                assert events[i - 1] == "consume", events
+
+        def tracked_put(tree):
+            counts["put"] += 1
+            if counts["put"] - counts["consume"] > 2:
+                violations.append(dict(counts))
+            return orig_put(tree)
+
+        ooc._put = tracked_put
+
+        def consume(group, dev):
+            counts["consume"] += 1
+
+        ooc._run_groups(lambda group: [], consume)
+        assert not violations, violations
+        assert counts["put"] == len(ooc.pass_plan)
+        assert counts["consume"] == len(ooc.pass_plan)
+        assert ooc.live_groups_high_water <= 2
 
     def test_budget_too_small_fails_loudly(self):
         keys, X, y, w = _zipf_data(seed=21)

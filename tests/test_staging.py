@@ -1,0 +1,359 @@
+"""Coalesced pinned-staging ingest pipeline.
+
+Three contracts pinned here (ISSUE 1 acceptance):
+
+1. **Staging parity** — packing a chunk's leaves into dtype-segregated
+   buffers and unpacking (host views AND the compiled device unpack) is
+   bit-exact, for every layout (dense / COO / tiled-Pallas) and for
+   sharded (leading shard axis) and unsharded chunks.
+2. **Streamed ≡ resident through the coalesced path** — the streamed
+   objective's value/grad still matches the resident objective now that
+   chunks cross as staging buffers with an in-program unpack.
+3. **Pipeline bounds & observability** — prefetch-depth edge cases
+   (1, > n_chunks), the ≤depth liveness bound, error propagation, and
+   the transfer-stat counters bench_streaming reports.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+os.environ.setdefault("PHOTON_PALLAS_INTERPRET", "1")
+
+from photon_ml_tpu.data.dataset import make_glm_data
+from photon_ml_tpu.data.prefetch import TransferStats, run_prefetched
+from photon_ml_tpu.data.staging import pack_chunk, plan_staging
+from photon_ml_tpu.data.streaming import make_streaming_glm_data
+from photon_ml_tpu.optim.objective import GlmObjective
+from photon_ml_tpu.optim.streaming import StreamingObjective
+from photon_ml_tpu.ops import losses
+
+LAYOUTS = ["dense", "coo", "pallas"]
+
+
+def _problem(rng, n, d, layout, seed=11):
+    if layout == "dense":
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        logits = X @ (rng.normal(size=d) * 0.3)
+    else:
+        X = sp.random(
+            n, d, density=0.15, random_state=seed, format="csr",
+            dtype=np.float32,
+        )
+        X = sp.hstack(
+            [sp.csr_matrix(np.ones((n, 1), np.float32)), X[:, 1:]]
+        ).tocsr()
+        logits = np.asarray(X @ (rng.normal(size=d) * 0.3)).ravel()
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    return X, y
+
+
+def _stream(rng, layout, n_shards=1, n=640, d=24, chunk_rows=256):
+    X, y = _problem(rng, n, d, layout)
+    return X, y, make_streaming_glm_data(
+        X, y, chunk_rows=chunk_rows, use_pallas=(layout == "pallas"),
+        n_shards=n_shards, depth_cap=16,
+    )
+
+
+class TestStagingRoundtrip:
+    @pytest.mark.parametrize("layout", LAYOUTS)
+    @pytest.mark.parametrize("n_shards", [1, 4])
+    def test_pack_view_unpack_bit_exact(self, rng, layout, n_shards):
+        _, _, stream = _stream(rng, layout, n_shards=n_shards)
+        assert stream.staged is not None and stream.staging is not None
+        staging = stream.staging
+        # Dtype segregation keeps the per-chunk transfer count O(1).
+        assert 1 <= staging.n_buffers <= 4
+        assert len(stream.staged) == stream.n_chunks
+        for bufs, chunk in zip(stream.staged, stream.chunks):
+            leaves = jax.tree_util.tree_leaves(chunk)
+            # Host views are ZERO-COPY into the staging buffers (no
+            # second host copy of the dataset)...
+            for leaf in leaves:
+                assert any(
+                    np.shares_memory(leaf, np.asarray(b)) for b in bufs
+                ) or leaf.size == 0
+            # ...and re-packing the views reproduces the buffers
+            # bit-for-bit (pack/view are exact inverses).
+            repacked = pack_chunk(staging, chunk)
+            for a, b in zip(repacked, bufs):
+                np.testing.assert_array_equal(a, np.asarray(b))
+            # Total staged bytes account for every leaf byte.
+            assert staging.nbytes == sum(
+                np.asarray(b).nbytes for b in bufs
+            )
+
+    @pytest.mark.parametrize("layout", LAYOUTS)
+    @pytest.mark.parametrize("n_shards", [1, 4])
+    def test_device_unpack_matches_host(self, rng, layout, n_shards):
+        """The compiled slice+reshape unpack restores every leaf exactly
+        (no kernels involved — pure XLA, so this covers the Pallas
+        layout's staging on CPU too)."""
+        _, _, stream = _stream(rng, layout, n_shards=n_shards)
+        staging = stream.staging
+        unpack = jax.jit(lambda bufs: staging.unpack_device(bufs))
+        for bufs, chunk in zip(stream.staged, stream.chunks):
+            restored = unpack(jax.device_put(bufs))
+            host = jax.tree_util.tree_leaves(chunk)
+            dev = jax.tree_util.tree_leaves(restored)
+            assert len(host) == len(dev)
+            for h, d_ in zip(host, dev):
+                assert h.shape == d_.shape and h.dtype == d_.dtype
+                np.testing.assert_array_equal(np.asarray(d_), h)
+
+    def test_plan_rejects_mismatched_chunk(self, rng):
+        _, _, stream = _stream(rng, "coo")
+        other = jax.tree_util.tree_map(
+            lambda x: np.zeros((3,) + x.shape[1:], x.dtype),
+            stream.chunks[0],
+        )
+        with pytest.raises(ValueError, match="staging plan"):
+            pack_chunk(stream.staging, other)
+
+    def test_ensure_staged_retrofits_hand_built_store(self, rng):
+        """A directly-constructed store (no builder) stages on first
+        consumer contact and keeps its values."""
+        from photon_ml_tpu.data.streaming import StreamingGlmData
+
+        X, y = _problem(rng, 300, 12, "dense")
+        n = X.shape[0]
+        chunks = [
+            make_glm_data(X[i: i + 100], y[i: i + 100])
+            for i in range(0, n, 100)
+        ]
+        host_chunks = [
+            jax.tree_util.tree_map(np.asarray, c) for c in chunks
+        ]
+        store = StreamingGlmData(
+            chunks=host_chunks, n_rows=n, n_features=12, chunk_rows=100
+        )
+        before = [
+            [np.array(l) for l in jax.tree_util.tree_leaves(c)]
+            for c in store.chunks
+        ]
+        assert store.ensure_staged()
+        assert store.staged is not None
+        for c, orig in zip(store.chunks, before):
+            for leaf, o in zip(jax.tree_util.tree_leaves(c), orig):
+                np.testing.assert_array_equal(np.asarray(leaf), o)
+
+
+class TestCoalescedEquivalence:
+    @pytest.mark.parametrize("layout", LAYOUTS)
+    def test_value_grad_matches_resident(self, rng, layout):
+        X, y, stream = _stream(rng, layout)
+        sobj = StreamingObjective("logistic", stream)
+        assert stream.staged is not None  # the coalesced path is live
+        data = make_glm_data(X, y, use_pallas=False)
+        obj = GlmObjective(losses.logistic)
+        w = jnp.asarray(rng.normal(size=stream.n_features), jnp.float32)
+        v_s, g_s = sobj.value_and_grad(w, l2_weight=0.5)
+        v_r, g_r = obj.value_and_grad(w, data, l2_weight=0.5)
+        assert float(jnp.abs(v_s - v_r)) < 1e-3 * max(1.0, abs(float(v_r)))
+        assert float(jnp.abs(g_s - g_r).max()) < 1e-3
+
+    @pytest.mark.parametrize("layout", ["dense", "coo"])
+    def test_sharded_value_grad_matches_resident(self, rng, layout):
+        """Streamed DP through the coalesced path: buffers placed
+        sharded over the mesh, shard_map unpack, fused psum — same
+        numbers as the resident single-device objective."""
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+        n_dev = mesh.devices.size
+        X, y, stream = _stream(rng, layout, n_shards=n_dev, n=960)
+        sobj = StreamingObjective("logistic", stream, mesh=mesh)
+        data = make_glm_data(X, y, use_pallas=False)
+        obj = GlmObjective(losses.logistic)
+        w = jnp.asarray(rng.normal(size=stream.n_features), jnp.float32)
+        v_s, g_s = sobj.value_and_grad(w, l2_weight=0.5)
+        v_r, g_r = obj.value_and_grad(w, data, l2_weight=0.5)
+        assert float(jnp.abs(v_s - v_r)) < 1e-3 * max(1.0, abs(float(v_r)))
+        assert float(jnp.abs(g_s - g_r).max()) < 1e-3
+
+    def test_sharded_pallas_matches_resident(self, rng):
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+        n_dev = mesh.devices.size
+        X, y, stream = _stream(rng, "pallas", n_shards=n_dev, n=960)
+        sobj = StreamingObjective("logistic", stream, mesh=mesh)
+        data = make_glm_data(X, y, use_pallas=False)
+        obj = GlmObjective(losses.logistic)
+        w = jnp.asarray(rng.normal(size=stream.n_features), jnp.float32)
+        v_s, g_s = sobj.value_and_grad(w, l2_weight=0.5)
+        v_r, g_r = obj.value_and_grad(w, data, l2_weight=0.5)
+        assert float(jnp.abs(v_s - v_r)) < 1e-3 * max(1.0, abs(float(v_r)))
+        assert float(jnp.abs(g_s - g_r).max()) < 1e-3
+
+    def test_scores_match_through_staging(self, rng):
+        X, y, stream = _stream(rng, "coo")
+        sobj = StreamingObjective("logistic", stream)
+        w = jnp.asarray(rng.normal(size=stream.n_features), jnp.float32)
+        np.testing.assert_allclose(
+            sobj.scores(w),
+            np.asarray(X @ np.asarray(w)).ravel(),
+            atol=1e-4,
+        )
+
+
+class TestPrefetchDepth:
+    @pytest.mark.parametrize("depth", [1, 3, 99])
+    def test_any_depth_matches_double_buffer(self, rng, depth):
+        """depth 1 (serial transfer/compute) and depth > n_chunks must
+        produce bit-identical results to the default double buffer —
+        chunks are consumed strictly in order regardless of depth."""
+        X, y, stream = _stream(rng, "coo")
+        assert depth != 2
+        w = jnp.asarray(rng.normal(size=stream.n_features), jnp.float32)
+        ref = StreamingObjective("logistic", stream, prefetch_depth=2)
+        v2, g2 = ref.value_and_grad(w, 0.5)
+        sobj = StreamingObjective("logistic", stream, prefetch_depth=depth)
+        v, g = sobj.value_and_grad(w, 0.5)
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(v2))
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(g2))
+        assert sobj.transfer_stats.max_live <= depth
+
+    def test_depth_exceeding_chunks(self, rng):
+        X, y, stream = _stream(rng, "dense")
+        sobj = StreamingObjective("logistic", stream, prefetch_depth=99)
+        w = jnp.zeros(stream.n_features, jnp.float32)
+        v, _ = sobj.value_and_grad(w)
+        assert np.isfinite(float(v))
+        assert sobj.transfer_stats.max_live <= stream.n_chunks
+
+    def test_invalid_depth_rejected(self, rng):
+        _, _, stream = _stream(rng, "dense")
+        with pytest.raises(ValueError, match="prefetch_depth"):
+            StreamingObjective("logistic", stream, prefetch_depth=0)
+
+
+class TestTransferStats:
+    def test_counters_after_one_pass(self, rng):
+        X, y, stream = _stream(rng, "coo")
+        sobj = StreamingObjective("logistic", stream)
+        w = jnp.zeros(stream.n_features, jnp.float32)
+        sobj.value_and_grad(w)
+        st = sobj.transfer_stats
+        assert st.passes == 1
+        assert st.chunks == stream.n_chunks
+        assert st.bytes == stream.n_chunks * stream.staging.nbytes
+        assert st.h2d_seconds >= 0.0
+        assert 1 <= st.max_live <= 2
+        snap = st.snapshot()
+        assert set(snap) >= {
+            "chunks", "bytes", "h2d_seconds", "gbps", "chunk_seconds",
+            "producer_stalls", "consumer_stalls", "max_live", "passes",
+        }
+
+    def test_accumulates_and_resets(self, rng):
+        X, y, stream = _stream(rng, "dense")
+        sobj = StreamingObjective("logistic", stream)
+        w = jnp.zeros(stream.n_features, jnp.float32)
+        sobj.value_and_grad(w)
+        sobj.value_and_grad(w)
+        st = sobj.transfer_stats
+        assert st.passes == 2
+        assert st.chunks == 2 * stream.n_chunks
+        st.reset()
+        assert st.passes == 0 and st.chunks == 0 and st.bytes == 0
+
+    def test_scores_pass_counts_too(self, rng):
+        X, y, stream = _stream(rng, "coo")
+        sobj = StreamingObjective("logistic", stream)
+        sobj.scores(jnp.zeros(stream.n_features, jnp.float32))
+        assert sobj.transfer_stats.chunks == stream.n_chunks
+
+
+class TestRunPrefetched:
+    """The pipeline primitive itself, against plain numpy items."""
+
+    def test_order_and_results(self):
+        items = [np.full((4,), k, np.float32) for k in range(7)]
+        seen = []
+        run_prefetched(
+            len(items),
+            lambda k: items[k],
+            lambda h: h * 2,
+            lambda k, dev: seen.append((k, float(dev[0]))),
+            depth=2,
+        )
+        assert seen == [(k, 2.0 * k) for k in range(7)]
+
+    def test_liveness_bound_holds_at_put(self):
+        counts = {"put": 0, "consumed": 0}
+        violations = []
+        depth = 3
+
+        def put(h):
+            counts["put"] += 1
+            if counts["put"] - counts["consumed"] > depth:
+                violations.append(dict(counts))
+            return h
+
+        run_prefetched(
+            20,
+            lambda k: np.zeros(1),
+            put,
+            lambda k, dev: counts.__setitem__(
+                "consumed", counts["consumed"] + 1
+            ),
+            depth=depth,
+        )
+        assert not violations
+
+    def test_producer_error_propagates(self):
+        def get_item(k):
+            if k == 2:
+                raise RuntimeError("ingest exploded")
+            return np.zeros(1)
+
+        consumed = []
+        with pytest.raises(RuntimeError, match="ingest exploded"):
+            run_prefetched(
+                5, get_item, lambda h: h,
+                lambda k, dev: consumed.append(k), depth=2,
+            )
+        assert consumed == [0, 1]
+
+    def test_consumer_error_stops_producer(self):
+        stats = TransferStats()
+
+        def consume(k, dev):
+            if k == 1:
+                raise ValueError("consumer bailed")
+
+        with pytest.raises(ValueError, match="consumer bailed"):
+            run_prefetched(
+                50, lambda k: np.zeros(1), lambda h: h, consume,
+                depth=2, stats=stats,
+            )
+        # The producer must wind down promptly (no leaked live thread
+        # still transferring the remaining ~48 items).
+        deadline = 50
+        for _ in range(deadline):
+            if not any(
+                t.name == "h2d-prefetch" and t.is_alive()
+                for t in threading.enumerate()
+            ):
+                break
+            import time
+
+            time.sleep(0.1)
+        else:
+            pytest.fail("producer thread still alive after consumer error")
+
+    def test_empty_and_invalid(self):
+        stats = TransferStats()
+        assert run_prefetched(
+            0, lambda k: None, lambda h: h, lambda k, d: None,
+            depth=2, stats=stats,
+        ) == 0
+        assert stats.passes == 1
+        with pytest.raises(ValueError, match="depth"):
+            run_prefetched(
+                1, lambda k: None, lambda h: h, lambda k, d: None, depth=0
+            )
