@@ -732,6 +732,16 @@ def bench_avro_write() -> dict:
 
 
 def main() -> None:
+    # Sink-less but ENABLED telemetry hub: the streamed/ooc sections'
+    # prefetch pipelines feed their TransferStats into its registry
+    # (h2d_gbps, stall counters — data/prefetch.py), events stay
+    # one-branch no-ops.  The snapshot rides the bench JSON so BENCH
+    # trajectory files carry stall/bandwidth/compile attribution.
+    from photon_ml_tpu import telemetry as telemetry_mod
+
+    bench_tel = telemetry_mod.Telemetry(enabled=True, sinks=[])
+    prev_tel = telemetry_mod.set_current(bench_tel)
+
     baseline = {}
     if os.path.exists(BASELINE_FILE):
         with open(BASELINE_FILE) as f:
@@ -875,6 +885,23 @@ def main() -> None:
         extra["game_cd_vs_baseline_normalized"] = round(
             (game_iters / chip_gbps) / base_cd_per_gbps, 4
         )
+    # Telemetry metrics snapshot: embedded in the bench line (so BENCH
+    # trajectory files carry it) AND written next to bench_baseline.json
+    # for direct inspection.  The driver section installs its own hub
+    # in-process, so its counters land in its output dir, not here.
+    telemetry_mod.set_current(prev_tel)
+    snap = bench_tel.snapshot()
+    extra["telemetry_metrics"] = {
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+    }
+    try:
+        bench_tel.write_snapshot(
+            os.path.join(os.path.dirname(BASELINE_FILE),
+                         "bench_metrics.json")
+        )
+    except OSError:
+        pass
     print(json.dumps(out))
 
 

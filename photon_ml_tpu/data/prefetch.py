@@ -37,6 +37,8 @@ from typing import Callable
 
 import jax
 
+from photon_ml_tpu import telemetry as telemetry_mod
+
 
 @dataclasses.dataclass
 class TransferStats:
@@ -86,6 +88,48 @@ class _ProducerFailure:
         self.exc = exc
 
 
+def _publish_pass(stats: TransferStats, before: tuple, run_max: int) -> None:
+    """Feed this pass's TransferStats DELTAS into the process telemetry
+    registry (PR 1 left the stats a dead-end dataclass unless a caller
+    printed them).  Counters accumulate correctly across every stream in
+    the process because each pass contributes only its own delta; gauges
+    carry the LAST pass's achieved rates.  One call per pass — nothing
+    here runs per chunk."""
+    tel = telemetry_mod.current()
+    if not tel.enabled:
+        return
+    bytes0, h2d0, chunks0, cs0, css0, ps0, pss0 = before
+    d_bytes = stats.bytes - bytes0
+    d_h2d = stats.h2d_seconds - h2d0
+    d_chunks = stats.chunks - chunks0
+    tel.counter("h2d_bytes_total").inc(d_bytes)
+    tel.counter("h2d_chunks_total").inc(d_chunks)
+    tel.counter("h2d_seconds").inc(d_h2d)
+    tel.counter("consumer_stalls").inc(stats.consumer_stalls - cs0)
+    tel.counter("consumer_stall_seconds").inc(
+        stats.consumer_stall_seconds - css0
+    )
+    tel.counter("producer_stalls").inc(stats.producer_stalls - ps0)
+    tel.counter("producer_stall_seconds").inc(
+        stats.producer_stall_seconds - pss0
+    )
+    tel.counter("prefetch_passes").inc()
+    if d_h2d > 0.0:
+        tel.gauge("h2d_gbps").set(d_bytes / d_h2d / 1e9)
+    if d_chunks > 0:
+        tel.gauge("h2d_chunk_seconds").set(d_h2d / d_chunks)
+    tel.gauge("prefetch_max_live").set(run_max)
+    tel.event(
+        "prefetch.pass",
+        chunks=d_chunks,
+        bytes=d_bytes,
+        h2d_seconds=round(d_h2d, 6),
+        consumer_stalls=stats.consumer_stalls - cs0,
+        producer_stalls=stats.producer_stalls - ps0,
+        max_live=run_max,
+    )
+
+
 def run_prefetched(
     n_items: int,
     get_item: Callable[[int], object],
@@ -116,6 +160,11 @@ def run_prefetched(
     if n_items == 0:
         stats.passes += 1
         return 0
+    stats_before = (
+        stats.bytes, stats.h2d_seconds, stats.chunks,
+        stats.consumer_stalls, stats.consumer_stall_seconds,
+        stats.producer_stalls, stats.producer_stall_seconds,
+    )
 
     q: queue.Queue = queue.Queue()
     permits = threading.Semaphore(depth)
@@ -200,4 +249,5 @@ def run_prefetched(
                 break
     stats.passes += 1
     stats.max_live = max(stats.max_live, run_max)
+    _publish_pass(stats, stats_before, run_max)
     return run_max

@@ -35,24 +35,25 @@ def build_arg_parser() -> argparse.ArgumentParser:
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
     os.makedirs(args.output_dir, exist_ok=True)
-    logger = PhotonLogger(args.output_dir)
-    shards, _, response, _, _, _, index_maps = read_game_avro(args.data)
-    if args.add_intercept:
-        # Shard names are only known after a first read; re-read with an
-        # intercept column appended to every shard.
-        shards, _, response, _, _, _, index_maps = read_game_avro(
-            args.data, add_intercept_shards=tuple(shards)
-        )
-    sizes = {}
-    for shard, imap in index_maps.items():
-        target = os.path.join(args.output_dir, shard)
-        imap.save(target)
-        if args.binary:
-            imap.save_binary(target)
-        sizes[shard] = len(imap)
-        logger.info("shard %s: %d features -> %s", shard, len(imap), target)
-    logger.close()
-    return {"shards": sizes, "n_rows": int(len(response))}
+    with PhotonLogger(args.output_dir) as logger:
+        shards, _, response, _, _, _, index_maps = read_game_avro(args.data)
+        if args.add_intercept:
+            # Shard names are only known after a first read; re-read with
+            # an intercept column appended to every shard.
+            shards, _, response, _, _, _, index_maps = read_game_avro(
+                args.data, add_intercept_shards=tuple(shards)
+            )
+        sizes = {}
+        for shard, imap in index_maps.items():
+            target = os.path.join(args.output_dir, shard)
+            imap.save(target)
+            if args.binary:
+                imap.save_binary(target)
+            sizes[shard] = len(imap)
+            logger.info(
+                "shard %s: %d features -> %s", shard, len(imap), target
+            )
+        return {"shards": sizes, "n_rows": int(len(response))}
 
 
 def main() -> None:

@@ -64,6 +64,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "scores arbitrary-size data via Spark partitions, SURVEY.md 3.3). "
         "0 = materialize the whole file",
     )
+    p.add_argument(
+        "--telemetry",
+        choices=["on", "off"],
+        default="on",
+        help="unified telemetry (events.jsonl + trace.json + metrics.json "
+        "in the output dir, summary in the log)",
+    )
     add_compile_cache_arg(p)
     return p
 
@@ -71,7 +78,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
     os.makedirs(args.output_dir, exist_ok=True)
-    logger = PhotonLogger(args.output_dir)
+    from photon_ml_tpu import telemetry as telemetry_mod
+
+    with PhotonLogger(args.output_dir) as logger:
+        tel = telemetry_mod.Telemetry(
+            output_dir=args.output_dir,
+            logger=logger,
+            enabled=args.telemetry != "off",
+        )
+        with tel, tel.span("run", driver="game_scoring_driver"):
+            return _run_impl(args, logger, tel)
+
+
+def _run_impl(args, logger, tel) -> dict:
     timer = Timer().start()
     enable_from_args(args, logger)
     from photon_ml_tpu.parallel.multihost import initialize_logged
@@ -190,6 +209,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         n_rows = len(scores)
 
     result = {"n_rows": int(n_rows), "wall_seconds": timer.stop()}
+    tel.gauge("scored_rows").set(int(n_rows))
+    tel.gauge("run_wall_seconds").set(result["wall_seconds"])
     if args.evaluator:
         ev = get_evaluator(args.evaluator)
         if scores is None and args.stream_block_rows > 0:
@@ -221,7 +242,6 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     with open(os.path.join(args.output_dir, "scoring_result.json"), "w") as f:
         json.dump(result, f, indent=2)
     logger.info("scored %d rows in %.2fs", result["n_rows"], result["wall_seconds"])
-    logger.close()
     return result
 
 

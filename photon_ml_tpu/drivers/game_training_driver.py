@@ -34,6 +34,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu import telemetry as telemetry_mod
 from photon_ml_tpu.data.game_reader import read_game_avro
 from photon_ml_tpu.evaluation.suite import EvaluationSuite
 from photon_ml_tpu.game.estimator import (
@@ -54,6 +55,7 @@ from photon_ml_tpu.ops import losses as losses_lib
 from photon_ml_tpu.utils.compile_cache import (
     add_compile_cache_arg,
     enable_from_args,
+    publish_cache_metrics,
 )
 from photon_ml_tpu.utils.logging import PhotonLogger
 from photon_ml_tpu.utils.timer import Timer
@@ -205,6 +207,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="initial seconds between retries (exponential, x2 per "
         "attempt, capped at 300s)",
     )
+    p.add_argument(
+        "--telemetry",
+        choices=["on", "off"],
+        default="on",
+        help="unified telemetry (events.jsonl + trace.json + metrics.json "
+        "in the output dir, summary in the log). 'off' reduces every "
+        "instrumented site to one branch",
+    )
     add_compile_cache_arg(p)
     return p
 
@@ -212,9 +222,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
     os.makedirs(args.output_dir, exist_ok=True)
-    logger = PhotonLogger(args.output_dir)
+    # Context-managed logger + telemetry: both own process-level
+    # resources that must release on ANY exit (see glm_driver).
+    with PhotonLogger(args.output_dir) as logger:
+        tel = telemetry_mod.Telemetry(
+            output_dir=args.output_dir,
+            logger=logger,
+            enabled=args.telemetry != "off",
+        )
+        with tel, tel.span("run", driver="game_training_driver"):
+            return _run_impl(args, logger, tel)
+
+
+def _run_impl(args, logger, tel) -> dict:
     timer = Timer().start()
-    enable_from_args(args, logger)
+    cache_dir = enable_from_args(args, logger)
     from photon_ml_tpu.parallel.multihost import initialize_logged
 
     initialize_logged(logger)
@@ -247,19 +269,24 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     # index maps — the data is read through them so coefficient vectors line
     # up column-for-column with the saved model.
     initial_model = None
-    if args.initial_model:
-        from photon_ml_tpu.io.game_store import load_game_model
+    with tel.span("read", path=args.train_data):
+        if args.initial_model:
+            from photon_ml_tpu.io.game_store import load_game_model
 
-        initial_model, initial_imaps = load_game_model(args.initial_model)
-        shards, ids, response, weight, offset, _, index_maps = read_game_avro(
-            args.train_data, index_maps=initial_imaps, logger=logger
-        )
-        index_maps = initial_imaps
-        logger.info("incremental training from %s", args.initial_model)
-    else:
-        shards, ids, response, weight, offset, _, index_maps = read_game_avro(
-            args.train_data
-        )
+            initial_model, initial_imaps = load_game_model(
+                args.initial_model
+            )
+            shards, ids, response, weight, offset, _, index_maps = (
+                read_game_avro(
+                    args.train_data, index_maps=initial_imaps, logger=logger
+                )
+            )
+            index_maps = initial_imaps
+            logger.info("incremental training from %s", args.initial_model)
+        else:
+            shards, ids, response, weight, offset, _, index_maps = (
+                read_game_avro(args.train_data)
+            )
     logger.info(
         "read %d rows; shards: %s; id columns: %s",
         len(response),
@@ -288,9 +315,10 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     n_cd_iterations = int(config.get("iterations", 1))
     validation = None
     if args.validate_data:
-        validation = read_game_avro(
-            args.validate_data, index_maps=index_maps, logger=logger
-        )
+        with tel.span("read", path=args.validate_data, validation=True):
+            validation = read_game_avro(
+                args.validate_data, index_maps=index_maps, logger=logger
+            )
 
     result = {"task": task, "n_rows": int(len(response))}
 
@@ -387,11 +415,15 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             else RandomSearch
         )
         search = search_cls([(lo, hi)] * len(names), log_scale=True, seed=0)
-        found = search.find(
-            evaluate,
-            int(tuning.get("iterations", 10)),
-            maximize=evaluator.larger_is_better,
-        )
+        with tel.span(
+            "tuning", mode=tuning.get("mode", "bayesian"),
+            iterations=int(tuning.get("iterations", 10)),
+        ):
+            found = search.find(
+                evaluate,
+                int(tuning.get("iterations", 10)),
+                maximize=evaluator.larger_is_better,
+            )
         coordinate_configs = {
             nm: _dc.replace(coordinate_configs[nm], reg_weight=float(xi))
             for nm, xi in zip(names, found.best_params)
@@ -443,11 +475,16 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         task, coordinate_configs, n_iterations=n_cd_iterations, logger=logger,
         mesh=mesh, device_metrics=args.device_metrics,
     )
-    from photon_ml_tpu.utils.watchdog import RetryPolicy, run_with_retries
+    from photon_ml_tpu.utils.watchdog import (
+        RetryPolicy,
+        RetryStats,
+        run_with_retries,
+    )
 
     retry_policy = RetryPolicy(
         max_retries=args.max_retries, backoff_seconds=args.retry_backoff
     )
+    retry_stats = RetryStats()
     if len(config_grid) > 1:
         if locked:
             raise SystemExit(
@@ -455,15 +492,19 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 "coordinate has nothing to sweep)"
             )
         # Config-grid fit with validation-driven selection (SURVEY.md §3.2).
-        model, grid_results = run_with_retries(
-            lambda attempt: estimator.fit_grid(
-                config_grid, shards, ids, response, weight=weight,
-                offset=offset, validation=val_tuple, suite=suite,
-                initial_model=initial_model,
-                grid_checkpointer=grid_checkpointer,
-            ),
-            retry_policy, logger,
-        )
+        with tel.span(
+            "train", grid_points=len(config_grid),
+            cd_iterations=n_cd_iterations,
+        ):
+            model, grid_results = run_with_retries(
+                lambda attempt: estimator.fit_grid(
+                    config_grid, shards, ids, response, weight=weight,
+                    offset=offset, validation=val_tuple, suite=suite,
+                    initial_model=initial_model,
+                    grid_checkpointer=grid_checkpointer,
+                ),
+                retry_policy, logger, stats=retry_stats,
+            )
         best = next(r for r in grid_results if r["best"])
         history = best["history"]
         result["grid"] = [
@@ -486,15 +527,16 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     else:
         # A retry resumes from the per-iteration CD checkpoint (the
         # CoordinateDescent loop reloads it on entry — SURVEY.md §5.3).
-        model, history = run_with_retries(
-            lambda attempt: estimator.fit(
-                shards, ids, response, weight=weight, offset=offset,
-                validation=val_tuple, suite=suite,
-                initial_model=initial_model, checkpointer=checkpointer,
-                locked_coordinates=locked,
-            ),
-            retry_policy, logger,
-        )
+        with tel.span("train", cd_iterations=n_cd_iterations):
+            model, history = run_with_retries(
+                lambda attempt: estimator.fit(
+                    shards, ids, response, weight=weight, offset=offset,
+                    validation=val_tuple, suite=suite,
+                    initial_model=initial_model, checkpointer=checkpointer,
+                    locked_coordinates=locked,
+                ),
+                retry_policy, logger, stats=retry_stats,
+            )
     result["history"] = history
     result["train_metric"] = history[-1].get("train_metric") if history else None
     if history and "validation" in history[-1]:
@@ -503,26 +545,35 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
     if validation is not None:
         v_shards, v_ids, v_resp, v_weight, v_offset, _, _ = validation
-        v_scores = GameTransformer(model).transform(v_shards, v_ids, v_offset)
-        v_groups = (
-            np.asarray(v_ids[suite.group_column])
-            if suite.group_column is not None
-            else None
-        )
-        result["validation_metric"] = evaluator.evaluate(
-            v_scores, v_resp, v_weight, group_ids=v_groups
-        )
+        with tel.span("validate", rows=int(len(v_resp))):
+            v_scores = GameTransformer(model).transform(
+                v_shards, v_ids, v_offset
+            )
+            v_groups = (
+                np.asarray(v_ids[suite.group_column])
+                if suite.group_column is not None
+                else None
+            )
+            result["validation_metric"] = evaluator.evaluate(
+                v_scores, v_resp, v_weight, group_ids=v_groups
+            )
         logger.info(
             "validation %s = %.6f",
             type(evaluator).__name__, result["validation_metric"],
         )
 
-    save_game_model(model, index_maps, os.path.join(args.output_dir, "models"))
+    with tel.span("write"):
+        save_game_model(
+            model, index_maps, os.path.join(args.output_dir, "models")
+        )
+    if retry_stats.retries or retry_stats.failures:
+        result["retry"] = retry_stats.snapshot()
     result["wall_seconds"] = timer.stop()
     with open(os.path.join(args.output_dir, "training_result.json"), "w") as f:
         json.dump(result, f, indent=2)
+    publish_cache_metrics(cache_dir)
+    tel.gauge("run_wall_seconds").set(result["wall_seconds"])
     logger.info("GAME training done in %.2fs", result["wall_seconds"])
-    logger.close()
     return result
 
 

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry as telemetry_mod
 from photon_ml_tpu.data import libsvm
 from photon_ml_tpu.data.dataset import make_glm_data
 from photon_ml_tpu.data.index_map import INTERCEPT_KEY, IndexMap
@@ -55,6 +56,7 @@ from photon_ml_tpu.optim.regularization import RegularizationContext, Regulariza
 from photon_ml_tpu.utils.compile_cache import (
     add_compile_cache_arg,
     enable_from_args,
+    publish_cache_metrics,
 )
 from photon_ml_tpu.utils.logging import PhotonLogger
 from photon_ml_tpu.utils.timer import Timer
@@ -190,6 +192,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "2 = the classic double buffer; 1 serializes transfer and "
         "compute (measurement baseline)",
     )
+    p.add_argument(
+        "--telemetry",
+        choices=["on", "off"],
+        default="on",
+        help="unified telemetry (events.jsonl + trace.json + metrics.json "
+        "in the output dir, summary in the log). 'off' reduces every "
+        "instrumented site to one branch",
+    )
     add_compile_cache_arg(p)
     return p
 
@@ -212,17 +222,33 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
 def _run(args) -> dict:
     os.makedirs(args.output_dir, exist_ok=True)
-    logger = PhotonLogger(args.output_dir)
+    # The logger and telemetry hub own process-level resources (file
+    # handles, the process-current hub slot); context managers release
+    # them on ANY exit — repeated in-process driver runs (tests, bench,
+    # hyperparameter search) must not leak either.
+    with PhotonLogger(args.output_dir) as logger:
+        tel = telemetry_mod.Telemetry(
+            output_dir=args.output_dir,
+            logger=logger,
+            enabled=args.telemetry != "off",
+        )
+        with tel, tel.span("run", driver="glm_driver", task=args.task):
+            return _run_impl(args, logger, tel)
+
+
+def _run_impl(args, logger, tel) -> dict:
     timer = Timer().start()
-    enable_from_args(args, logger)
+    cache_dir = enable_from_args(args, logger)
     from photon_ml_tpu.parallel.multihost import initialize_logged
 
     initialize_logged(logger)
 
     # Stage 1: read ---------------------------------------------------------
-    X_train, y_train = libsvm.read_libsvm(
-        args.train_data, n_features=args.n_features, add_intercept=args.intercept
-    )
+    with tel.span("read", path=args.train_data):
+        X_train, y_train = libsvm.read_libsvm(
+            args.train_data, n_features=args.n_features,
+            add_intercept=args.intercept,
+        )
     d = X_train.shape[1]
     logger.info(
         "read %d rows x %d features from %s", X_train.shape[0], d, args.train_data
@@ -244,17 +270,18 @@ def _run(args) -> dict:
             "--stream-storage-dir requires --stream-chunk-rows > 0"
         )
     streaming = args.stream_chunk_rows > 0
-    if data_parallel or streaming:
-        # The sharded path uploads the matrix across the mesh (and the
-        # streamed path never uploads it whole); a second full
-        # single-device copy just for summarization would defeat both.
-        from photon_ml_tpu.data.stats import summarize_host
+    with tel.span("summarize", rows=int(X_train.shape[0]), features=int(d)):
+        if data_parallel or streaming:
+            # The sharded path uploads the matrix across the mesh (and the
+            # streamed path never uploads it whole); a second full
+            # single-device copy just for summarization would defeat both.
+            from photon_ml_tpu.data.stats import summarize_host
 
-        train_data = None
-        summary = summarize_host(X_train)
-    else:
-        train_data = make_glm_data(X_train, y_train)
-        summary = summarize(train_data)
+            train_data = None
+            summary = summarize_host(X_train)
+        else:
+            train_data = make_glm_data(X_train, y_train)
+            summary = summarize(train_data)
     norm_type = NormalizationType(args.normalization)
     normalization = (
         None
@@ -481,24 +508,38 @@ def _run(args) -> dict:
             solved=solved_now, on_solved=on_solved, bounds=bounds,
         )
 
-    from photon_ml_tpu.utils.watchdog import RetryPolicy, run_with_retries
-
-    grid = run_with_retries(
-        train,
-        RetryPolicy(
-            max_retries=args.max_retries,
-            backoff_seconds=args.retry_backoff,
-        ),
-        logger,
+    from photon_ml_tpu.utils.watchdog import (
+        RetryPolicy,
+        RetryStats,
+        run_with_retries,
     )
+
+    retry_stats = RetryStats()
+    with tel.span(
+        "train", grid_points=len(reg_weights),
+        streaming=streaming, data_parallel=data_parallel,
+    ):
+        grid = run_with_retries(
+            train,
+            RetryPolicy(
+                max_retries=args.max_retries,
+                backoff_seconds=args.retry_backoff,
+            ),
+            logger,
+            stats=retry_stats,
+        )
+    grid_walls = getattr(problem, "grid_wall_seconds", {})
     for lam, _, res in grid:
         if res is None:
             logger.info("lambda=%g: restored from checkpoint", lam)
             continue
-        tracker = OptimizationStatesTracker.from_solve_result(res)
+        tracker = OptimizationStatesTracker.from_solve_result(
+            res, wall_seconds=grid_walls.get(lam, float("nan"))
+        )
         logger.info(
-            "lambda=%g: value=%.8g iters=%d converged=%s",
+            "lambda=%g: value=%.8g iters=%d converged=%s wall=%.3fs",
             lam, float(res.value), tracker.iterations, tracker.converged,
+            tracker.wall_seconds,
         )
 
     # Stage 4: validate + select --------------------------------------------
@@ -542,52 +583,59 @@ def _run(args) -> dict:
     metrics = {}
     best: tuple[float, GeneralizedLinearModel] | None = None
     best_metric = None
-    for lam, model, res in grid:
-        if host_scoring:
-            # Host scipy matvec: validation never needs a device round trip
-            # of a full unsharded copy.
-            scores = np.asarray(
-                X_val @ np.asarray(model.coefficients.means, np.float32)
-            ).ravel()
-            val_weights = None
-        else:
-            scores = np.asarray(model.compute_score(val_data))
-            val_weights = np.asarray(val_data.weights)
-        m = evaluator.evaluate(scores, y_val, val_weights)
-        metrics[lam] = m
-        logger.info("lambda=%g: %s=%.6f", lam, type(evaluator).__name__, m)
-        if best_metric is None or evaluator.better_than(m, best_metric):
-            best_metric, best = m, (lam, model)
-        if report is not None:
-            if res is not None:
-                report.add_convergence(lam, res.values, res.grad_norms)
-            report.add_metric(
-                type(evaluator).__name__, lam,
-                bootstrap_metric_ci(
-                    lambda s, l: evaluator.evaluate(s, l, None),
-                    scores, np.asarray(y_val),
-                ),
+    with tel.span(
+        "validate", rows=int(len(y_val)),
+        evaluator=type(evaluator).__name__,
+    ):
+        for lam, model, res in grid:
+            if host_scoring:
+                # Host scipy matvec: validation never needs a device round
+                # trip of a full unsharded copy.
+                scores = np.asarray(
+                    X_val @ np.asarray(model.coefficients.means, np.float32)
+                ).ravel()
+                val_weights = None
+            else:
+                scores = np.asarray(model.compute_score(val_data))
+                val_weights = np.asarray(val_data.weights)
+            m = evaluator.evaluate(scores, y_val, val_weights)
+            metrics[lam] = m
+            logger.info(
+                "lambda=%g: %s=%.6f", lam, type(evaluator).__name__, m
             )
-            if problem.task == "logistic":
-                report.add_calibration(
-                    lam, hosmer_lemeshow(scores, np.asarray(y_val))
+            if best_metric is None or evaluator.better_than(m, best_metric):
+                best_metric, best = m, (lam, model)
+            if report is not None:
+                if res is not None:
+                    report.add_convergence(lam, res.values, res.grad_norms)
+                report.add_metric(
+                    type(evaluator).__name__, lam,
+                    bootstrap_metric_ci(
+                        lambda s, l: evaluator.evaluate(s, l, None),
+                        scores, np.asarray(y_val),
+                    ),
                 )
-            report.add_importance(lam, feature_importance(
-                np.asarray(model.coefficients.means),
-                feature_std=report_std,
-                name_fn=index_map.index_to_name,
-            ))
+                if problem.task == "logistic":
+                    report.add_calibration(
+                        lam, hosmer_lemeshow(scores, np.asarray(y_val))
+                    )
+                report.add_importance(lam, feature_importance(
+                    np.asarray(model.coefficients.means),
+                    feature_std=report_std,
+                    name_fn=index_map.index_to_name,
+                ))
 
     # Stage 5: write --------------------------------------------------------
     assert best is not None
     best_lam, best_model = best
-    to_write = grid if args.output_mode == "all" else [
-        (lam, mdl, res) for lam, mdl, res in grid if lam == best_lam
-    ]
-    for lam, model, _ in to_write:
-        out = os.path.join(args.output_dir, f"model_lambda_{lam:g}.avro")
-        save_glm_model(model, index_map, out, model_id=f"lambda={lam:g}")
-    index_map.save(args.output_dir)
+    with tel.span("write", output_mode=args.output_mode):
+        to_write = grid if args.output_mode == "all" else [
+            (lam, mdl, res) for lam, mdl, res in grid if lam == best_lam
+        ]
+        for lam, model, _ in to_write:
+            out = os.path.join(args.output_dir, f"model_lambda_{lam:g}.avro")
+            save_glm_model(model, index_map, out, model_id=f"lambda={lam:g}")
+        index_map.save(args.output_dir)
     result = {
         "best_lambda": best_lam,
         "metrics": {str(k): v for k, v in metrics.items()},
@@ -595,18 +643,24 @@ def _run(args) -> dict:
         "n_rows": int(X_train.shape[0]),
         "n_features": int(d),
         "wall_seconds": timer.stop(),
+        "solver_wall_seconds": {
+            str(lam): w for lam, w in sorted(grid_walls.items())
+        },
     }
+    if retry_stats.retries or retry_stats.failures:
+        result["retry"] = retry_stats.snapshot()
     if report is not None:
         jpath, hpath = report.save(args.output_dir)
         result["report"] = {"json": jpath, "html": hpath}
         logger.info("training report: %s", hpath)
     with open(os.path.join(args.output_dir, "training_result.json"), "w") as f:
         json.dump(result, f, indent=2)
+    publish_cache_metrics(cache_dir)
+    tel.gauge("run_wall_seconds").set(result["wall_seconds"])
     logger.info(
         "selected lambda=%g (%s=%.6f) in %.2fs",
         best_lam, type(evaluator).__name__, best_metric, result["wall_seconds"],
     )
-    logger.close()
     return result
 
 

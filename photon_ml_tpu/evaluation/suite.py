@@ -15,6 +15,7 @@ from typing import Optional, Sequence, Union
 
 import numpy as np
 
+from photon_ml_tpu import telemetry as telemetry_mod
 from photon_ml_tpu.evaluation.evaluators import (
     Evaluator,
     default_evaluator_for_task,
@@ -84,10 +85,16 @@ class EvaluationSuite:
         group_ids: Optional[np.ndarray] = None,
     ) -> dict:
         """name → metric value, every evaluator on one score pass."""
-        return {
-            name: ev.evaluate(scores, labels, weights, group_ids)
-            for name, ev in self.evaluators
-        }
+        with telemetry_mod.current().span(
+            "evaluation",
+            evaluators=[n for n, _ in self.evaluators],
+            grouped=group_ids is not None,
+            rows=len(scores),
+        ):
+            return {
+                name: ev.evaluate(scores, labels, weights, group_ids)
+                for name, ev in self.evaluators
+            }
 
     def evaluate_device(
         self,
@@ -119,22 +126,31 @@ class EvaluationSuite:
             )
         from photon_ml_tpu.evaluation.device import device_evaluator_fn
 
-        out = {}
-        host_pull = None
-        for name, ev in self.evaluators:
-            fn = device_evaluator_fn(ev)
-            if fn is not None:
-                m = fn(scores, labels, weights)
-                out[name] = float(m) if materialize else m
-                continue
-            if host_pull is None:
-                host_pull = (
-                    np.asarray(scores),
-                    np.asarray(labels),
-                    None if weights is None else np.asarray(weights),
-                )
-            out[name] = ev.evaluate(*host_pull)
-        return out
+        # Span covers DISPATCH wall when materialize=False (device
+        # metrics flush later in the CD batched readback — forcing a
+        # sync here for timing would defeat that design).
+        with telemetry_mod.current().span(
+            "evaluation",
+            evaluators=[n for n, _ in self.evaluators],
+            device=True,
+            materialize=materialize,
+        ):
+            out = {}
+            host_pull = None
+            for name, ev in self.evaluators:
+                fn = device_evaluator_fn(ev)
+                if fn is not None:
+                    m = fn(scores, labels, weights)
+                    out[name] = float(m) if materialize else m
+                    continue
+                if host_pull is None:
+                    host_pull = (
+                        np.asarray(scores),
+                        np.asarray(labels),
+                        None if weights is None else np.asarray(weights),
+                    )
+                out[name] = ev.evaluate(*host_pull)
+            return out
 
     def better_than(self, a: Optional[float], b: Optional[float]) -> bool:
         """Compare two PRIMARY metric values; None/NaN always loses."""

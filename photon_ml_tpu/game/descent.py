@@ -15,13 +15,25 @@ instead of an (n-1)-way join.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry as telemetry_mod
 from photon_ml_tpu.game.coordinates import Coordinate
+
+
+def _optimizer_name(coord) -> Optional[str]:
+    """Best-effort optimizer label for a coordinate's solver span (the
+    config lives at different depths across coordinate flavors)."""
+    cfg = getattr(coord, "config", None)
+    if cfg is None:
+        cfg = getattr(getattr(coord, "problem", None), "config", None)
+    opt = getattr(getattr(cfg, "optimizer", None), "optimizer", None)
+    return getattr(opt, "value", None)
 
 
 def _state_to_device(st):
@@ -251,31 +263,60 @@ class CoordinateDescent:
                     "resumed checkpoint"
                 )
 
+        tel = telemetry_mod.current()
         flush_per_iteration = logger is not None or checkpointer is not None
         for it in range(start_it, n_iterations):
-            for coord in self.coordinates:
-                if coord.name in locked:
-                    continue  # partial retrain: contributes scores only
-                offsets = total - scores[coord.name]
-                state = coord.train(offsets, warm_state=states[coord.name])
-                new_score = coord.score(state)
-                states[coord.name] = state
-                total = offsets + new_score
-                scores[coord.name] = new_score
+            it_t0 = time.perf_counter()
+            with tel.span("cd_iteration", iteration=it):
+                for coord in self.coordinates:
+                    if coord.name in locked:
+                        continue  # partial retrain: contributes scores only
+                    offsets = total - scores[coord.name]
+                    upd_t0 = time.perf_counter()
+                    # Coordinate/solver spans cover the HOST wall of the
+                    # update: real wall for streamed/out-of-core
+                    # coordinates (their train blocks per pass), dispatch
+                    # wall for resident ones — the batched-flush design
+                    # forbids a per-update device sync, so the true
+                    # per-iteration wall rides the cd_iteration span /
+                    # histogram measured across the flush below.
+                    with tel.span(
+                        "coordinate", coordinate=coord.name, iteration=it
+                    ):
+                        with tel.span(
+                            "solver",
+                            coordinate=coord.name,
+                            optimizer=_optimizer_name(coord),
+                        ):
+                            state = coord.train(
+                                offsets, warm_state=states[coord.name]
+                            )
+                        new_score = coord.score(state)
+                    states[coord.name] = state
+                    total = offsets + new_score
+                    scores[coord.name] = new_score
 
-                entry = {"iteration": it, "coordinate": coord.name}
-                if eval_fn is not None:
-                    entry.update(eval_fn(it, coord.name, scores, states))
-                # The norm is just another deferred floating scalar —
-                # the flush walk materializes it with the metrics.
-                entry["score_norm"] = jnp.linalg.norm(new_score)
-                pending.append(entry)
-            if flush_per_iteration:
-                flush()
-            if checkpointer is not None:
-                checkpointer.save(
-                    it, total, scores, states, history,
-                    locked=sorted(locked),
+                    entry = {"iteration": it, "coordinate": coord.name}
+                    if eval_fn is not None:
+                        entry.update(eval_fn(it, coord.name, scores, states))
+                    # The norm is just another deferred floating scalar —
+                    # the flush walk materializes it with the metrics.
+                    entry["score_norm"] = jnp.linalg.norm(new_score)
+                    entry["wall_seconds"] = time.perf_counter() - upd_t0
+                    pending.append(entry)
+                if flush_per_iteration:
+                    flush()
+                if checkpointer is not None:
+                    checkpointer.save(
+                        it, total, scores, states, history,
+                        locked=sorted(locked),
+                    )
+            if flush_per_iteration and tel.enabled:
+                # The flush materialized device scalars (a real sync), so
+                # this iteration wall is achieved wall-clock, not
+                # dispatch rate.
+                tel.histogram("cd_iteration_seconds").observe(
+                    time.perf_counter() - it_t0
                 )
         flush()
         return CoordinateDescentResult(states=states, scores=scores, history=history)

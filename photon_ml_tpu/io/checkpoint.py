@@ -31,12 +31,29 @@ from typing import Optional
 
 import numpy as np
 
+from photon_ml_tpu import telemetry as telemetry_mod
+
 
 def _atomic_savez(path: str, arrays: dict) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
     os.replace(tmp, path)
+
+
+def _checkpoint_event(kind: str, path: str, **attrs) -> None:
+    """One telemetry event + counter per checkpoint save/restore, with
+    the on-disk size when the file exists (host stat, never a device
+    touch)."""
+    tel = telemetry_mod.current()
+    if not tel.enabled:
+        return
+    try:
+        attrs["bytes"] = os.path.getsize(path)
+    except OSError:
+        pass
+    tel.event(f"checkpoint.{kind}", path=path, **attrs)
+    tel.counter(f"checkpoint_{kind}s").inc()
 
 
 def _flatten_state(prefix: str, st, arrays: dict):
@@ -144,6 +161,7 @@ class CoordinateDescentCheckpointer:
             )
         )
         _atomic_savez(self.path, arrays)
+        _checkpoint_event("save", self.path, store="cd", iteration=iteration)
 
     def load(self) -> Optional[dict]:
         """Returns {iteration, total, scores, states, history} or None.
@@ -194,6 +212,9 @@ class CoordinateDescentCheckpointer:
             name: _unflatten_state(f"state__{name}", specs.get(name), arrays)
             for name in meta["coordinates"]
         }
+        _checkpoint_event(
+            "restore", self.path, store="cd", iteration=int(meta["iteration"])
+        )
         return {
             "iteration": int(meta["iteration"]),
             "total": arrays["total"],
@@ -238,6 +259,9 @@ class GridCheckpointer:
             meta.update(extra_meta)
         arrays["__meta__"] = np.asarray(json.dumps(meta))
         _atomic_savez(self.path, arrays)
+        _checkpoint_event(
+            "save", self.path, store="grid", solved=len(solved)
+        )
 
     def load(self) -> dict:
         """Returns λ → coefficient vector (insertion order = solve order)."""
@@ -245,6 +269,9 @@ class GridCheckpointer:
         if loaded is None:
             return {}
         meta, arrays = loaded
+        _checkpoint_event(
+            "restore", self.path, store="grid", solved=len(meta["lambdas"])
+        )
         return {lam: arrays[f"w__{i}"] for i, lam in enumerate(meta["lambdas"])}
 
     def load_meta(self) -> dict:
@@ -344,6 +371,10 @@ class GameGridCheckpointer:
         # complete model + metadata.
         shutil.rmtree(d, ignore_errors=True)
         os.replace(tmp, d)
+        telemetry_mod.current().event(
+            "checkpoint.save", store="game_grid", grid_index=gi, path=d
+        )
+        telemetry_mod.current().counter("checkpoint_saves").inc()
 
     def load_point(self, gi: int, configs: dict, metric_key: str):
         """Returns ``(model, metric, history)`` for a completed matching
@@ -364,4 +395,9 @@ class GameGridCheckpointer:
         if meta.get("metric_key") != metric_key:
             return None
         model, _ = load_game_model(self._point_dir(gi))
+        telemetry_mod.current().event(
+            "checkpoint.restore", store="game_grid", grid_index=gi,
+            path=self._point_dir(gi),
+        )
+        telemetry_mod.current().counter("checkpoint_restores").inc()
         return model, meta["metric"], meta.get("history", [])

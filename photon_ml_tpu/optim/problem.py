@@ -22,6 +22,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from photon_ml_tpu import telemetry as telemetry_mod
 from photon_ml_tpu.data.dataset import GlmData
 from photon_ml_tpu.data.normalization import NormalizationContext
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
@@ -79,6 +80,9 @@ class GlmOptimizationProblem:
     ):
         self.task = losses_lib.get(task).name  # canonicalize aliases
         self.config = config
+        #: per-λ blocking wall seconds of the LAST grid_loop run (drivers
+        #: read it to put real wall-clock on convergence trackers).
+        self.grid_wall_seconds: dict[float, float] = {}
         self.objective = GlmObjective(
             losses_lib.get(task), normalization, accumulate=accumulate
         )
@@ -273,7 +277,19 @@ class GlmOptimizationProblem:
         the uninterrupted one bit-for-bit.  ``on_solved(lam, w)`` fires
         after each fresh solve (the driver persists the checkpoint there).
         ``variance_fn(w, lam)`` runs for EVERY grid point (including
-        restored ones) when coefficient variances are requested."""
+        restored ones) when coefficient variances are requested.
+
+        Each fresh solve runs under a ``solver`` telemetry span and is
+        wall-clocked to COMPLETION (``Timer.stop_blocking`` on the
+        solution vector — the grid is a warm-start chain, so solves were
+        already serialized; the block only moves the sync to where it can
+        be attributed).  Per-λ walls land in ``self.grid_wall_seconds``
+        so drivers can put real wall-clock on their convergence
+        trackers."""
+        from photon_ml_tpu.utils.timer import Timer
+
+        tel = telemetry_mod.current()
+        self.grid_wall_seconds: dict[float, float] = {}
         results = []
         w_prev = w0
         solved = solved or {}
@@ -281,8 +297,28 @@ class GlmOptimizationProblem:
             if lam in solved:
                 w = jnp.asarray(solved[lam])
                 res = None
+                tel.event("grid.restored", reg_weight=float(lam))
             else:
-                res = solve_fn(lam, w_prev)
+                with tel.span(
+                    "solver",
+                    reg_weight=float(lam),
+                    optimizer=self.config.optimizer.optimizer.value,
+                ) as sp:
+                    timer = Timer().start()
+                    res = solve_fn(lam, w_prev)
+                    wall = timer.stop_blocking(res.w)
+                    if tel.enabled:
+                        # res.w is ready (blocked above), so these scalar
+                        # readbacks cost a copy, not a device sync.
+                        iters = int(res.iterations)
+                        sp.set(
+                            iterations=iters,
+                            converged=bool(res.converged),
+                            wall_seconds=wall,
+                        )
+                        tel.counter("solver_iterations").inc(iters)
+                        tel.histogram("solver_wall_seconds").observe(wall)
+                self.grid_wall_seconds[lam] = wall
                 w = res.w
                 if on_solved is not None:
                     on_solved(lam, w)

@@ -1,0 +1,35 @@
+"""Unified telemetry: spans, metrics, and pluggable sinks.
+
+Public surface::
+
+    from photon_ml_tpu import telemetry
+
+    with telemetry.Telemetry(output_dir=out, logger=logger) as tel:
+        with tel.span("run", driver="glm"):
+            tel.event("checkpoint.save", path=p)
+            tel.counter("solver_iterations").inc(12)
+
+Library code that cannot be handed a hub uses :func:`current` — a
+disabled no-op by default, the driver-installed hub inside a driver run.
+``python -m photon_ml_tpu.telemetry --selfcheck`` exercises every sink
+and validates the outputs (see __main__.py).
+"""
+
+from photon_ml_tpu.telemetry.core import (  # noqa: F401
+    NULL,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    Span,
+    Telemetry,
+    current,
+    json_safe,
+    set_current,
+)
+from photon_ml_tpu.telemetry.sinks import (  # noqa: F401
+    ChromeTraceSink,
+    JsonlSink,
+    LoggerSummarySink,
+    Sink,
+)

@@ -1,0 +1,211 @@
+"""Telemetry smoke entry point.
+
+``python -m photon_ml_tpu.telemetry --selfcheck`` emits a synthetic span
+tree (including a cross-thread producer span, instant events, and every
+metric kind) through the full sink set into a scratch directory, then
+validates the outputs:
+
+- every ``events.jsonl`` line parses as JSON and carries type/name/ts;
+- ``trace.json`` parses as a Chrome trace-event ARRAY whose span events
+  have the required ph/ts/dur/pid/tid fields and whose parent links
+  resolve;
+- ``metrics.json`` round-trips the registry snapshot.
+
+Exit status 0 on success; nonzero with a diagnostic on any failure —
+CI-greppable, device-free (never imports jax).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+
+def _build_synthetic_run(out_dir: str) -> dict:
+    from photon_ml_tpu.telemetry import Telemetry
+
+    with Telemetry(output_dir=out_dir, run_name="selfcheck") as tel:
+        with tel.span("run", driver="selfcheck"):
+            for it in range(2):
+                with tel.span("cd_iteration", iteration=it):
+                    for coord in ("fixed", "per_user"):
+                        with tel.span(
+                            "coordinate", coordinate=coord, iteration=it
+                        ):
+                            with tel.span(
+                                "solver", coordinate=coord,
+                                optimizer="lbfgs",
+                            ) as sp:
+                                time.sleep(0.001)
+                                sp.set(iterations=7, converged=True)
+                            tel.counter("solver_iterations").inc(7)
+                tel.event(
+                    "checkpoint.save", iteration=it, path="<synthetic>"
+                )
+
+            def producer():
+                # Cross-thread spans root their own stacks (the h2d
+                # prefetch producer's shape).
+                for k in range(3):
+                    with tel.span("chunk", index=k):
+                        time.sleep(0.0005)
+                    tel.histogram("h2d_chunk_seconds").observe(0.0005)
+                tel.gauge("h2d_gbps").set(1.25)
+                tel.counter("h2d_bytes_total").inc(3 * 1024)
+
+            t = threading.Thread(target=producer, name="h2d-prefetch")
+            t.start()
+            t.join()
+            tel.event(
+                "watchdog.attempt", attempt=0, outcome="ok",
+                exception=None,
+            )
+        snap = tel.snapshot()
+    return snap
+
+
+def validate_outputs(out_dir: str, snapshot: dict) -> list[str]:
+    """Returns a list of failure strings (empty = pass)."""
+    failures: list[str] = []
+
+    events_path = os.path.join(out_dir, "events.jsonl")
+    trace_path = os.path.join(out_dir, "trace.json")
+    metrics_path = os.path.join(out_dir, "metrics.json")
+    for p in (events_path, trace_path, metrics_path):
+        if not os.path.exists(p):
+            failures.append(f"missing output: {p}")
+    if failures:
+        return failures
+
+    span_ids = set()
+    parents = []
+    n_lines = 0
+    with open(events_path) as f:
+        for lineno, line in enumerate(f, 1):
+            n_lines += 1
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                failures.append(f"events.jsonl:{lineno} unparseable: {e}")
+                continue
+            if rec.get("type") == "metrics":
+                # Trailing registry snapshot record — no name/ts.
+                continue
+            if "type" not in rec or "name" not in rec or "ts" not in rec:
+                failures.append(
+                    f"events.jsonl:{lineno} missing type/name/ts: {rec}"
+                )
+            if rec.get("type") == "span":
+                span_ids.add(rec["id"])
+                if rec.get("parent") is not None:
+                    parents.append((lineno, rec["parent"]))
+                if rec.get("dur", -1.0) < 0.0:
+                    failures.append(
+                        f"events.jsonl:{lineno} negative span duration"
+                    )
+    if n_lines == 0:
+        failures.append("events.jsonl is empty")
+    for lineno, parent in parents:
+        if parent not in span_ids:
+            failures.append(
+                f"events.jsonl:{lineno} dangling parent span {parent}"
+            )
+
+    with open(trace_path) as f:
+        try:
+            trace = json.load(f)
+        except json.JSONDecodeError as e:
+            failures.append(f"trace.json unparseable: {e}")
+            trace = None
+    if trace is not None:
+        if not isinstance(trace, list):
+            failures.append(
+                f"trace.json is {type(trace).__name__}, not an array"
+            )
+        else:
+            n_spans = 0
+            for i, ev in enumerate(trace):
+                if not isinstance(ev, dict):
+                    failures.append(f"trace.json[{i}] not an object")
+                    continue
+                missing = [k for k in ("name", "ph", "ts", "pid", "tid")
+                           if k not in ev]
+                if missing:
+                    failures.append(
+                        f"trace.json[{i}] missing {missing}"
+                    )
+                if ev.get("ph") == "X":
+                    n_spans += 1
+                    if "dur" not in ev:
+                        failures.append(
+                            f"trace.json[{i}] X event without dur"
+                        )
+            if n_spans == 0:
+                failures.append("trace.json holds no span (X) events")
+
+    with open(metrics_path) as f:
+        try:
+            metrics = json.load(f)
+        except json.JSONDecodeError as e:
+            failures.append(f"metrics.json unparseable: {e}")
+            metrics = {}
+    for kind in ("counters", "gauges", "histograms"):
+        if kind not in metrics:
+            failures.append(f"metrics.json missing {kind!r}")
+        elif snapshot.get(kind) and metrics[kind] != json.loads(
+            json.dumps(snapshot[kind])
+        ):
+            failures.append(
+                f"metrics.json {kind} diverge from the live snapshot"
+            )
+    return failures
+
+
+def selfcheck(keep_dir: str | None = None) -> int:
+    if keep_dir is not None:
+        os.makedirs(keep_dir, exist_ok=True)
+        out_dir = keep_dir
+        snap = _build_synthetic_run(out_dir)
+        failures = validate_outputs(out_dir, snap)
+    else:
+        with tempfile.TemporaryDirectory() as td:
+            out_dir = td
+            snap = _build_synthetic_run(out_dir)
+            failures = validate_outputs(out_dir, snap)
+    if failures:
+        for f in failures:
+            print(f"telemetry selfcheck FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        "telemetry selfcheck OK: events.jsonl + trace.json + metrics.json "
+        f"valid ({out_dir})"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m photon_ml_tpu.telemetry")
+    p.add_argument(
+        "--selfcheck", action="store_true",
+        help="emit a synthetic span tree through every sink and validate "
+        "the outputs",
+    )
+    p.add_argument(
+        "--keep-dir",
+        help="with --selfcheck: write the outputs here (inspectable) "
+        "instead of a throwaway tempdir",
+    )
+    args = p.parse_args(argv)
+    if args.selfcheck:
+        return selfcheck(args.keep_dir)
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,489 @@
+"""Telemetry core: hierarchical spans, a metrics registry, and the hub.
+
+The reference leaned on Spark's UI and executor logs for run visibility;
+a single-process TPU driver has neither, so this package is the common
+event stream the scattered fragments (``PhotonLogger`` lines, ``Timer``
+measurements, ``TransferStats``, watchdog decisions) feed into:
+
+- **Spans** — hierarchical wall-clock intervals (``run → coordinate →
+  solver → chunk``) with monotonic timestamps and structured attributes.
+  Nesting is tracked per thread; spans opened on other threads (the
+  prefetch producer) become roots of their own stacks.
+- **Metrics registry** — named counters, gauges, and histograms
+  (``h2d_gbps``, ``consumer_stall_seconds``, ``solver_iterations``, ...)
+  snapshotted to JSON at end of run.
+- **Sinks** (telemetry/sinks.py) — JSONL event log (source of truth),
+  Chrome trace-event ``trace.json`` (Perfetto / ``chrome://tracing``),
+  and a human-readable end-of-run summary through ``PhotonLogger``.
+
+Cost contract: telemetry is default-on but must be no-op cheap — a
+disabled or sink-less hub costs ONE branch per event/span, and nothing
+in this package ever touches a device array's values or forces a sync
+the caller didn't already do (device arrays in attributes are recorded
+as shape/dtype placeholders, never materialized).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import math
+import os
+import threading
+import time
+from typing import Optional
+
+
+# ---------------------------------------------------------------------------
+# JSON sanitization (device-sync-safe)
+# ---------------------------------------------------------------------------
+
+def json_safe(value):
+    """Best-effort conversion of an attribute value to JSON-able data.
+
+    Never materializes a device array: anything exposing ``shape``/
+    ``dtype`` that is not a host numpy array becomes a placeholder
+    string (reading ``.shape`` does not sync; ``str(arr)`` would).
+    """
+    if value is None or isinstance(value, (bool, int, str)):
+        return value
+    if isinstance(value, float):
+        # NaN/inf are not valid JSON; keep the record parseable.
+        return value if math.isfinite(value) else repr(value)
+    import numpy as np
+
+    if isinstance(value, np.generic):
+        return json_safe(value.item())
+    if isinstance(value, np.ndarray):
+        if value.size <= 32:
+            return [json_safe(v) for v in value.tolist()]
+        return f"<ndarray shape={value.shape} dtype={value.dtype}>"
+    if hasattr(value, "shape") and hasattr(value, "dtype"):
+        # jax.Array (possibly still executing on device): shape/dtype are
+        # metadata reads, str() would block on the computation.
+        return f"<array shape={tuple(value.shape)} dtype={value.dtype}>"
+    if isinstance(value, dict):
+        return {str(k): json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [json_safe(v) for v in value]
+    if isinstance(value, BaseException):
+        return f"{type(value).__name__}: {value}"
+    try:
+        json.dumps(value)
+        return value
+    except TypeError:
+        return str(value)
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+class Counter:
+    """Monotonically increasing count (events, retries, bytes moved)."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-written value (rates, depths, sizes)."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = None
+
+    def set(self, v) -> None:
+        with self._lock:
+            self.value = v
+
+
+class Histogram:
+    """Streaming summary of observed values (count/sum/min/max/last)."""
+
+    __slots__ = ("_lock", "count", "sum", "min", "max", "last")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self.last = None
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self.last = v
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min,
+                "max": self.max,
+                "mean": self.sum / self.count if self.count else None,
+                "last": self.last,
+            }
+
+
+class _NullMetric:
+    """Shared no-op metric: one attribute call and out."""
+
+    __slots__ = ()
+
+    def inc(self, n=1) -> None:
+        pass
+
+    def set(self, v) -> None:
+        pass
+
+    def observe(self, v) -> None:
+        pass
+
+
+_NULL_METRIC = _NullMetric()
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms, thread-safe, JSON-snapshottable.
+
+    Disabled registries hand back a shared no-op metric object, so an
+    instrumented call site pays one branch whether telemetry is on or
+    off.
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def _get(self, table: dict, name: str, cls):
+        if not self.enabled:
+            return _NULL_METRIC
+        with self._lock:
+            m = table.get(name)
+            if m is None:
+                m = table[name] = cls(self._lock)
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(self._histograms, name, Histogram)
+
+    def snapshot(self) -> dict:
+        """JSON-able view of every metric, stable key order."""
+        with self._lock:
+            counters = {k: self._counters[k].value
+                        for k in sorted(self._counters)}
+            gauges = {k: json_safe(self._gauges[k].value)
+                      for k in sorted(self._gauges)}
+            hists = dict(sorted(self._histograms.items()))
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {k: h.summary() for k, h in hists.items()},
+        }
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+class _NullSpan:
+    """Shared no-op span for the disabled path (no allocation per call)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One wall-clock interval; emits a record to the hub's sinks on exit.
+
+    Timestamps are monotonic (``perf_counter``) relative to the hub's
+    epoch, so span math is immune to wall-clock steps; the hub's meta
+    record carries the wall-clock epoch for correlation.
+    """
+
+    __slots__ = ("_hub", "name", "attrs", "span_id", "parent_id", "t0",
+                 "_tid")
+
+    def __init__(self, hub: "Telemetry", name: str, attrs: dict):
+        self._hub = hub
+        self.name = name
+        self.attrs = attrs
+        self.span_id = None
+        self.parent_id = None
+        self.t0 = None
+        self._tid = None
+
+    def set(self, **attrs) -> "Span":
+        """Attach attributes mid-span (solver iteration counts, sizes)."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        hub = self._hub
+        stack = hub._span_stack()
+        self.parent_id = stack[-1].span_id if stack else None
+        self.span_id = next(hub._ids)
+        self._tid = threading.get_ident()
+        stack.append(self)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t1 = time.perf_counter()
+        hub = self._hub
+        stack = hub._span_stack()
+        # Defensive pop: a mismatched exit (caller error) must not corrupt
+        # sibling spans' parents for the rest of the run.
+        while stack and stack.pop() is not self:
+            pass
+        record = {
+            "type": "span",
+            "name": self.name,
+            "ts": self.t0 - hub._epoch_perf,
+            "dur": t1 - self.t0,
+            "id": self.span_id,
+            "parent": self.parent_id,
+            "tid": self._tid,
+        }
+        if exc_type is not None:
+            record["error"] = f"{exc_type.__name__}: {exc}"
+        if self.attrs:
+            record["attrs"] = {k: json_safe(v)
+                               for k, v in self.attrs.items()}
+        hub._emit(record)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# The hub
+# ---------------------------------------------------------------------------
+
+class Telemetry:
+    """Span + event + metrics hub feeding a list of sinks.
+
+    ``output_dir`` builds the standard sink set: ``events.jsonl``
+    (JSONL, source of truth), ``trace.json`` (Chrome trace-event array),
+    and — when ``logger`` is given — an end-of-run summary through it.
+    ``enabled=False`` (or an empty sink list) makes every span/event a
+    single-branch no-op; the metrics registry follows ``enabled``.
+
+    Use as a context manager to install as the process-current hub
+    (:func:`current`), restoring the previous one and closing sinks on
+    exit::
+
+        with Telemetry(output_dir=out, logger=logger) as tel:
+            with tel.span("run", driver="glm"):
+                ...
+    """
+
+    def __init__(
+        self,
+        output_dir: Optional[str] = None,
+        sinks=None,
+        logger=None,
+        enabled: bool = True,
+        run_name: str = "run",
+    ):
+        self.enabled = enabled
+        self.run_name = run_name
+        self.output_dir = output_dir
+        self._epoch_perf = time.perf_counter()
+        self._epoch_wall = time.time()
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        self._emit_lock = threading.Lock()
+        self._closed = False
+        self._restore_token = None
+        self.metrics = MetricsRegistry(enabled=enabled)
+        if sinks is None:
+            sinks = []
+            if enabled and output_dir is not None:
+                from photon_ml_tpu.telemetry.sinks import (
+                    ChromeTraceSink,
+                    JsonlSink,
+                    LoggerSummarySink,
+                )
+
+                os.makedirs(output_dir, exist_ok=True)
+                sinks.append(
+                    JsonlSink(os.path.join(output_dir, "events.jsonl"))
+                )
+                sinks.append(
+                    ChromeTraceSink(os.path.join(output_dir, "trace.json"))
+                )
+                if logger is not None:
+                    sinks.append(LoggerSummarySink(logger))
+        self._sinks = list(sinks)
+        if self.active:
+            self._emit({
+                "type": "meta",
+                "name": run_name,
+                "ts": 0.0,
+                "wall_epoch": self._epoch_wall,
+                "pid": os.getpid(),
+            })
+
+    # -- state ---------------------------------------------------------------
+    @property
+    def active(self) -> bool:
+        """True when events/spans actually reach a sink."""
+        return self.enabled and bool(self._sinks) and not self._closed
+
+    def _span_stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    # -- recording -----------------------------------------------------------
+    def span(self, name: str, **attrs):
+        """Context manager for a hierarchical wall-clock span."""
+        if not self.active:
+            return _NULL_SPAN
+        return Span(self, name, attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        """Instant (zero-duration) event under the current span."""
+        if not self.active:
+            return
+        stack = self._span_stack()
+        record = {
+            "type": "event",
+            "name": name,
+            "ts": time.perf_counter() - self._epoch_perf,
+            "parent": stack[-1].span_id if stack else None,
+            "tid": threading.get_ident(),
+        }
+        if attrs:
+            record["attrs"] = {k: json_safe(v) for k, v in attrs.items()}
+        self._emit(record)
+
+    def counter(self, name: str) -> Counter:
+        return self.metrics.counter(name)
+
+    def gauge(self, name: str) -> Gauge:
+        return self.metrics.gauge(name)
+
+    def histogram(self, name: str) -> Histogram:
+        return self.metrics.histogram(name)
+
+    def _emit(self, record: dict) -> None:
+        with self._emit_lock:
+            for sink in self._sinks:
+                try:
+                    sink.emit(record)
+                except Exception:
+                    # Observability must never sink the job it observes.
+                    pass
+
+    # -- snapshot / shutdown -------------------------------------------------
+    def snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    def write_snapshot(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the metrics snapshot JSON; defaults to
+        ``<output_dir>/metrics.json``.  Safe to call repeatedly (the
+        drivers write once at end of run)."""
+        if path is None:
+            if self.output_dir is None:
+                return None
+            path = os.path.join(self.output_dir, "metrics.json")
+        snap = self.snapshot()
+        snap["wall_epoch"] = self._epoch_wall
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f, indent=2)
+        os.replace(tmp, path)
+        return path
+
+    def close(self) -> None:
+        """Flush and close every sink (passing them the final metrics
+        snapshot) and write ``metrics.json``.  Idempotent."""
+        if self._closed:
+            return
+        snap = self.snapshot()
+        self._closed = True
+        for sink in self._sinks:
+            try:
+                sink.close(snap)
+            except Exception:
+                pass
+        if self.enabled and self.output_dir is not None:
+            try:
+                self.write_snapshot()
+            except OSError:
+                pass
+
+    # -- context manager: install as current ----------------------------------
+    def __enter__(self) -> "Telemetry":
+        self._restore_token = set_current(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        set_current(self._restore_token)
+        self._restore_token = None
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Process-current hub
+# ---------------------------------------------------------------------------
+
+#: Shared disabled hub: the default target for instrumented call sites, so
+#: library use without a driver costs one branch per event.
+NULL = Telemetry(enabled=False, sinks=[])
+
+_current: Telemetry = NULL
+_current_lock = threading.Lock()
+
+
+def current() -> Telemetry:
+    """The process-current telemetry hub (a disabled no-op by default)."""
+    return _current
+
+
+def set_current(hub: Optional[Telemetry]) -> Telemetry:
+    """Install ``hub`` (None → the disabled NULL hub) as process-current;
+    returns the previous hub so callers can restore it."""
+    global _current
+    with _current_lock:
+        prev = _current
+        _current = hub if hub is not None else NULL
+        return prev

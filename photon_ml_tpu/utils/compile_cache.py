@@ -19,7 +19,47 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from photon_ml_tpu import telemetry as telemetry_mod
+
 _DEFAULT_ENV = "PHOTON_COMPILE_CACHE"
+
+#: cache dir -> entry count at enable time (for end-of-run miss deltas).
+_ENABLE_COUNTS: dict[str, int] = {}
+
+
+def cache_entry_count(path: Optional[str]) -> Optional[int]:
+    """Number of persisted executables in the cache dir (None when the
+    dir is unreadable/absent).  JAX writes one flat file per program."""
+    if not path:
+        return None
+    try:
+        return sum(
+            1 for e in os.scandir(path) if e.is_file()
+        )
+    except OSError:
+        return None
+
+
+def publish_cache_metrics(path: Optional[str]) -> Optional[int]:
+    """End-of-run compile-cache attribution: entries now vs at enable
+    time.  New persisted entries are programs this run compiled (cache
+    MISSES at the >= min_compile_secs threshold); a run serving entirely
+    from cache adds zero.  Returns the delta (None when unknown)."""
+    tel = telemetry_mod.current()
+    n = cache_entry_count(path)
+    if n is None:
+        return None
+    start = _ENABLE_COUNTS.get(path)
+    delta = None if start is None else max(0, n - start)
+    if tel.enabled:
+        tel.gauge("compile_cache_entries").set(n)
+        if delta is not None:
+            tel.counter("compile_cache_new_entries").inc(delta)
+            tel.event(
+                "compile_cache.summary", dir=path, entries=n,
+                new_entries=delta,
+            )
+    return delta
 
 
 def add_compile_cache_arg(parser) -> None:
@@ -38,6 +78,13 @@ def enable_from_args(args, logger=None) -> Optional[str]:
     cache_dir = enable_compile_cache(args.compile_cache)
     if cache_dir and logger is not None:
         logger.info(f"compilation cache: {cache_dir}")
+    if cache_dir:
+        n = cache_entry_count(cache_dir)
+        if n is not None:
+            _ENABLE_COUNTS[cache_dir] = n
+            telemetry_mod.current().event(
+                "compile_cache.enabled", dir=cache_dir, entries=n
+            )
     return cache_dir
 
 

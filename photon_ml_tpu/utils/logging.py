@@ -8,18 +8,37 @@ driver's output dir.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import sys
 
+# Unique per-instance logger suffix.  ``id(self)`` (the previous scheme)
+# is only unique among LIVE objects — the allocator reuses addresses, so
+# a long process running many drivers could hand a new PhotonLogger a
+# dead instance's logging.Logger, inheriting its closed handlers.
+_INSTANCE_IDS = itertools.count()
+
 
 class PhotonLogger:
-    """Console + file logger; the file lives next to the job's outputs."""
+    """Console + file logger; the file lives next to the job's outputs.
+
+    Each instance registers a uniquely named stdlib logger and OWNS its
+    handlers; :meth:`close` detaches and closes them (and drops the
+    logger from the process registry), so repeated driver invocations in
+    one process — tests, hyperparameter search — don't leak file handles
+    or logger entries.  Usable as a context manager::
+
+        with PhotonLogger(output_dir) as logger:
+            logger.info("...")
+    """
 
     def __init__(self, output_dir: str | None = None, name: str = "photon_ml_tpu"):
-        self._logger = logging.getLogger(f"{name}.{id(self):x}")
+        self._name = f"{name}.{next(_INSTANCE_IDS)}"
+        self._logger = logging.getLogger(self._name)
         self._logger.setLevel(logging.INFO)
         self._logger.propagate = False
+        self._closed = False
         fmt = logging.Formatter(
             "%(asctime)s %(levelname)s %(message)s", "%Y-%m-%d %H:%M:%S"
         )
@@ -47,7 +66,28 @@ class PhotonLogger:
     def debug(self, msg: str, *args) -> None:
         self._logger.debug(msg, *args)
 
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
     def close(self) -> None:
+        """Detach + close every handler and unregister the logger.
+        Idempotent; a closed logger's methods are safe no-ops at the
+        stdlib level (no handlers, propagate off)."""
+        if self._closed:
+            return
+        self._closed = True
         for h in list(self._logger.handlers):
-            h.close()
             self._logger.removeHandler(h)
+            h.close()
+        self._file_handler = None
+        # Drop the entry from logging's process-global registry so the
+        # Manager dict doesn't grow one dead Logger per driver run.
+        registry = logging.Logger.manager.loggerDict
+        registry.pop(self._name, None)
+
+    def __enter__(self) -> "PhotonLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

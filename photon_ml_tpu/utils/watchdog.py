@@ -23,6 +23,8 @@ import dataclasses
 import time
 from typing import Callable, Optional, Sequence, TypeVar
 
+from photon_ml_tpu import telemetry as telemetry_mod
+
 T = TypeVar("T")
 
 # gRPC-ish status markers + transport phrases that indicate the RUN may
@@ -59,6 +61,39 @@ _TRANSIENT_TYPE_NAMES = ("XlaRuntimeError",)
 
 
 @dataclasses.dataclass(frozen=True)
+class Classification:
+    """Why an exception was (or wasn't) judged transient: the verdict plus
+    the pattern/type-name that decided it — what the watchdog logs and
+    emits as a telemetry event per attempt."""
+
+    transient: bool
+    #: the matched message pattern or type name, None when nothing matched
+    matched: Optional[str] = None
+    #: "non_transient_pattern" | "transient_pattern" | "type_name" | "none"
+    source: str = "none"
+
+
+@dataclasses.dataclass
+class RetryStats:
+    """Observable retry behavior of one :func:`run_with_retries` call.
+
+    Tests assert on this instead of timing sleeps; drivers surface it in
+    their result JSON.  ``failures`` holds one dict per caught exception
+    (attempt, exception type, message head, verdict, matched pattern,
+    backoff seconds — backoff is None when the failure propagated)."""
+
+    attempts: int = 0  # fn invocations started
+    retries: int = 0  # sleeps taken (= transient failures retried)
+    sleep_seconds: float = 0.0  # total backoff requested
+    succeeded: bool = False
+    gave_up: bool = False  # budget exhausted on a transient failure
+    failures: list = dataclasses.field(default_factory=list)
+
+    def snapshot(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """How a long run reacts to transient failures.
 
@@ -73,19 +108,26 @@ class RetryPolicy:
     max_backoff_seconds: float = 300.0
     extra_patterns: Sequence[str] = ()
 
-    def is_transient(self, exc: BaseException) -> bool:
+    def classify(self, exc: BaseException) -> Classification:
+        """Verdict + the pattern that decided it (see Classification)."""
         msg = str(exc).lower()
         # Deterministic-failure markers veto everything, including the
         # type-name fallback: an XlaRuntimeError carrying
         # RESOURCE_EXHAUSTED re-runs the same allocation and dies again.
-        if any(p.lower() in msg for p in _NON_TRANSIENT_PATTERNS):
-            return False
-        patterns = tuple(_TRANSIENT_PATTERNS) + tuple(
-            p.lower() for p in self.extra_patterns
-        )
-        if any(p.lower() in msg for p in patterns):
-            return True
-        return type(exc).__name__ in _TRANSIENT_TYPE_NAMES
+        for p in _NON_TRANSIENT_PATTERNS:
+            if p.lower() in msg:
+                return Classification(False, p, "non_transient_pattern")
+        patterns = tuple(_TRANSIENT_PATTERNS) + tuple(self.extra_patterns)
+        for p in patterns:
+            if p.lower() in msg:
+                return Classification(True, p, "transient_pattern")
+        name = type(exc).__name__
+        if name in _TRANSIENT_TYPE_NAMES:
+            return Classification(True, name, "type_name")
+        return Classification(False)
+
+    def is_transient(self, exc: BaseException) -> bool:
+        return self.classify(exc).transient
 
     def backoff(self, attempt: int) -> float:
         return min(
@@ -99,6 +141,7 @@ def run_with_retries(
     policy: RetryPolicy,
     logger=None,
     sleep: Callable[[float], None] = time.sleep,
+    stats: Optional[RetryStats] = None,
 ) -> T:
     """Run ``fn(attempt)`` until it returns, retrying transient failures.
 
@@ -107,15 +150,53 @@ def run_with_retries(
     instead of restart (the drivers' closures reload the grid / CD
     checkpointers).  Non-transient exceptions and exhausted budgets
     propagate unchanged.
+
+    ``stats`` (a RetryStats, mutated in place) records every attempt's
+    classification and backoff — tests assert on it instead of timing
+    sleeps.  Each classify/backoff/give-up decision is also emitted as a
+    ``watchdog.attempt`` telemetry event and counted on the
+    ``watchdog_retries`` metric.
     """
+    tel = telemetry_mod.current()
+    if stats is None:
+        stats = RetryStats()
     attempt = 0
     while True:
+        stats.attempts += 1
         try:
-            return fn(attempt)
+            result = fn(attempt)
         except Exception as exc:  # noqa: BLE001 — classified below
-            if attempt >= policy.max_retries or not policy.is_transient(exc):
+            verdict = policy.classify(exc)
+            retrying = verdict.transient and attempt < policy.max_retries
+            delay = policy.backoff(attempt) if retrying else None
+            stats.gave_up = verdict.transient and not retrying
+            stats.failures.append({
+                "attempt": attempt,
+                "exception": type(exc).__name__,
+                "message": str(exc)[:200],
+                "transient": verdict.transient,
+                "matched": verdict.matched,
+                "source": verdict.source,
+                "backoff_seconds": delay,
+            })
+            tel.event(
+                "watchdog.attempt",
+                attempt=attempt,
+                outcome=(
+                    "retry" if retrying
+                    else "gave_up" if verdict.transient
+                    else "non_transient"
+                ),
+                exception=type(exc).__name__,
+                matched=verdict.matched,
+                source=verdict.source,
+                backoff_seconds=delay,
+            )
+            if not retrying:
                 raise
-            delay = policy.backoff(attempt)
+            stats.retries += 1
+            stats.sleep_seconds += delay
+            tel.counter("watchdog_retries").inc()
             if logger is not None:
                 logger.warning(
                     "transient failure (attempt %d/%d), retrying in %.1fs: "
@@ -125,3 +206,11 @@ def run_with_retries(
                 )
             sleep(delay)
             attempt += 1
+        else:
+            stats.succeeded = True
+            if stats.retries or stats.failures:
+                tel.event(
+                    "watchdog.recovered",
+                    attempts=stats.attempts, retries=stats.retries,
+                )
+            return result

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Repo-local check: telemetry selfcheck + the tier-1 test suite.
+#
+#   scripts/check.sh            # selfcheck + full tier-1 (CPU backend)
+#   scripts/check.sh --fast     # selfcheck + the telemetry/watchdog tests
+#
+# The selfcheck (python -m photon_ml_tpu.telemetry --selfcheck) pushes a
+# synthetic span tree through every sink and validates events.jsonl /
+# trace.json / metrics.json; it is device-free and takes < 1 s, so run
+# it first — a broken sink should fail in seconds, not after the suite.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== telemetry selfcheck =="
+python -m photon_ml_tpu.telemetry --selfcheck
+
+echo "== tier-1 tests (JAX_PLATFORMS=cpu) =="
+if [[ "${1:-}" == "--fast" ]]; then
+  exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_telemetry.py tests/test_watchdog.py \
+    -q -p no:cacheprovider
+fi
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider \
+  -p no:xdist -p no:randomly

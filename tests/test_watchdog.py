@@ -13,7 +13,11 @@ import numpy as np
 import pytest
 import scipy.sparse as sp
 
-from photon_ml_tpu.utils.watchdog import RetryPolicy, run_with_retries
+from photon_ml_tpu.utils.watchdog import (
+    RetryPolicy,
+    RetryStats,
+    run_with_retries,
+)
 
 
 class _FakeLogger:
@@ -104,6 +108,118 @@ class TestRunWithRetries:
 
         with pytest.raises(RuntimeError):
             run_with_retries(fn, RetryPolicy(), sleep=lambda s: None)
+
+
+class TestClassification:
+    def test_classify_reports_matched_pattern(self):
+        p = RetryPolicy()
+        c = p.classify(RuntimeError("UNAVAILABLE: Socket closed"))
+        assert c.transient and c.matched == "UNAVAILABLE"
+        assert c.source == "transient_pattern"
+        c = p.classify(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+        assert not c.transient and c.matched == "RESOURCE_EXHAUSTED"
+        assert c.source == "non_transient_pattern"
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        c = p.classify(XlaRuntimeError("mystery"))
+        assert c.transient and c.matched == "XlaRuntimeError"
+        assert c.source == "type_name"
+        c = p.classify(ValueError("bad shape"))
+        assert not c.transient and c.matched is None and c.source == "none"
+
+
+class TestRetryStats:
+    def test_stats_record_each_attempt_without_sleeping(self):
+        """The retry-behavior assertion surface: verdicts, matched
+        patterns, and backoffs observable on RetryStats — no timing."""
+        slept = []
+
+        def fn(attempt):
+            if attempt < 2:
+                raise RuntimeError("UNAVAILABLE: transport lost")
+            return "ok"
+
+        stats = RetryStats()
+        out = run_with_retries(
+            fn, RetryPolicy(max_retries=3, backoff_seconds=2.0),
+            sleep=slept.append, stats=stats,
+        )
+        assert out == "ok"
+        assert stats.succeeded and not stats.gave_up
+        assert stats.attempts == 3 and stats.retries == 2
+        assert stats.sleep_seconds == pytest.approx(2.0 + 4.0)
+        assert [f["attempt"] for f in stats.failures] == [0, 1]
+        assert all(f["matched"] == "UNAVAILABLE" for f in stats.failures)
+        assert [f["backoff_seconds"] for f in stats.failures] == [2.0, 4.0]
+        # snapshot() is JSON-able driver-result material.
+        import json
+
+        json.dumps(stats.snapshot())
+
+    def test_stats_mark_gave_up_on_budget_exhaustion(self):
+        stats = RetryStats()
+
+        def fn(attempt):
+            raise RuntimeError("UNAVAILABLE: still down")
+
+        with pytest.raises(RuntimeError):
+            run_with_retries(
+                fn, RetryPolicy(max_retries=1, backoff_seconds=0),
+                sleep=lambda s: None, stats=stats,
+            )
+        assert stats.gave_up and not stats.succeeded
+        assert stats.attempts == 2 and stats.retries == 1
+        assert stats.failures[-1]["backoff_seconds"] is None
+
+    def test_stats_non_transient_single_failure(self):
+        stats = RetryStats()
+
+        def fn(attempt):
+            raise ValueError("broken")
+
+        with pytest.raises(ValueError):
+            run_with_retries(
+                fn, RetryPolicy(max_retries=5), sleep=lambda s: None,
+                stats=stats,
+            )
+        assert not stats.gave_up  # non-transient, not a budget give-up
+        assert stats.attempts == 1 and stats.retries == 0
+        assert stats.failures[0]["transient"] is False
+
+    def test_telemetry_events_per_attempt(self, tmp_path):
+        """Every classify/backoff decision is emitted as a
+        watchdog.attempt event; retries increment the counter."""
+        import json
+
+        from photon_ml_tpu import telemetry
+
+        def fn(attempt):
+            if attempt == 0:
+                raise RuntimeError("DEADLINE_EXCEEDED: slow transport")
+            return 42
+
+        with telemetry.Telemetry(output_dir=str(tmp_path)) as tel:
+            out = run_with_retries(
+                fn, RetryPolicy(max_retries=2, backoff_seconds=0.5),
+                sleep=lambda s: None,
+            )
+            snap = tel.snapshot()
+        assert out == 42
+        assert snap["counters"]["watchdog_retries"] == 1
+        with open(tmp_path / "events.jsonl") as f:
+            records = [json.loads(line) for line in f]
+        attempts = [
+            r for r in records
+            if r.get("type") == "event" and r["name"] == "watchdog.attempt"
+        ]
+        assert len(attempts) == 1
+        a = attempts[0]["attrs"]
+        assert a["outcome"] == "retry"
+        assert a["matched"] == "DEADLINE_EXCEEDED"
+        assert a["backoff_seconds"] == 0.5
+        assert any(
+            r.get("type") == "event" and r["name"] == "watchdog.recovered"
+            for r in records
+        )
 
 
 class TestGlmDriverRecovery:
