@@ -34,7 +34,7 @@ the primary metric in the required fields, the other metrics under "extra"
 with their own vs_baseline ratios.
 
 Env knobs: BENCH_SMALL=1 shrinks every workload (CI/smoke); BENCH_ONLY=
-glm|game|driver|stream runs a single section.
+glm|game|driver|stream|serving runs a single section.
 """
 
 import json
@@ -731,6 +731,73 @@ def bench_avro_write() -> dict:
     return out
 
 
+def bench_serving() -> dict:
+    """Online serving (PR 3): closed-loop throughput + latency of the
+    micro-batched scoring service on a synthetic GAME model with ≥10k
+    random-effect entities (zipf-skewed request stream, so the LRU hot
+    set sees realistic hits over a cold tail).  In-process submits — no
+    HTTP framing — so the number is the batcher+kernel path itself."""
+    from photon_ml_tpu.serving import loadgen
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService
+    from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+    n_entities = 10_000 if SMALL else 50_000
+    duration = 2.0 if SMALL else 6.0
+    clients = 16
+    _log(f"serving: building synthetic GAME model "
+         f"({n_entities} entities)...")
+    workload = SyntheticWorkload(
+        n_entities=n_entities, fixed_dim=64, re_dim=8, seed=9
+    )
+    runtime = ScoringRuntime(
+        workload.model, workload.index_maps,
+        RuntimeConfig(max_batch_size=64, hot_entities=4096),
+    )
+    _log(f"serving: warmed {runtime.warmup_compiles} bucket kernels "
+         f"{runtime.buckets}; loading...")
+    service = ScoringService(runtime, BatcherConfig(
+        max_batch_size=64, max_wait_us=1000, max_queue=1024,
+    ))
+    with service:
+        # Short warm run: first-touch allocator/pipeline costs and the
+        # initial hot-set fill stay out of the timed window.
+        loadgen.closed_loop(
+            service.submit, workload.request, clients=4, duration_s=0.5
+        )
+        report = loadgen.closed_loop(
+            service.submit, workload.request,
+            clients=clients, duration_s=duration,
+        )
+    snap = report.snapshot()
+    stats = runtime.stats()
+    hot = stats["hot_sets"]["per_entity"]
+    mean_batch = (
+        stats["rows_scored"] / stats["batches"] if stats["batches"] else None
+    )
+    _log(f"serving: {snap['throughput_rps']} rps over {clients} closed-"
+         f"loop clients, p50 {snap['latency_p50_ms']} ms / p99 "
+         f"{snap['latency_p99_ms']} ms, mean batch "
+         f"{mean_batch and round(mean_batch, 1)} rows, hot hit rate "
+         f"{hot['hit_rate'] and round(hot['hit_rate'], 3)}")
+    return {
+        "serving_throughput_rps": snap["throughput_rps"],
+        "serving_latency_p50_ms": snap["latency_p50_ms"],
+        "serving_latency_p99_ms": snap["latency_p99_ms"],
+        "serving_completed": report.completed,
+        "serving_rejected": report.rejected,
+        "serving_clients": clients,
+        "serving_entities": n_entities,
+        "serving_mean_batch_rows": (
+            None if mean_batch is None else round(mean_batch, 2)
+        ),
+        "serving_hot_hit_rate": (
+            None if hot["hit_rate"] is None else round(hot["hit_rate"], 4)
+        ),
+    }
+
+
 def main() -> None:
     # Sink-less but ENABLED telemetry hub: the streamed/ooc sections'
     # prefetch pipelines feed their TransferStats into its registry
@@ -826,6 +893,11 @@ def main() -> None:
             extra.update(bench_avro_write())
         except Exception as e:  # new section: never sink the headline
             extra["avro_write_native_recs_per_sec"] = f"failed: {e}"
+    if ONLY in ("", "serving"):
+        try:
+            extra.update(bench_serving())
+        except Exception as e:  # new section: never sink the headline
+            extra["serving_throughput_rps"] = f"failed: {e}"
     out = {
         "metric": "logistic_glm_rows_per_sec",
         "unit": "rows/s",

@@ -5,6 +5,13 @@ load a saved GameModel, read GAME Avro data through the SAVED index maps
 (unseen features drop, as the reference's scoring path does), score (fixed
 effect matvec + per-entity random-effect gathers, summed with offsets), and
 write ``ScoringResultAvro`` records.
+
+The scoring math is the serving subsystem's (``serving/kernels.py``, via
+``GameTransformer``): batch jobs here and the online request path
+(``python -m photon_ml_tpu.serving``) share ONE implementation of the
+fixed-effect matvec + random-effect gather + offset sum, so a model
+validated offline scores identically when deployed behind the
+micro-batched HTTP endpoint (docs/serving.md).
 """
 
 from __future__ import annotations

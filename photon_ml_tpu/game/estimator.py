@@ -1001,6 +1001,11 @@ class GameTransformer:
     (SURVEY.md §3.3): fixed effect = one matvec; each random effect = block
     gather of per-entity coefficients; total = sum + offset.
 
+    The scoring math itself lives in ``serving/kernels.py`` — ONE
+    implementation shared with the online serving runtime, so batch jobs
+    (``game_scoring_driver``) and the request path score through the same
+    fixed-effect matvec + random-effect gather + offset sum.
+
     Scoring is pure host compute (scipy matvec + packed-table gathers):
     uploading scoring shards to the accelerator just to pull scores back
     would waste PCIe/HBM.  Repeated calls on the SAME (shards, ids) objects
@@ -1086,34 +1091,30 @@ class GameTransformer:
                 f"the shards have {n}; prepare() must be called on the same "
                 "data being transformed"
             )
-        total = (
-            np.zeros(n, np.float32) if offset is None else np.asarray(offset, np.float32).copy()
-        )
+        from photon_ml_tpu.serving import kernels as serving_kernels
+
+        parts = []
         for name, sub in self.model.models.items():
             if isinstance(sub, FixedEffectModel):
-                w = np.asarray(sub.model.coefficients.means, np.float32)
-                total += np.asarray(
-                    shards[sub.feature_shard] @ w, np.float32
-                ).ravel()
+                parts.append(serving_kernels.fixed_effect_matvec(
+                    shards[sub.feature_shard], sub.model.coefficients.means
+                ))
             else:
                 if prepared is None:
                     prepared = self._prepared_for(shards, ids)
-                total += self._score_random_effect(
+                parts.append(serving_kernels.random_effect_block_scores(
                     sub, prepared.re_datasets[name]
-                )
-        return total
+                ))
+        return serving_kernels.sum_margins(n, offset, parts)
 
     @staticmethod
     def _score_random_effect(model: RandomEffectModel, dataset) -> np.ndarray:
-        """Score a pre-grouped dataset through the block pipeline; entities
-        without trained coefficients (and padding) contribute zero."""
-        n = dataset.n_global_rows
-        out = np.zeros(n + 1, np.float32)
-        for block, block_ids in zip(dataset.blocks, dataset.entity_ids):
-            coefs = model.coefficient_matrix_for(block.col_map, block_ids)
-            scores = np.einsum("erd,ed->er", block.X, coefs)
-            np.add.at(out, block.row_index.ravel(), scores.ravel())
-        return out[:n]
+        """Back-compat shim; the implementation moved to
+        ``serving.kernels.random_effect_block_scores`` (shared with the
+        online runtime)."""
+        from photon_ml_tpu.serving import kernels as serving_kernels
+
+        return serving_kernels.random_effect_block_scores(model, dataset)
 
     def transform_with_mean(self, shards, ids, offset=None) -> np.ndarray:
         """Scores passed through the task's inverse link (probabilities for

@@ -11,8 +11,10 @@ manifest for coordinate order/types.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import struct
 
 import numpy as np
 
@@ -48,17 +50,40 @@ RANDOM_EFFECT_MODEL_SCHEMA = {
 }
 
 
+def random_effect_checksum(records) -> str:
+    """sha256 over per-entity (entityId, name, term, value, variance)
+    entries in file order — both save and load feed the raw Avro records,
+    so the checksum binds to the persisted content."""
+    h = hashlib.sha256()
+    for rec in records:
+        h.update(b"\x00ENTITY\x00")
+        h.update(str(rec["entityId"]).encode())
+        for e in rec["coefficients"]:
+            h.update(str(e["name"]).encode())
+            h.update(b"\x00")
+            h.update(str(e["term"]).encode())
+            h.update(struct.pack("<d", float(e["value"])))
+            var = e.get("variance")
+            h.update(b"\x01" if var is None else struct.pack("<d", float(var)))
+    return h.hexdigest()
+
+
 def save_game_model(
     model: GameModel, index_maps: dict, directory: str
 ) -> None:
-    """``index_maps`` maps feature-shard name → IndexMap."""
+    """``index_maps`` maps feature-shard name → IndexMap.
+
+    ``metadata.json`` carries a per-coordinate fingerprint (feature
+    count, task, coefficient checksum) that :func:`load_game_model`
+    verifies; non-finite coefficients are rejected here instead of being
+    silently persisted."""
     os.makedirs(directory, exist_ok=True)
-    manifest = {"task": model.task, "coordinates": []}
+    manifest = {"task": model.task, "coordinates": [], "fingerprints": {}}
     for name, sub in model.models.items():
         if isinstance(sub, FixedEffectModel):
             sub_dir = os.path.join(directory, "fixed-effect", name)
             os.makedirs(sub_dir, exist_ok=True)
-            save_glm_model(
+            manifest["fingerprints"][name] = save_glm_model(
                 sub.model,
                 index_maps[sub.feature_shard],
                 os.path.join(sub_dir, "coefficients.avro"),
@@ -78,6 +103,16 @@ def save_game_model(
                     if sub.variances is not None
                     else None
                 )
+                if not np.all(np.isfinite(vals)) or (
+                    variances is not None
+                    and not np.all(np.isfinite(variances))
+                ):
+                    raise ValueError(
+                        f"refusing to save coordinate {name!r}: entity "
+                        f"{entity!r} carries non-finite coefficients — a "
+                        "model with NaN/inf coefficients scores NaN; fix "
+                        "the training run instead of persisting it"
+                    )
                 coefs = []
                 for j, (c, v) in enumerate(zip(cols, vals)):
                     fname, _, term = imap.index_to_name(int(c)).partition("\x01")
@@ -96,6 +131,13 @@ def save_game_model(
                 RANDOM_EFFECT_MODEL_SCHEMA,
                 records,
             )
+            manifest["fingerprints"][name] = {
+                "version": 1,
+                "task": model.task,
+                "feature_count": sub.n_features,
+                "n_entities": len(records),
+                "coefficient_checksum": random_effect_checksum(records),
+            }
             manifest["coordinates"].append({
                 "name": name,
                 "type": "random",
@@ -110,9 +152,14 @@ def save_game_model(
 
 
 def load_game_model(directory: str) -> tuple[GameModel, dict]:
-    """Returns (model, index_maps-by-shard)."""
+    """Returns (model, index_maps-by-shard).
+
+    Models saved with manifest fingerprints are verified per coordinate
+    (random-effect checksums here, fixed-effect sidecars inside
+    ``load_glm_model``); pre-fingerprint directories load unverified."""
     with open(os.path.join(directory, "metadata.json")) as f:
         manifest = json.load(f)
+    fingerprints = manifest.get("fingerprints") or {}
     index_maps: dict = {}
     imap_root = os.path.join(directory, "index-maps")
     if os.path.isdir(imap_root):
@@ -134,6 +181,23 @@ def load_game_model(directory: str) -> tuple[GameModel, dict]:
                 directory, "random-effect", name, "coefficients.avro"
             )
             _, records = avro.read_container(path)
+            fp = fingerprints.get(name)
+            if fp:
+                actual = random_effect_checksum(records)
+                if actual != fp.get("coefficient_checksum"):
+                    raise ValueError(
+                        f"{path}: coefficient checksum mismatch (file "
+                        f"{actual[:16]}…, fingerprint "
+                        f"{str(fp.get('coefficient_checksum'))[:16]}…) — "
+                        "the coefficient file was modified/truncated "
+                        "after save"
+                    )
+                if fp.get("n_entities") is not None and len(records) != \
+                        fp["n_entities"]:
+                    raise ValueError(
+                        f"{path}: {len(records)} entities on disk, "
+                        f"fingerprint says {fp['n_entities']}"
+                    )
             imap = index_maps[coord["feature_shard"]]
             table = {}
             var_table: dict = {}

@@ -10,7 +10,10 @@ files do the same); loading uses an index map to place named coefficients.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import struct
 from typing import Optional
 
 import jax.numpy as jnp
@@ -35,20 +38,82 @@ def _split_key(key: str) -> tuple[str, str]:
     return name, term if sep else ""
 
 
+# ---------------------------------------------------------------------------
+# Fingerprints: a save-time identity the load path verifies, so a
+# truncated / hand-edited / wrong-version coefficient file fails LOUDLY at
+# load instead of silently serving garbage scores.
+# ---------------------------------------------------------------------------
+
+def coefficient_checksum(entry_lists) -> str:
+    """sha256 over (name, term, value) coefficient entries, in file order.
+
+    ``entry_lists`` is a sequence of entry lists (means, then variances
+    when present, separated by a marker) — both save and load feed the
+    RAW record entries, so the checksum binds to the Avro content
+    regardless of which index map later places the coefficients.  Values
+    hash as their exact float64 bit pattern (Avro stores doubles)."""
+    h = hashlib.sha256()
+    for entries in entry_lists:
+        h.update(b"\x00SECTION\x00")
+        if entries is None:
+            continue
+        for e in entries:
+            h.update(str(e["name"]).encode())
+            h.update(b"\x00")
+            h.update(str(e["term"]).encode())
+            h.update(struct.pack("<d", float(e["value"])))
+    return h.hexdigest()
+
+
+def glm_fingerprint(task: str, feature_count: int, record: dict) -> dict:
+    return {
+        "version": 1,
+        "task": task,
+        "feature_count": int(feature_count),
+        "n_coefficients": len(record["means"]),
+        "coefficient_checksum": coefficient_checksum(
+            [record["means"], record["variances"]]
+        ),
+    }
+
+
+def _reject_nonfinite(vec: Optional[np.ndarray], what: str, path: str):
+    """NaN/inf coefficients persist silently in Avro and then poison every
+    score downstream; refuse at save time with a pointed error."""
+    if vec is None:
+        return
+    bad = ~np.isfinite(vec)
+    if bad.any():
+        idx = np.flatnonzero(bad)
+        raise ValueError(
+            f"refusing to save {path}: {idx.size} non-finite {what} "
+            f"value(s) (first at index {int(idx[0])}: {vec[idx[0]]!r}); "
+            "a model with NaN/inf coefficients scores NaN — fix the "
+            "training run (check for exploding optimizer steps or bad "
+            "regularization) instead of persisting it"
+        )
+
+
 def save_glm_model(
     model: GeneralizedLinearModel,
     index_map: IndexMap,
     path: str,
     model_id: str = "",
     sparsify: bool = True,
-) -> None:
-    """Write a model as an Avro container file (.avro)."""
+) -> dict:
+    """Write a model as an Avro container file (.avro) plus a
+    ``<path>.meta.json`` sidecar carrying the model fingerprint (feature
+    count, task, coefficient checksum) that :func:`load_glm_model`
+    verifies.  Returns the fingerprint.  Non-finite coefficients are
+    rejected here rather than silently persisted."""
     means = np.asarray(model.coefficients.means, np.float64)
     variances = (
         None
         if model.coefficients.variances is None
         else np.asarray(model.coefficients.variances, np.float64)
     )
+    _reject_nonfinite(means, "coefficient", path)
+    _reject_nonfinite(variances, "variance", path)
 
     def entries(vec):
         out = []
@@ -67,6 +132,50 @@ def save_glm_model(
         "variances": None if variances is None else entries(variances),
     }
     avro.write_container(path, BAYESIAN_LINEAR_MODEL, [record])
+    fingerprint = glm_fingerprint(model.task, len(index_map), record)
+    with open(path + ".meta.json", "w") as f:
+        json.dump({"fingerprint": fingerprint}, f, indent=2)
+    return fingerprint
+
+
+def verify_glm_fingerprint(
+    path: str, task: str, record: dict, index_map: Optional[IndexMap]
+) -> Optional[dict]:
+    """Check file content against the save-time fingerprint sidecar (a
+    no-op when no sidecar exists).  Returns the fingerprint when one was
+    verified."""
+    meta_path = path + ".meta.json"
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        fingerprint = json.load(f).get("fingerprint")
+    if not fingerprint:
+        return None
+    actual = coefficient_checksum([record["means"], record["variances"]])
+    if actual != fingerprint.get("coefficient_checksum"):
+        raise ValueError(
+            f"{path}: coefficient checksum mismatch (file {actual[:16]}…, "
+            f"fingerprint {str(fingerprint.get('coefficient_checksum'))[:16]}…) "
+            "— the model file was modified/truncated after save, or the "
+            "sidecar belongs to a different save"
+        )
+    if fingerprint.get("task") != task:
+        raise ValueError(
+            f"{path}: task mismatch — file says {task!r}, fingerprint "
+            f"says {fingerprint.get('task')!r}"
+        )
+    if (
+        index_map is not None
+        and fingerprint.get("feature_count") is not None
+        and len(index_map) != fingerprint["feature_count"]
+    ):
+        raise ValueError(
+            f"{path}: model was saved with "
+            f"{fingerprint['feature_count']} features but the provided "
+            f"index map has {len(index_map)}; read the data with the "
+            "model's saved index maps"
+        )
+    return fingerprint
 
 
 def load_glm_model(
@@ -75,12 +184,19 @@ def load_glm_model(
     """Read a model written by :func:`save_glm_model`.
 
     Without an index map, one is reconstructed from the coefficient names in
-    file order (sufficient for scoring data indexed with the same map)."""
+    file order (sufficient for scoring data indexed with the same map).
+
+    When the save-time ``<path>.meta.json`` fingerprint sidecar is
+    present (absent on pre-fingerprint files: those load unverified), the
+    file content is verified against it — coefficient checksum, task,
+    and, when ``index_map`` is given, feature count — and a mismatch
+    raises instead of returning a silently-wrong model."""
     _, records = avro.read_container(path)
     if len(records) != 1:
         raise ValueError(f"{path}: expected 1 model record, found {len(records)}")
     rec = records[0]
     task = _CLASS_TO_TASK.get(rec["modelClass"], rec["lossFunction"])
+    verify_glm_fingerprint(path, task, rec, index_map)
 
     keys = [feature_key(e["name"], e["term"]) for e in rec["means"]]
     if index_map is None:

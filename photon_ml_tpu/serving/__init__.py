@@ -1,0 +1,61 @@
+"""Online serving: micro-batched low-latency GLM / GAME scoring.
+
+The reference deploys GAME models behind LinkedIn's online scorers (the
+per-entity random-effect story of SURVEY.md §0 only pays off when a
+request for user *u* can fetch w_u in microseconds); this package is the
+TPU-native analogue of that request path over the batch stack:
+
+- :mod:`~photon_ml_tpu.serving.kernels` — the ONE implementation of
+  fixed-effect matvec + random-effect gather + offset sum, shared by
+  batch scoring (``GameTransformer`` / ``game_scoring_driver``) and the
+  online runtime.
+- :mod:`~photon_ml_tpu.serving.runtime` — ``ScoringRuntime``: pre-compiled
+  jit kernels at a ladder of padded batch-size buckets, a per-entity
+  coefficient table with an LRU hot set resident on device, host-side
+  fallback gathers for the cold tail.
+- :mod:`~photon_ml_tpu.serving.batcher` — ``MicroBatcher``: bounded-queue
+  request coalescing under ``max_batch_size`` / ``max_wait_us``, padding
+  to the nearest bucket, per-request futures, admission control and
+  deadline timeouts classified through ``utils/watchdog``.
+- :mod:`~photon_ml_tpu.serving.service` — ``ScoringService`` (in-process
+  API) and a stdlib ``ThreadingHTTPServer`` JSON endpoint
+  (``/score``, ``/healthz``, ``/stats``).
+- :mod:`~photon_ml_tpu.serving.loadgen` — closed/open-loop load
+  generators (used by ``--loadgen`` and ``bench.py bench_serving``).
+
+``python -m photon_ml_tpu.serving --selfcheck`` builds a synthetic GAME
+model, serves concurrent HTTP requests, and verifies batched results are
+bit-identical to single-request scoring.  See docs/serving.md.
+
+Imports here are lazy: ``game.estimator`` imports ``serving.kernels``
+(the shared scoring math), so the package root must not import modules
+that import the estimator back.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "ScoringRuntime": ("photon_ml_tpu.serving.runtime", "ScoringRuntime"),
+    "RuntimeConfig": ("photon_ml_tpu.serving.runtime", "RuntimeConfig"),
+    "MicroBatcher": ("photon_ml_tpu.serving.batcher", "MicroBatcher"),
+    "BatcherConfig": ("photon_ml_tpu.serving.batcher", "BatcherConfig"),
+    "RejectedError": ("photon_ml_tpu.serving.batcher", "RejectedError"),
+    "DeadlineExceededError": (
+        "photon_ml_tpu.serving.batcher", "DeadlineExceededError",
+    ),
+    "ScoringService": ("photon_ml_tpu.serving.service", "ScoringService"),
+    "start_http_server": (
+        "photon_ml_tpu.serving.service", "start_http_server",
+    ),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(entry[0]), entry[1])

@@ -1,0 +1,345 @@
+"""Serving CLI: selfcheck, HTTP server, and the built-in load generator.
+
+Selfcheck (device-free beyond the CPU backend, CI-greppable)::
+
+    python -m photon_ml_tpu.serving --selfcheck
+
+builds a synthetic GAME model, warms the bucket ladder, serves CONCURRENT
+requests through the real HTTP endpoint, and verifies:
+
+- every batched score is BIT-IDENTICAL to single-request scoring
+  (the padded-bucket kernel's parity contract);
+- the telemetry snapshot carries request-latency histograms and a
+  nonzero batch-occupancy gauge;
+- /healthz and /stats answer.
+
+Serve a saved model::
+
+    python -m photon_ml_tpu.serving --model-dir /tmp/game_out --port 8080
+
+Load-generate against an in-process service (no HTTP overhead)::
+
+    python -m photon_ml_tpu.serving --synthetic 50000 \
+        --loadgen closed --clients 16 --duration 5
+    python -m photon_ml_tpu.serving --synthetic 50000 \
+        --loadgen open --rate 500 --duration 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import urllib.request
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m photon_ml_tpu.serving",
+        description="online GAME/GLM scoring service",
+    )
+    p.add_argument("--selfcheck", action="store_true")
+    p.add_argument(
+        "--model-dir",
+        help="saved GAME model directory (or a GLM .avro file)",
+    )
+    p.add_argument(
+        "--synthetic", type=int, metavar="N_ENTITIES", default=0,
+        help="serve a synthetic GAME model with this many random-effect "
+        "entities instead of --model-dir",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--max-batch-size", type=int, default=64)
+    p.add_argument(
+        "--max-wait-us", type=int, default=2000,
+        help="how long the dispatcher holds the first request open for "
+        "coalescing (docs/serving.md has the tuning guide)",
+    )
+    p.add_argument("--max-queue", type=int, default=256)
+    p.add_argument(
+        "--hot-entities", type=int, default=1024,
+        help="per-coordinate LRU hot-set capacity (device-resident rows)",
+    )
+    p.add_argument(
+        "--timeout-ms", type=float, default=None,
+        help="default per-request deadline (None = no deadline)",
+    )
+    p.add_argument(
+        "--loadgen", choices=["closed", "open"],
+        help="run the built-in load generator against the service, print "
+        "a JSON report, and exit",
+    )
+    p.add_argument("--clients", type=int, default=8, help="closed-loop")
+    p.add_argument("--rate", type=float, default=200.0, help="open-loop rps")
+    p.add_argument("--duration", type=float, default=5.0, help="seconds")
+    p.add_argument(
+        "--output-dir",
+        help="telemetry output dir (selfcheck defaults to a tempdir)",
+    )
+    p.add_argument("--telemetry", choices=["on", "off"], default="on")
+    return p
+
+
+def _make_service(args):
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService
+
+    rt_cfg = RuntimeConfig(
+        max_batch_size=args.max_batch_size, hot_entities=args.hot_entities
+    )
+    if args.synthetic:
+        from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+        workload = SyntheticWorkload(n_entities=args.synthetic)
+        runtime = ScoringRuntime(
+            workload.model, workload.index_maps, rt_cfg
+        )
+    elif args.model_dir:
+        workload = None
+        runtime = ScoringRuntime.load(args.model_dir, rt_cfg)
+    else:
+        raise SystemExit(
+            "one of --selfcheck / --model-dir / --synthetic is required"
+        )
+    service = ScoringService(runtime, BatcherConfig(
+        max_batch_size=args.max_batch_size,
+        max_wait_us=args.max_wait_us,
+        max_queue=args.max_queue,
+        default_timeout_ms=args.timeout_ms,
+    ))
+    return service, workload
+
+
+# ---------------------------------------------------------------------------
+# Selfcheck
+# ---------------------------------------------------------------------------
+
+def run_selfcheck(out_dir: str) -> list[str]:
+    """Returns failure strings (empty = pass)."""
+    import numpy as np
+
+    from photon_ml_tpu import telemetry as telemetry_mod
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService, start_http_server
+    from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+    failures: list[str] = []
+    n_requests = 24
+    with telemetry_mod.Telemetry(
+        output_dir=out_dir, run_name="serving-selfcheck"
+    ) as tel:
+        with tel.span("selfcheck", subsystem="serving"):
+            # Small hot set (< entities) so BOTH the device hot-table path
+            # and the host cold-gather path serve real traffic.
+            workload = SyntheticWorkload(n_entities=64, seed=3)
+            runtime = ScoringRuntime(
+                workload.model, workload.index_maps,
+                RuntimeConfig(max_batch_size=8, hot_entities=16),
+            )
+            requests = [workload.request(i) for i in range(n_requests)]
+            rows = [runtime.parse_request(r) for r in requests]
+
+            # Single-request reference: every row alone through bucket 1.
+            reference = np.asarray(
+                [runtime.score_rows([row])[0][0] for row in rows],
+                np.float32,
+            )
+
+            service = ScoringService(runtime, BatcherConfig(
+                max_batch_size=8, max_wait_us=20_000, max_queue=64,
+            ))
+            with service:
+                server, _ = start_http_server(service, port=0)
+                port = server.server_address[1]
+                try:
+                    # Concurrent clients through the REAL HTTP endpoint,
+                    # 6 rows per POST, 4 posts in flight.
+                    got: dict[int, list] = {}
+                    errs: list[str] = []
+
+                    def client(t: int) -> None:
+                        chunk = requests[t * 6:(t + 1) * 6]
+                        body = json.dumps({"rows": chunk}).encode()
+                        req = urllib.request.Request(
+                            f"http://127.0.0.1:{port}/score",
+                            data=body,
+                            headers={"Content-Type": "application/json"},
+                        )
+                        try:
+                            with urllib.request.urlopen(
+                                req, timeout=30
+                            ) as resp:
+                                got[t] = json.loads(resp.read())["results"]
+                        except Exception as exc:  # noqa: BLE001
+                            errs.append(f"client {t}: {exc}")
+
+                    threads = [
+                        threading.Thread(target=client, args=(t,))
+                        for t in range(4)
+                    ]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    failures.extend(errs)
+
+                    served = np.zeros(n_requests, np.float32)
+                    for t, results in got.items():
+                        for j, r in enumerate(results):
+                            if "error" in r:
+                                failures.append(
+                                    f"row {t * 6 + j} failed: {r}"
+                                )
+                            else:
+                                served[t * 6 + j] = np.float32(r["score"])
+                    if not failures and served.tobytes() != \
+                            reference.tobytes():
+                        bad = int(np.argmax(served != reference))
+                        failures.append(
+                            "batched scores are NOT bit-identical to "
+                            f"single-request scoring (first diff row "
+                            f"{bad}: {served[bad]!r} vs "
+                            f"{reference[bad]!r})"
+                        )
+
+                    # /healthz and /stats answer.
+                    for route in ("/healthz", "/stats"):
+                        with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}{route}", timeout=10
+                        ) as resp:
+                            if resp.status != 200:
+                                failures.append(
+                                    f"{route} -> HTTP {resp.status}"
+                                )
+                            json.loads(resp.read())
+                finally:
+                    server.shutdown()
+                    server.server_close()
+
+        snap = tel.snapshot()
+    # Snapshot content: request-latency histogram + nonzero occupancy.
+    hist = snap["histograms"].get("serving_request_latency_seconds", {})
+    if not hist.get("count"):
+        failures.append(
+            "metrics snapshot has no serving_request_latency_seconds "
+            "histogram observations"
+        )
+    occupancy = snap["gauges"].get("serving_batch_occupancy")
+    if not occupancy:
+        failures.append(
+            f"serving_batch_occupancy gauge is {occupancy!r}, expected "
+            "nonzero"
+        )
+    metrics_path = os.path.join(out_dir, "metrics.json")
+    if not os.path.exists(metrics_path):
+        failures.append(f"missing {metrics_path}")
+    else:
+        with open(metrics_path) as f:
+            on_disk = json.load(f)
+        if "serving_request_latency_seconds" not in on_disk.get(
+            "histograms", {}
+        ):
+            failures.append(
+                "metrics.json lacks the request-latency histogram"
+            )
+    if not failures:
+        hot = runtime.stats()["hot_sets"]["per_entity"]
+        print(
+            f"serving selfcheck: {n_requests} rows bit-identical over "
+            f"{runtime.batches - n_requests} coalesced batches "
+            f"(buckets {runtime.buckets}, hot hits {hot['hits']}, cold "
+            f"misses {hot['misses']}, mean latency "
+            f"{1e3 * hist['sum'] / hist['count']:.2f} ms), "
+            f"occupancy gauge {occupancy:.3f}"
+        )
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+
+    if args.selfcheck:
+        if args.output_dir:
+            os.makedirs(args.output_dir, exist_ok=True)
+            failures = run_selfcheck(args.output_dir)
+        else:
+            with tempfile.TemporaryDirectory(
+                prefix="photon_serving_selfcheck_"
+            ) as td:
+                failures = run_selfcheck(td)
+        if failures:
+            print("serving selfcheck FAILED:", file=sys.stderr)
+            for f in failures:
+                print(f"  - {f}", file=sys.stderr)
+            return 1
+        print("serving selfcheck PASSED")
+        return 0
+
+    from photon_ml_tpu import telemetry as telemetry_mod
+
+    tel = telemetry_mod.Telemetry(
+        output_dir=args.output_dir,
+        enabled=args.telemetry != "off",
+        run_name="serving",
+        sinks=None if args.output_dir else [],
+    )
+    with tel:
+        service, workload = _make_service(args)
+        if args.loadgen:
+            from photon_ml_tpu.serving import loadgen
+
+            if workload is None:
+                from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+                workload = SyntheticWorkload(n_entities=10_000)
+            with service:
+                if args.loadgen == "closed":
+                    report = loadgen.closed_loop(
+                        service.submit, workload.request,
+                        clients=args.clients, duration_s=args.duration,
+                    )
+                else:
+                    report = loadgen.open_loop(
+                        service.submit, workload.request,
+                        rate_rps=args.rate, duration_s=args.duration,
+                    )
+            print(json.dumps({
+                "loadgen": report.snapshot(),
+                "stats": service.stats(),
+            }, indent=2))
+            return 0
+
+        from photon_ml_tpu.serving.service import start_http_server
+
+        with service:
+            server, thread = start_http_server(
+                service, host=args.host, port=args.port
+            )
+            host, port = server.server_address[:2]
+            print(
+                f"serving on http://{host}:{port} "
+                f"(/score /healthz /stats); Ctrl-C to stop",
+                flush=True,
+            )
+            try:
+                thread.join()
+            except KeyboardInterrupt:
+                print("shutting down")
+            finally:
+                server.shutdown()
+                server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

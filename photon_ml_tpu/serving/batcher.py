@@ -1,0 +1,274 @@
+"""Micro-batching request coalescer for the online scoring path.
+
+One dispatch thread drains a bounded queue: it blocks for the first
+pending request, then coalesces more until ``max_batch_size`` rows are in
+hand or ``max_wait_us`` has elapsed, pads to the runtime's nearest bucket,
+dispatches ONE kernel call, and scatters results back to per-request
+futures.  The shape follows the batching/caching discipline of
+hierarchical ML runtimes (Snap ML, arXiv:1803.06333) and the
+pipeline-overlap serving designs of arXiv:1702.07005: fixed-shape
+pre-compiled kernels + request coalescing turn many tiny latency-bound
+calls into few device-efficient ones.
+
+Failure semantics ride :mod:`photon_ml_tpu.utils.watchdog`'s
+classification vocabulary so clients can reuse its retry discipline:
+
+- **Admission control**: a full queue rejects at submit time with
+  :class:`RejectedError` ("UNAVAILABLE: ..." — transient, retry later).
+- **Deadlines**: a request that waited past its ``timeout_ms`` fails with
+  :class:`DeadlineExceededError` ("DEADLINE_EXCEEDED: ..." — transient).
+- Every failure is classified through the batcher's ``RetryPolicy``
+  (``classify(exc)``) and counted as transient vs permanent in both the
+  internal stats and the telemetry registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.utils.watchdog import RetryPolicy
+
+
+class RejectedError(RuntimeError):
+    """Admission control: the bounded request queue is full.
+
+    The message carries watchdog's UNAVAILABLE marker — transient by
+    classification, the client should back off and retry."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline passed before (or while) it was scored."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BatcherConfig:
+    """Coalescing knobs (model/bucket knobs live on RuntimeConfig)."""
+
+    #: rows one dispatch coalesces at most; capped by the runtime's top
+    #: bucket at construction.
+    max_batch_size: int = 64
+    #: how long the dispatcher waits for more rows after the first one.
+    #: 0 disables coalescing (every request scores alone — highest
+    #: throughput cost, lowest latency under no load).
+    max_wait_us: int = 2000
+    #: bounded queue depth; submissions beyond it are REJECTED, not
+    #: buffered (explicit backpressure beats silent latency collapse).
+    max_queue: int = 256
+    #: default per-request deadline; None = no deadline.
+    default_timeout_ms: Optional[float] = None
+
+
+@dataclasses.dataclass
+class _Pending:
+    row: object
+    future: Future
+    t_submit: float
+    deadline: Optional[float]  # perf_counter seconds, None = no deadline
+
+
+_STOP = object()
+
+
+class MicroBatcher:
+    """Bounded-queue request coalescer in front of a ScoringRuntime."""
+
+    def __init__(
+        self,
+        runtime,
+        config: Optional[BatcherConfig] = None,
+        policy: Optional[RetryPolicy] = None,
+    ):
+        cfg = config or BatcherConfig()
+        if cfg.max_batch_size > runtime.buckets[-1]:
+            cfg = dataclasses.replace(
+                cfg, max_batch_size=runtime.buckets[-1]
+            )
+        self.runtime = runtime
+        self.config = cfg
+        self.policy = policy or RetryPolicy()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=cfg.max_queue)
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # Internal counters mirror telemetry but survive a disabled hub
+        # (the /stats endpoint reads these).
+        self._counts = {
+            "submitted": 0,
+            "completed": 0,
+            "rejected": 0,
+            "expired": 0,
+            "failed": 0,
+            "failed_transient": 0,
+            "failed_permanent": 0,
+            "batches": 0,
+            "max_batch_rows": 0,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="scoring-dispatch", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if self._thread is None:
+            return
+        self._queue.put(_STOP)
+        self._thread.join(timeout=timeout)
+        self._thread = None
+
+    # -- submission (any thread) -------------------------------------------
+    def submit(self, row, timeout_ms: Optional[float] = None) -> Future:
+        """Enqueue one request; returns its future.
+
+        Raises :class:`RejectedError` immediately when the queue is full
+        — admission control is synchronous so the caller can shed load
+        (HTTP 429) without waiting on a future.
+        """
+        tel = telemetry_mod.current()
+        timeout = (
+            timeout_ms
+            if timeout_ms is not None
+            else getattr(row, "timeout_ms", None)
+        )
+        if timeout is None:
+            timeout = self.config.default_timeout_ms
+        now = time.perf_counter()
+        pending = _Pending(
+            row=row,
+            future=Future(),
+            t_submit=now,
+            deadline=None if timeout is None else now + timeout / 1e3,
+        )
+        try:
+            self._queue.put_nowait(pending)
+        except queue.Full:
+            self._count("rejected")
+            tel.counter("serving_rejected_total").inc()
+            exc = RejectedError(
+                f"UNAVAILABLE: serving queue full "
+                f"({self.config.max_queue} pending); retry with backoff"
+            )
+            self._classify(exc)
+            raise exc
+        self._count("submitted")
+        tel.counter("serving_requests_total").inc()
+        tel.gauge("serving_queue_depth").set(self._queue.qsize())
+        return pending.future
+
+    # -- dispatch loop (one thread) ----------------------------------------
+    def _loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is _STOP:
+                return
+            batch = [item]
+            stop_after = False
+            wait_s = self.config.max_wait_us / 1e6
+            t_close = time.perf_counter() + wait_s
+            while len(batch) < self.config.max_batch_size:
+                remaining = t_close - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop_after = True
+                    break
+                batch.append(nxt)
+            self._dispatch(batch)
+            if stop_after:
+                return
+
+    def _dispatch(self, batch: list) -> None:
+        tel = telemetry_mod.current()
+        tel.gauge("serving_queue_depth").set(self._queue.qsize())
+        now = time.perf_counter()
+        live = []
+        for p in batch:
+            if p.deadline is not None and now > p.deadline:
+                waited_ms = (now - p.t_submit) * 1e3
+                self._count("expired")
+                tel.counter("serving_deadline_expired_total").inc()
+                self._fail(p, DeadlineExceededError(
+                    f"DEADLINE_EXCEEDED: request waited {waited_ms:.1f} ms "
+                    "past its deadline before dispatch"
+                ))
+            else:
+                live.append(p)
+        if not live:
+            return
+        try:
+            margins, means = self.runtime.score_rows([p.row for p in live])
+        except Exception as exc:  # noqa: BLE001 — classified + surfaced
+            for p in live:
+                self._fail(p, exc)
+            return
+        done = time.perf_counter()
+        bucket = self.runtime.bucket_for(len(live))
+        with self._lock:
+            self._counts["batches"] += 1
+            self._counts["completed"] += len(live)
+            self._counts["max_batch_rows"] = max(
+                self._counts["max_batch_rows"], len(live)
+            )
+        tel.histogram("serving_batch_rows").observe(len(live))
+        tel.gauge("serving_batch_occupancy").set(len(live) / bucket)
+        for i, p in enumerate(live):
+            latency = done - p.t_submit
+            tel.histogram("serving_request_latency_seconds").observe(latency)
+            if not p.future.set_running_or_notify_cancel():
+                continue  # client cancelled while queued
+            p.future.set_result({
+                "score": float(margins[i]),
+                "mean": float(means[i]),
+                "latency_ms": latency * 1e3,
+            })
+
+    # -- failure plumbing --------------------------------------------------
+    def _classify(self, exc: BaseException):
+        """Watchdog-vocabulary classification of a request failure; feeds
+        the transient/permanent split in stats and telemetry."""
+        verdict = self.policy.classify(exc)
+        self._count(
+            "failed_transient" if verdict.transient else "failed_permanent"
+        )
+        telemetry_mod.current().counter(
+            "serving_failures_transient_total" if verdict.transient
+            else "serving_failures_permanent_total"
+        ).inc()
+        return verdict
+
+    def _fail(self, p: _Pending, exc: BaseException) -> None:
+        self._count("failed")
+        self._classify(exc)
+        if p.future.set_running_or_notify_cancel():
+            p.future.set_exception(exc)
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] += n
+
+    # -- observability -----------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def stats(self) -> dict:
+        with self._lock:
+            counts = dict(self._counts)
+        counts["queue_depth"] = self._queue.qsize()
+        counts["max_queue"] = self.config.max_queue
+        counts["max_batch_size"] = self.config.max_batch_size
+        counts["max_wait_us"] = self.config.max_wait_us
+        return counts

@@ -1,0 +1,192 @@
+"""Built-in load generators for the serving path.
+
+Two disciplines, both driving ``ScoringService.submit``:
+
+- **Closed loop** (:func:`closed_loop`): N client threads, each with one
+  request in flight — measures the service's achievable throughput at a
+  concurrency level (latency and throughput are coupled; this is the
+  classic saturation probe).
+- **Open loop** (:func:`open_loop`): requests arrive on a Poisson clock
+  at ``rate`` rps regardless of completions — measures latency under a
+  FIXED offered load, including the queueing delay a closed loop hides
+  (coordinated omission).  Arrivals that find the queue full count as
+  rejections, which is the admission-control design working as intended.
+
+Used by ``python -m photon_ml_tpu.serving --loadgen ...`` and by
+``bench.py``'s ``bench_serving`` section.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from photon_ml_tpu.serving.batcher import RejectedError
+
+
+@dataclasses.dataclass
+class LoadReport:
+    """Latency/throughput summary of one load-generator run."""
+
+    mode: str
+    wall_seconds: float
+    completed: int
+    rejected: int
+    errors: int
+    latencies_ms: np.ndarray  # completed requests only, milliseconds
+
+    @property
+    def throughput_rps(self) -> float:
+        return self.completed / self.wall_seconds if self.wall_seconds else 0.0
+
+    def percentile_ms(self, q: float) -> Optional[float]:
+        if len(self.latencies_ms) == 0:
+            return None
+        return float(np.percentile(self.latencies_ms, q))
+
+    def snapshot(self) -> dict:
+        return {
+            "mode": self.mode,
+            "wall_seconds": round(self.wall_seconds, 3),
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "errors": self.errors,
+            "throughput_rps": round(self.throughput_rps, 1),
+            "latency_p50_ms": _round(self.percentile_ms(50)),
+            "latency_p90_ms": _round(self.percentile_ms(90)),
+            "latency_p99_ms": _round(self.percentile_ms(99)),
+            "latency_max_ms": _round(
+                float(self.latencies_ms.max())
+                if len(self.latencies_ms) else None
+            ),
+        }
+
+
+def _round(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 3)
+
+
+def closed_loop(
+    submit: Callable,
+    make_request: Callable[[int], object],
+    clients: int = 8,
+    duration_s: float = 5.0,
+    timeout_s: float = 30.0,
+) -> LoadReport:
+    """``clients`` threads, one in-flight request each, for
+    ``duration_s``.  ``make_request(i)`` builds the i-th request (vary it
+    so the hot/cold split sees a realistic entity stream)."""
+    latencies: list[list[float]] = [[] for _ in range(clients)]
+    counts = np.zeros((clients, 3), np.int64)  # completed/rejected/errors
+    stop = time.perf_counter() + duration_s
+    seq = [0]
+    seq_lock = threading.Lock()
+
+    def client(ci: int) -> None:
+        while time.perf_counter() < stop:
+            with seq_lock:
+                i = seq[0]
+                seq[0] += 1
+            t0 = time.perf_counter()
+            try:
+                fut = submit(make_request(i))
+                fut.result(timeout=timeout_s)
+            except RejectedError:
+                counts[ci, 1] += 1
+                continue
+            except Exception:  # noqa: BLE001 — loadgen counts, not raises
+                counts[ci, 2] += 1
+                continue
+            latencies[ci].append((time.perf_counter() - t0) * 1e3)
+            counts[ci, 0] += 1
+
+    t_start = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(ci,), daemon=True)
+        for ci in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    return LoadReport(
+        mode=f"closed(clients={clients})",
+        wall_seconds=wall,
+        completed=int(counts[:, 0].sum()),
+        rejected=int(counts[:, 1].sum()),
+        errors=int(counts[:, 2].sum()),
+        latencies_ms=np.concatenate(
+            [np.asarray(c) for c in latencies]
+        ) if any(latencies) else np.zeros(0),
+    )
+
+
+def open_loop(
+    submit: Callable,
+    make_request: Callable[[int], object],
+    rate_rps: float = 200.0,
+    duration_s: float = 5.0,
+    timeout_s: float = 30.0,
+    seed: int = 0,
+) -> LoadReport:
+    """Poisson arrivals at ``rate_rps`` for ``duration_s``; latency is
+    measured from the SCHEDULED arrival time (no coordinated omission —
+    a stalled service accrues queueing delay against every later
+    arrival)."""
+    rng = np.random.default_rng(seed)
+    results_lock = threading.Lock()
+    latencies: list[float] = []
+    counts = [0, 0, 0]  # completed / rejected / errors
+    pending: list[threading.Thread] = []
+
+    def waiter(fut, t_sched: float) -> None:
+        try:
+            fut.result(timeout=timeout_s)
+        except Exception:  # noqa: BLE001
+            with results_lock:
+                counts[2] += 1
+            return
+        lat = (time.perf_counter() - t_sched) * 1e3
+        with results_lock:
+            latencies.append(lat)
+            counts[0] += 1
+
+    t_start = time.perf_counter()
+    t_next = t_start
+    i = 0
+    while t_next < t_start + duration_s:
+        now = time.perf_counter()
+        if now < t_next:
+            time.sleep(t_next - now)
+        try:
+            fut = submit(make_request(i))
+        except RejectedError:
+            with results_lock:
+                counts[1] += 1
+        except Exception:  # noqa: BLE001
+            with results_lock:
+                counts[2] += 1
+        else:
+            t = threading.Thread(
+                target=waiter, args=(fut, t_next), daemon=True
+            )
+            t.start()
+            pending.append(t)
+        i += 1
+        t_next += float(rng.exponential(1.0 / rate_rps))
+    for t in pending:
+        t.join(timeout=timeout_s)
+    wall = time.perf_counter() - t_start
+    return LoadReport(
+        mode=f"open(rate={rate_rps:g}rps)",
+        wall_seconds=wall,
+        completed=counts[0],
+        rejected=counts[1],
+        errors=counts[2],
+        latencies_ms=np.asarray(latencies),
+    )

@@ -1,0 +1,456 @@
+"""Online scoring runtime: pre-compiled bucket kernels + hot/cold entities.
+
+``ScoringRuntime`` loads a saved GLM or GAME model ONCE and turns it into
+a request-path scorer:
+
+- **Bucket ladder** — the jitted batch kernel (serving/kernels.py) is
+  compiled ahead of time at a ladder of padded batch sizes (powers of two
+  up to ``max_batch_size``), warmed through
+  :func:`photon_ml_tpu.utils.compile_cache.warmup` at startup, so the
+  request path never compiles.  A batch of B rows pads to the smallest
+  bucket ≥ B; padding rows are zeros and slot 0 (exact no-ops).
+- **Hot/cold split** — each random-effect coordinate's per-entity
+  coefficients live host-side as the model's sparse table (millions of
+  entities), while an LRU hot set of ``hot_entities`` dense rows stays
+  resident on device as a ``(H+1, D)`` table (row 0 reserved zero).  Hot
+  rows gather ON DEVICE by slot; the cold tail falls back to host-side
+  gathers (:func:`~photon_ml_tpu.serving.kernels.dense_coefficient_rows`)
+  uploaded with the batch, then promotes into the hot set (evicting LRU)
+  for the next request.  ``table[slot] + cold`` keeps hot and cold rows
+  bit-identical.
+
+All mutation (LRU order, hot-table updates) happens on the dispatch
+thread — the MicroBatcher owns scoring — so the runtime needs no locks;
+``parse_request`` is read-only and safe from any request thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.ops import losses as losses_lib
+from photon_ml_tpu.serving import kernels as kernels_lib
+from photon_ml_tpu import telemetry as telemetry_mod
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    """Serving-side model knobs (batching knobs live on BatcherConfig)."""
+
+    #: top of the bucket ladder; also the largest batch one dispatch scores.
+    max_batch_size: int = 64
+    #: per-coordinate LRU hot-set capacity (dense rows resident on device).
+    hot_entities: int = 1024
+    #: compile every bucket at startup (skip only in tests that assert on
+    #: compile behavior themselves).
+    warmup: bool = True
+
+
+@dataclasses.dataclass
+class Row:
+    """One parsed scoring request."""
+
+    features: dict  # shard name -> np.float32 (D,) or None (all zeros)
+    ids: dict  # entity-key name -> str entity id (or absent)
+    offset: float = 0.0
+    timeout_ms: Optional[float] = None
+
+
+class _HotTable:
+    """LRU hot set of dense per-entity coefficient rows, device-resident.
+
+    Slot 0 is the reserved zero row (cold / unknown / padding); slots
+    1..capacity hold entities in LRU order.  Eviction is O(1)
+    (OrderedDict), inserts are one ``at[slot].set`` device update.
+    """
+
+    def __init__(self, capacity: int, dim: int):
+        import jax.numpy as jnp
+
+        self.capacity = int(capacity)
+        self.dim = int(dim)
+        self.table = jnp.zeros((self.capacity + 1, self.dim), jnp.float32)
+        self._slots: "collections.OrderedDict[object, int]" = (
+            collections.OrderedDict()
+        )
+        self._free = list(range(self.capacity, 0, -1))
+        self.hits = 0
+        self.misses = 0
+        self.inserts = 0
+        self.evictions = 0
+
+    def lookup(self, key) -> int:
+        """Hot slot for ``key`` (marks it most-recently-used), 0 if cold."""
+        slot = self._slots.get(key)
+        if slot is None:
+            return 0
+        self._slots.move_to_end(key)
+        self.hits += 1
+        return slot
+
+    def insert(self, key, dense_row: np.ndarray) -> None:
+        """Promote ``key``; evicts the least-recently-used entity when full."""
+        if self.capacity == 0 or key in self._slots:
+            return
+        if self._free:
+            slot = self._free.pop()
+        else:
+            _, slot = self._slots.popitem(last=False)
+            self.evictions += 1
+        import jax.numpy as jnp
+
+        self.table = self.table.at[slot].set(jnp.asarray(dense_row))
+        self._slots[key] = slot
+        self.inserts += 1
+
+    @property
+    def size(self) -> int:
+        return len(self._slots)
+
+    def hot_keys(self) -> list:
+        """LRU→MRU order (test/diagnostic view)."""
+        return list(self._slots)
+
+
+@dataclasses.dataclass
+class _FixedCoord:
+    name: str
+    shard: str
+    means: object  # jnp (D,)
+
+
+@dataclasses.dataclass
+class _RandomCoord:
+    name: str
+    shard: str
+    entity_key: str
+    model: RandomEffectModel
+    hot: _HotTable
+    unknown: int = 0
+
+
+class ScoringRuntime:
+    """A loaded model, compiled and warmed for the online request path."""
+
+    def __init__(
+        self,
+        model: GameModel,
+        index_maps: Optional[dict] = None,
+        config: Optional[RuntimeConfig] = None,
+    ):
+        import jax.numpy as jnp
+
+        self.config = config or RuntimeConfig()
+        if self.config.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self.model = model
+        self.index_maps = index_maps or {}
+        self.task = model.task
+        self._mean_fn = losses_lib.get(model.task).mean_fn
+        self.fixed: list[_FixedCoord] = []
+        self.random: list[_RandomCoord] = []
+        self.shard_dims: dict[str, int] = {}
+        for name, sub in model.models.items():
+            if isinstance(sub, FixedEffectModel):
+                w = np.asarray(sub.model.coefficients.means, np.float32)
+                self.fixed.append(
+                    _FixedCoord(name, sub.feature_shard, jnp.asarray(w))
+                )
+                self.shard_dims[sub.feature_shard] = w.shape[0]
+            elif isinstance(sub, RandomEffectModel):
+                self.random.append(_RandomCoord(
+                    name, sub.feature_shard, sub.entity_key, sub,
+                    _HotTable(self.config.hot_entities, sub.n_features),
+                ))
+                self.shard_dims[sub.feature_shard] = sub.n_features
+            else:
+                raise TypeError(f"unsupported coordinate type: {type(sub)}")
+        if not self.fixed and not self.random:
+            raise ValueError("model has no coordinates to serve")
+        self.buckets = self._bucket_ladder(self.config.max_batch_size)
+        self._kernel = kernels_lib.build_bucket_kernel(self._mean_fn)
+        self.batches = 0
+        self.rows_scored = 0
+        self.warmup_compiles = 0
+        self._lock = threading.Lock()  # stats snapshot vs dispatch thread
+        if self.config.warmup:
+            self.warm_up()
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def _bucket_ladder(max_batch: int) -> list[int]:
+        ladder = []
+        b = 1
+        while b < max_batch:
+            ladder.append(b)
+            b *= 2
+        ladder.append(max_batch)
+        return ladder
+
+    @classmethod
+    def from_glm_model(
+        cls,
+        model: GeneralizedLinearModel,
+        index_map=None,
+        shard: str = "features",
+        config: Optional[RuntimeConfig] = None,
+    ) -> "ScoringRuntime":
+        """Serve a plain GLM as a one-fixed-coordinate GAME model."""
+        game = GameModel(
+            models={"fixed": FixedEffectModel(model, shard)},
+            task=model.task,
+        )
+        imaps = {shard: index_map} if index_map is not None else {}
+        return cls(game, imaps, config)
+
+    @classmethod
+    def load(
+        cls, path: str, config: Optional[RuntimeConfig] = None
+    ) -> "ScoringRuntime":
+        """Load a saved model: a GAME model directory (either the
+        directory holding ``metadata.json`` or a driver output dir with a
+        ``models/`` subdir) or a GLM ``.avro`` file."""
+        if os.path.isdir(path):
+            from photon_ml_tpu.io.game_store import load_game_model
+
+            if not os.path.exists(os.path.join(path, "metadata.json")):
+                nested = os.path.join(path, "models")
+                if os.path.exists(os.path.join(nested, "metadata.json")):
+                    path = nested
+            model, index_maps = load_game_model(path)
+            return cls(model, index_maps, config)
+        from photon_ml_tpu.io.model_store import load_glm_model
+
+        glm, imap = load_glm_model(path)
+        return cls.from_glm_model(glm, imap, config=config)
+
+    # -- warmup ------------------------------------------------------------
+    def _abstract_args(self, bucket: int) -> tuple:
+        import jax
+
+        f32 = np.float32
+        sds = jax.ShapeDtypeStruct
+        offsets = sds((bucket,), f32)
+        fixed_x = tuple(
+            sds((bucket, int(c.means.shape[0])), f32) for c in self.fixed
+        )
+        fixed_w = tuple(sds((int(c.means.shape[0]),), f32) for c in self.fixed)
+        re_x = tuple(sds((bucket, c.hot.dim), f32) for c in self.random)
+        re_tables = tuple(
+            sds((c.hot.capacity + 1, c.hot.dim), f32) for c in self.random
+        )
+        re_slots = tuple(sds((bucket,), np.int32) for c in self.random)
+        re_cold = tuple(sds((bucket, c.hot.dim), f32) for c in self.random)
+        return (offsets, fixed_x, fixed_w, re_x, re_tables, re_slots, re_cold)
+
+    def warm_up(self) -> int:
+        """Compile the scoring kernel at every bucket shape (no compiles
+        on the request path afterwards).  Returns the compile count."""
+        from photon_ml_tpu.utils.compile_cache import warmup
+
+        shapes = [self._abstract_args(b) for b in self.buckets]
+        self.warmup_compiles = warmup(
+            [self._kernel] * len(self.buckets), shapes
+        )
+        return self.warmup_compiles
+
+    # -- request parsing ---------------------------------------------------
+    def parse_request(self, obj: dict) -> Row:
+        """Validate one JSON-shaped request into a :class:`Row`.
+
+        ``dense``: shard → full-width float list.  ``features``: shard →
+        named entries (``{"name", "term", "value"}`` dicts or
+        ``[name, term, value]`` triples) resolved through the saved index
+        map — unseen features drop, exactly like batch scoring.
+        """
+        if not isinstance(obj, dict):
+            raise ValueError("request must be a JSON object")
+        features: dict = {}
+        for shard, vec in (obj.get("dense") or {}).items():
+            dim = self.shard_dims.get(shard)
+            if dim is None:
+                raise ValueError(f"unknown feature shard {shard!r}")
+            arr = np.asarray(vec, np.float32)
+            if arr.shape != (dim,):
+                raise ValueError(
+                    f"shard {shard!r} expects {dim} features, got "
+                    f"{arr.shape}"
+                )
+            features[shard] = arr
+        for shard, entries in (obj.get("features") or {}).items():
+            dim = self.shard_dims.get(shard)
+            if dim is None:
+                raise ValueError(f"unknown feature shard {shard!r}")
+            imap = self.index_maps.get(shard)
+            if imap is None:
+                raise ValueError(
+                    f"shard {shard!r} has no saved index map; send "
+                    "'dense' features"
+                )
+            from photon_ml_tpu.data.index_map import feature_key
+
+            arr = features.get(shard)
+            if arr is None:
+                arr = np.zeros(dim, np.float32)
+            for e in entries:
+                if isinstance(e, dict):
+                    name, term, value = (
+                        e.get("name"), e.get("term", ""), e.get("value"),
+                    )
+                else:
+                    name, term, value = e
+                idx = imap.get_index(feature_key(str(name), str(term or "")))
+                if idx >= 0:
+                    arr[idx] = np.float32(value)
+            features[shard] = arr
+        ids = {}
+        for key, value in (obj.get("ids") or {}).items():
+            if value is not None:
+                ids[str(key)] = str(value)
+        timeout = obj.get("timeout_ms")
+        return Row(
+            features=features,
+            ids=ids,
+            offset=float(obj.get("offset") or 0.0),
+            timeout_ms=None if timeout is None else float(timeout),
+        )
+
+    # -- scoring -----------------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"batch of {n} exceeds max_batch_size={self.buckets[-1]}"
+        )
+
+    def score_rows(self, rows: Sequence[Row]) -> tuple[np.ndarray, np.ndarray]:
+        """Score a batch through the padded bucket kernel.
+
+        Returns ``(margins, means)`` float32 arrays of ``len(rows)``.
+        Dispatch-thread only (mutates the LRU hot sets).
+        """
+        import jax.numpy as jnp
+
+        n = len(rows)
+        bucket = self.bucket_for(n)
+        tel = telemetry_mod.current()
+
+        offsets = np.zeros(bucket, np.float32)
+        for i, row in enumerate(rows):
+            offsets[i] = row.offset
+
+        def shard_matrix(shard: str, dim: int) -> np.ndarray:
+            x = np.zeros((bucket, dim), np.float32)
+            for i, row in enumerate(rows):
+                vec = row.features.get(shard)
+                if vec is not None:
+                    x[i] = vec
+            return x
+
+        fixed_x = tuple(
+            jnp.asarray(shard_matrix(c.shard, int(c.means.shape[0])))
+            for c in self.fixed
+        )
+        fixed_w = tuple(c.means for c in self.fixed)
+
+        re_x, re_tables, re_slots, re_cold = [], [], [], []
+        promotions: list[tuple[_RandomCoord, object, np.ndarray]] = []
+        for c in self.random:
+            slots = np.zeros(bucket, np.int32)
+            cold = np.zeros((bucket, c.hot.dim), np.float32)
+            pending: dict = {}
+            hits_before = c.hot.hits
+            for i, row in enumerate(rows):
+                key = row.ids.get(c.entity_key)
+                if key is None:
+                    continue
+                slot = c.hot.lookup(key)
+                if slot:
+                    slots[i] = slot
+                    continue
+                entry = c.model.coefficients.get(key)
+                if entry is None:
+                    c.unknown += 1
+                    tel.counter("serving_unknown_entities_total").inc()
+                    continue
+                c.hot.misses += 1
+                tel.counter("serving_cold_misses_total").inc()
+                vec = pending.get(key)
+                if vec is None:
+                    vec = kernels_lib.dense_coefficient_rows(
+                        c.model, [key]
+                    )[0]
+                    pending[key] = vec
+                    promotions.append((c, key, vec))
+                cold[i] = vec
+            tel.counter("serving_hot_hits_total").inc(
+                c.hot.hits - hits_before
+            )
+            re_x.append(jnp.asarray(shard_matrix(c.shard, c.hot.dim)))
+            re_tables.append(c.hot.table)
+            re_slots.append(jnp.asarray(slots))
+            re_cold.append(jnp.asarray(cold))
+
+        margins, means = self._kernel(
+            jnp.asarray(offsets), fixed_x, fixed_w,
+            tuple(re_x), tuple(re_tables), tuple(re_slots), tuple(re_cold),
+        )
+        margins = np.asarray(margins[:n], np.float32)
+        means = np.asarray(means[:n], np.float32)
+
+        # Promote the cold tail AFTER this batch (the batch itself scored
+        # through the cold path; the next request finds the entity hot).
+        for c, key, vec in promotions:
+            c.hot.insert(key, vec)
+        with self._lock:
+            self.batches += 1
+            self.rows_scored += n
+        tel.counter("serving_batches_total").inc()
+        tel.counter("serving_rows_scored_total").inc(n)
+        return margins, means
+
+    # -- observability -----------------------------------------------------
+    def stats(self) -> dict:
+        """Mirrors the telemetry counters, independent of the hub state
+        (the /stats endpoint must work with telemetry disabled)."""
+        with self._lock:
+            batches, rows = self.batches, self.rows_scored
+        hot = {}
+        for c in self.random:
+            total = c.hot.hits + c.hot.misses
+            hot[c.name] = {
+                "capacity": c.hot.capacity,
+                "resident": c.hot.size,
+                "hits": c.hot.hits,
+                "misses": c.hot.misses,
+                "hit_rate": (c.hot.hits / total) if total else None,
+                "inserts": c.hot.inserts,
+                "evictions": c.hot.evictions,
+                "unknown_entities": c.unknown,
+                "n_entities": c.model.n_entities,
+            }
+        return {
+            "task": self.task,
+            "buckets": list(self.buckets),
+            "coordinates": {
+                "fixed": [c.name for c in self.fixed],
+                "random": [c.name for c in self.random],
+            },
+            "batches": batches,
+            "rows_scored": rows,
+            "warmup_compiles": self.warmup_compiles,
+            "hot_sets": hot,
+        }

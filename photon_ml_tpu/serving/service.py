@@ -1,0 +1,221 @@
+"""ScoringService: the in-process API and the stdlib HTTP endpoint.
+
+``ScoringService`` composes a :class:`~photon_ml_tpu.serving.runtime.
+ScoringRuntime` with a :class:`~photon_ml_tpu.serving.batcher.MicroBatcher`
+and is the one object callers touch:
+
+    with ScoringService(runtime) as svc:
+        fut = svc.submit({"dense": {"global": [...]}, "ids": {...}})
+        result = svc.score({...})            # blocking convenience
+        many = svc.score_many([{...}, ...])  # coalesces naturally
+
+``start_http_server(svc, port)`` exposes the same API over a stdlib
+``ThreadingHTTPServer`` (one thread per connection; the dispatch thread
+still owns all scoring, so concurrency is safe by construction):
+
+- ``POST /score`` — ``{"rows": [...]}`` or a single request object;
+  responds ``{"results": [...]}`` with per-row ``{"score", "mean",
+  "latency_ms"}`` or ``{"error", "kind"}``.  A fully-rejected call
+  returns 429, a fully-expired one 504, bad input 400.
+- ``GET /healthz`` — liveness + model identity.
+- ``GET /stats`` — runtime + batcher counters (works with telemetry
+  disabled; the telemetry registry carries the same numbers when a hub
+  is installed).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import Future
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+from photon_ml_tpu.serving.batcher import (
+    BatcherConfig,
+    DeadlineExceededError,
+    MicroBatcher,
+    RejectedError,
+)
+from photon_ml_tpu.serving.runtime import Row, ScoringRuntime
+
+
+class ScoringService:
+    """Runtime + batcher, started/stopped as one unit."""
+
+    def __init__(
+        self,
+        runtime: ScoringRuntime,
+        batcher_config: Optional[BatcherConfig] = None,
+        policy=None,
+    ):
+        self.runtime = runtime
+        self.batcher = MicroBatcher(runtime, batcher_config, policy=policy)
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ScoringService":
+        self.batcher.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        self.batcher.stop()
+        self._started = False
+
+    def __enter__(self) -> "ScoringService":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- scoring -----------------------------------------------------------
+    def submit(self, request, timeout_ms: Optional[float] = None) -> Future:
+        """Parse + enqueue one request (dict or pre-parsed Row); returns
+        the future.  Raises RejectedError on a full queue and ValueError
+        on malformed input."""
+        row = (
+            request
+            if isinstance(request, Row)
+            else self.runtime.parse_request(request)
+        )
+        return self.batcher.submit(row, timeout_ms=timeout_ms)
+
+    def score(self, request, timeout: Optional[float] = 30.0) -> dict:
+        """Blocking single-request convenience."""
+        return self.submit(request).result(timeout=timeout)
+
+    def score_many(
+        self, requests: Sequence, timeout: Optional[float] = 30.0
+    ) -> list:
+        """Submit all, then gather — concurrent submissions coalesce into
+        shared batches.  Per-row failures come back as result dicts
+        (``{"error", "kind"}``), not exceptions, so one bad row doesn't
+        void its batch-mates."""
+        slots: list = [None] * len(requests)
+        futures: list[tuple[int, Future]] = []
+        for i, req in enumerate(requests):
+            try:
+                futures.append((i, self.submit(req)))
+            except (RejectedError, ValueError, DeadlineExceededError) as exc:
+                slots[i] = _error_result(exc)
+        for i, fut in futures:
+            try:
+                slots[i] = fut.result(timeout=timeout)
+            except Exception as exc:  # noqa: BLE001 — per-row reporting
+                slots[i] = _error_result(exc)
+        return slots
+
+    # -- observability -----------------------------------------------------
+    def healthz(self) -> dict:
+        return {
+            "status": "ok" if self._started else "stopped",
+            "task": self.runtime.task,
+            "coordinates": self.runtime.stats()["coordinates"],
+            "buckets": list(self.runtime.buckets),
+        }
+
+    def stats(self) -> dict:
+        return {
+            "runtime": self.runtime.stats(),
+            "batcher": self.batcher.stats(),
+        }
+
+
+def _error_kind(exc: BaseException) -> str:
+    if isinstance(exc, RejectedError):
+        return "rejected"
+    if isinstance(exc, DeadlineExceededError):
+        return "deadline"
+    if isinstance(exc, ValueError):
+        return "bad_request"
+    return "internal"
+
+
+def _error_result(exc: BaseException) -> dict:
+    return {"error": str(exc), "kind": _error_kind(exc)}
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint
+# ---------------------------------------------------------------------------
+
+_KIND_STATUS = {
+    "rejected": 429,
+    "deadline": 504,
+    "bad_request": 400,
+    "internal": 500,
+}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: ScoringService  # set on the server class per instance
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ----------------------------------------------------------
+    def log_message(self, fmt, *args):  # noqa: A003 — stdlib signature
+        pass  # request logging rides telemetry, not stderr
+
+    def _send_json(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- routes ------------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802 — stdlib casing
+        if self.path == "/healthz":
+            self._send_json(200, self.server.service.healthz())
+        elif self.path == "/stats":
+            self._send_json(200, self.server.service.stats())
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 — stdlib casing
+        if self.path != "/score":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            obj = json.loads(self.rfile.read(length) or b"{}")
+            rows = obj["rows"] if isinstance(obj, dict) and "rows" in obj \
+                else [obj]
+            if not isinstance(rows, list) or not rows:
+                raise ValueError("'rows' must be a non-empty list")
+        except (ValueError, KeyError, TypeError) as exc:
+            self._send_json(400, {"error": f"bad request: {exc}"})
+            return
+        results = self.server.service.score_many(rows)
+        errors = [r["kind"] for r in results if r and "error" in r]
+        if errors and len(errors) == len(results):
+            # Every row failed the same way → surface it as the HTTP
+            # status (429 tells a client to back off, 504 to re-budget).
+            kinds = set(errors)
+            status = _KIND_STATUS[errors[0]] if len(kinds) == 1 else 500
+        else:
+            status = 200  # partial failure reports per-row
+        self._send_json(status, {"results": results})
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    service: ScoringService
+
+
+def start_http_server(
+    service: ScoringService, host: str = "127.0.0.1", port: int = 0
+) -> tuple[_Server, threading.Thread]:
+    """Serve ``service`` over HTTP on a daemon thread; returns
+    ``(server, thread)``.  ``port=0`` binds an ephemeral port — read it
+    back from ``server.server_address[1]``.  Shut down with
+    ``server.shutdown(); server.server_close()``."""
+    server = _Server((host, port), _Handler)
+    server.service = service
+    thread = threading.Thread(
+        target=server.serve_forever, name="scoring-http", daemon=True
+    )
+    thread.start()
+    return server, thread

@@ -17,7 +17,8 @@ library users call :func:`enable_compile_cache` themselves.
 from __future__ import annotations
 
 import os
-from typing import Optional
+import time
+from typing import Optional, Sequence
 
 from photon_ml_tpu import telemetry as telemetry_mod
 
@@ -60,6 +61,74 @@ def publish_cache_metrics(path: Optional[str]) -> Optional[int]:
                 new_entries=delta,
             )
     return delta
+
+
+def warmup(fns: Sequence, shapes: Sequence, logger=None) -> int:
+    """Pre-compile jitted functions ahead of a latency-sensitive path.
+
+    ``fns[i]`` is called once with zero-filled arguments materialized
+    from ``shapes[i]`` — a tuple (or any pytree) of
+    ``jax.ShapeDtypeStruct`` leaves (concrete arrays work too: only
+    ``.shape``/``.dtype`` are read).  Calling through the normal jit
+    entry populates jit's own executable cache — unlike
+    ``fn.lower(...).compile()``, whose result a later direct call would
+    not reuse — and routes compilations through the persistent
+    compilation cache when one is enabled, so a restarted server warms
+    from disk instead of recompiling.
+
+    The serving runtime uses this at startup to compile its whole
+    padded-batch bucket ladder off the request path.  Returns the number
+    of NEW compilations (per-fn jit cache-size delta where the private
+    ``_cache_size`` API exists, else the call count), and reports it
+    through telemetry (``compile_cache_warmup_compiles`` counter,
+    ``compile_cache.warmup`` event with wall seconds).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if len(fns) != len(shapes):
+        raise ValueError(
+            f"warmup needs one shape tree per fn: {len(fns)} fns, "
+            f"{len(shapes)} shapes"
+        )
+    tel = telemetry_mod.current()
+    t0 = time.perf_counter()
+
+    def cache_size(fn) -> Optional[int]:
+        try:
+            return fn._cache_size()
+        except Exception:
+            return None
+
+    compiles = 0
+    counted = True
+    for fn, args in zip(fns, shapes):
+        before = cache_size(fn)
+        zeros = jax.tree_util.tree_map(
+            lambda leaf: jnp.zeros(leaf.shape, leaf.dtype), args
+        )
+        out = fn(*zeros)
+        jax.block_until_ready(out)
+        after = cache_size(fn)
+        if before is None or after is None:
+            counted = False
+            compiles += 1
+        else:
+            compiles += max(0, after - before)
+    wall = time.perf_counter() - t0
+    if tel.enabled:
+        tel.counter("compile_cache_warmup_compiles").inc(compiles)
+        tel.gauge("compile_cache_warmup_seconds").set(round(wall, 4))
+        tel.event(
+            "compile_cache.warmup", fns=len(fns), compiles=compiles,
+            exact=counted, seconds=wall,
+        )
+    if logger is not None:
+        logger.info(
+            "warmup: %d fn calls, %d compiles in %.2fs",
+            len(fns), compiles, wall,
+        )
+    return compiles
 
 
 def add_compile_cache_arg(parser) -> None:

@@ -14,10 +14,17 @@ cd "$(dirname "$0")/.."
 echo "== telemetry selfcheck =="
 python -m photon_ml_tpu.telemetry --selfcheck
 
+# The serving selfcheck builds a synthetic GAME model, serves concurrent
+# HTTP requests, and verifies batched results are bit-identical to
+# single-request scoring (plus the telemetry snapshot contents).
+echo "== serving selfcheck (JAX_PLATFORMS=cpu) =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.serving --selfcheck
+
 echo "== tier-1 tests (JAX_PLATFORMS=cpu) =="
 if [[ "${1:-}" == "--fast" ]]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_watchdog.py \
+    tests/test_serving.py -m 'not slow' \
     -q -p no:cacheprovider
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -1,5 +1,8 @@
 """IO tests: Avro codec, model store, LIBSVM, index maps, stats."""
 
+import json
+import os
+
 import numpy as np
 import pytest
 import scipy.sparse as sp
@@ -403,3 +406,196 @@ class TestScoringContainerWriter:
         blocks = [(uids[:99], scores, labels, ids)]
         with pytest.raises(ValueError, match="do not match len"):
             avro.write_scoring_container(str(tmp_path / "y.avro"), blocks)
+
+
+class TestModelFingerprints:
+    """PR-3 satellite: save-time fingerprints verified at load, NaN/inf
+    coefficients rejected at save (io/model_store.py, io/game_store.py)."""
+
+    def _save(self, tmp_path, means, task="logistic", variances=None):
+        imap = IndexMap.build([f"f{j}" for j in range(len(means))])
+        model = GeneralizedLinearModel(
+            Coefficients(
+                jnp.asarray(np.asarray(means, np.float32)),
+                None if variances is None else jnp.asarray(
+                    np.asarray(variances, np.float32)
+                ),
+            ),
+            task,
+        )
+        path = str(tmp_path / "model.avro")
+        fp = save_glm_model(model, imap, path)
+        return path, imap, fp
+
+    def test_fingerprint_written_and_verified(self, tmp_path):
+        path, imap, fp = self._save(tmp_path, [1.0, -2.0, 0.5])
+        assert fp["task"] == "logistic" and fp["feature_count"] == 3
+        assert os.path.exists(path + ".meta.json")
+        loaded, _ = load_glm_model(path, imap)  # verifies silently
+        assert loaded.task == "logistic"
+
+    def test_tampered_file_rejected(self, tmp_path):
+        from photon_ml_tpu.io.schemas import BAYESIAN_LINEAR_MODEL
+
+        path, imap, _ = self._save(tmp_path, [1.0, -2.0, 0.5])
+        _, records = avro.read_container(path)
+        records[0]["means"][0]["value"] = 99.0  # silent corruption
+        avro.write_container(path, BAYESIAN_LINEAR_MODEL, records)
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            load_glm_model(path, imap)
+
+    def test_wrong_width_index_map_rejected(self, tmp_path):
+        path, _, _ = self._save(tmp_path, [1.0, -2.0, 0.5])
+        wider = IndexMap.build([f"f{j}" for j in range(5)])
+        with pytest.raises(ValueError, match="saved with 3 features"):
+            load_glm_model(path, wider)
+
+    def test_missing_sidecar_loads_unverified(self, tmp_path):
+        # Pre-fingerprint files (older saves) keep loading.
+        path, imap, _ = self._save(tmp_path, [1.0, -2.0, 0.5])
+        os.remove(path + ".meta.json")
+        loaded, _ = load_glm_model(path, imap)
+        assert loaded.task == "logistic"
+
+    def test_nan_coefficients_rejected_at_save(self, tmp_path):
+        with pytest.raises(ValueError, match="non-finite"):
+            self._save(tmp_path, [1.0, float("nan"), 0.5])
+        with pytest.raises(ValueError, match="non-finite"):
+            self._save(tmp_path, [1.0, float("inf"), 0.5])
+        with pytest.raises(ValueError, match="variance"):
+            self._save(
+                tmp_path, [1.0, 2.0], variances=[0.1, float("nan")]
+            )
+
+    def _game_model(self, bad_entity=False):
+        from photon_ml_tpu.game.model import (
+            FixedEffectModel,
+            GameModel,
+            RandomEffectModel,
+        )
+
+        glm = GeneralizedLinearModel(
+            Coefficients(jnp.asarray(np.array([1.0, -1.0], np.float32))),
+            "logistic",
+        )
+        vals2 = np.array(
+            [np.nan if bad_entity else 0.5, 1.5], np.float32
+        )
+        table = {
+            "e1": (np.array([0, 1], np.int32),
+                   np.array([0.5, -0.5], np.float32)),
+            "e2": (np.array([0, 1], np.int32), vals2),
+        }
+        model = GameModel(
+            models={
+                "fixed": FixedEffectModel(glm, "global"),
+                "per_e": RandomEffectModel(
+                    coefficients=table, feature_shard="ef",
+                    entity_key="eid", task="logistic", n_features=2,
+                ),
+            },
+            task="logistic",
+        )
+        imaps = {
+            "global": IndexMap.build(["g0", "g1"]),
+            "ef": IndexMap.build(["r0", "r1"]),
+        }
+        return model, imaps
+
+    def test_game_fingerprints_roundtrip(self, tmp_path):
+        from photon_ml_tpu.io.game_store import (
+            load_game_model,
+            save_game_model,
+        )
+
+        model, imaps = self._game_model()
+        d = str(tmp_path / "game")
+        save_game_model(model, imaps, d)
+        with open(os.path.join(d, "metadata.json")) as f:
+            manifest = json.load(f)
+        assert set(manifest["fingerprints"]) == {"fixed", "per_e"}
+        assert manifest["fingerprints"]["per_e"]["n_entities"] == 2
+        loaded, _ = load_game_model(d)  # verifies both coordinates
+        assert set(loaded.models) == {"fixed", "per_e"}
+
+    def test_game_tampered_random_effect_rejected(self, tmp_path):
+        from photon_ml_tpu.io.game_store import (
+            RANDOM_EFFECT_MODEL_SCHEMA,
+            load_game_model,
+            save_game_model,
+        )
+
+        model, imaps = self._game_model()
+        d = str(tmp_path / "game")
+        save_game_model(model, imaps, d)
+        path = os.path.join(
+            d, "random-effect", "per_e", "coefficients.avro"
+        )
+        _, records = avro.read_container(path)
+        records[0]["coefficients"][0]["value"] = 42.0
+        avro.write_container(path, RANDOM_EFFECT_MODEL_SCHEMA, records)
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            load_game_model(d)
+
+    def test_game_nan_random_effect_rejected_at_save(self, tmp_path):
+        from photon_ml_tpu.io.game_store import save_game_model
+
+        model, imaps = self._game_model(bad_entity=True)
+        with pytest.raises(ValueError, match="non-finite"):
+            save_game_model(model, imaps, str(tmp_path / "game"))
+
+
+class TestCompileCacheWarmup:
+    """PR-3 satellite: utils/compile_cache.warmup pre-compiles jitted
+    fns at given shapes and reports the compile count via telemetry."""
+
+    def test_warmup_compiles_and_counts(self):
+        import jax
+
+        from photon_ml_tpu import telemetry as telemetry_mod
+        from photon_ml_tpu.utils.compile_cache import warmup
+
+        calls = []
+
+        @jax.jit
+        def f(x, y):
+            calls.append(1)
+            return x * 2.0 + y
+
+        sds = jax.ShapeDtypeStruct
+        shapes = [
+            (sds((4,), np.float32), (sds((4,), np.float32))),
+            (sds((8,), np.float32), (sds((8,), np.float32))),
+        ]
+        tel = telemetry_mod.Telemetry(enabled=True, sinks=[])
+        with tel:
+            n = warmup([f, f], shapes)
+            assert n == 2  # two distinct shapes -> two compiles
+            # Re-warming the same shapes compiles nothing new.
+            assert warmup([f, f], shapes) == 0
+            snap = tel.snapshot()
+        assert snap["counters"]["compile_cache_warmup_compiles"] == 2
+        assert snap["gauges"]["compile_cache_warmup_seconds"] >= 0
+
+    def test_warmup_populates_the_jit_cache(self):
+        import jax
+
+        traces = []
+
+        @jax.jit
+        def g(x):
+            traces.append(x.shape)
+            return x + 1.0
+
+        from photon_ml_tpu.utils.compile_cache import warmup
+
+        warmup([g], [(jax.ShapeDtypeStruct((3,), np.float32),)])
+        n_traces = len(traces)
+        g(jnp.zeros(3, jnp.float32))  # request-path call: no retrace
+        assert len(traces) == n_traces
+
+    def test_length_mismatch_rejected(self):
+        from photon_ml_tpu.utils.compile_cache import warmup
+
+        with pytest.raises(ValueError, match="one shape tree per fn"):
+            warmup([lambda: None], [])

@@ -1,0 +1,435 @@
+"""Online serving subsystem tests (ISSUE 3).
+
+The load-bearing contract: every score the micro-batched path produces is
+BIT-IDENTICAL to single-request scoring, across the whole bucket ladder
+and regardless of an entity's hot/cold state.  Plus the operational
+behaviors: coalescing, admission control (queue-full rejection), deadline
+timeouts classified through the watchdog vocabulary, and LRU hot-set
+eviction/refill.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.serving.batcher import (
+    BatcherConfig,
+    DeadlineExceededError,
+    MicroBatcher,
+    RejectedError,
+)
+from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+from photon_ml_tpu.serving.service import ScoringService, start_http_server
+from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+
+@pytest.fixture(scope="module")
+def workload():
+    return SyntheticWorkload(n_entities=32, seed=7, unknown_rate=0.1)
+
+
+def _runtime(workload, **kwargs):
+    cfg = RuntimeConfig(**{"max_batch_size": 8, "hot_entities": 8, **kwargs})
+    return ScoringRuntime(workload.model, workload.index_maps, cfg)
+
+
+def _rows(runtime, workload, n, start=0):
+    return [
+        runtime.parse_request(workload.request(i))
+        for i in range(start, start + n)
+    ]
+
+
+class TestRuntimeParity:
+    def test_batched_bit_identical_to_single_all_buckets(self, workload):
+        runtime = _runtime(workload)
+        rows = _rows(runtime, workload, runtime.buckets[-1])
+        # Reference: every row alone (bucket 1), BEFORE any batch has
+        # warmed the hot set.
+        reference = np.asarray(
+            [runtime.score_rows([r])[0][0] for r in rows], np.float32
+        )
+        for n in range(1, len(rows) + 1):
+            margins, means = runtime.score_rows(rows[:n])
+            assert margins.tobytes() == reference[:n].tobytes(), (
+                f"bucket for n={n} broke bit-parity"
+            )
+            # means are the margins through the task's inverse link,
+            # elementwise — same parity requirement.
+            assert means.shape == (n,)
+
+    def test_parity_unchanged_by_hot_cold_state(self, workload):
+        """The same row scores identically whether its entity comes from
+        the device hot table or the host cold gather."""
+        runtime = _runtime(workload, hot_entities=4)
+        row = runtime.parse_request(workload.request(1))
+        cold_score = runtime.score_rows([row])[0][0]  # cold: promotes
+        hot_score = runtime.score_rows([row])[0][0]  # now hot
+        assert np.float32(cold_score).tobytes() == \
+            np.float32(hot_score).tobytes()
+
+    def test_offset_and_unknown_entity(self, workload):
+        runtime = _runtime(workload)
+        req = workload.request(2)
+        req["ids"] = {"userId": "never-trained"}
+        base = runtime.score_rows([runtime.parse_request(req)])[0][0]
+        req2 = dict(req, offset=(req.get("offset") or 0.0) + 1.0)
+        shifted = runtime.score_rows([runtime.parse_request(req2)])[0][0]
+        assert shifted == pytest.approx(base + 1.0, abs=1e-6)
+        assert runtime.stats()["hot_sets"]["per_entity"][
+            "unknown_entities"] >= 1
+
+    def test_matches_batch_transformer(self, workload):
+        """Online margins agree with the batch GameTransformer (shared
+        kernels; float32 tolerance — dense jit reduce vs scipy matvec)."""
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.game.estimator import GameTransformer
+
+        runtime = _runtime(workload)
+        rows = _rows(runtime, workload, 8)
+        margins, _ = runtime.score_rows(rows)
+        shards = {
+            workload.fixed_shard: sp.csr_matrix(
+                np.stack([r.features[workload.fixed_shard] for r in rows])
+            ),
+            workload.re_shard: sp.csr_matrix(
+                np.stack([r.features[workload.re_shard] for r in rows])
+            ),
+        }
+        ids = {
+            workload.entity_key: np.asarray(
+                [r.ids.get(workload.entity_key) for r in rows], object
+            )
+        }
+        offsets = np.asarray([r.offset for r in rows], np.float32)
+        batch = GameTransformer(workload.model).transform(
+            shards, ids, offsets
+        )
+        np.testing.assert_allclose(margins, batch, rtol=1e-5, atol=1e-6)
+
+    def test_named_features_resolve_through_index_map(self, workload):
+        runtime = _runtime(workload)
+        dense_req = workload.request(3)
+        named_req = {
+            "features": {
+                workload.fixed_shard: [
+                    {"name": f"g{j}", "term": "", "value": v}
+                    for j, v in enumerate(
+                        dense_req["dense"][workload.fixed_shard]
+                    )
+                ] + [{"name": "UNSEEN", "term": "", "value": 99.0}],
+                workload.re_shard: [
+                    [f"r{j}", "", v]  # triple form
+                    for j, v in enumerate(
+                        dense_req["dense"][workload.re_shard]
+                    )
+                ],
+            },
+            "ids": dense_req["ids"],
+            "offset": dense_req["offset"],
+        }
+        a = runtime.score_rows([runtime.parse_request(dense_req)])[0][0]
+        b = runtime.score_rows([runtime.parse_request(named_req)])[0][0]
+        assert np.float32(a).tobytes() == np.float32(b).tobytes()
+
+    def test_parse_rejects_bad_input(self, workload):
+        runtime = _runtime(workload)
+        with pytest.raises(ValueError, match="unknown feature shard"):
+            runtime.parse_request({"dense": {"nope": [1.0]}})
+        with pytest.raises(ValueError, match="expects"):
+            runtime.parse_request(
+                {"dense": {workload.fixed_shard: [1.0, 2.0]}}
+            )
+        with pytest.raises(ValueError, match="exceeds max_batch_size"):
+            runtime.score_rows(
+                _rows(runtime, workload, runtime.buckets[-1] + 1)
+            )
+
+    def test_warmup_compiles_every_bucket(self, workload):
+        runtime = ScoringRuntime(
+            workload.model, workload.index_maps,
+            RuntimeConfig(max_batch_size=8, hot_entities=4, warmup=False),
+        )
+        assert runtime.warmup_compiles == 0
+        n = runtime.warm_up()
+        assert n == len(runtime.buckets) == 4  # [1, 2, 4, 8]
+        # Warm again: everything already compiled.
+        assert runtime.warm_up() == 0
+
+
+class TestHotSetLRU:
+    def test_eviction_and_refill(self, workload):
+        runtime = _runtime(workload, hot_entities=2)
+        hot = runtime.random[0].hot
+
+        def score_entity(i, ent):
+            req = workload.request(i)
+            req["ids"] = {workload.entity_key: ent}
+            return runtime.score_rows([runtime.parse_request(req)])[0][0]
+
+        s1 = score_entity(0, "u1")  # cold -> promote
+        score_entity(1, "u2")  # cold -> promote (table full)
+        assert hot.hot_keys() == ["u1", "u2"]
+        score_entity(2, "u1")  # hot hit, u1 becomes MRU
+        assert hot.hits == 1 and hot.hot_keys() == ["u2", "u1"]
+        score_entity(3, "u3")  # cold -> evicts LRU u2
+        assert hot.evictions == 1 and hot.hot_keys() == ["u1", "u3"]
+        # Refill: the evicted entity scores through the cold path again,
+        # bit-identically, and re-promotes.
+        s1_again = score_entity(0, "u1")
+        assert np.float32(s1).tobytes() == np.float32(s1_again).tobytes()
+        score_entity(4, "u2")
+        assert "u2" in hot.hot_keys() and hot.misses == 4
+
+    def test_zero_capacity_serves_cold_only(self, workload):
+        runtime = _runtime(workload, hot_entities=0)
+        ref = _runtime(workload, hot_entities=8)
+        rows = _rows(runtime, workload, 8)
+        a, _ = runtime.score_rows(rows)
+        b, _ = ref.score_rows(rows)
+        assert a.tobytes() == b.tobytes()
+        assert runtime.random[0].hot.size == 0
+
+
+class TestMicroBatcher:
+    def test_coalesces_concurrent_submissions(self, workload):
+        runtime = _runtime(workload)
+        batcher = MicroBatcher(runtime, BatcherConfig(
+            max_batch_size=8, max_wait_us=50_000, max_queue=64,
+        ))
+        rows = _rows(runtime, workload, 8)
+        reference = np.asarray(
+            [runtime.score_rows([r])[0][0] for r in rows], np.float32
+        )
+        # Enqueue everything BEFORE starting the dispatcher: the first
+        # pop must coalesce the rest into one batch deterministically.
+        futures = [batcher.submit(r) for r in rows]
+        batcher.start()
+        got = np.asarray(
+            [f.result(timeout=30)["score"] for f in futures], np.float32
+        )
+        batcher.stop()
+        assert got.tobytes() == reference.tobytes()
+        stats = batcher.stats()
+        assert stats["batches"] == 1 and stats["max_batch_rows"] == 8
+
+    def test_queue_full_rejection(self, workload):
+        runtime = _runtime(workload)
+        batcher = MicroBatcher(runtime, BatcherConfig(max_queue=3))
+        rows = _rows(runtime, workload, 4)
+        for r in rows[:3]:
+            batcher.submit(r)
+        with pytest.raises(RejectedError, match="UNAVAILABLE"):
+            batcher.submit(rows[3])
+        stats = batcher.stats()
+        assert stats["rejected"] == 1
+        # UNAVAILABLE is transient in the watchdog vocabulary: clients
+        # may retry with backoff.
+        assert stats["failed_transient"] == 1
+        batcher.start()
+        batcher.stop()  # drains the 3 queued rows before exiting
+
+    def test_deadline_timeout_classified_transient(self, workload):
+        runtime = _runtime(workload)
+        batcher = MicroBatcher(runtime, BatcherConfig())
+        row = _rows(runtime, workload, 1)[0]
+        fut = batcher.submit(row, timeout_ms=1.0)
+        time.sleep(0.02)  # deadline passes while the dispatcher is down
+        batcher.start()
+        with pytest.raises(DeadlineExceededError, match="DEADLINE_EXCEEDED"):
+            fut.result(timeout=30)
+        batcher.stop()
+        stats = batcher.stats()
+        assert stats["expired"] == 1 and stats["failed_transient"] == 1
+        from photon_ml_tpu.utils.watchdog import RetryPolicy
+
+        verdict = RetryPolicy().classify(fut.exception())
+        assert verdict.transient and verdict.matched == "DEADLINE_EXCEEDED"
+
+    def test_default_timeout_from_config(self, workload):
+        runtime = _runtime(workload)
+        batcher = MicroBatcher(
+            runtime, BatcherConfig(default_timeout_ms=1.0)
+        )
+        fut = batcher.submit(_rows(runtime, workload, 1)[0])
+        time.sleep(0.02)
+        batcher.start()
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=30)
+        batcher.stop()
+
+
+class TestScoringService:
+    def test_concurrent_clients_stress(self, workload):
+        runtime = _runtime(workload, max_batch_size=16)
+        n_clients, per_client = 8, 25
+        requests = [
+            workload.request(i) for i in range(n_clients * per_client)
+        ]
+        reference = np.asarray([
+            runtime.score_rows([runtime.parse_request(r)])[0][0]
+            for r in requests
+        ], np.float32)
+        service = ScoringService(runtime, BatcherConfig(
+            max_batch_size=16, max_wait_us=500, max_queue=512,
+        ))
+        results = np.zeros(len(requests), np.float32)
+        errors: list = []
+
+        def client(c):
+            for k in range(per_client):
+                i = c * per_client + k
+                try:
+                    results[i] = np.float32(
+                        service.score(requests[i], timeout=60)["score"]
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    errors.append((i, exc))
+
+        with service:
+            threads = [
+                threading.Thread(target=client, args=(c,))
+                for c in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert not errors
+        assert results.tobytes() == reference.tobytes()
+        stats = service.stats()
+        assert stats["batcher"]["completed"] == len(requests)
+
+    def test_score_many_reports_per_row_errors(self, workload):
+        runtime = _runtime(workload)
+        service = ScoringService(runtime)
+        good = workload.request(0)
+        bad = {"dense": {"nope": [1.0]}}
+        with service:
+            results = service.score_many([good, bad, good])
+        assert "score" in results[0] and "score" in results[2]
+        assert results[1]["kind"] == "bad_request"
+
+    def test_http_endpoint(self, workload):
+        runtime = _runtime(workload)
+        reference = [
+            float(runtime.score_rows(
+                [runtime.parse_request(workload.request(i))]
+            )[0][0])
+            for i in range(3)
+        ]
+        service = ScoringService(runtime)
+        with service:
+            server, _ = start_http_server(service, port=0)
+            port = server.server_address[1]
+            try:
+                body = json.dumps(
+                    {"rows": [workload.request(i) for i in range(3)]}
+                ).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/score", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    assert resp.status == 200
+                    results = json.loads(resp.read())["results"]
+                got = [np.float32(r["score"]) for r in results]
+                assert got == [np.float32(r) for r in reference]
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10
+                ) as resp:
+                    health = json.loads(resp.read())
+                    assert health["status"] == "ok"
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/stats", timeout=10
+                ) as resp:
+                    stats = json.loads(resp.read())
+                    assert stats["batcher"]["completed"] >= 3
+                # Bad request -> 400 with a JSON error body.
+                bad = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/score",
+                    data=json.dumps(
+                        {"rows": [{"dense": {"nope": [1]}}]}
+                    ).encode(),
+                )
+                try:
+                    urllib.request.urlopen(bad, timeout=10)
+                    raise AssertionError("expected HTTP 400")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 400
+                    assert json.loads(e.read())["results"][0]["kind"] == \
+                        "bad_request"
+            finally:
+                server.shutdown()
+                server.server_close()
+
+    def test_glm_model_serves(self):
+        from photon_ml_tpu.models.glm import (
+            Coefficients,
+            GeneralizedLinearModel,
+        )
+
+        rng = np.random.default_rng(5)
+        w = rng.normal(size=6).astype(np.float32)
+        glm = GeneralizedLinearModel(Coefficients(means=w), "logistic")
+        runtime = ScoringRuntime.from_glm_model(
+            glm, shard="features",
+            config=RuntimeConfig(max_batch_size=4, hot_entities=0),
+        )
+        x = rng.normal(size=6).astype(np.float32)
+        margins, means = runtime.score_rows([runtime.parse_request(
+            {"dense": {"features": x.tolist()}}
+        )])
+        assert margins[0] == pytest.approx(float(np.sum(x * w)), rel=1e-5)
+        assert 0.0 < means[0] < 1.0  # sigmoid of the margin
+
+
+class TestSelfcheckAndLoadGen:
+    def test_selfcheck_passes(self, tmp_path):
+        from photon_ml_tpu.serving.__main__ import run_selfcheck
+
+        failures = run_selfcheck(str(tmp_path))
+        assert failures == []
+        with open(tmp_path / "metrics.json") as f:
+            snap = json.load(f)
+        assert snap["histograms"]["serving_request_latency_seconds"][
+            "count"] >= 24
+        assert snap["gauges"]["serving_batch_occupancy"] > 0
+
+    @pytest.mark.slow
+    def test_closed_loop_loadgen(self, workload):
+        from photon_ml_tpu.serving import loadgen
+
+        runtime = _runtime(workload, max_batch_size=16)
+        service = ScoringService(runtime, BatcherConfig(
+            max_batch_size=16, max_wait_us=200, max_queue=256,
+        ))
+        with service:
+            report = loadgen.closed_loop(
+                service.submit, workload.request,
+                clients=4, duration_s=1.0,
+            )
+        snap = report.snapshot()
+        assert report.completed > 0 and report.errors == 0
+        assert snap["latency_p99_ms"] >= snap["latency_p50_ms"] > 0
+
+    @pytest.mark.slow
+    def test_open_loop_loadgen(self, workload):
+        from photon_ml_tpu.serving import loadgen
+
+        runtime = _runtime(workload, max_batch_size=16)
+        service = ScoringService(runtime, BatcherConfig(
+            max_batch_size=16, max_wait_us=200, max_queue=256,
+        ))
+        with service:
+            report = loadgen.open_loop(
+                service.submit, workload.request,
+                rate_rps=100.0, duration_s=1.0,
+            )
+        assert report.completed > 0 and report.errors == 0
