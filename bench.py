@@ -34,7 +34,7 @@ the primary metric in the required fields, the other metrics under "extra"
 with their own vs_baseline ratios.
 
 Env knobs: BENCH_SMALL=1 shrinks every workload (CI/smoke); BENCH_ONLY=
-glm|game|driver|stream|serving runs a single section.
+glm|game|driver|stream|serving|tuning runs a single section.
 """
 
 import json
@@ -798,6 +798,82 @@ def bench_serving() -> dict:
     }
 
 
+def bench_tuning() -> dict:
+    """Tuning orchestrator (PR 4): sequential vs parallel-4 wall clock of
+    the SAME synthetic GLM λ sweep (GridProposer over a fixed λ path, so
+    both runs fit the identical trial set), plus best-metric parity.
+    λ-path warm starts stay ON — parity within 1e-6 is the acceptance
+    bar: the L2 problem is strictly convex, so different warm-start
+    availability under parallel scheduling must not move the selected
+    optimum beyond solver tolerance."""
+    import tempfile as _tf
+
+    from photon_ml_tpu.drivers.glm_driver import make_fit_once
+    from photon_ml_tpu.tuning.executor import (
+        TuningConfig,
+        TuningOrchestrator,
+    )
+    from photon_ml_tpu.tuning.scheduler import GridProposer, SearchSpace
+    from photon_ml_tpu.tuning.state import TuningJournal
+
+    n_rows = 20_000 if SMALL else 120_000
+    d = 256
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(n_rows, d)).astype(np.float32)
+    w_true = (
+        rng.normal(size=d) * (rng.uniform(size=d) < 0.3)
+    ).astype(np.float32)
+    y = (
+        rng.uniform(size=n_rows) < 1.0 / (1.0 + np.exp(-(X @ w_true)))
+    ).astype(np.float32)
+    split = int(n_rows * 0.8)
+    lambdas = np.geomspace(1e-4, 1e2, 8)
+    _log(f"tuning: {split} train rows x {d} features, "
+         f"{len(lambdas)}-point λ sweep...")
+    fit_once = make_fit_once(
+        X[:split], y[:split], X[split:], y[split:],
+        task="logistic", reg_type="l2", max_iters=60, tolerance=1e-8,
+    )
+    fit_once(np.array([1.0]), 0, None)  # compile outside the timing
+
+    space = SearchSpace.create([(1e-5, 1e3)], log_scale=True,
+                               names=["lambda"])
+
+    def sweep(workers: int) -> tuple:
+        with _tf.TemporaryDirectory(prefix="bench_tuning_") as td:
+            journal = TuningJournal(td, fsync=False)
+            cfg = TuningConfig(
+                max_trials=len(lambdas), workers=workers,
+                maximize=fit_once.larger_is_better,
+            )
+            t0 = time.perf_counter()
+            result = TuningOrchestrator(
+                space, fit_once,
+                GridProposer(space, [[lam] for lam in lambdas]),
+                cfg, journal,
+            ).run()
+            wall = time.perf_counter() - t0
+            journal.close()
+        return result, wall
+
+    seq, seq_wall = sweep(1)
+    par, par_wall = sweep(4)
+    delta = abs(seq.best_metric - par.best_metric)
+    _log(f"tuning: sequential {seq_wall:.2f}s vs parallel-4 "
+         f"{par_wall:.2f}s ({seq_wall / par_wall:.2f}x), best metric "
+         f"{seq.best_metric:.6f} vs {par.best_metric:.6f} "
+         f"(delta {delta:.2e})")
+    return {
+        "tuning_seq_seconds": round(seq_wall, 3),
+        "tuning_par4_seconds": round(par_wall, 3),
+        "tuning_speedup": round(seq_wall / par_wall, 3),
+        "tuning_best_lambda": seq.best_params[0],
+        "tuning_best_metric_delta": delta,
+        "tuning_parity_ok": bool(delta <= 1e-6),
+        "tuning_trials": seq.n_trials,
+    }
+
+
 def main() -> None:
     # Sink-less but ENABLED telemetry hub: the streamed/ooc sections'
     # prefetch pipelines feed their TransferStats into its registry
@@ -898,6 +974,11 @@ def main() -> None:
             extra.update(bench_serving())
         except Exception as e:  # new section: never sink the headline
             extra["serving_throughput_rps"] = f"failed: {e}"
+    if ONLY in ("", "tuning"):
+        try:
+            extra.update(bench_tuning())
+        except Exception as e:  # new section: never sink the headline
+            extra["tuning_seq_seconds"] = f"failed: {e}"
     out = {
         "metric": "logistic_glm_rows_per_sec",
         "unit": "rows/s",

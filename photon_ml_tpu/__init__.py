@@ -24,6 +24,10 @@ Mixed Effects) models.  This package rebuilds those capabilities TPU-first:
                    grouped (per-query) evaluators.
 - ``hyperparameter`` — random search and Gaussian-process (Matérn + EI)
                    Bayesian search over regularization weights.
+- ``tuning``     — trial orchestration over ``hyperparameter``: parallel
+                   trials on a worker pool, constant-liar batched GP asks,
+                   ASHA successive halving, warm starts, and a journaled
+                   crash-safe ``--resume`` (``python -m photon_ml_tpu.tuning``).
 - ``drivers``    — end-to-end CLI drivers mirroring the reference's
                    ``Driver`` (legacy GLM), ``GameTrainingDriver``,
                    ``GameScoringDriver``, ``FeatureIndexingDriver``.

@@ -151,6 +151,106 @@ def parse_coordinate_config(spec: dict):
     raise ValueError(f"unknown coordinate type {spec['type']!r}")
 
 
+def make_fit_once(
+    task: str,
+    coordinate_configs: dict,
+    shards: dict,
+    ids: dict,
+    response,
+    validation,
+    *,
+    weight=None,
+    offset=None,
+    suite=None,
+    mesh=None,
+    device_metrics: bool = False,
+):
+    """Reusable single-fit entry for the tuning orchestrator
+    (photon_ml_tpu/tuning/): ``fit_once(params, resource, warm_start) ->
+    (metric, metrics, None)``.
+
+    ``params`` carries one regularization weight per coordinate (in
+    ``coordinate_configs`` order) and ``resource`` the number of CD
+    iterations (an ASHA rung's budget; 0 uses the config count of 1).
+    ``warm_start`` is accepted but unused — GAME coordinate state does
+    not warm-start across trials; ASHA's cross-rung refits are whole
+    fits at a larger iteration budget.
+
+    Trials mutate per-coordinate ``reg_weight`` (a traced argument), so
+    one coordinate build serves MANY trials — but never two in-flight
+    trials at once: coordinates carry mutable per-fit state.  Builds
+    live in a checkout pool per iteration budget, so the number of
+    builds is bounded by the executor's peak concurrency (not
+    trials × rungs) and builds are reused across searches sharing this
+    ``fit_once``.
+    """
+    import threading
+
+    import dataclasses as _dc
+
+    from photon_ml_tpu.evaluation.suite import EvaluationSuite
+
+    if suite is None:
+        suite = EvaluationSuite.for_task(losses_lib.get(task).name)
+    evaluator = suite.primary_evaluator
+    names = list(coordinate_configs)
+    # Never pay the coefficient-variance finalize cost per tuning point
+    # (same policy as this driver's built-in tuning mode).
+    base_configs = {
+        nm: _dc.replace(
+            cfg,
+            optimization=_dc.replace(
+                cfg.optimization, compute_variances=False
+            ),
+        )
+        for nm, cfg in coordinate_configs.items()
+    }
+    v_shards, v_ids, v_resp, v_weight, v_offset = validation[:5]
+    v_groups = (
+        np.asarray(v_ids[suite.group_column])
+        if suite.group_column is not None
+        else None
+    )
+    pools: dict[int, list] = {}
+    pool_lock = threading.Lock()
+
+    def _checkout(resource: int):
+        n_iter = int(resource) if resource else 1
+        with pool_lock:
+            free = pools.setdefault(n_iter, [])
+            if free:
+                return n_iter, free.pop()
+        est = GameEstimator(
+            task, base_configs, n_iterations=n_iter, mesh=mesh,
+            device_metrics=device_metrics,
+        )
+        coords = est.build_coordinates(shards, ids, response, weight, offset)
+        return n_iter, (est, coords)
+
+    def fit_once(params, resource=0, warm_start=None):
+        n_iter, inst = _checkout(resource)
+        try:
+            est, coords = inst
+            for coord, xi in zip(coords, np.asarray(params, float).ravel()):
+                coord.reg_weight = float(xi)
+            model, _ = est.fit_coordinates(
+                coords, response, weight, offset, evaluator
+            )
+        finally:
+            with pool_lock:
+                pools[n_iter].append(inst)
+        scores = GameTransformer(model).transform(v_shards, v_ids, v_offset)
+        metric, all_metrics = suite.evaluate_primary(
+            scores, v_resp, v_weight, group_ids=v_groups
+        )
+        return metric, all_metrics, None
+
+    fit_once.suite = suite
+    fit_once.larger_is_better = evaluator.larger_is_better
+    fit_once.names = names
+    return fit_once
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="game_training_driver", description="TPU-native GAME training"

@@ -204,6 +204,93 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return p
 
 
+def make_fit_once(
+    X_train,
+    y_train,
+    X_val,
+    y_val,
+    *,
+    task: str = "logistic",
+    reg_type: str = "l2",
+    elastic_net_alpha: float = 0.5,
+    optimizer: str = "lbfgs",
+    max_iters: int = 100,
+    tolerance: float = 1e-8,
+    suite=None,
+    val_weights=None,
+):
+    """Reusable single-fit entry for the tuning orchestrator
+    (photon_ml_tpu/tuning/): ``fit_once(params, resource, warm_start) ->
+    (metric, metrics, coefficients)``.
+
+    ``params[0]`` is the regularization weight λ.  ``resource`` > 0 caps
+    the optimizer's iteration budget (an ASHA rung's resource; 0 uses
+    ``max_iters``), and ``warm_start`` seeds the solve — the executor
+    chains a promoted trial from its own previous rung and a fresh trial
+    from the nearest completed λ's coefficients, the λ-path warm-start
+    pattern this driver's own grid loop uses.  Data uploads once; every
+    trial at one rung level shares one compiled solver (λ, w0 are traced
+    arguments), so a parallel sweep adds no recompiles.
+
+    Exposes ``fit_once.suite`` and ``fit_once.larger_is_better`` so
+    callers wire the orchestrator's direction without re-deriving it.
+    """
+    import threading
+
+    from photon_ml_tpu.evaluation.suite import EvaluationSuite
+
+    if suite is None:
+        from photon_ml_tpu.ops import losses as losses_lib
+
+        suite = EvaluationSuite.for_task(losses_lib.get(task).name)
+    data = make_glm_data(X_train, y_train)
+    y_val = np.asarray(y_val)
+    problems: dict[int, GlmOptimizationProblem] = {}
+    lock = threading.Lock()
+
+    def _problem(iters: int) -> GlmOptimizationProblem:
+        # One problem (= one jitted solver) per distinct iteration
+        # budget, shared across trials and threads.
+        with lock:
+            p = problems.get(iters)
+            if p is None:
+                p = problems[iters] = GlmOptimizationProblem(
+                    task,
+                    GlmOptimizationConfig(
+                        optimizer=OptimizerConfig(
+                            optimizer=OptimizerType(optimizer),
+                            max_iters=iters,
+                            tolerance=tolerance,
+                        ),
+                        regularization=RegularizationContext(
+                            RegularizationType(reg_type), elastic_net_alpha
+                        ),
+                    ),
+                )
+            return p
+
+    def fit_once(params, resource=0, warm_start=None):
+        problem = _problem(int(resource) if resource else max_iters)
+        w0 = (
+            None
+            if warm_start is None
+            else jnp.asarray(np.asarray(warm_start, np.float32))
+        )
+        res = problem.solve_single_device(
+            data, reg_weight=float(np.asarray(params).ravel()[0]), w0=w0
+        )
+        w = np.asarray(res.w, np.float32)
+        scores = np.asarray(X_val @ w).ravel()
+        metric, all_metrics = suite.evaluate_primary(
+            scores, y_val, val_weights
+        )
+        return metric, all_metrics, w
+
+    fit_once.suite = suite
+    fit_once.larger_is_better = suite.primary_evaluator.larger_is_better
+    return fit_once
+
+
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
     # x64 is process-global jax state; restore it afterwards so one

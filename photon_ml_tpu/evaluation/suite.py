@@ -96,6 +96,23 @@ class EvaluationSuite:
                 for name, ev in self.evaluators
             }
 
+    def evaluate_primary(
+        self,
+        scores: np.ndarray,
+        labels: np.ndarray,
+        weights: Optional[np.ndarray] = None,
+        group_ids: Optional[np.ndarray] = None,
+    ) -> tuple[float, dict]:
+        """(primary metric, full name→value dict) from one score pass.
+
+        The tuning orchestrator's per-rung reporting contract
+        (tuning/executor.py): ASHA promotes/kills on the PRIMARY metric
+        while the journal's rung reports carry the whole suite, so a
+        finished search can be audited on every configured metric, not
+        just the one that drove the decisions."""
+        values = self.evaluate(scores, labels, weights, group_ids)
+        return values[self.primary], values
+
     def evaluate_device(
         self,
         scores,

@@ -83,13 +83,37 @@ def _matern52(X1: np.ndarray, X2: np.ndarray, length_scale: float) -> np.ndarray
     return (1.0 + s + s**2 / 3.0) * np.exp(-s)
 
 
+# Escalating diagonal jitter for a non-PD Gram matrix.  Repeated or
+# near-repeated observed points (an ASHA sweep re-proposing a killed
+# trial's region, a λ path with clustered weights) make the Matérn Gram
+# numerically singular at tiny noise levels; each retry adds 100x more
+# jitter before giving up.  The first rung (0.0) is the exact matrix.
+_JITTER_LADDER = (0.0, 1e-10, 1e-8, 1e-6, 1e-4)
+
+
+def _chol_with_jitter(K: np.ndarray) -> np.ndarray:
+    for jitter in _JITTER_LADDER:
+        try:
+            if jitter:
+                K = K.copy()
+                K[np.diag_indices_from(K)] += jitter
+            return np.linalg.cholesky(K)
+        except np.linalg.LinAlgError:
+            continue
+    raise np.linalg.LinAlgError(
+        "GP Gram matrix is not positive definite even with "
+        f"{_JITTER_LADDER[-1]:g} diagonal jitter — observed points are "
+        "degenerate (all identical?)"
+    )
+
+
 def _chol_lml(
     X: np.ndarray, y: np.ndarray, length_scale: float, noise: float
 ) -> tuple[np.ndarray, np.ndarray, float]:
     """Cholesky + α + log marginal likelihood for one (ℓ, σ²) setting."""
     K = _matern52(X, X, length_scale)
     K[np.diag_indices_from(K)] += noise
-    L = np.linalg.cholesky(K)
+    L = _chol_with_jitter(K)
     alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
     lml = (
         -0.5 * float(y @ alpha)
@@ -97,6 +121,32 @@ def _chol_lml(
         - 0.5 * len(y) * np.log(2.0 * np.pi)
     )
     return L, alpha, lml
+
+
+def _deduplicate(
+    X: np.ndarray, y: np.ndarray, tol: float = 1e-9
+) -> tuple[np.ndarray, np.ndarray]:
+    """Merge (near-)repeated rows of X, averaging their targets.
+
+    Two evaluations of the same point (priors fed back in, a proposer
+    re-asking a boundary point) put two identical rows in the Gram
+    matrix — exactly rank-deficient before noise.  Points within ``tol``
+    Euclidean distance (inputs are normalized to [0,1]^d) collapse to
+    their first representative with the mean target; N is tens, so the
+    O(N²) scan is free next to one objective evaluation."""
+    keep: list[int] = []
+    groups: list[list[int]] = []
+    for i in range(len(X)):
+        for gi, k in enumerate(keep):
+            if np.sum((X[i] - X[k]) ** 2) <= tol * tol:
+                groups[gi].append(i)
+                break
+        else:
+            keep.append(i)
+            groups.append([i])
+    if len(keep) == len(X):
+        return X, y
+    return X[keep], np.array([float(np.mean(y[g])) for g in groups])
 
 
 # Hyperparameter grids for type-II maximum likelihood: inputs are
@@ -127,7 +177,10 @@ class GaussianProcessModel:
         self._X: Optional[np.ndarray] = None
 
     def fit(self, X: np.ndarray, y: np.ndarray) -> "GaussianProcessModel":
-        self._X = np.atleast_2d(X)
+        # De-duplicate BEFORE standardization: repeated rows make the
+        # Gram matrix exactly singular, and the jitter ladder in
+        # _chol_lml should be the fallback, not the steady state.
+        self._X, y = _deduplicate(np.atleast_2d(X), np.asarray(y, float))
         self._y_mean = float(np.mean(y))
         self._y_std = float(np.std(y)) or 1.0
         self._y = (np.asarray(y, float) - self._y_mean) / self._y_std

@@ -34,10 +34,22 @@ import numpy as np
 from photon_ml_tpu import telemetry as telemetry_mod
 
 
+def fsync_file(f) -> None:
+    """Flush + fsync an open file object: the durability barrier every
+    crash-safe writer in this package shares (checkpoints here, the
+    tuning journal's per-record appends — tuning/state.py)."""
+    f.flush()
+    os.fsync(f.fileno())
+
+
 def _atomic_savez(path: str, arrays: dict) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
+        # fsync BEFORE the rename: os.replace is atomic in the namespace
+        # but not a data barrier — a power cut after the rename could
+        # otherwise leave a complete-looking checkpoint with torn bytes.
+        fsync_file(f)
     os.replace(tmp, path)
 
 

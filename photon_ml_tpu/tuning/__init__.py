@@ -1,0 +1,36 @@
+"""Tuning orchestration: parallel trials, ASHA, crash-safe resumable search.
+
+The production layer over photon_ml_tpu/hyperparameter/search.py (the
+reference's ``ml.hyperparameter`` package): ask/tell proposers with
+constant-liar GP batching and an ASHA successive-halving scheduler
+(tuning/scheduler.py), a bounded-concurrency trial executor with λ-path
+warm starts and watchdog-classified crash handling
+(tuning/executor.py), and an fsync'd append-only decision journal that
+makes ``--resume`` replay a killed search bit-identically
+(tuning/state.py).  ``python -m photon_ml_tpu.tuning`` is the CLI over
+the GLM and GAME drivers; docs/tuning.md is the guide.
+"""
+
+from photon_ml_tpu.tuning.executor import (  # noqa: F401
+    TrialReport,
+    TuningConfig,
+    TuningOrchestrator,
+    TuningResult,
+)
+from photon_ml_tpu.tuning.scheduler import (  # noqa: F401
+    AshaConfig,
+    AshaScheduler,
+    GPProposer,
+    GridProposer,
+    Proposer,
+    RandomProposer,
+    SearchSpace,
+    make_proposer,
+)
+from photon_ml_tpu.tuning.state import (  # noqa: F401
+    ResumeMismatch,
+    SearchAborted,
+    TrialStore,
+    TuningJournal,
+    replay_journal,
+)

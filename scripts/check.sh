@@ -20,11 +20,19 @@ python -m photon_ml_tpu.telemetry --selfcheck
 echo "== serving selfcheck (JAX_PLATFORMS=cpu) =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.serving --selfcheck
 
+# The tuning selfcheck runs a parallel ASHA+GP search on a synthetic
+# GAME workload, kills it mid-flight, resumes from tuning_state.jsonl,
+# and asserts the resumed trial history + journal decision sequence are
+# identical to an uninterrupted run (plus executor crash/retry paths
+# and the tuning telemetry contract).
+echo "== tuning selfcheck (JAX_PLATFORMS=cpu) =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.tuning --selfcheck
+
 echo "== tier-1 tests (JAX_PLATFORMS=cpu) =="
 if [[ "${1:-}" == "--fast" ]]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_watchdog.py \
-    tests/test_serving.py -m 'not slow' \
+    tests/test_serving.py tests/test_tuning.py -m 'not slow' \
     -q -p no:cacheprovider
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
