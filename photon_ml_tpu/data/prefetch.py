@@ -3,24 +3,45 @@
 The synchronous pattern (``device_put`` then step, inline in the consume
 loop) leaves every host-side cost — packing, slicing, dispatch syscalls,
 multihost local-block assembly — on the critical path between two device
-programs.  This module moves all of it onto a producer thread:
+programs.  This module moves all of it off that path, as a three-stage
+software pipeline (the classic latency-hiding shape from the TPU
+performance literature — double buffering generalized to a bounded
+window):
 
-    producer thread:  get_item(k) → put(item) → [transfer timed] → queue
+    pack thread:      get_item(k) ──bounded hand-off queue──►
+    transfer thread:  put(item) → [transfer timed to completion] ──►
     caller thread:    queue → consume(k, dev) → release permit
+
+Pack and transfer are SEPARATE threads: chunk k+1's host-side
+materialization (staging-buffer stacking, memmap paging, multihost
+assembly) runs while chunk k's bytes are still crossing the link — the
+transfer thread, not the packer, waits on the transferred array's
+readiness, so the link and the host-side copy machinery stay busy
+simultaneously.  A bounded hand-off queue (``depth`` items) keeps the
+packer from running arbitrarily ahead of the link (host RAM for packed
+items stays O(depth)).
 
 A semaphore of ``depth`` permits bounds how many device items are live
 (transferred or transferring, not yet consumed): ``depth=2`` is the
 classic double buffer (chunk k+1 moves while chunk k computes, ≤2 chunks
-in HBM), ``depth=1`` degrades to fully-serial transfer/compute (the
+in HBM), ``depth=1`` degrades to serial transfer/compute (the
 measurement baseline), larger depths absorb jittery transports.  A
 permit is released only after ``consume`` returns — consumers that sync
-on their result (the streamed accumulators block on the carry) therefore
-bound actual HBM residency, not just Python references.
+on their results bound actual HBM residency, not just Python references
+(the streamed accumulators sync on a bounded window of carries:
+optim/streaming.py).
 
-Every transfer is timed to completion on the producer thread, so
+Every transfer is timed to completion on the transfer thread, so
 :class:`TransferStats` reports ACHIEVED bytes/second, not dispatch rate
 — the distinction that made round 1's throughput numbers wrong (see
-ops/README.md "Measurement discipline").  Stall counters tell the two
+ops/README.md "Measurement discipline").  The stats attribute wall time
+to STAGES so a regression names the guilty one: ``pack_seconds`` (host
+materialization), ``dispatch_seconds`` (the ``put`` call itself, i.e.
+Python/runtime dispatch — a subset of ``h2d_seconds``), ``h2d_seconds``
+(dispatch through transfer completion) and ``consume_seconds`` (the
+caller's per-item compute dispatch + syncs).  When the pipeline
+overlaps, the summed stage seconds EXCEED the pass's wall time — the
+signature bench_streaming checks for.  Stall counters tell the two
 failure stories apart: ``consumer_stalls`` (compute waited on the
 queue: the stream is ingest-bound — the 150× gap's signature) vs
 ``producer_stalls`` (transfers waited on compute: the link is keeping
@@ -45,12 +66,16 @@ class TransferStats:
     """Cumulative host→device transfer observability for one stream.
 
     Aggregated across passes (``reset()`` between measurement windows);
-    ``gbps``/``chunk_seconds`` derive the headline rates.
+    ``gbps``/``chunk_seconds`` derive the headline rates and the
+    ``*_seconds`` fields attribute wall time per pipeline stage.
     """
 
     chunks: int = 0  # transfers completed
     bytes: int = 0  # host bytes moved
+    pack_seconds: float = 0.0  # summed get_item wall (pack stage)
+    dispatch_seconds: float = 0.0  # summed put() call wall (⊂ h2d_seconds)
     h2d_seconds: float = 0.0  # summed per-transfer wall time (to completion)
+    consume_seconds: float = 0.0  # summed consume() wall (compute stage)
     producer_stalls: int = 0  # transfer waited for a free permit (healthy)
     producer_stall_seconds: float = 0.0
     consumer_stalls: int = 0  # compute waited for a transfer (ingest-bound)
@@ -70,10 +95,20 @@ class TransferStats:
         """Mean per-chunk transfer wall time."""
         return self.h2d_seconds / self.chunks if self.chunks else 0.0
 
+    @property
+    def stage_seconds(self) -> float:
+        """Summed wall across the three pipeline stages (pack + transfer
+        + compute).  When this exceeds a pass's wall-clock time, the
+        stages overlapped — the structural witness bench_streaming
+        reports.  ``dispatch_seconds`` is a subset of ``h2d_seconds``
+        and is NOT double-counted here."""
+        return self.pack_seconds + self.h2d_seconds + self.consume_seconds
+
     def snapshot(self) -> dict:
         d = dataclasses.asdict(self)
         d["gbps"] = self.gbps
         d["chunk_seconds"] = self.chunk_seconds
+        d["stage_seconds"] = self.stage_seconds
         return d
 
     def reset(self) -> None:
@@ -98,13 +133,20 @@ def _publish_pass(stats: TransferStats, before: tuple, run_max: int) -> None:
     tel = telemetry_mod.current()
     if not tel.enabled:
         return
-    bytes0, h2d0, chunks0, cs0, css0, ps0, pss0 = before
+    (bytes0, h2d0, chunks0, cs0, css0, ps0, pss0,
+     pack0, disp0, cons0) = before
     d_bytes = stats.bytes - bytes0
     d_h2d = stats.h2d_seconds - h2d0
     d_chunks = stats.chunks - chunks0
+    d_pack = stats.pack_seconds - pack0
+    d_disp = stats.dispatch_seconds - disp0
+    d_cons = stats.consume_seconds - cons0
     tel.counter("h2d_bytes_total").inc(d_bytes)
     tel.counter("h2d_chunks_total").inc(d_chunks)
     tel.counter("h2d_seconds").inc(d_h2d)
+    tel.counter("prefetch_pack_seconds").inc(d_pack)
+    tel.counter("prefetch_dispatch_seconds").inc(d_disp)
+    tel.counter("prefetch_consume_seconds").inc(d_cons)
     tel.counter("consumer_stalls").inc(stats.consumer_stalls - cs0)
     tel.counter("consumer_stall_seconds").inc(
         stats.consumer_stall_seconds - css0
@@ -118,12 +160,18 @@ def _publish_pass(stats: TransferStats, before: tuple, run_max: int) -> None:
         tel.gauge("h2d_gbps").set(d_bytes / d_h2d / 1e9)
     if d_chunks > 0:
         tel.gauge("h2d_chunk_seconds").set(d_h2d / d_chunks)
+        tel.gauge("prefetch_pack_chunk_seconds").set(d_pack / d_chunks)
+        tel.gauge("prefetch_dispatch_chunk_seconds").set(d_disp / d_chunks)
+        tel.gauge("prefetch_consume_chunk_seconds").set(d_cons / d_chunks)
     tel.gauge("prefetch_max_live").set(run_max)
     tel.event(
         "prefetch.pass",
         chunks=d_chunks,
         bytes=d_bytes,
         h2d_seconds=round(d_h2d, 6),
+        pack_seconds=round(d_pack, 6),
+        dispatch_seconds=round(d_disp, 6),
+        consume_seconds=round(d_cons, 6),
         consumer_stalls=stats.consumer_stalls - cs0,
         producer_stalls=stats.producer_stalls - ps0,
         max_live=run_max,
@@ -138,20 +186,22 @@ def run_prefetched(
     depth: int = 2,
     stats: TransferStats | None = None,
 ) -> int:
-    """Stream ``n_items`` through a bounded-depth transfer pipeline.
+    """Stream ``n_items`` through a bounded-depth three-stage pipeline.
 
-    ``get_item(k)`` (producer thread) materializes the host item — any
-    packing/slicing cost overlaps device compute here.  ``put(item)``
-    (producer thread) dispatches it to the device; the pipeline blocks
-    the producer until the transfer completes, both for honest timing
-    and so ``depth`` bounds bytes in flight.  ``consume(k, dev)``
-    (caller thread) runs the item's compute; items arrive strictly in
-    order.  Returns this run's high-water of live device items (≤
-    ``depth`` by construction).
+    ``get_item(k)`` (pack thread) materializes the host item — packing,
+    slicing, stacking, memmap paging all overlap BOTH the link and
+    device compute here.  ``put(item)`` (transfer thread) dispatches it
+    to the device; the transfer thread — never the packer — waits for
+    the transfer to complete, both for honest timing and so ``depth``
+    bounds bytes in flight.  ``consume(k, dev)`` (caller thread) runs
+    the item's compute; items arrive strictly in order.  Returns this
+    run's high-water of live device items (≤ ``depth`` by construction).
 
-    Producer exceptions re-raise on the caller thread at the failed
-    item's position; a consumer exception aborts the producer promptly
-    (its permit wait polls an abort flag).
+    Pack/transfer/consume wall times land in ``stats`` per stage (see
+    :class:`TransferStats`).  Pack or transfer exceptions re-raise on
+    the caller thread at the failed item's position; a consumer
+    exception aborts both background threads promptly (their blocking
+    waits poll an abort flag).
     """
     if depth < 1:
         raise ValueError(f"prefetch depth must be >= 1, got {depth}")
@@ -164,8 +214,10 @@ def run_prefetched(
         stats.bytes, stats.h2d_seconds, stats.chunks,
         stats.consumer_stalls, stats.consumer_stall_seconds,
         stats.producer_stalls, stats.producer_stall_seconds,
+        stats.pack_seconds, stats.dispatch_seconds, stats.consume_seconds,
     )
 
+    handoff: queue.Queue = queue.Queue(maxsize=depth)
     q: queue.Queue = queue.Queue()
     permits = threading.Semaphore(depth)
     abort = threading.Event()
@@ -179,9 +231,59 @@ def run_prefetched(
             live += delta
             run_max = max(run_max, live)
 
-    def _producer() -> None:
+    def _handoff_put(item) -> bool:
+        while not abort.is_set():
+            try:
+                handoff.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                pass
+        return False
+
+    def _packer() -> None:
+        # Stage 1: host materialization only — no device calls, so a slow
+        # pack never gates the link and a slow link never gates the pack
+        # (up to the hand-off bound).
         try:
             for k in range(n_items):
+                if abort.is_set():
+                    return
+                t0 = time.perf_counter()
+                host = get_item(k)
+                stats.pack_seconds += time.perf_counter() - t0
+                nbytes = sum(
+                    leaf.nbytes
+                    for leaf in jax.tree_util.tree_leaves(host)
+                    if hasattr(leaf, "nbytes")
+                )
+                if not _handoff_put((k, host, nbytes)):
+                    return
+                del host
+        except BaseException as exc:  # surfaced on the caller thread
+            # In order: the failure rides the hand-off queue behind the
+            # items that packed successfully, so the consumer sees items
+            # 0..k-1 and then the exception at position k.
+            _handoff_put(_ProducerFailure(exc))
+
+    def _transfer() -> None:
+        # Stage 2: device dispatch + transfer completion.  Timing waits
+        # on the transferred arrays' readiness happen HERE, where they
+        # block nobody but the (already link-bound) transfer stream.
+        try:
+            for _ in range(n_items):
+                item = None
+                while not abort.is_set():
+                    try:
+                        item = handoff.get(timeout=0.05)
+                        break
+                    except queue.Empty:
+                        pass
+                if item is None:
+                    return
+                if isinstance(item, _ProducerFailure):
+                    q.put(item)
+                    return
+                k, host, nbytes = item
                 if not permits.acquire(blocking=False):
                     t0 = time.perf_counter()
                     while not permits.acquire(timeout=0.05):
@@ -193,14 +295,9 @@ def run_prefetched(
                     )
                 if abort.is_set():
                     return
-                host = get_item(k)
-                nbytes = sum(
-                    leaf.nbytes
-                    for leaf in jax.tree_util.tree_leaves(host)
-                    if hasattr(leaf, "nbytes")
-                )
                 t0 = time.perf_counter()
                 dev = put(host)
+                stats.dispatch_seconds += time.perf_counter() - t0
                 for leaf in jax.tree_util.tree_leaves(dev):
                     if hasattr(leaf, "block_until_ready"):
                         leaf.block_until_ready()
@@ -209,14 +306,16 @@ def run_prefetched(
                 stats.chunks += 1
                 _bump(+1)
                 q.put((k, dev))
-                del dev, host
+                del dev, host, item
         except BaseException as exc:  # surfaced on the caller thread
             q.put(_ProducerFailure(exc))
 
-    producer = threading.Thread(
-        target=_producer, name="h2d-prefetch", daemon=True
+    packer = threading.Thread(target=_packer, name="h2d-pack", daemon=True)
+    transfer = threading.Thread(
+        target=_transfer, name="h2d-prefetch", daemon=True
     )
-    producer.start()
+    packer.start()
+    transfer.start()
     try:
         for _ in range(n_items):
             if q.empty():
@@ -229,7 +328,9 @@ def run_prefetched(
             if isinstance(item, _ProducerFailure):
                 raise item.exc
             k, dev = item
+            t0 = time.perf_counter()
             consume(k, dev)
+            stats.consume_seconds += time.perf_counter() - t0
             # Drop the device reference BEFORE releasing the permit: the
             # permit accounting is the HBM bound, and a live reference
             # here would let a freed permit admit chunk k+depth while
@@ -241,7 +342,8 @@ def run_prefetched(
         abort.set()
         raise
     finally:
-        producer.join(timeout=30.0)
+        packer.join(timeout=30.0)
+        transfer.join(timeout=30.0)
         while True:  # drop any queued device refs deterministically
             try:
                 q.get_nowait()

@@ -112,6 +112,11 @@ def parse_coordinate_config(spec: dict):
             streaming_chunk_rows=int(spec.get("streaming_chunk_rows", 0)),
             # chunks the ingest pipeline keeps in flight when streaming.
             prefetch_depth=int(spec.get("prefetch_depth", 2)),
+            # chunks folded per device dispatch (lax.scan) when streaming;
+            # amortizes per-dispatch overhead for small chunks.
+            chunk_fuse=int(spec.get("chunk_fuse", 1)),
+            # batch line-search trials into one streamed pass per bracket.
+            batch_linesearch=bool(spec.get("batch_linesearch", True)),
         )
     if spec["type"] == "random":
         return name, RandomEffectCoordinateConfig(

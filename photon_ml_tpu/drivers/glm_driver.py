@@ -188,9 +188,28 @@ def build_arg_parser() -> argparse.ArgumentParser:
         type=int,
         default=2,
         help="with --stream-chunk-rows: how many chunks the background "
-        "ingest pipeline keeps in flight (HBM holds at most this many). "
-        "2 = the classic double buffer; 1 serializes transfer and "
-        "compute (measurement baseline)",
+        "ingest pipeline keeps in flight, and how many dispatched chunk "
+        "programs the consumer runs ahead of its carry sync (HBM holds "
+        "at most 2x this many chunks). 2 = the classic double buffer; 1 "
+        "serializes transfer and compute (measurement baseline)",
+    )
+    p.add_argument(
+        "--stream-chunk-fuse",
+        type=int,
+        default=1,
+        help="with --stream-chunk-rows: fold this many chunks into one "
+        "device dispatch (an in-program lax.scan over a stacked "
+        "super-chunk) — amortizes per-dispatch overhead when chunks are "
+        "small. Single-device only; 1 disables fusion",
+    )
+    p.add_argument(
+        "--stream-batch-linesearch",
+        choices=["on", "off"],
+        default="on",
+        help="with --stream-chunk-rows: evaluate a bracket of line-search "
+        "candidate steps in ONE streamed pass (identical trial sequence, "
+        "roughly half the passes per solve). 'off' streams one trial per "
+        "pass",
     )
     p.add_argument(
         "--telemetry",
@@ -355,6 +374,13 @@ def _run_impl(args, logger, tel) -> dict:
         # exists for.
         raise ValueError(
             "--stream-storage-dir requires --stream-chunk-rows > 0"
+        )
+    if args.stream_chunk_fuse > 1 and data_parallel:
+        # StreamingObjective would refuse this at construction anyway,
+        # but only after the (possibly long) chunk-store ingest.
+        raise ValueError(
+            "--stream-chunk-fuse > 1 is single-device only (the scan-"
+            "fused program does not compose with the mesh reduction)"
         )
     streaming = args.stream_chunk_rows > 0
     with tel.span("summarize", rows=int(X_train.shape[0]), features=int(d)):
@@ -575,6 +601,8 @@ def _run_impl(args, logger, tel) -> dict:
                 problem, stream, reg_weights, w0=w0, mesh=mesh,
                 solved=solved_now, on_solved=on_solved, l1_mask=l1_mask,
                 prefetch_depth=args.stream_prefetch_depth,
+                chunk_fuse=args.stream_chunk_fuse,
+                batch_linesearch=args.stream_batch_linesearch == "on",
             )
         if data_parallel:
             from photon_ml_tpu.parallel.distributed import (

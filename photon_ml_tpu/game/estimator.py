@@ -56,9 +56,18 @@ class FixedEffectCoordinateConfig:
     #: device memory.  All three optimizers stream (L-BFGS, OWL-QN for
     #: L1/elastic-net, smooth TRON).
     streaming_chunk_rows: int = 0
-    #: chunks the ingest pipeline keeps in flight when streaming (HBM
-    #: holds at most this many; 2 = the classic double buffer).
+    #: chunks the ingest pipeline keeps in flight when streaming (2 = the
+    #: classic double buffer; the consumer additionally syncs a window of
+    #: this many carries behind dispatch, so HBM holds ≤ 2× this many
+    #: chunks).
     prefetch_depth: int = 2
+    #: chunks folded per device dispatch via an in-program lax.scan when
+    #: streaming (single-device only) — amortizes per-dispatch overhead
+    #: for small chunks; 1 disables fusion.
+    chunk_fuse: int = 1
+    #: evaluate a bracket of line-search candidates per streamed pass
+    #: (identical trial sequence, roughly half the passes per solve).
+    batch_linesearch: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -252,6 +261,8 @@ class GameEstimator:
                         cfg.reg_weight, feature_shard=cfg.feature_shard,
                         mesh=self.mesh,
                         prefetch_depth=cfg.prefetch_depth,
+                        chunk_fuse=cfg.chunk_fuse,
+                        batch_linesearch=cfg.batch_linesearch,
                     ))
                     continue
                 if self.mesh is not None:

@@ -228,20 +228,37 @@ class OutOfCoreFactoredRandomEffectCoordinate(OutOfCoreRandomEffectCoordinate):
             # (2) projection step: host-loop L-BFGS; every evaluation is
             # one streamed pass accumulating (val, grad) on device.
             def vg(vflat):
+                import collections
+
                 acc = [
                     jnp.zeros((), jnp.float32),
                     jnp.zeros(
                         (self._n_features + 1, self.rank), jnp.float32
                     ),
                 ]
+                # Windowed carry sync (optim/streaming.py's discipline):
+                # run up to prefetch_depth dispatched-but-unexecuted
+                # group programs ahead, then block on the value scalar a
+                # window behind — keeps the device fed through each
+                # group's Python dispatch while bounding live group
+                # buffers (the device_budget contract) instead of
+                # letting the dispatch queue pin arbitrarily many.
+                window = 0 if self.prefetch_depth == 1 else (
+                    self.prefetch_depth
+                )
+                ring: collections.deque = collections.deque()
 
                 def consume(group, dev):
                     for blk, u in dev:
                         acc[0], acc[1] = self._proj_jit(
                             acc[0], acc[1], blk, u, offsets, vflat
                         )
+                    ring.append(acc[0])
+                    if len(ring) > window:
+                        jax.block_until_ready(ring.popleft())
 
                 self._run_groups(host_group, consume)
+                ring.clear()
                 return self._proj_finish_jit(acc[0], acc[1], vflat, l2v)
 
             V = streaming_lbfgs_solve(

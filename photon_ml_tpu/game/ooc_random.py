@@ -334,13 +334,15 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
 
     def _run_groups(self, make_host_group, consume):
         """Prefetch-pipelined group runner (the chunk store's ingest
-        pipeline, data/prefetch.py): a producer thread slices the NEXT
-        group on the host and dispatches its transfer while the caller
-        thread consumes the current one, with at most ``prefetch_depth``
-        groups live on the device (the permit accounting replaces the
-        old hand-rolled double buffer — and its reference-lifetime
-        subtleties — outright).  ``make_host_group(group) → host pytree
-        list``; host slicing cost now overlaps device compute too."""
+        pipeline, data/prefetch.py): a PACK thread slices the next
+        groups on the host, a TRANSFER thread dispatches them and waits
+        out their h2d completion, and the caller thread consumes the
+        current one — host slicing, the link, and device compute all
+        overlap, with at most ``prefetch_depth`` groups admitted by the
+        permit accounting (which replaced the old hand-rolled double
+        buffer — and its reference-lifetime subtleties — outright).
+        ``make_host_group(group) → host pytree list``; per-stage wall
+        attribution lands in ``self.transfer_stats``."""
         plan = self.pass_plan
         self.live_groups_high_water = 0
         if not plan:

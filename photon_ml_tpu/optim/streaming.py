@@ -7,29 +7,47 @@ hundreds of GB of slot data), the structure inverts to the reference's own
 shape: the OUTER loop runs on the host (the reference's driver-side Breeze
 L-BFGS — SURVEY.md §2 Optimizers), and each objective evaluation is one
 full pass over the data (the ``treeAggregate`` analogue, SURVEY.md §3.1) —
-here a pipelined stream of host chunks, value/grad accumulated on device:
+here a three-stage software pipeline of host chunks, value/grad
+accumulated on device:
 
-    producer thread: pack/fetch chunk k+1 ──one coalesced transfer──► HBM
+    pack thread:     stack/slice chunk k+2's host buffers ──►
+    transfer thread: chunk k+1 ──one coalesced transfer──► HBM
     caller thread:   HBM chunk k ──unpack+Pallas/XLA──► (value, grad) +=
 
 Each chunk crosses as a few large dtype-segregated staging buffers
-(data/staging.py) rather than a pytree of small per-leaf transfers, a
-producer thread keeps ``prefetch_depth`` (default 2) chunks in flight
-(data/prefetch.py), and HBM holds ≤ ``prefetch_depth`` chunks regardless
-of dataset size.  The inner per-chunk program is ONE jitted function for
-all chunks (uniform shapes — see data/streaming.py) with the staging
-unpack traced in, so there is exactly one compile per solve; per-chunk
-transfer timing and stall counters accumulate on
-``StreamingObjective.transfer_stats``.
+(data/staging.py), the pack and transfer stages run on their own threads
+(data/prefetch.py) with ``prefetch_depth`` (default 2) chunks in flight,
+and the consumer syncs on a bounded WINDOW of carries (it dispatches
+chunk k's program, then waits only for chunk k-depth's carry), so the
+device never idles during a chunk's Python dispatch.  Accumulator
+buffers are donated back to XLA each step (in-place updates), HBM holds
+O(``prefetch_depth``) chunks regardless of dataset size, and the f32
+accumulation order stays strictly per-chunk-sequential — the async
+pipeline is bit-identical to the ``prefetch_depth=1`` serial baseline
+(pinned by tests/test_streaming.py).  ``chunk_fuse > 1`` additionally
+stacks that many chunks per dispatch and folds them with an in-program
+``lax.scan`` (same order, one dispatch), amortizing per-dispatch
+overhead when chunks are small.
+
+The inner per-chunk program is ONE jitted function for all chunks
+(uniform shapes — see data/streaming.py) with the staging unpack traced
+in, so there is one compile per solve (two with a ragged fused tail);
+per-chunk transfer timing, per-stage wall attribution, and stall
+counters accumulate on ``StreamingObjective.transfer_stats``.
 
 Host-loop math mirrors lbfgs_solve step-for-step (same two-loop recursion
 and history via the SAME jitted helpers, same weak-Wolfe bracketing, same
 stall/convergence rules), so a single-chunk streamed solve lands on the
 resident solution to float tolerance; tests/test_streaming.py pins that.
+Line searches batch their trials: one streamed pass evaluates the current
+candidate step PLUS its possible successors (vector-free-L-BFGS-style
+pass fusion), so a bracketing search costs about half the passes of the
+one-trial-per-pass loop while examining the identical candidate sequence.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
 from typing import Callable, Optional, Sequence
@@ -54,6 +72,13 @@ from photon_ml_tpu.optim.owlqn import OWLQNConfig, _pseudo_gradient
 
 Array = jax.Array
 
+#: candidate steps per batched weak-Wolfe pass: the current trial plus its
+#: two possible bisection successors (see ``_host_wolfe``).
+_WOLFE_TRIAL_BATCH = 3
+#: candidate steps per batched OWL-QN Armijo pass (the geometric
+#: backtracking ladder is fully deterministic, so any prefix batches).
+_OWLQN_TRIAL_BATCH = 4
+
 
 # ---------------------------------------------------------------------------
 # Streamed objective: value+grad as one pass over host chunks
@@ -77,13 +102,26 @@ class StreamingObjective:
 
     Transfers ride the coalesced ingest pipeline: each chunk moves as a
     few large dtype-segregated staging buffers (data/staging.py) whose
-    compiled unpack is traced into the per-chunk program, and a
-    background producer thread keeps ``prefetch_depth`` chunks in flight
-    (data/prefetch.py; depth 2 = the classic double buffer, preserving
-    the ≤2-chunks-in-HBM invariant).  ``transfer_stats`` accumulates
-    per-chunk h2d timing, achieved GB/s, and queue-stall counters across
-    passes — reset it around a measurement window (bench_streaming
-    does).
+    compiled unpack is traced into the per-chunk program, and two
+    background threads (pack + transfer, data/prefetch.py) keep
+    ``prefetch_depth`` chunks in flight while the consumer syncs on a
+    bounded window of carries — pack, transfer and compute overlap, and
+    results stay bit-identical to ``prefetch_depth=1`` because the f32
+    accumulation order is per-chunk-sequential either way.  HBM holds at
+    most ``2·prefetch_depth`` chunks (``prefetch_depth`` transferred-not-
+    consumed + a ``prefetch_depth``-deep window of dispatched-not-synced
+    programs), times ``chunk_fuse`` when fusing.
+
+    ``chunk_fuse > 1`` stacks that many chunks per transfer and folds
+    them on device with ``lax.scan`` (one dispatch per group, same
+    accumulation order) — for stores whose chunks are small enough that
+    per-dispatch overhead dominates.  Single-device only (no mesh), and
+    requires the staged (coalesced-buffer) representation.
+
+    ``transfer_stats`` accumulates per-chunk h2d timing, achieved GB/s,
+    per-stage wall attribution (pack/dispatch/h2d/consume) and
+    queue-stall counters across passes — reset it around a measurement
+    window (bench_streaming does).
     """
 
     def __init__(
@@ -94,6 +132,7 @@ class StreamingObjective:
         mesh=None,
         accumulate: str = "f32",
         prefetch_depth: int = 2,
+        chunk_fuse: int = 1,
     ):
         from photon_ml_tpu.ops import losses as losses_lib
 
@@ -109,16 +148,39 @@ class StreamingObjective:
             raise ValueError(
                 f"prefetch_depth must be >= 1, got {prefetch_depth}"
             )
+        if chunk_fuse < 1:
+            raise ValueError(f"chunk_fuse must be >= 1, got {chunk_fuse}")
+        if chunk_fuse > 1 and mesh is not None:
+            raise ValueError(
+                "chunk_fuse > 1 is single-device only: the scan-fused "
+                "program is not composed with the shard_map reduction — "
+                "pass chunk_fuse=1 with a mesh"
+            )
         self.stream = stream
         self.mesh = mesh
         self.accumulate = accumulate
         self.prefetch_depth = int(prefetch_depth)
+        self.chunk_fuse = int(chunk_fuse)
         self.transfer_stats = TransferStats()
         # Coalesce to staging buffers (no-op when the builder already
         # did); falls back to per-leaf pytree transfers only for
         # hand-built disk-backed stores, which cannot pack in RAM.
         stream.ensure_staged()
         self._staging = stream.staging
+        if self.chunk_fuse > 1 and stream.staged is None:
+            raise ValueError(
+                "chunk_fuse > 1 needs the staged (coalesced-buffer) "
+                "representation — this store could not be staged "
+                "(hand-built disk-backed per-leaf store?)"
+            )
+        # Fused transfer groups: consecutive chunk ranges of chunk_fuse
+        # (the last one ragged).  With chunk_fuse == 1 the pipeline runs
+        # per chunk and this grouping is the identity.
+        n_ch = stream.n_chunks
+        fuse = min(self.chunk_fuse, max(n_ch, 1))
+        self._groups = [
+            range(lo, min(lo + fuse, n_ch)) for lo in range(0, n_ch, fuse)
+        ]
         self._sharding = None
         # Multi-host (pod) mode: every process holds a chunk store over
         # ITS host-local rows only (n_shards = local device count) and
@@ -198,21 +260,6 @@ class StreamingObjective:
             chunk = dataclasses.replace(chunk, offsets=chunk.offsets + off)
             return obj.raw_value_and_grad(w, chunk)
 
-        def acc_step(carry, w, off, chunk):
-            v, g = chunk_vg(w, off, chunk)
-            if accumulate == "f32":
-                vacc, gacc = carry
-                return (vacc + v, gacc + g)
-            # Kahan: carry = (vacc, vcomp, gacc, gcomp)
-            vacc, vc, gacc, gc = carry
-            yv = v - vc
-            tv = vacc + yv
-            vc = (tv - vacc) - yv
-            yg = g - gc
-            tg = gacc + yg
-            gc = (tg - gacc) - yg
-            return (tv, vc, tg, gc)
-
         def chunk_hvp(w, v, off, chunk):
             # Recomputes the d2 weights inside the chunk program (one extra
             # margins matvec) — the streamed analogue of the reference's
@@ -233,15 +280,6 @@ class StreamingObjective:
             chunk = dataclasses.replace(chunk, offsets=chunk.offsets + off)
             return obj.raw_hvp(w, v, chunk)
 
-        def hvp_step(acc, w, v, off, chunk):
-            h = chunk_hvp(w, v, off, chunk)
-            if accumulate == "f32":
-                return acc + h
-            hacc, hc = acc  # Kahan, matching acc_step's gradient pair
-            yh = h - hc
-            th = hacc + yh
-            return (th, (th - hacc) - yh)
-
         def chunk_diag(w, off, chunk):
             chunk = unpack(chunk)
             if mesh is not None:
@@ -258,9 +296,6 @@ class StreamingObjective:
             d2w = obj.d2_weights(w, chunk)
             return chunk.features.sq_rmatvec(d2w)
 
-        def diag_step(diag, w, off, chunk):
-            return diag + chunk_diag(w, off, chunk)
-
         def score_step(w, chunk):
             chunk = unpack(chunk)
             if mesh is not None:
@@ -268,57 +303,126 @@ class StreamingObjective:
                 return obj.margins(w, local)
             return obj.margins(w, chunk)
 
+        def acc_update(carry, v, g):
+            # Shared f32/kahan accumulator fold; elementwise, so the SAME
+            # formulas serve the plain and the batched ((K,)/(K,d)) carry.
+            if accumulate == "f32":
+                vacc, gacc = carry
+                return (vacc + v, gacc + g)
+            vacc, vc, gacc, gc = carry
+            yv = v - vc
+            tv = vacc + yv
+            vc = (tv - vacc) - yv
+            yg = g - gc
+            tg = gacc + yg
+            gc = (tg - gacc) - yg
+            return (tv, vc, tg, gc)
+
+        def hvp_update(carry, h):
+            if accumulate == "f32":
+                return (carry[0] + h,)
+            hacc, hc = carry
+            yh = h - hc
+            th = hacc + yh
+            return (th, (th - hacc) - yh)
+
+        # Flattened step functions: ``step(*carry, *args, off, chunk) ->
+        # carry tuple``.  The carry is flattened into SEPARATE positional
+        # args so donation can target just the gradient accumulators
+        # (donate_argnums is per-argument) while the value scalar stays
+        # un-donated — it is the windowed-sync handle _stream_accumulate
+        # blocks on (a donated buffer cannot be synced: it is deleted the
+        # moment the next step consumes it).
+        self._n_carry = {
+            "acc": 2 if accumulate == "f32" else 4,
+            "hvp": 1 if accumulate == "f32" else 2,
+            "diag": 1,
+        }
+        self._n_args = {"acc": 1, "hvp": 2, "diag": 1}
+        # Gradient/HVP accumulators update IN PLACE via buffer donation.
+        # The value scalar (leaf 0 of "acc") is deliberately NOT donated:
+        # it is the sync handle.  "hvp"/"diag" carries are their own sync
+        # handles, so they are not donated either.
+        self._donate = {
+            "acc": (1,) if accumulate == "f32" else (2, 3),
+            "hvp": (),
+            "diag": (),
+        }
+
+        def make_step(kind: str, batch: int | None):
+            nc = self._n_carry[kind]
+
+            def step(*fl):
+                carry = fl[:nc]
+                off, chunk = fl[-2], fl[-1]
+                if kind == "acc":
+                    w = fl[nc]
+                    if batch is None:
+                        v, g = chunk_vg(w, off, chunk)
+                    else:
+                        # UNROLLED over the K candidates, not vmapped:
+                        # each candidate's arithmetic is the exact graph
+                        # the single-w program runs, so a batched trial
+                        # matches a sequential trial bitwise (vmap would
+                        # re-block the matvecs by batch shape — the same
+                        # parity hazard serving/kernels.py documents).
+                        outs = [
+                            chunk_vg(w[i], off, chunk) for i in range(batch)
+                        ]
+                        v = jnp.stack([o[0] for o in outs])
+                        g = jnp.stack([o[1] for o in outs])
+                    return acc_update(carry, v, g)
+                if kind == "hvp":
+                    w, vec = fl[nc], fl[nc + 1]
+                    return hvp_update(carry, chunk_hvp(w, vec, off, chunk))
+                diag = carry[0]
+                w = fl[nc]
+                return (diag + chunk_diag(w, off, chunk),)
+
+            return step
+
+        def fuse_step(step, kind: str, n_fused: int):
+            nc = self._n_carry[kind]
+            na = self._n_args[kind]
+
+            def fused(*fl):
+                carry = tuple(fl[:nc])
+                rest = fl[nc:nc + na]
+                off, chunk = fl[-2], fl[-1]
+
+                def body(c, xs):
+                    o, b = xs
+                    return tuple(step(*c, *rest, o, b)), None
+
+                out, _ = lax.scan(body, carry, (off, chunk), length=n_fused)
+                return out
+
+            return fused
+
+        self._make_step = make_step
+        self._fuse_step = fuse_step
+        self._score_step = score_step
+        self._progs: dict = {}
+
         if mesh is not None:
             from jax.sharding import PartitionSpec as P
 
-            spec = P(self._axis)
-            n_acc = 2 if accumulate == "f32" else 4
-            acc_carry = (P(),) * n_acc
-            hvp_carry = P() if accumulate == "f32" else (P(), P())
-            # Two shard_map variants per pass, built lazily and cached:
-            # scalar offsets (plain GLM — a replicated traced 0, no
-            # transfer) vs ROW offsets sharded like the chunk (streamed
-            # GAME × data parallelism, the other coordinates' scores).
-            self._mesh_progs: dict = {}
-            builders = {
-                "acc": lambda off_spec: shard_map(
-                    acc_step, mesh=mesh,
-                    in_specs=(acc_carry, P(), off_spec, spec),
-                    out_specs=acc_carry, check_vma=False,
-                ),
-                "diag": lambda off_spec: shard_map(
-                    diag_step, mesh=mesh,
-                    in_specs=(P(), P(), off_spec, spec), out_specs=P(),
-                    check_vma=False,
-                ),
-                "hvp": lambda off_spec: shard_map(
-                    hvp_step, mesh=mesh,
-                    in_specs=(hvp_carry, P(), P(), off_spec, spec),
-                    out_specs=hvp_carry, check_vma=False,
-                ),
-            }
-
-            def _program(name: str, row_off: bool):
-                key = (name, row_off)
-                if key not in self._mesh_progs:
-                    self._mesh_progs[key] = jax.jit(
-                        builders[name](spec if row_off else P())
-                    )
-                return self._mesh_progs[key]
-
-            self._mesh_program = _program
+            self._chunk_spec = P(self._axis)
             self._score = jax.jit(shard_map(
-                score_step, mesh=mesh, in_specs=(P(), spec), out_specs=spec,
+                score_step, mesh=mesh,
+                in_specs=(P(), self._chunk_spec), out_specs=self._chunk_spec,
                 check_vma=False,
             ))
         else:
-            self._acc = jax.jit(acc_step)
-            self._diag = jax.jit(diag_step)
-            self._hvp = jax.jit(hvp_step)
             self._score = jax.jit(score_step)
         self._finish = jax.jit(
             lambda v, g, w, l2: (
                 v + 0.5 * l2 * jnp.dot(w, w), g + l2 * w
+            )
+        )
+        self._finish_batch = jax.jit(
+            lambda v, g, w, l2: (
+                v + 0.5 * l2 * jnp.einsum("kd,kd->k", w, w), g + l2 * w
             )
         )
         self._hvp_finish = jax.jit(lambda h, v, l2: h + l2 * v)
@@ -326,6 +430,36 @@ class StreamingObjective:
     @property
     def n_features(self) -> int:
         return self.stream.n_features
+
+    def _program(self, kind: str, n_fused: int = 1, batch: int | None = None,
+                 row_off: bool = False) -> Callable:
+        """The compiled per-item program for pass ``kind`` — built lazily
+        and cached per (fused length, trial-batch width, offset kind).
+        One compile per solve in the common case; a ragged fused tail
+        adds one more."""
+        key = (kind, n_fused, batch, row_off)
+        prog = self._progs.get(key)
+        if prog is not None:
+            return prog
+        step = self._make_step(kind, batch)
+        if self.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            nc = self._n_carry[kind]
+            na = self._n_args[kind]
+            carry_specs = (P(),) * nc
+            off_spec = self._chunk_spec if row_off else P()
+            step = shard_map(
+                step, mesh=self.mesh,
+                in_specs=carry_specs + (P(),) * na
+                + (off_spec, self._chunk_spec),
+                out_specs=carry_specs, check_vma=False,
+            )
+        elif n_fused > 1:
+            step = self._fuse_step(step, kind, n_fused)
+        prog = jax.jit(step, donate_argnums=self._donate[kind])
+        self._progs[key] = prog
+        return prog
 
     def _align_multihost_chunks(self) -> None:
         """Pod-wide agreement checks the streamed loop's collectives need.
@@ -382,6 +516,14 @@ class StreamingObjective:
             else:
                 blank = jax.tree.map(np.zeros_like, chunks[0])
             self.stream.chunks = chunks + [blank] * pad
+        # The fused grouping is sized off n_chunks; re-derive after any
+        # equalization padding (fusion is single-device-only today, but
+        # keep the invariant locally true).
+        n_ch = self.stream.n_chunks
+        fuse = min(self.chunk_fuse, max(n_ch, 1))
+        self._groups = [
+            range(lo, min(lo + fuse, n_ch)) for lo in range(0, n_ch, fuse)
+        ]
 
     def _put_local_block(self, x) -> Array:
         """Assemble one globally-sharded array from THIS process's local
@@ -402,17 +544,6 @@ class StreamingObjective:
                 return jax.tree.map(self._put_local_block, chunk)
             return jax.device_put(chunk, self._sharding)
         return jax.device_put(chunk)
-
-    def _select(self, name: str, per_chunk) -> Callable:
-        """The compiled per-chunk program for pass ``name`` — on a mesh,
-        picked by whether the offset slices are scalars or sharded rows
-        (two distinct shard_map signatures)."""
-        if self.mesh is None:
-            return {
-                "acc": self._acc, "diag": self._diag, "hvp": self._hvp,
-            }[name]
-        row_off = getattr(per_chunk[0], "ndim", 0) != 0
-        return self._mesh_program(name, row_off)
 
     def offset_slices(self, offsets) -> list:
         """Per-chunk slices of coordinate-descent offsets (the other
@@ -477,30 +608,114 @@ class StreamingObjective:
             return self.stream.staged[k]
         return self.stream.chunks[k]
 
-    def _stream_accumulate(self, step: Callable, init, args=(),
-                           per_chunk=None):
-        """Run ``carry = step(carry, *args, per_chunk[k], chunk)`` over
-        all chunks through the prefetch pipeline: a producer thread
-        dispatches transfers up to ``prefetch_depth`` chunks ahead
-        (depth 2 = chunk k+1 moving while chunk k computes), so host-side
-        packing/dispatch overhead overlaps device compute.  The per-chunk
-        sync on the (tiny) carry is the backpressure that makes the
-        pipeline's depth bound actual HBM residency — without it the host
-        would enqueue every chunk's compute and HBM would hold the whole
-        dataset again."""
-        n = self.stream.n_chunks
-        carry_box = [init]
+    def _fused_host_item(self, g: int):
+        """Fused group ``g``'s transfer item: the group's staging buffers
+        stacked on a new leading chunk axis (the scan axis of the fused
+        program).  The stack is a transient host copy that runs on the
+        PACK thread, where it overlaps both the link and device compute;
+        memmapped (disk-backed) buffers page in here too.  A singleton
+        group (the ragged tail) stays a plain un-stacked chunk item and
+        runs the ordinary per-chunk program."""
+        ks = self._groups[g]
+        staged = self.stream.staged
+        if len(ks) == 1:
+            return staged[ks[0]]
+        n_buf = len(staged[ks[0]])
+        return tuple(
+            np.stack([np.asarray(staged[k][b]) for k in ks])
+            for b in range(n_buf)
+        )
 
-        def consume(k, dev):
-            extra = (per_chunk[k],) if per_chunk is not None else ()
-            carry_box[0] = step(carry_box[0], *args, *extra, dev)
-            jax.block_until_ready(jax.tree.leaves(carry_box[0])[0])
+    def _group_offsets(self, slices: list) -> list:
+        """Per-ITEM offsets under fusion: each group's per-chunk slices
+        stacked on the scan axis (identity when chunk_fuse == 1;
+        singleton groups keep their plain per-chunk slice)."""
+        if self.chunk_fuse == 1:
+            return slices
+        return [
+            slices[grp[0]] if len(grp) == 1
+            else jnp.stack([slices[k] for k in grp])
+            for grp in self._groups
+        ]
+
+    def _stream_accumulate(self, kind: str, init: tuple, args=(),
+                           per_chunk=None, batch: int | None = None):
+        """Run ``carry = prog(*carry, *args, off_i, item_i)`` over all
+        chunks (or fused chunk groups) through the prefetch pipeline,
+        syncing on a bounded WINDOW of carries.
+
+        The pack and transfer threads keep ``prefetch_depth`` items in
+        flight (data/prefetch.py); the consumer dispatches item k's
+        program and then blocks only on item ``k - prefetch_depth``'s
+        sync handle, so the device always has up to ``prefetch_depth``
+        programs queued behind the executing one and never idles during
+        a chunk's Python dispatch.  The window is the backpressure that
+        bounds HBM residency: a dispatched-but-unexecuted program pins
+        its chunk's buffers, so ≤ ``2·prefetch_depth`` chunk groups are
+        ever live (``prefetch_depth`` un-consumed transfers + the
+        window).  ``prefetch_depth=1`` degrades to the fully-serial
+        sync-every-chunk baseline.  The sync handle is carry leaf 0,
+        which is never donated (see ``__init__``); gradient accumulators
+        ARE donated, updating in place.  Accumulation order is strictly
+        chunk-sequential regardless of depth/window/fusion — results are
+        bit-identical across all of them on f32.
+        """
+        if self.chunk_fuse == 1:
+            n_items = self.stream.n_chunks
+            get_host = self._host_item
+            items_off = per_chunk
+            lens = None  # all programs identical
+        else:
+            n_items = len(self._groups)
+            get_host = self._fused_host_item
+            items_off = self._group_offsets(per_chunk)
+            lens = [len(g) for g in self._groups]
+        row_off = (
+            self.mesh is not None
+            and getattr(per_chunk[0], "ndim", 0) != 0
+        )
+        if lens is None:
+            prog = self._program(kind, 1, batch, row_off)
+            progs = [prog] * n_items
+        else:
+            progs = [
+                self._program(kind, L, batch, row_off) for L in lens
+            ]
+        window = 0 if self.prefetch_depth == 1 else self.prefetch_depth
+        carry_box = [tuple(init)]
+        ring: collections.deque = collections.deque()
+
+        def consume(i, dev):
+            carry_box[0] = progs[i](
+                *carry_box[0], *args, items_off[i], dev
+            )
+            ring.append(carry_box[0][0])
+            if len(ring) > window:
+                jax.block_until_ready(ring.popleft())
 
         run_prefetched(
-            n, self._host_item, self._put, consume,
+            n_items, get_host, self._put, consume,
             depth=self.prefetch_depth, stats=self.transfer_stats,
         )
+        if ring:
+            # Drain: the carry chain is sequential, so the LAST handle's
+            # readiness implies every chunk executed (and every chunk
+            # buffer is collectable) before the pass returns.
+            jax.block_until_ready(ring[-1])
+            ring.clear()
         return carry_box[0]
+
+    def _acc_init(self, batch: int | None):
+        d = self.stream.n_features
+        shp_v = () if batch is None else (batch,)
+        shp_g = (d,) if batch is None else (batch, d)
+        if self.accumulate == "f32":
+            return (jnp.zeros(shp_v, jnp.float32),
+                    jnp.zeros(shp_g, jnp.float32))
+        return (
+            jnp.zeros(shp_v, jnp.float32), jnp.zeros(shp_v, jnp.float32),
+            jnp.zeros(shp_g, jnp.float32), jnp.zeros(shp_g, jnp.float32),
+        )
 
     def value_and_grad(
         self, w: Array, l2_weight=0.0, offsets=None
@@ -508,67 +723,108 @@ class StreamingObjective:
         """One full streamed pass; returns device (value, grad) with the L2
         term applied.  ``offsets``: optional (n_rows,) extra margins added
         per row (coordinate descent)."""
-        d = self.stream.n_features
-        if self.accumulate == "f32":
-            init = (jnp.zeros((), jnp.float32), jnp.zeros((d,), jnp.float32))
-        else:
-            init = (
-                jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
-                jnp.zeros((d,), jnp.float32), jnp.zeros((d,), jnp.float32),
-            )
         slices = self.offset_slices(offsets)
         out = self._stream_accumulate(
-            self._select("acc", slices), init, args=(w,), per_chunk=slices,
+            "acc", self._acc_init(None), args=(w,), per_chunk=slices,
         )
         v, g = (out[0], out[1]) if self.accumulate == "f32" else (
             out[0], out[2]
         )
         return self._finish(v, g, w, jnp.asarray(l2_weight, jnp.float32))
 
+    def value_and_grad_batch(
+        self, ws: Array, l2_weight=0.0, offsets=None
+    ) -> tuple[Array, Array]:
+        """K objective evaluations in ONE streamed pass: ``ws`` is (K, d)
+        candidate weight vectors (a line search's trial bracket), the
+        per-chunk program evaluates all K against each chunk (unrolled,
+        not vmapped — each candidate runs the exact single-w graph, so a
+        batched trial is bitwise the sequential trial), and K (value,
+        grad) accumulators ride one carry.  Returns ((K,), (K, d)) with
+        the L2 term applied per candidate.  This is the vector-free
+        L-BFGS pass-fusion trick: the line search streams the dataset
+        once per BRACKET instead of once per trial."""
+        ws = jnp.asarray(ws)
+        if ws.ndim != 2:
+            raise ValueError(
+                f"value_and_grad_batch wants (K, n_features), got "
+                f"{ws.shape}"
+            )
+        K = int(ws.shape[0])
+        slices = self.offset_slices(offsets)
+        out = self._stream_accumulate(
+            "acc", self._acc_init(K), args=(ws,), per_chunk=slices,
+            batch=K,
+        )
+        v, g = (out[0], out[1]) if self.accumulate == "f32" else (
+            out[0], out[2]
+        )
+        return self._finish_batch(
+            v, g, ws, jnp.asarray(l2_weight, jnp.float32)
+        )
+
     def hessian_diagonal(self, w: Array, offsets=None) -> Array:
         """Σᵢ wᵢ·d2ᵢ·X²ᵢⱼ streamed over chunks (for coefficient variances)."""
         d = self.stream.n_features
         slices = self.offset_slices(offsets)
         return self._stream_accumulate(
-            self._select("diag", slices), jnp.zeros((d,), jnp.float32),
+            "diag", (jnp.zeros((d,), jnp.float32),),
             args=(w,), per_chunk=slices,
-        )
+        )[0]
 
     def hvp(self, w: Array, v: Array, l2_weight=0.0, offsets=None) -> Array:
         """H(w)·v = Xᵀ(d2w ⊙ (Xv)) + λ·v as ONE streamed pass over the
         chunks — the ``HessianVectorAggregator`` ``treeAggregate`` round of
         the reference's distributed TRON (SURVEY.md §3.1), here a
-        double-buffered chunk stream.  Callers issuing many HVPs against
+        windowed-async chunk stream.  Callers issuing many HVPs against
         fixed offsets (a whole CG solve) should pre-slice via
         :meth:`offset_slices` and pass the list."""
         d = self.stream.n_features
         zero = jnp.zeros((d,), jnp.float32)
-        init = zero if self.accumulate == "f32" else (zero, zero)
+        init = (zero,) if self.accumulate == "f32" else (zero, zero)
         slices = self.offset_slices(offsets)
         h = self._stream_accumulate(
-            self._select("hvp", slices), init, args=(w, v),
-            per_chunk=slices,
-        )
-        if self.accumulate != "f32":
-            h = h[0]
+            "hvp", init, args=(w, v), per_chunk=slices,
+        )[0]
         return self._hvp_finish(h, v, jnp.asarray(l2_weight, jnp.float32))
 
     def scores(self, w: Array) -> np.ndarray:
-        """Margins for every row of THIS STORE, streamed.
+        """Margins for every row of THIS STORE, streamed, with the
+        device→host readbacks pipelined: each chunk's margins start an
+        ASYNC D2H copy at dispatch and materialize a window of
+        ``prefetch_depth`` chunks behind, so readback latency overlaps
+        the next chunks' transfer + compute instead of serializing the
+        pass.
 
         On a pod the contract is PROCESS-LOCAL (the defined edge VERDICT
         r4 missing #3 asked for): each process gets the margins of its
         own rows — the rows its chunk store holds — read from its
-        addressable shards of the globally-sharded per-chunk result.
-        That matches the pod CD layout (per-row state lives partitioned
-        next to the data, like the reference's score RDDs); GLOBAL
+        addressable shards of the globally-sharded per-chunk result
+        (that path keeps the synchronous shard readback).  GLOBAL
         metrics over these scores reduce with one psum
         (evaluation/device.py) or an explicit allgather, never by
         materializing global rows on one host."""
-        outs: list = [None] * self.stream.n_chunks
+        fused = self.chunk_fuse > 1
+        if fused:
+            n_items = len(self._groups)
+            get_host = self._fused_host_item
+            progs = [
+                self._score if len(g) == 1 else self._score_fused(len(g))
+                for g in self._groups
+            ]
+        else:
+            n_items = self.stream.n_chunks
+            get_host = self._host_item
+            progs = [self._score] * n_items
+        outs: list = [None] * n_items
+        window = 0 if self.prefetch_depth == 1 else self.prefetch_depth
+        pend: collections.deque = collections.deque()
+
+        def materialize(j, m):
+            outs[j] = np.asarray(m).reshape(-1)
 
         def consume(k, dev):
-            m = self._score(w, dev)
+            m = progs[k](w, dev)
             if self._multihost:
                 # Local shard blocks, in global (= process-major) order:
                 # together they are exactly this process's contiguous
@@ -579,15 +835,40 @@ class StreamingObjective:
                 outs[k] = np.concatenate(
                     [np.asarray(s.data).reshape(-1) for s in shards]
                 )
-            else:
-                # The readback is the per-chunk sync (backpressure).
-                outs[k] = np.asarray(m).reshape(-1)
+                return
+            if hasattr(m, "copy_to_host_async"):
+                m.copy_to_host_async()
+            pend.append((k, m))
+            if len(pend) > window:
+                materialize(*pend.popleft())
 
         run_prefetched(
-            self.stream.n_chunks, self._host_item, self._put, consume,
+            n_items, get_host, self._put, consume,
             depth=self.prefetch_depth, stats=self.transfer_stats,
         )
+        while pend:
+            materialize(*pend.popleft())
         return np.concatenate(outs)[: self.stream.n_rows]
+
+    def _score_fused(self, n_fused: int) -> Callable:
+        key = ("score", n_fused, None, False)
+        prog = self._progs.get(key)
+        if prog is not None:
+            return prog
+        score = self._score_step
+
+        def fused(w, chunk):
+            def body(c, b):
+                return c, score(w, b)
+
+            _, ms = lax.scan(
+                body, jnp.zeros((), jnp.float32), chunk, length=n_fused
+            )
+            return ms
+
+        prog = jax.jit(fused)
+        self._progs[key] = prog
+        return prog
 
 
 # ---------------------------------------------------------------------------
@@ -613,6 +894,13 @@ def _axpy_jit(w0, t, direction):
 
 
 @jax.jit
+def _axpy_batch_jit(w0, ts, direction):
+    # Row i is w0 + ts[i]·direction, elementwise — bitwise the _axpy_jit
+    # result for that step (broadcasting adds no reduction or re-blocking).
+    return w0[None, :] + ts[:, None] * direction[None, :]
+
+
+@jax.jit
 def _vdot_jit(a, b):
     return jnp.vdot(a, b)
 
@@ -631,21 +919,69 @@ class _HostLS:
         self.success = success
 
 
-def _host_wolfe(vg, w0, f0, g0, direction, initial_step, cfg: LineSearchConfig):
+def _host_wolfe(vg, w0, f0, g0, direction, initial_step,
+                cfg: LineSearchConfig, vg_batch=None):
     """Weak-Wolfe bisection search with host control flow — the same
     bracketing rules as optim/linesearch.wolfe_line_search, but each trial
     evaluation is a full streamed pass, so host round trips are free by
-    comparison."""
-    dg0 = float(_vdot_jit(direction, g0))
+    comparison.
 
-    def evaluate(t):
-        w = _axpy_jit(w0, jnp.float32(t), direction)
-        f, g = vg(w)
-        return w, float(f), g, float(_vdot_jit(direction, g))
+    With ``vg_batch`` (a (K, d) → ((K,), (K, d)) batched evaluator, e.g.
+    :meth:`StreamingObjective.value_and_grad_batch`), each streamed pass
+    SPECULATIVELY evaluates the current trial step plus its two possible
+    bisection successors — the successor for either branch of the Armijo
+    test is computable from the current bracket before the trial's result
+    is known — so every pass resolves two levels of the search and the
+    pass count per line search roughly halves.  The examined candidate
+    sequence (and therefore the accepted step and ``n_evals``) is
+    IDENTICAL to the one-trial-per-pass loop.
+    """
+    dg0 = float(_vdot_jit(direction, g0))
+    cache: dict = {}
+
+    def clamp(t):
+        return min(max(t, cfg.min_step), cfg.max_step)
+
+    def successors(t, lo, hi):
+        # The two possible next trials after examining t with bracket
+        # (lo, hi): armijo-ok moves lo up to t, armijo-fail moves hi down
+        # to t — the SAME update+bisection+clamp arithmetic as the main
+        # loop, so a later cache lookup hits the exact float.
+        out = []
+        for lo2, hi2 in ((max(lo, t), hi), (lo, min(hi, t))):
+            tn = 2.0 * lo2 if math.isinf(hi2) else 0.5 * (lo2 + hi2)
+            out.append(clamp(tn))
+        return out
+
+    def evaluate(t, lo, hi):
+        if vg_batch is None:
+            w = _axpy_jit(w0, jnp.float32(t), direction)
+            f, g = vg(w)
+            return w, float(f), g, float(_vdot_jit(direction, g))
+        if t not in cache:
+            cands = [t]
+            for tn in successors(t, lo, hi):
+                if tn not in cands and tn not in cache:
+                    cands.append(tn)
+            while len(cands) < _WOLFE_TRIAL_BATCH:
+                cands.append(cands[-1])  # pad: one static batch shape
+            cands = cands[:_WOLFE_TRIAL_BATCH]
+            ws = _axpy_batch_jit(
+                w0, jnp.asarray(cands, jnp.float32), direction
+            )
+            fs, gs = vg_batch(ws)
+            fs_host = np.asarray(fs)
+            for i, tc in enumerate(cands):
+                if tc not in cache:
+                    cache[tc] = (
+                        ws[i], float(fs_host[i]), gs[i],
+                        float(_vdot_jit(direction, gs[i])),
+                    )
+        return cache[t]
 
     t = float(initial_step)
     lo, hi = 0.0, math.inf
-    w, f, g, dg = evaluate(t)
+    w, f, g, dg = evaluate(t, lo, hi)
     n_evals = 1
     while True:
         armijo_ok = f <= f0 + cfg.c1 * t * dg0
@@ -659,11 +995,11 @@ def _host_wolfe(vg, w0, f0, g0, direction, initial_step, cfg: LineSearchConfig):
         else:
             hi = min(hi, t)
         t_next = 2.0 * lo if math.isinf(hi) else 0.5 * (lo + hi)
-        t_next = min(max(t_next, cfg.min_step), cfg.max_step)
+        t_next = clamp(t_next)
         if t_next == t or hi - lo < cfg.min_step:
             break
         t = t_next
-        w, f, g, dg = evaluate(t)
+        w, f, g, dg = evaluate(t, lo, hi)
         n_evals += 1
     success = (
         f <= f0 + cfg.c1 * t * dg0 and dg >= cfg.c2 * dg0
@@ -675,6 +1011,7 @@ def streaming_lbfgs_solve(
     value_and_grad: Callable[[Array], tuple[Array, Array]],
     w0: Array,
     config: LBFGSConfig = LBFGSConfig(),
+    value_and_grad_batch=None,
 ) -> SolveResult:
     """L-BFGS with the outer loop on the host: ``value_and_grad`` may do
     arbitrary host work per call (stream chunks, launch many programs).
@@ -683,6 +1020,12 @@ def streaming_lbfgs_solve(
     and curvature-history update (via the SAME functions, jitted), same
     weak-Wolfe bracketing constants, same stall rule (a failed,
     non-improving line search keeps the incumbent), same convergence tests.
+
+    ``value_and_grad_batch``: optional (K, d) → ((K,), (K, d)) evaluator
+    (:meth:`StreamingObjective.value_and_grad_batch`); when given, the
+    line search batches each trial with its speculative successors so one
+    streamed pass resolves ~2 trials (identical trajectory — see
+    :func:`_host_wolfe`).
     """
     m = config.history
     d = w0.shape[0]
@@ -717,7 +1060,8 @@ def streaming_lbfgs_solve(
         init_step = min(1.0, 1.0 / g_norm) if first else 1.0
 
         ls = _host_wolfe(
-            value_and_grad, w, f, g, direction, init_step, config.line_search
+            value_and_grad, w, f, g, direction, init_step,
+            config.line_search, vg_batch=value_and_grad_batch,
         )
 
         S, Y, rho, gamma, n_pairs = _history_jit(
@@ -781,6 +1125,14 @@ def _ow_trial_jit(w, t, direction, xi):
 
 
 @jax.jit
+def _ow_trials_jit(w, ts, direction, xi):
+    # Row i is the _ow_trial_jit result for ts[i], elementwise (broadcast
+    # only — no reductions), so the batched trials match bitwise.
+    wt = w[None, :] + ts[:, None] * direction[None, :]
+    return jnp.where(wt * xi[None, :] >= 0, wt, 0.0)
+
+
+@jax.jit
 def _ow_l1_jit(w, l1, mask):
     return l1 * jnp.vdot(mask, jnp.abs(w))
 
@@ -791,12 +1143,18 @@ def streaming_owlqn_solve(
     l1_weight: float,
     config: OWLQNConfig = OWLQNConfig(),
     l1_mask: Optional[Array] = None,
+    value_and_grad_batch=None,
 ) -> SolveResult:
     """OWL-QN with the outer loop on the host — the streamed counterpart
     of optim/owlqn.owlqn_solve (same pseudo-gradient, orthant alignment
     and projection, projected-step Armijo with non-strict backtracking,
     smooth-gradient history, stall rule, convergence tests).
-    ``value_and_grad`` evaluates only the smooth part."""
+    ``value_and_grad`` evaluates only the smooth part.
+
+    ``value_and_grad_batch``: optional batched smooth evaluator; when
+    given, each streamed pass evaluates a ladder of backtracking
+    candidates ``t, tβ, tβ², …`` at once (the ladder is deterministic, so
+    the examined sequence is identical to one-trial-per-pass)."""
     m = config.history
     d = w0.shape[0]
     dtype = w0.dtype
@@ -813,6 +1171,10 @@ def streaming_owlqn_solve(
     f_smooth, g = value_and_grad(w0)
     w = w0
     f = full_value(w, f_smooth)
+    # The pseudo-gradient is maintained as an invariant (pg ≡ pseudo(w, g))
+    # across the loop: computed once here, refreshed only on acceptance —
+    # the old loop recomputed it at the top of every iteration even though
+    # the accepted iteration had just evaluated the identical value.
     pg = _ow_pseudo_jit(w, g, l1, mask)
     pg_norm = float(jnp.linalg.norm(pg))
     tol_scale = max(1.0, pg_norm)
@@ -831,7 +1193,6 @@ def streaming_owlqn_solve(
     k = 0
     converged = pg_norm <= config.tolerance * tol_scale
     while not converged and k < config.max_iters:
-        pg = _ow_pseudo_jit(w, g, l1, mask)
         direction = _ow_dir_jit(pg, S, Y, rho, gamma, n_pairs)
         # Orthant: sign(w) where nonzero, else the step's sign.
         xi = jnp.where(w != 0, jnp.sign(w), jnp.sign(-pg))
@@ -840,10 +1201,36 @@ def streaming_owlqn_solve(
             if int(n_pairs) == 0 else 1.0
         )
 
+        cache: dict = {}
+
         def trial(t):
-            wt = _ow_trial_jit(w, jnp.float32(t), direction, xi)
-            smooth, grad = value_and_grad(wt)
-            return wt, full_value(wt, smooth), grad
+            if value_and_grad_batch is None:
+                wt = _ow_trial_jit(w, jnp.float32(t), direction, xi)
+                smooth, grad = value_and_grad(wt)
+                return wt, full_value(wt, smooth), grad
+            if t not in cache:
+                # The backtracking ladder from t, by REPEATED
+                # multiplication (exactly the floats `t *= backtrack`
+                # would visit — t·β**i differs bitwise).
+                ts = [t]
+                for _ in range(_OWLQN_TRIAL_BATCH - 1):
+                    ts.append(ts[-1] * config.backtrack)
+                ts = [tc for tc in ts if tc not in cache]
+                while len(ts) < _OWLQN_TRIAL_BATCH:
+                    ts.append(ts[-1])
+                wts = _ow_trials_jit(
+                    w, jnp.asarray(ts, jnp.float32), direction, xi
+                )
+                smooths, grads = value_and_grad_batch(wts)
+                smooths_host = np.asarray(smooths)
+                for i, tc in enumerate(ts):
+                    if tc not in cache:
+                        cache[tc] = (
+                            wts[i],
+                            full_value(wts[i], smooths_host[i]),
+                            grads[i],
+                        )
+            return cache[t]
 
         w_new, f_new, g_new = trial(t)
         n_evals = 1
@@ -870,8 +1257,8 @@ def streaming_owlqn_solve(
             )
         else:
             w, f, g = w_new, f_new, g_new
-            pg_new = _ow_pseudo_jit(w, g, l1, mask)
-            pg_norm = float(jnp.linalg.norm(pg_new))
+            pg = _ow_pseudo_jit(w, g, l1, mask)
+            pg_norm = float(jnp.linalg.norm(pg))
             converged = (
                 pg_norm <= config.tolerance * tol_scale
                 or rel_impr <= config.tolerance * 1e-2
@@ -881,11 +1268,12 @@ def streaming_owlqn_solve(
         if stalled:
             break
 
-    pg_final = _ow_pseudo_jit(w, g, l1, mask)
+    # pg already equals the pseudo-gradient at the returned (w, g) — the
+    # invariant holds through both the acceptance and stall branches.
     return SolveResult(
         w=w,
         value=jnp.asarray(f, jnp.float32),
-        grad=pg_final,
+        grad=pg,
         iterations=jnp.asarray(k, jnp.int32),
         converged=jnp.asarray(bool(converged)),
         values=jnp.asarray(values, jnp.float32),
@@ -1070,11 +1458,18 @@ def streaming_run_grid(
     accumulate: str = "f32",
     l1_mask: Optional[Array] = None,
     prefetch_depth: int = 2,
+    chunk_fuse: int = 1,
+    batch_linesearch: bool = True,
 ):
     """The λ-grid warm-start chain (optim.problem.grid_loop) over a
     streamed dataset.  L1/elastic-net routes to the streamed OWL-QN and
     smooth TRON to the streamed trust-region solver (exactly like the
     resident problem.solve's static routing).
+
+    ``chunk_fuse``: chunks folded per device dispatch (``lax.scan``) —
+    amortizes per-dispatch overhead for small chunks; ``batch_linesearch``
+    evaluates a bracket of line-search candidates per streamed pass
+    (identical trial sequence, ~half the passes).
     """
     from photon_ml_tpu.optim.problem import OptimizerType
     from photon_ml_tpu.optim.tron import TRONConfig
@@ -1083,7 +1478,7 @@ def streaming_run_grid(
     ensure_streamable(cfg)
     sobj = StreamingObjective(
         problem.objective, stream, mesh=mesh, accumulate=accumulate,
-        prefetch_depth=prefetch_depth,
+        prefetch_depth=prefetch_depth, chunk_fuse=chunk_fuse,
     )
     opt = cfg.optimizer
     lbfgs_cfg = LBFGSConfig(
@@ -1103,12 +1498,16 @@ def streaming_run_grid(
         l2 = cfg.regularization.l2_weight(1.0) * float(lam)
         if w_prev is None:
             w_prev = jnp.zeros((stream.n_features,), jnp.float32)
+        vgb = (
+            (lambda ws: sobj.value_and_grad_batch(ws, l2))
+            if batch_linesearch else None
+        )
         # Static routing, as in problem.solve: any L1 component needs the
         # orthant machinery.
         if opt.optimizer is OptimizerType.OWLQN or l1_frac > 0.0:
             return streaming_owlqn_solve(
                 lambda w: sobj.value_and_grad(w, l2), w_prev, l1,
-                owlqn_cfg, l1_mask=l1_mask,
+                owlqn_cfg, l1_mask=l1_mask, value_and_grad_batch=vgb,
             )
         if opt.optimizer is OptimizerType.TRON:
             return streaming_tron_solve(
@@ -1118,7 +1517,8 @@ def streaming_run_grid(
                 TRONConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
             )
         return streaming_lbfgs_solve(
-            lambda w: sobj.value_and_grad(w, l2), w_prev, lbfgs_cfg
+            lambda w: sobj.value_and_grad(w, l2), w_prev, lbfgs_cfg,
+            value_and_grad_batch=vgb,
         )
 
     variance_fn = None

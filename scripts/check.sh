@@ -30,10 +30,15 @@ env JAX_PLATFORMS=cpu python -m photon_ml_tpu.tuning --selfcheck
 
 echo "== tier-1 tests (JAX_PLATFORMS=cpu) =="
 if [[ "${1:-}" == "--fast" ]]; then
+  # Streaming-parity smoke rides the fast lane: a tiny 4-chunk store,
+  # asserting the windowed-async pipeline is BIT-IDENTICAL to the
+  # depth=1 serial baseline (value/grad, hvp, scores) — the invariant
+  # every other streamed number rests on.
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_watchdog.py \
-    tests/test_serving.py tests/test_tuning.py -m 'not slow' \
-    -q -p no:cacheprovider
+    tests/test_serving.py tests/test_tuning.py \
+    "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
+    -m 'not slow' -q -p no:cacheprovider
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider \

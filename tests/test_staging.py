@@ -357,3 +357,81 @@ class TestRunPrefetched:
             run_prefetched(
                 1, lambda k: None, lambda h: h, lambda k, d: None, depth=0
             )
+
+
+class TestStageAttribution:
+    """The three-stage split (pack thread / transfer thread / consumer)
+    must attribute wall time per stage, and the attribution must add up:
+    dispatch ⊆ h2d, stage_seconds = pack + h2d + consume."""
+
+    def test_stage_seconds_recorded_and_consistent(self):
+        import time
+
+        stats = TransferStats()
+
+        def slow_get(k):
+            time.sleep(0.002)
+            return np.zeros(64, np.float32)
+
+        def slow_put(h):
+            time.sleep(0.002)
+            return h
+
+        def slow_consume(k, dev):
+            time.sleep(0.002)
+
+        run_prefetched(
+            6, slow_get, slow_put, slow_consume, depth=2, stats=stats
+        )
+        assert stats.pack_seconds > 0.0
+        assert stats.dispatch_seconds > 0.0
+        assert stats.h2d_seconds >= stats.dispatch_seconds
+        assert stats.consume_seconds > 0.0
+        expect = (
+            stats.pack_seconds + stats.h2d_seconds + stats.consume_seconds
+        )
+        assert abs(stats.stage_seconds - expect) < 1e-12
+        snap = stats.snapshot()
+        assert set(snap) >= {
+            "pack_seconds", "dispatch_seconds", "consume_seconds",
+            "stage_seconds",
+        }
+
+    def test_pack_runs_on_its_own_thread(self):
+        """get_item must execute off BOTH the caller thread and the
+        transfer thread — the split that lets packing overlap the link."""
+        import threading
+
+        names = set()
+
+        def get_item(k):
+            names.add(threading.current_thread().name)
+            return np.zeros(8, np.float32)
+
+        put_names = set()
+
+        def put(h):
+            put_names.add(threading.current_thread().name)
+            return h
+
+        run_prefetched(4, get_item, put, lambda k, d: None, depth=2)
+        assert names == {"h2d-pack"}
+        assert put_names == {"h2d-prefetch"}
+
+    def test_pack_failure_propagates_in_order(self):
+        """A pack-stage exception must surface at the failed item's
+        position AFTER items 0..k-1 were consumed (the two-thread relay
+        preserves stream order)."""
+        consumed = []
+
+        def get_item(k):
+            if k == 3:
+                raise RuntimeError("pack exploded")
+            return np.zeros(4, np.float32)
+
+        with pytest.raises(RuntimeError, match="pack exploded"):
+            run_prefetched(
+                8, get_item, lambda h: h,
+                lambda k, d: consumed.append(k), depth=2,
+            )
+        assert consumed == [0, 1, 2]
