@@ -1,0 +1,45 @@
+"""Deterministic fault injection + recovery verification (see core.py).
+
+Public surface::
+
+    chaos.maybe_fail(site, **ctx)   # the instrumented seams' hook
+    chaos.FaultPlan / chaos.FaultSpec
+    chaos.InjectedFault / chaos.InjectedDeviceLost
+    chaos.CircuitBreaker            # closed -> open -> half-open probe
+    chaos.KNOWN_SITES               # the fault-site catalog
+
+``python -m photon_ml_tpu.chaos --selfcheck`` runs the scripted
+kill/resume/degrade scenario end-to-end (docs/robustness.md).
+"""
+
+from photon_ml_tpu.chaos.breaker import (  # noqa: F401
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
+from photon_ml_tpu.chaos.core import (  # noqa: F401
+    EXCEPTIONS,
+    KNOWN_SITES,
+    FaultPlan,
+    FaultSpec,
+    InjectedDeviceLost,
+    InjectedFault,
+    current_plan,
+    maybe_fail,
+)
+
+__all__ = [
+    "CLOSED",
+    "HALF_OPEN",
+    "OPEN",
+    "CircuitBreaker",
+    "EXCEPTIONS",
+    "KNOWN_SITES",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedDeviceLost",
+    "InjectedFault",
+    "current_plan",
+    "maybe_fail",
+]

@@ -1,0 +1,509 @@
+"""Chaos CLI: the scripted kill/resume/degrade selfcheck.
+
+::
+
+    python -m photon_ml_tpu.chaos --selfcheck
+
+runs the whole recovery story end-to-end on the CPU backend (< 1 min,
+device-free, CI-greppable), proving — not asserting — that:
+
+1. a streamed GLM λ-grid killed at a grid-point boundary resumes through
+   the watchdog and lands on coefficients BITWISE identical to an
+   uninterrupted run;
+2. a mid-pass streaming fault (the carry-sync seam) tears down both
+   pipeline threads promptly — no deadlock, no leaked daemon thread
+   (``prefetch_thread_leak`` stays 0) — and the next clean pass is
+   bit-identical to a never-faulted one (no corrupted donated
+   accumulators);
+3. a GAME coordinate-descent run killed at a CD iteration boundary
+   resumes from ``cd_checkpoint.npz`` bitwise identically;
+4. a device-lost fault during serving degrades to host-side scoring with
+   ZERO request errors (scores correct, degraded flag on /healthz), and
+   the circuit breaker re-promotes once the fault clears;
+5. checkpoint hardening: a truncated newest checkpoint falls back to the
+   previous verifiable one, full corruption raises a pointed
+   :class:`~photon_ml_tpu.io.checkpoint.CheckpointCorruptError`, and a
+   kill between tmp-write and rename leaves the old checkpoint intact.
+
+``--list-sites`` prints the fault-site catalog; ``--plan FILE`` validates
+a JSON fault plan without running anything (CI lint for scripted
+scenarios).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+
+def _bitwise(a, b) -> bool:
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    return a.shape == b.shape and a.tobytes() == b.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Scenario 1+2: streamed GLM grid — kill/resume + mid-pass teardown
+# ---------------------------------------------------------------------------
+
+def _check_streamed_glm(tmp: str, failures: list[str]) -> None:
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+
+    from photon_ml_tpu import chaos
+    from photon_ml_tpu import telemetry as telemetry_mod
+    from photon_ml_tpu.data.streaming import make_streaming_glm_data
+    from photon_ml_tpu.io.checkpoint import GridCheckpointer
+    from photon_ml_tpu.optim.problem import (
+        GlmOptimizationConfig,
+        GlmOptimizationProblem,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.optim.regularization import RegularizationContext
+    from photon_ml_tpu.optim.streaming import (
+        StreamingObjective,
+        streaming_run_grid,
+    )
+    from photon_ml_tpu.utils.watchdog import (
+        RetryPolicy,
+        RetryStats,
+        run_with_retries,
+    )
+
+    rng = np.random.default_rng(7)
+    n, d = 240, 12
+    X = sp.random(n, d, density=0.4, random_state=3, format="csr",
+                  dtype=np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (np.asarray(X @ w_true).ravel() > 0).astype(np.float32)
+    stream = make_streaming_glm_data(X, y, chunk_rows=60, use_pallas=False)
+    problem = GlmOptimizationProblem(
+        "logistic",
+        GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=25, tolerance=1e-7),
+            regularization=RegularizationContext.l2(),
+        ),
+    )
+    lams = [3.0, 1.0, 0.3]
+
+    # Uninterrupted reference.
+    full = streaming_run_grid(problem, stream, lams)
+    ref = {lam: np.asarray(m.coefficients.means) for lam, m, _ in full}
+
+    # Killed-and-resumed run: the fault fires at the SECOND grid-point
+    # boundary (λ solved + checkpointed, next λ untouched); the watchdog
+    # re-enters the closure, which reloads the checkpoint.
+    ckpt = GridCheckpointer(os.path.join(tmp, "glm_ck"))
+    plan = chaos.FaultPlan([chaos.FaultSpec(site="grid.point", at=1)])
+
+    def train(attempt: int):
+        solved = ckpt.load() if attempt else {}
+        acc = dict(solved)
+
+        def on_solved(lam, w):
+            acc[lam] = np.asarray(w)
+            ckpt.save(acc)
+
+        return streaming_run_grid(
+            problem, stream, lams, solved=solved, on_solved=on_solved,
+        )
+
+    stats = RetryStats()
+    with plan:
+        resumed = run_with_retries(
+            train, RetryPolicy(max_retries=2), sleep=lambda s: None,
+            stats=stats,
+        )
+    if not plan.fired_at("grid.point"):
+        failures.append("streamed grid: the scripted kill never fired")
+    if stats.retries != 1:
+        failures.append(
+            f"streamed grid: expected exactly 1 watchdog retry, got "
+            f"{stats.retries}"
+        )
+    for lam, model, res in resumed:
+        if not _bitwise(ref[lam], model.coefficients.means):
+            failures.append(
+                f"streamed grid: resumed λ={lam} coefficients are NOT "
+                "bitwise identical to the uninterrupted run"
+            )
+    restored = sum(1 for _, _, res in resumed if res is None)
+    if restored != 2:
+        failures.append(
+            f"streamed grid: resume restored {restored} points from the "
+            "checkpoint, expected 2"
+        )
+
+    # Mid-pass teardown: a carry-sync fault aborts the pass promptly,
+    # leaks no pipeline thread, and the next clean pass is bit-identical
+    # to a never-faulted one.
+    sobj = StreamingObjective(problem.objective, stream)
+    w0 = jnp.zeros((d,), jnp.float32)
+    v_clean, g_clean = sobj.value_and_grad(w0, 1.0)
+    v_clean, g_clean = np.asarray(v_clean), np.asarray(g_clean)
+    tel = telemetry_mod.current()
+    leaks_before = tel.counter("prefetch_thread_leak").value
+    midpass = chaos.FaultPlan([
+        chaos.FaultSpec(site="streaming.carry_sync", at=2),
+    ])
+    with midpass:
+        try:
+            sobj.value_and_grad(w0, 1.0)
+            failures.append("mid-pass fault: the scripted fault never fired")
+        except chaos.InjectedFault:
+            pass
+    if tel.counter("prefetch_thread_leak").value != leaks_before:
+        failures.append(
+            "mid-pass fault: a prefetch pipeline thread leaked during "
+            "teardown"
+        )
+    v2, g2 = sobj.value_and_grad(w0, 1.0)
+    if not (_bitwise(v_clean, v2) and _bitwise(g_clean, g2)):
+        failures.append(
+            "mid-pass fault: the pass AFTER the fault is not bit-identical "
+            "to a clean pass (corrupted accumulators?)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Scenario 3: GAME CD — kill at an iteration boundary, resume bitwise
+# ---------------------------------------------------------------------------
+
+def _check_game_cd(tmp: str, failures: list[str]) -> None:
+    import scipy.sparse as sp
+
+    from photon_ml_tpu import chaos
+    from photon_ml_tpu.game.estimator import (
+        FixedEffectCoordinateConfig,
+        GameEstimator,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_ml_tpu.io.checkpoint import CoordinateDescentCheckpointer
+    from photon_ml_tpu.optim.problem import (
+        GlmOptimizationConfig,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.optim.regularization import RegularizationContext
+    from photon_ml_tpu.utils.watchdog import (
+        RetryPolicy,
+        RetryStats,
+        run_with_retries,
+    )
+
+    rng = np.random.default_rng(13)
+    n, n_users = 300, 10
+    user_effect = rng.normal(scale=2.0, size=n_users)
+    Xg = rng.normal(size=(n, 3)).astype(np.float32)
+    users = rng.integers(n_users, size=n)
+    margin = 1.3 * Xg[:, 0] - 0.7 * Xg[:, 1] + user_effect[users]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+    shards = {
+        "global": sp.csr_matrix(Xg),
+        "userFeatures": sp.csr_matrix(np.ones((n, 1), np.float32)),
+    }
+    ids = {"userId": np.array([f"u{u}" for u in users])}
+
+    opt = GlmOptimizationConfig(
+        optimizer=OptimizerConfig(max_iters=25, tolerance=1e-7),
+        regularization=RegularizationContext.l2(),
+    )
+    configs = lambda: {  # noqa: E731 — fresh configs per estimator
+        "fixed": FixedEffectCoordinateConfig(
+            feature_shard="global", optimization=opt, reg_weight=0.5
+        ),
+        "per_user": RandomEffectCoordinateConfig(
+            feature_shard="userFeatures", entity_key="userId",
+            optimization=opt, reg_weight=0.5,
+        ),
+    }
+
+    model_full, hist_full = GameEstimator(
+        "logistic", configs(), n_iterations=3
+    ).fit(shards, ids, y)
+
+    ck = CoordinateDescentCheckpointer(os.path.join(tmp, "cd_ck"))
+    plan = chaos.FaultPlan([chaos.FaultSpec(site="cd.iteration", at=1)])
+
+    def attempt(a: int):
+        return GameEstimator("logistic", configs(), n_iterations=3).fit(
+            shards, ids, y, checkpointer=ck
+        )
+
+    stats = RetryStats()
+    with plan:
+        model_res, hist_res = run_with_retries(
+            attempt, RetryPolicy(max_retries=2), sleep=lambda s: None,
+            stats=stats,
+        )
+    if not plan.fired_at("cd.iteration"):
+        failures.append("game cd: the scripted kill never fired")
+    if stats.retries != 1:
+        failures.append(
+            f"game cd: expected exactly 1 watchdog retry, got "
+            f"{stats.retries}"
+        )
+    if not _bitwise(
+        model_full["fixed"].model.coefficients.means,
+        model_res["fixed"].model.coefficients.means,
+    ):
+        failures.append(
+            "game cd: resumed fixed-effect coefficients are NOT bitwise "
+            "identical to the uninterrupted run"
+        )
+    cf = model_full["per_user"].coefficients
+    cr = model_res["per_user"].coefficients
+    if set(cf) != set(cr) or any(
+        not _bitwise(cf[k][1], cr[k][1]) for k in cf
+    ):
+        failures.append(
+            "game cd: resumed per-entity coefficients are NOT bitwise "
+            "identical to the uninterrupted run"
+        )
+    if len(hist_res) != len(hist_full):
+        failures.append(
+            f"game cd: resumed history has {len(hist_res)} entries, "
+            f"uninterrupted has {len(hist_full)}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Scenario 4: serving — degrade on device loss, re-promote via breaker
+# ---------------------------------------------------------------------------
+
+def _check_serving(tmp: str, failures: list[str]) -> None:
+    from photon_ml_tpu import chaos
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService
+    from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+    workload = SyntheticWorkload(n_entities=32, seed=5)
+    runtime = ScoringRuntime(
+        workload.model, workload.index_maps,
+        RuntimeConfig(
+            max_batch_size=4, hot_entities=8, breaker_cooldown_s=0.0
+        ),
+    )
+    requests = [workload.request(i) for i in range(16)]
+    rows = [runtime.parse_request(r) for r in requests]
+    # Healthy-path reference BEFORE any plan is installed (these batches
+    # must not consume serving.device occurrences).
+    reference = np.asarray(
+        [runtime.score_rows([row])[0][0] for row in rows], np.float32
+    )
+
+    service = ScoringService(runtime, BatcherConfig(
+        max_batch_size=4, max_wait_us=0, max_queue=64,
+    ))
+    # Device lost for 4 consecutive batches, then it "comes back".
+    plan = chaos.FaultPlan([
+        chaos.FaultSpec(
+            site="serving.device", at=0, count=4,
+            exception="InjectedDeviceLost",
+        ),
+    ])
+    degraded_seen = False
+    errors = 0
+    served = np.zeros(len(rows), np.float32)
+    with service, plan:
+        for i, req in enumerate(requests):
+            result = service.score(req)
+            if "error" in result:
+                errors += 1
+            else:
+                served[i] = np.float32(result["score"])
+            if service.healthz()["degraded"]:
+                degraded_seen = True
+    if errors:
+        failures.append(
+            f"serving: {errors} request(s) errored during the device-lost "
+            "window — degraded mode must keep every request succeeding"
+        )
+    if not degraded_seen:
+        failures.append("serving: the degraded flag never showed on healthz")
+    if not plan.fired_at("serving.device"):
+        failures.append("serving: the scripted device fault never fired")
+    if runtime.degraded or runtime.breaker.state != "closed":
+        failures.append(
+            f"serving: breaker did not re-promote after the fault cleared "
+            f"(degraded={runtime.degraded}, breaker="
+            f"{runtime.breaker.state})"
+        )
+    if runtime.repromotions < 1 or runtime.degraded_batches < 1:
+        failures.append(
+            "serving: expected >= 1 degraded batch and >= 1 re-promotion, "
+            f"got {runtime.degraded_batches} / {runtime.repromotions}"
+        )
+    if not np.allclose(served, reference, rtol=1e-5, atol=1e-6):
+        bad = int(np.argmax(~np.isclose(served, reference,
+                                        rtol=1e-5, atol=1e-6)))
+        failures.append(
+            "serving: degraded-mode scores diverge from the healthy "
+            f"reference (first bad row {bad}: {served[bad]!r} vs "
+            f"{reference[bad]!r})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Scenario 5: checkpoint hardening — torn files, fallback, mid-save kill
+# ---------------------------------------------------------------------------
+
+def _check_checkpoint_hardening(tmp: str, failures: list[str]) -> None:
+    from photon_ml_tpu import chaos
+    from photon_ml_tpu.io.checkpoint import (
+        CheckpointCorruptError,
+        GridCheckpointer,
+    )
+
+    ck = GridCheckpointer(os.path.join(tmp, "hard_ck"))
+    w1 = {1.0: np.ones(4, np.float32)}
+    w2 = {1.0: np.ones(4, np.float32), 0.5: np.full(4, 2.0, np.float32)}
+    ck.save(w1)
+    ck.save(w2)
+
+    # Kill between tmp-write and rename: the published checkpoint must
+    # still be the complete previous one.
+    with chaos.FaultPlan([chaos.FaultSpec(site="checkpoint.save", at=0)]):
+        try:
+            ck.save({**w2, 0.1: np.zeros(4, np.float32)})
+            failures.append("hardening: mid-save kill never fired")
+        except chaos.InjectedFault:
+            pass
+    if sorted(ck.load()) != sorted(w2):
+        failures.append(
+            "hardening: a kill before the atomic rename damaged the "
+            "published checkpoint"
+        )
+
+    # Truncate the newest file: restore must fall back to the previous
+    # verifiable generation (w1), not crash and not return nothing.
+    with open(ck.path, "r+b") as f:
+        f.truncate(32)
+    loaded = ck.load()
+    if sorted(loaded) != sorted(w1):
+        failures.append(
+            f"hardening: fallback after truncation loaded {sorted(loaded)} "
+            f"instead of the previous generation {sorted(w1)}"
+        )
+
+    # Corrupt every retained generation: a pointed error naming the path.
+    with open(ck.path + ".1", "r+b") as f:
+        f.truncate(16)
+    try:
+        ck.load()
+        failures.append(
+            "hardening: fully-corrupt checkpoints loaded without error"
+        )
+    except CheckpointCorruptError as exc:
+        if ck.path not in str(exc):
+            failures.append(
+                f"hardening: corruption error does not name the path: {exc}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def run_selfcheck(out_dir: str) -> list[str]:
+    """Returns failure strings (empty = pass)."""
+    from photon_ml_tpu import telemetry as telemetry_mod
+
+    failures: list[str] = []
+    with telemetry_mod.Telemetry(
+        output_dir=out_dir, run_name="chaos-selfcheck"
+    ) as tel:
+        with tel.span("selfcheck", subsystem="chaos"):
+            with tel.span("streamed_glm_kill_resume"):
+                _check_streamed_glm(out_dir, failures)
+            with tel.span("game_cd_kill_resume"):
+                _check_game_cd(out_dir, failures)
+            with tel.span("serving_degrade"):
+                _check_serving(out_dir, failures)
+            with tel.span("checkpoint_hardening"):
+                _check_checkpoint_hardening(out_dir, failures)
+        snap = tel.snapshot()
+    injected = snap["counters"].get("chaos_faults_injected", 0)
+    if injected < 4:
+        failures.append(
+            f"chaos_faults_injected counter is {injected}, expected >= 4 "
+            "(one per scripted scenario)"
+        )
+    if snap["counters"].get("prefetch_thread_leak", 0):
+        failures.append("prefetch_thread_leak counter is nonzero")
+    if not os.path.exists(os.path.join(out_dir, "metrics.json")):
+        failures.append(f"missing {os.path.join(out_dir, 'metrics.json')}")
+    if not failures:
+        print(
+            f"chaos selfcheck: {injected} scripted faults injected; "
+            "streamed-grid + GAME-CD kill/resume bitwise-identical, "
+            "mid-pass teardown leak-free, serving degraded with 0 errors "
+            "and re-promoted, checkpoint fallback + pointed corruption "
+            "errors verified"
+        )
+    return failures
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m photon_ml_tpu.chaos",
+        description="deterministic fault injection / recovery selfcheck",
+    )
+    p.add_argument("--selfcheck", action="store_true")
+    p.add_argument(
+        "--list-sites", action="store_true",
+        help="print the fault-site catalog as JSON",
+    )
+    p.add_argument(
+        "--plan", metavar="FILE",
+        help="validate a JSON fault plan (parse + site/spec checks) "
+        "without running anything",
+    )
+    p.add_argument(
+        "--output-dir",
+        help="telemetry output dir (selfcheck defaults to a tempdir)",
+    )
+    args = p.parse_args(argv)
+
+    if args.list_sites:
+        from photon_ml_tpu.chaos import KNOWN_SITES
+
+        print(json.dumps(KNOWN_SITES, indent=2))
+        return 0
+
+    if args.plan:
+        from photon_ml_tpu.chaos import FaultPlan
+
+        with open(args.plan) as f:
+            plan = FaultPlan.from_json(f.read())
+        print(f"{args.plan}: {len(plan.faults)} fault spec(s) valid")
+        return 0
+
+    if not args.selfcheck:
+        p.print_help()
+        return 2
+
+    if args.output_dir:
+        os.makedirs(args.output_dir, exist_ok=True)
+        failures = run_selfcheck(args.output_dir)
+    else:
+        with tempfile.TemporaryDirectory(
+            prefix="photon_chaos_selfcheck_"
+        ) as td:
+            failures = run_selfcheck(td)
+    if failures:
+        print("chaos selfcheck FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("chaos selfcheck PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,326 @@
+"""Deterministic fault injection: named sites, scripted plans.
+
+The reference inherits its fault-tolerance STORY from Spark (task retry,
+lineage re-execution — SURVEY.md §5.3) and its fault-tolerance PROOF from
+running on clusters where machines actually die.  A single-process TPU
+driver has neither: recovery here is checkpoint + resume through
+``utils/watchdog.py``, and until this module existed nothing in the repo
+ever killed a run mid-flight — the recovery story was asserted, not
+verified.
+
+This module is the verification substrate: a seeded, deterministic
+fault-injection layer with NAMED sites wired through the hot seams
+(prefetch pack/transfer threads, staged h2d puts, the streamed carry
+sync, checkpoint save/restore, CD iteration boundaries, grid-point
+boundaries, the serving device path, tuning trials).  A
+:class:`FaultPlan` — JSON-scriptable, so crash schedules live in test
+files and CI recipes — names a site, an occurrence index, and what to
+inject (an exception from a small registry, or a delay), and the plan
+replays EXACTLY: occurrence counters are plan-local and thread-safe, so
+the same plan against the same workload kills at the same boundary
+every time.
+
+Cost contract (mirrors the telemetry hub): with no plan installed,
+every instrumented seam pays ONE module-global read + one branch
+(:func:`maybe_fail`).  ``bench.py``'s ``BENCH_ONLY=chaos`` section
+measures that disabled path against the streamed pass wall and gates it
+at ≤ 1%.
+
+Usage::
+
+    from photon_ml_tpu import chaos
+
+    plan = chaos.FaultPlan([
+        chaos.FaultSpec(site="grid.point", at=1,
+                        message="UNAVAILABLE: injected preemption"),
+    ])
+    with plan:
+        ...  # the second grid-point boundary raises InjectedFault
+
+    plan.fired  # -> [{"site": "grid.point", "occurrence": 1, ...}]
+
+Exception messages default to watchdog-transient vocabulary
+("UNAVAILABLE: ..."), so an injected fault exercises the SAME
+classify/backoff/resume machinery a real lost device would.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Optional, Sequence
+
+from photon_ml_tpu import telemetry as telemetry_mod
+
+
+class InjectedFault(RuntimeError):
+    """An exception raised on purpose by an installed :class:`FaultPlan`.
+
+    Default messages carry watchdog-transient markers so the injected
+    fault rides the real recovery path; a plan can override the message
+    to exercise the non-transient vocabulary instead."""
+
+
+class InjectedDeviceLost(InjectedFault):
+    """A chaos stand-in for the runtime losing its accelerator (the
+    XlaRuntimeError("UNAVAILABLE: ...") family) — what the serving
+    degraded-mode path and the training watchdog both classify as
+    transient."""
+
+
+#: Exception types a FaultSpec may name.  Deliberately small: injected
+#: faults should either speak the watchdog vocabulary (InjectedFault /
+#: InjectedDeviceLost with a gRPC-ish message) or be a plain stdlib type
+#: a seam's own error handling already knows.
+EXCEPTIONS = {
+    "InjectedFault": InjectedFault,
+    "InjectedDeviceLost": InjectedDeviceLost,
+    "RuntimeError": RuntimeError,
+    "OSError": OSError,
+    "TimeoutError": TimeoutError,
+    "ValueError": ValueError,
+}
+
+
+#: The fault-site catalog: every name ``maybe_fail`` is called with, and
+#: what a fault there simulates.  Plans naming an unknown site are
+#: refused at construction (a typo'd site would silently never fire and
+#: the test would "pass" without killing anything).  docs/robustness.md
+#: renders this table.
+KNOWN_SITES = {
+    "prefetch.pack": (
+        "pack thread, before get_item(k): host materialization dies "
+        "mid-stream (data/prefetch.py)"
+    ),
+    "prefetch.transfer": (
+        "transfer thread, before put(item): the h2d dispatch path dies "
+        "mid-stream (data/prefetch.py)"
+    ),
+    "staging.put": (
+        "the staged device_put of one chunk's coalesced buffers "
+        "(optim/streaming.py _put, on the transfer thread)"
+    ),
+    "streaming.carry_sync": (
+        "consumer thread, before dispatching chunk k's program into the "
+        "carry window (optim/streaming.py _stream_accumulate)"
+    ),
+    "checkpoint.save": (
+        "after the checkpoint tmp file is written+fsynced, BEFORE the "
+        "atomic rename publishes it (io/checkpoint.py) — a kill here "
+        "must leave the previous checkpoint intact"
+    ),
+    "checkpoint.restore": (
+        "at restore entry, before the checkpoint file is opened "
+        "(io/checkpoint.py)"
+    ),
+    "cd.iteration": (
+        "GAME coordinate-descent iteration boundary, after that "
+        "iteration's checkpoint save (game/descent.py)"
+    ),
+    "grid.point": (
+        "λ-grid point boundary, after on_solved persisted the point "
+        "(optim/problem.py grid_loop)"
+    ),
+    "serving.batch": (
+        "batcher dispatch, before the runtime scores a batch "
+        "(serving/batcher.py)"
+    ),
+    "serving.device": (
+        "the device scoring kernel call (serving/runtime.py) — a fault "
+        "here simulates a lost accelerator and must flip the runtime "
+        "into degraded host-side scoring"
+    ),
+    "tuning.trial": (
+        "worker thread, before a tuning trial's fit runs "
+        "(tuning/executor.py)"
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scripted fault: fire at site ``site`` on occurrence ``at``
+    (0-based, counted per plan per site), for ``count`` consecutive
+    occurrences (-1 = every occurrence from ``at`` on).
+
+    ``action`` is ``"raise"`` (build ``exception`` with ``message``) or
+    ``"delay"`` (sleep ``delay_seconds`` then continue — for deadline /
+    stall scenarios).  The default message speaks the watchdog's
+    transient vocabulary and names the site, so logs and RetryStats say
+    exactly which scripted fault fired.
+    """
+
+    site: str
+    at: int = 0
+    count: int = 1
+    action: str = "raise"  # "raise" | "delay"
+    exception: str = "InjectedFault"
+    message: Optional[str] = None
+    delay_seconds: float = 0.0
+
+    def __post_init__(self):
+        if self.site not in KNOWN_SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r}; known sites: "
+                f"{sorted(KNOWN_SITES)}"
+            )
+        if self.action not in ("raise", "delay"):
+            raise ValueError(
+                f"action must be 'raise' or 'delay', got {self.action!r}"
+            )
+        if self.exception not in EXCEPTIONS:
+            raise ValueError(
+                f"unknown exception {self.exception!r}; registry: "
+                f"{sorted(EXCEPTIONS)}"
+            )
+        if self.at < 0:
+            raise ValueError(f"at must be >= 0, got {self.at}")
+        if self.count < -1 or self.count == 0:
+            raise ValueError(
+                f"count must be positive or -1 (forever), got {self.count}"
+            )
+
+    def matches(self, occurrence: int) -> bool:
+        if occurrence < self.at:
+            return False
+        if self.count == -1:
+            return True
+        return occurrence < self.at + self.count
+
+    def build_exception(self, occurrence: int) -> BaseException:
+        msg = self.message
+        if msg is None:
+            msg = (
+                f"UNAVAILABLE: chaos-injected fault at site "
+                f"{self.site!r} (occurrence {occurrence})"
+            )
+        return EXCEPTIONS[self.exception](msg)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSpec":
+        return cls(**d)
+
+
+class FaultPlan:
+    """A repeatable crash schedule: scripted faults + per-site occurrence
+    counters + a log of what actually fired.
+
+    Install with :meth:`install` / :meth:`uninstall` or as a context
+    manager; only one plan may be installed at a time (two concurrent
+    plans would race each other's occurrence counters and neither
+    schedule would be deterministic).  Counters persist across
+    uninstall/reinstall of the SAME plan object — that is what lets a
+    kill/resume scenario arm "occurrence 1" once and have the resumed
+    run sail past it.
+    """
+
+    def __init__(self, faults: Sequence[FaultSpec] = ()):
+        self.faults = list(faults)
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        #: what fired, in order: {"site", "occurrence", "action", ...}
+        self.fired: list[dict] = []
+
+    # -- (de)serialization --------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps([f.to_dict() for f in self.faults], indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        specs = json.loads(text)
+        if not isinstance(specs, list):
+            raise ValueError("a fault plan is a JSON list of fault specs")
+        return cls([FaultSpec.from_dict(d) for d in specs])
+
+    # -- installation -------------------------------------------------------
+    def install(self) -> "FaultPlan":
+        global _PLAN
+        with _INSTALL_LOCK:
+            if _PLAN is not None and _PLAN is not self:
+                raise RuntimeError(
+                    "another FaultPlan is already installed; uninstall it "
+                    "first (concurrent plans would race occurrence "
+                    "counters)"
+                )
+            _PLAN = self
+        return self
+
+    def uninstall(self) -> None:
+        global _PLAN
+        with _INSTALL_LOCK:
+            if _PLAN is self:
+                _PLAN = None
+
+    def __enter__(self) -> "FaultPlan":
+        return self.install()
+
+    def __exit__(self, *exc) -> bool:
+        self.uninstall()
+        return False
+
+    # -- observation --------------------------------------------------------
+    def occurrences(self, site: str) -> int:
+        """How many times ``site`` has been reached under this plan."""
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def fired_at(self, site: str) -> list[dict]:
+        with self._lock:
+            return [f for f in self.fired if f["site"] == site]
+
+    # -- the hot path (called via maybe_fail) --------------------------------
+    def _hit(self, site: str, ctx: dict) -> None:
+        with self._lock:
+            occurrence = self._counts.get(site, 0)
+            self._counts[site] = occurrence + 1
+            spec = next(
+                (f for f in self.faults
+                 if f.site == site and f.matches(occurrence)),
+                None,
+            )
+            if spec is None:
+                return
+            record = {
+                "site": site,
+                "occurrence": occurrence,
+                "action": spec.action,
+                **{k: telemetry_mod.json_safe(v) for k, v in ctx.items()},
+            }
+            self.fired.append(record)
+        tel = telemetry_mod.current()
+        tel.counter("chaos_faults_injected").inc()
+        tel.event("chaos.fault", **record)
+        if spec.action == "delay":
+            time.sleep(spec.delay_seconds)
+            return
+        raise spec.build_exception(occurrence)
+
+
+_INSTALL_LOCK = threading.Lock()
+_PLAN: Optional[FaultPlan] = None
+
+
+def current_plan() -> Optional[FaultPlan]:
+    """The installed plan, or None (the default, zero-cost state)."""
+    return _PLAN
+
+
+def maybe_fail(site: str, **ctx) -> None:
+    """The instrumented seams' hook: a no-op unless a plan is installed.
+
+    Disabled path = one global read + one branch (the whole cost
+    contract); with a plan installed, the plan counts the occurrence
+    and fires any matching scripted fault (raise or delay).  ``ctx``
+    (chunk index, λ, trial id, ...) rides the injection log and the
+    ``chaos.fault`` telemetry event — it is only touched when a fault
+    actually fires.
+    """
+    plan = _PLAN
+    if plan is None:
+        return
+    plan._hit(site, ctx)

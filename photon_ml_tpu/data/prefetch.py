@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import dataclasses
 import queue
+import sys
 import threading
 import time
 from typing import Callable
@@ -59,6 +60,12 @@ from typing import Callable
 import jax
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.chaos import core as chaos_mod
+
+#: how long the caller waits for the background threads after a pass (a
+#: healthy pipeline joins in microseconds — this bounds a WEDGED thread).
+#: Module-level so tests can shrink it without patching call sites.
+JOIN_TIMEOUT_SECONDS = 30.0
 
 
 @dataclasses.dataclass
@@ -248,6 +255,7 @@ def run_prefetched(
             for k in range(n_items):
                 if abort.is_set():
                     return
+                chaos_mod.maybe_fail("prefetch.pack", item=k)
                 t0 = time.perf_counter()
                 host = get_item(k)
                 stats.pack_seconds += time.perf_counter() - t0
@@ -295,6 +303,7 @@ def run_prefetched(
                     )
                 if abort.is_set():
                     return
+                chaos_mod.maybe_fail("prefetch.transfer", item=k)
                 t0 = time.perf_counter()
                 dev = put(host)
                 stats.dispatch_seconds += time.perf_counter() - t0
@@ -342,8 +351,27 @@ def run_prefetched(
         abort.set()
         raise
     finally:
-        packer.join(timeout=30.0)
-        transfer.join(timeout=30.0)
+        packer.join(timeout=JOIN_TIMEOUT_SECONDS)
+        transfer.join(timeout=JOIN_TIMEOUT_SECONDS)
+        leaked = [t.name for t in (packer, transfer) if t.is_alive()]
+        if leaked:
+            # A wedged daemon thread outliving its pass is a leak — it
+            # pins chunk buffers and (on the transfer thread) the device
+            # transport.  Returning normally here used to hide that
+            # entirely; now it is counted, and raised when this pass was
+            # otherwise about to succeed (an already-propagating failure
+            # keeps priority — the count still records the leak).
+            tel = telemetry_mod.current()
+            tel.counter("prefetch_thread_leak").inc(len(leaked))
+            tel.event("prefetch.thread_leak", threads=leaked)
+            if sys.exc_info()[0] is None:
+                raise RuntimeError(
+                    f"prefetch pipeline thread(s) {leaked} still alive "
+                    f"after join(timeout={JOIN_TIMEOUT_SECONDS}s): a "
+                    "wedged daemon thread leaked — its blocking call "
+                    "(get_item/put/transfer wait) never returned; the "
+                    "pass's results cannot be trusted to be complete"
+                )
         while True:  # drop any queued device refs deterministically
             try:
                 q.get_nowait()

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.game.coordinates import Coordinate
 
 
@@ -311,6 +312,11 @@ class CoordinateDescent:
                         it, total, scores, states, history,
                         locked=sorted(locked),
                     )
+                # The CD outer-iteration boundary (the distributed-CD
+                # resume point): iteration ``it`` is complete AND
+                # checkpointed; a kill here must resume at it+1
+                # bit-identically (docs/robustness.md).
+                chaos_mod.maybe_fail("cd.iteration", iteration=it)
             if flush_per_iteration and tel.enabled:
                 # The flush materialized device scalars (a real sync), so
                 # this iteration wall is achieved wall-clock, not

@@ -21,10 +21,24 @@ and an embedded JSON metadata string, written to a temp path and atomically
 renamed — a kill at any instant leaves either the previous complete
 checkpoint or the new complete one, never a torn pairing of old metadata
 with new arrays.
+
+Hardening (the fsync above the rename guards the NAMESPACE; these guard
+the BYTES):
+
+- every save embeds a sha256 digest of the payload arrays
+  (``__checksum__``), recomputed and compared at restore — bit rot or a
+  torn write raises :class:`CheckpointCorruptError` naming the path and
+  both digests instead of a raw ``zipfile``/``OSError`` from deep inside
+  numpy;
+- saves retain the last ``keep_last`` checkpoints (``path`` newest,
+  ``path.1`` previous, ...), and restore automatically falls back to the
+  NEWEST VERIFIABLE one — a corrupted latest checkpoint costs one
+  checkpoint interval of progress, not the whole run.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Optional
@@ -32,6 +46,25 @@ from typing import Optional
 import numpy as np
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.chaos import core as chaos_mod
+
+#: retained checkpoint generations per path (newest + K-1 fallbacks).
+DEFAULT_KEEP_LAST = 2
+
+_CHECKSUM_KEY = "__checksum__"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file exists but cannot be trusted: unreadable npz
+    (truncated/torn) or a payload-checksum mismatch.  Carries the path
+    and the reason so the operator knows WHICH file to delete or restore
+    from backup — the raw ``zipfile.BadZipFile`` this used to surface
+    named neither."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupt checkpoint {path}: {reason}")
+        self.path = path
+        self.reason = reason
 
 
 def fsync_file(f) -> None:
@@ -42,7 +75,31 @@ def fsync_file(f) -> None:
     os.fsync(f.fileno())
 
 
-def _atomic_savez(path: str, arrays: dict) -> None:
+def _payload_digest(arrays: dict) -> str:
+    """sha256 over every payload array's (name, dtype, shape, bytes), in
+    sorted name order — deterministic, and covering exactly what
+    ``np.load`` hands back, so save-time and restore-time digests agree
+    iff the arrays round-tripped intact."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.asarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(tuple(a.shape)).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def _retained_paths(path: str, keep_last: int) -> list[str]:
+    """Newest-first candidate list: ``path``, ``path.1``, ..."""
+    return [path] + [f"{path}.{i}" for i in range(1, max(keep_last, 1))]
+
+
+def _atomic_savez(
+    path: str, arrays: dict, keep_last: int = 1
+) -> None:
+    arrays = dict(arrays)
+    arrays[_CHECKSUM_KEY] = np.asarray(_payload_digest(arrays))
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
@@ -50,6 +107,17 @@ def _atomic_savez(path: str, arrays: dict) -> None:
         # but not a data barrier — a power cut after the rename could
         # otherwise leave a complete-looking checkpoint with torn bytes.
         fsync_file(f)
+    # Mid-save crash boundary: tmp is complete but unpublished — a kill
+    # here must leave the previous checkpoint (and its fallbacks) intact.
+    chaos_mod.maybe_fail("checkpoint.save", path=path)
+    # Keep-last-K rotation (newest -> .1 -> .2 ...), oldest dropped by
+    # overwrite.  Each shift is its own atomic replace, so any crash
+    # point leaves every retained slot either its old or new complete
+    # file — never a torn one.
+    for i in range(max(keep_last, 1) - 1, 0, -1):
+        src = path if i == 1 else f"{path}.{i - 1}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{i}")
     os.replace(tmp, path)
 
 
@@ -95,14 +163,78 @@ def _unflatten_state(prefix: str, spec, arrays: dict):
     ]
 
 
-def _load_npz_with_meta(path: str) -> Optional[tuple[dict, dict]]:
-    """Returns (meta, arrays) or None if the file doesn't exist."""
-    if not os.path.exists(path):
-        return None
-    with np.load(path) as z:
-        arrays = {k: z[k] for k in z.files}
-    meta = json.loads(str(arrays.pop("__meta__")))
+def _verified_load(path: str) -> tuple[dict, dict]:
+    """Load + verify ONE npz checkpoint file; raises
+    :class:`CheckpointCorruptError` on a torn/truncated file or a
+    checksum mismatch.  Files written before the checksum era (no
+    ``__checksum__`` entry) load unverified."""
+    try:
+        with np.load(path) as z:
+            arrays = {k: z[k] for k in z.files}
+    except Exception as exc:  # noqa: BLE001 — numpy surfaces zipfile/
+        # OSError/EOFError/ValueError depending on WHERE the file is torn;
+        # all of them mean the same thing here.
+        raise CheckpointCorruptError(
+            path,
+            f"unreadable npz ({type(exc).__name__}: {exc}) — the file is "
+            "truncated or torn (killed mid-write on a pre-atomic-rename "
+            "writer, or disk corruption)",
+        ) from exc
+    recorded = arrays.pop(_CHECKSUM_KEY, None)
+    if recorded is not None:
+        computed = _payload_digest(arrays)
+        if str(recorded) != computed:
+            raise CheckpointCorruptError(
+                path,
+                f"payload checksum mismatch (recorded {recorded}, "
+                f"computed {computed}) — the arrays do not match what "
+                "was saved",
+            )
+    try:
+        meta = json.loads(str(arrays.pop("__meta__")))
+    except (KeyError, ValueError) as exc:
+        raise CheckpointCorruptError(
+            path, f"missing/unparseable __meta__ record ({exc})"
+        ) from exc
     return meta, arrays
+
+
+def _load_npz_with_meta(
+    path: str, keep_last: int = 1
+) -> Optional[tuple[dict, dict]]:
+    """Returns (meta, arrays) from the newest VERIFIABLE retained
+    checkpoint, or None if none exists.
+
+    Corruption handling: a corrupt newest file falls back to the next
+    retained generation (with a warning + ``checkpoint_corruptions``
+    counter); when every existing candidate is corrupt, the NEWEST one's
+    error propagates — silently returning None there would restart the
+    run from scratch as if no checkpoint had ever been written."""
+    chaos_mod.maybe_fail("checkpoint.restore", path=path)
+    first_error: Optional[CheckpointCorruptError] = None
+    tel = telemetry_mod.current()
+    for p in _retained_paths(path, keep_last):
+        if not os.path.exists(p):
+            continue
+        try:
+            result = _verified_load(p)
+        except CheckpointCorruptError as exc:
+            first_error = first_error or exc
+            tel.counter("checkpoint_corruptions").inc()
+            tel.event("checkpoint.corrupt", path=p, reason=exc.reason)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s; trying the previous retained checkpoint", exc
+            )
+            continue
+        if p != path:
+            tel.counter("checkpoint_fallbacks").inc()
+            tel.event("checkpoint.fallback", path=p, wanted=path)
+        return result
+    if first_error is not None:
+        raise first_error
+    return None
 
 
 class CoordinateDescentCheckpointer:
@@ -124,16 +256,18 @@ class CoordinateDescentCheckpointer:
 
     FILENAME = "cd_checkpoint.npz"
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, keep_last: int = DEFAULT_KEEP_LAST):
         self.directory = directory
         self.path = os.path.join(directory, self.FILENAME)
+        self.keep_last = max(int(keep_last), 1)
 
     def exists(self) -> bool:
         return os.path.exists(self.path)
 
     def clear(self) -> None:
-        if self.exists():
-            os.remove(self.path)
+        for p in _retained_paths(self.path, self.keep_last):
+            if os.path.exists(p):
+                os.remove(p)
 
     def save(
         self,
@@ -172,7 +306,7 @@ class CoordinateDescentCheckpointer:
                 }
             )
         )
-        _atomic_savez(self.path, arrays)
+        _atomic_savez(self.path, arrays, keep_last=self.keep_last)
         _checkpoint_event("save", self.path, store="cd", iteration=iteration)
 
     def load(self) -> Optional[dict]:
@@ -182,7 +316,7 @@ class CoordinateDescentCheckpointer:
         refused (None, with a warning): its random-effect state shapes
         were padded to the OLD grid and would shape-crash deep inside
         the rebuilt coordinates' vmapped solvers."""
-        loaded = _load_npz_with_meta(self.path)
+        loaded = _load_npz_with_meta(self.path, keep_last=self.keep_last)
         if loaded is None:
             return None
         meta, arrays = loaded
@@ -244,16 +378,18 @@ class GridCheckpointer:
 
     FILENAME = "grid_checkpoint.npz"
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, keep_last: int = DEFAULT_KEEP_LAST):
         self.directory = directory
         self.path = os.path.join(directory, self.FILENAME)
+        self.keep_last = max(int(keep_last), 1)
 
     def exists(self) -> bool:
         return os.path.exists(self.path)
 
     def clear(self) -> None:
-        if self.exists():
-            os.remove(self.path)
+        for p in _retained_paths(self.path, self.keep_last):
+            if os.path.exists(p):
+                os.remove(p)
 
     def save(self, solved: dict, extra_meta: Optional[dict] = None) -> None:
         """``solved``: λ (float) → coefficient vector, in solve order.
@@ -270,14 +406,14 @@ class GridCheckpointer:
         if extra_meta:
             meta.update(extra_meta)
         arrays["__meta__"] = np.asarray(json.dumps(meta))
-        _atomic_savez(self.path, arrays)
+        _atomic_savez(self.path, arrays, keep_last=self.keep_last)
         _checkpoint_event(
             "save", self.path, store="grid", solved=len(solved)
         )
 
     def load(self) -> dict:
         """Returns λ → coefficient vector (insertion order = solve order)."""
-        loaded = _load_npz_with_meta(self.path)
+        loaded = _load_npz_with_meta(self.path, keep_last=self.keep_last)
         if loaded is None:
             return {}
         meta, arrays = loaded
@@ -289,7 +425,7 @@ class GridCheckpointer:
     def load_meta(self) -> dict:
         """The checkpoint's metadata dict ({} when no checkpoint exists):
         ``lambdas`` plus whatever ``extra_meta`` the writer recorded."""
-        loaded = _load_npz_with_meta(self.path)
+        loaded = _load_npz_with_meta(self.path, keep_last=self.keep_last)
         return {} if loaded is None else loaded[0]
 
 

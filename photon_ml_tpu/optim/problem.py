@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.data.dataset import GlmData
 from photon_ml_tpu.data.normalization import NormalizationContext
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
@@ -322,6 +323,10 @@ class GlmOptimizationProblem:
                 w = res.w
                 if on_solved is not None:
                     on_solved(lam, w)
+                # The natural crash/resume boundary of the warm-start
+                # chain: the point is solved AND persisted, nothing of
+                # the next λ has started (docs/robustness.md).
+                chaos_mod.maybe_fail("grid.point", reg_weight=float(lam))
             variances = variance_fn(w, lam) if variance_fn is not None else None
             results.append((lam, self.make_model(w, variances), res))
             if warm_start:

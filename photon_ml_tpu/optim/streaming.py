@@ -57,6 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.data.prefetch import TransferStats, run_prefetched
 from photon_ml_tpu.data.streaming import StreamingGlmData
 from photon_ml_tpu.parallel.compat import shard_map
@@ -537,6 +538,7 @@ class StreamingObjective:
         )
 
     def _put(self, chunk):
+        chaos_mod.maybe_fail("staging.put")
         if self._sharding is not None:
             if self._multihost:
                 # Each process contributes ONLY its local shard block of
@@ -686,6 +688,7 @@ class StreamingObjective:
         ring: collections.deque = collections.deque()
 
         def consume(i, dev):
+            chaos_mod.maybe_fail("streaming.carry_sync", item=i)
             carry_box[0] = progs[i](
                 *carry_box[0], *args, items_off[i], dev
             )

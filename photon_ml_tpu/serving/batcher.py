@@ -32,6 +32,7 @@ from concurrent.futures import Future
 from typing import Optional
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.utils.watchdog import RetryPolicy
 
 
@@ -209,6 +210,7 @@ class MicroBatcher:
         if not live:
             return
         try:
+            chaos_mod.maybe_fail("serving.batch", rows=len(live))
             margins, means = self.runtime.score_rows([p.row for p in live])
         except Exception as exc:  # noqa: BLE001 — classified + surfaced
             for p in live:

@@ -22,6 +22,18 @@ a request-path scorer:
 All mutation (LRU order, hot-table updates) happens on the dispatch
 thread — the MicroBatcher owns scoring — so the runtime needs no locks;
 ``parse_request`` is read-only and safe from any request thread.
+
+**Graceful degradation** — the runtime survives losing its accelerator:
+a device-path failure the watchdog vocabulary classifies as transient
+(``UNAVAILABLE``/device lost/...) flips the runtime into DEGRADED mode —
+every batch scores through a pure-numpy host cold path (same margins and
+mean link, no device touch, correct scores at host float tolerance) and
+requests keep succeeding with zero errors.  A per-runtime circuit
+breaker (:class:`photon_ml_tpu.chaos.CircuitBreaker`, closed → open →
+half-open) guards re-promotion: after ``breaker_cooldown_s`` one batch
+probes the device path; success re-promotes (degraded flag clears), a
+failed probe re-opens the breaker and degraded serving continues.  The
+``degraded`` flag rides ``/healthz`` and ``/stats``.
 """
 
 from __future__ import annotations
@@ -34,6 +46,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.chaos import core as chaos_mod
+from photon_ml_tpu.chaos.breaker import CircuitBreaker
 from photon_ml_tpu.game.model import (
     FixedEffectModel,
     GameModel,
@@ -56,6 +70,29 @@ class RuntimeConfig:
     #: compile every bucket at startup (skip only in tests that assert on
     #: compile behavior themselves).
     warmup: bool = True
+    #: seconds the circuit breaker stays OPEN after a device-path failure
+    #: before admitting one half-open probe batch (re-promotion guard).
+    breaker_cooldown_s: float = 5.0
+    #: consecutive device-path failures before the breaker trips.
+    breaker_failure_threshold: int = 1
+
+
+def _host_mean(task: str, margins: np.ndarray) -> np.ndarray:
+    """The mean link evaluated with host numpy (degraded-mode scoring):
+    the same inverse links ops/losses.py defines, no device touch.  The
+    logistic branch mirrors jax.nn.sigmoid's numerically-stable split so
+    large |margin| rows agree with the device path."""
+    if task == "logistic":
+        out = np.empty_like(margins, np.float32)
+        pos = margins >= 0
+        out[pos] = 1.0 / (1.0 + np.exp(-margins[pos]))
+        em = np.exp(margins[~pos])
+        out[~pos] = em / (1.0 + em)
+        return out
+    if task == "poisson":
+        return np.exp(margins).astype(np.float32)
+    # squared / smoothed_hinge: identity link.
+    return margins.astype(np.float32)
 
 
 @dataclasses.dataclass
@@ -129,6 +166,7 @@ class _FixedCoord:
     name: str
     shard: str
     means: object  # jnp (D,)
+    host_means: object = None  # np.float32 (D,) — the degraded cold path
 
 
 @dataclasses.dataclass
@@ -166,7 +204,7 @@ class ScoringRuntime:
             if isinstance(sub, FixedEffectModel):
                 w = np.asarray(sub.model.coefficients.means, np.float32)
                 self.fixed.append(
-                    _FixedCoord(name, sub.feature_shard, jnp.asarray(w))
+                    _FixedCoord(name, sub.feature_shard, jnp.asarray(w), w)
                 )
                 self.shard_dims[sub.feature_shard] = w.shape[0]
             elif isinstance(sub, RandomEffectModel):
@@ -185,6 +223,19 @@ class ScoringRuntime:
         self.rows_scored = 0
         self.warmup_compiles = 0
         self._lock = threading.Lock()  # stats snapshot vs dispatch thread
+        # Graceful degradation: device-lost flips scoring onto the host
+        # cold path; the breaker guards re-promotion (module docstring).
+        self.degraded = False
+        self.breaker = CircuitBreaker(
+            cooldown_seconds=self.config.breaker_cooldown_s,
+            failure_threshold=self.config.breaker_failure_threshold,
+        )
+        from photon_ml_tpu.utils.watchdog import RetryPolicy
+
+        self._fault_policy = RetryPolicy()  # classification only
+        self.degraded_batches = 0
+        self.device_failures = 0
+        self.repromotions = 0
         if self.config.warmup:
             self.warm_up()
 
@@ -337,13 +388,113 @@ class ScoringRuntime:
         )
 
     def score_rows(self, rows: Sequence[Row]) -> tuple[np.ndarray, np.ndarray]:
-        """Score a batch through the padded bucket kernel.
+        """Score a batch; survives a lost device.
 
         Returns ``(margins, means)`` float32 arrays of ``len(rows)``.
-        Dispatch-thread only (mutates the LRU hot sets).
+        Dispatch-thread only (mutates the LRU hot sets and the breaker).
+
+        The healthy path is the padded bucket kernel
+        (:meth:`_score_rows_device`).  A transient device failure (the
+        watchdog's UNAVAILABLE/device-lost vocabulary) degrades THIS
+        batch — and every batch until the breaker re-promotes — onto the
+        pure-host cold path (:meth:`_score_rows_host`): requests keep
+        succeeding, the ``degraded`` flag rides /healthz and /stats.
+        Non-transient failures (bad batch size, programming errors)
+        propagate unchanged — degrading on those would mask real bugs.
         """
+        if self.degraded and not self.breaker.allow_request():
+            return self._score_rows_host(rows)
+        try:
+            margins, means = self._score_rows_device(rows)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if not self._fault_policy.classify(exc).transient:
+                raise
+            self._note_device_failure(exc)
+            return self._score_rows_host(rows)
+        if self.degraded:
+            self._note_repromotion()
+        return margins, means
+
+    def _note_device_failure(self, exc: BaseException) -> None:
+        tel = telemetry_mod.current()
+        self.breaker.record_failure()
+        self.device_failures += 1
+        tel.counter("serving_device_failures_total").inc()
+        tel.gauge("serving_degraded").set(1)
+        if not self.degraded:
+            self.degraded = True
+            tel.event(
+                "serving.degraded",
+                error=f"{type(exc).__name__}: {exc}"[:200],
+                breaker=self.breaker.state,
+            )
+
+    def _note_repromotion(self) -> None:
+        tel = telemetry_mod.current()
+        self.breaker.record_success()
+        self.degraded = False
+        self.repromotions += 1
+        tel.counter("serving_repromotions_total").inc()
+        tel.gauge("serving_degraded").set(0)
+        tel.event("serving.repromoted", breaker=self.breaker.state)
+
+    def _score_rows_host(
+        self, rows: Sequence[Row]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Degraded-mode scoring: pure numpy, zero device touches.
+
+        Same margin arithmetic as the kernel (offset + Σ fixed x·w +
+        Σ random-effect x·row) and the same mean link, evaluated with
+        host numpy — scores agree with the device path to host float
+        tolerance (the device kernel's reduce order differs in the last
+        ulp; bit parity is the HEALTHY path's contract, availability is
+        this one's).  The LRU hot sets are deliberately untouched: their
+        device tables may be gone with the device."""
+        tel = telemetry_mod.current()
+        n = len(rows)
+        margins = np.zeros(n, np.float32)
+        for i, row in enumerate(rows):
+            margins[i] = np.float32(row.offset)
+        for c in self.fixed:
+            for i, row in enumerate(rows):
+                vec = row.features.get(c.shard)
+                if vec is not None:
+                    margins[i] += np.float32(np.dot(vec, c.host_means))
+        for c in self.random:
+            for i, row in enumerate(rows):
+                key = row.ids.get(c.entity_key)
+                if key is None:
+                    continue
+                entry = c.model.coefficients.get(key)
+                if entry is None:
+                    c.unknown += 1
+                    tel.counter("serving_unknown_entities_total").inc()
+                    continue
+                vec = row.features.get(c.shard)
+                if vec is None:
+                    continue
+                dense = kernels_lib.dense_coefficient_rows(c.model, [key])[0]
+                margins[i] += np.float32(np.dot(vec, dense))
+        means = _host_mean(self.task, margins)
+        with self._lock:
+            self.batches += 1
+            self.rows_scored += n
+            self.degraded_batches += 1
+        tel.counter("serving_batches_total").inc()
+        tel.counter("serving_rows_scored_total").inc(n)
+        tel.counter("serving_degraded_batches_total").inc()
+        return margins, means
+
+    def _score_rows_device(
+        self, rows: Sequence[Row]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """The healthy path: the padded bucket kernel (bit-parity
+        contract).  Dispatch-thread only (mutates the LRU hot sets)."""
         import jax.numpy as jnp
 
+        # The device-lost seam: a scripted fault here exercises the whole
+        # degrade → breaker → re-promote machinery above.
+        chaos_mod.maybe_fail("serving.device", rows=len(rows))
         n = len(rows)
         bucket = self.bucket_for(n)
         tel = telemetry_mod.current()
@@ -428,6 +579,7 @@ class ScoringRuntime:
         (the /stats endpoint must work with telemetry disabled)."""
         with self._lock:
             batches, rows = self.batches, self.rows_scored
+            degraded_batches = self.degraded_batches
         hot = {}
         for c in self.random:
             total = c.hot.hits + c.hot.misses
@@ -453,4 +605,12 @@ class ScoringRuntime:
             "rows_scored": rows,
             "warmup_compiles": self.warmup_compiles,
             "hot_sets": hot,
+            # Degraded-mode observability (docs/robustness.md): the flag
+            # /healthz mirrors, the breaker state machine, and how much
+            # traffic the host cold path carried.
+            "degraded": self.degraded,
+            "degraded_batches": degraded_batches,
+            "device_failures": self.device_failures,
+            "repromotions": self.repromotions,
+            "breaker": self.breaker.snapshot(),
         }

@@ -109,8 +109,18 @@ class ScoringService:
 
     # -- observability -----------------------------------------------------
     def healthz(self) -> dict:
+        # "degraded" ≠ down: requests still succeed through the host cold
+        # path (runtime docstring); status stays distinguishable so a
+        # load balancer can shed-or-keep by policy, not by guessing.
+        degraded = self.runtime.degraded
         return {
-            "status": "ok" if self._started else "stopped",
+            "status": (
+                "stopped" if not self._started
+                else "degraded" if degraded
+                else "ok"
+            ),
+            "degraded": degraded,
+            "breaker": self.runtime.breaker.state,
             "task": self.runtime.task,
             "coordinates": self.runtime.stats()["coordinates"],
             "buckets": list(self.runtime.buckets),

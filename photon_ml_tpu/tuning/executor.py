@@ -62,6 +62,7 @@ from photon_ml_tpu.tuning.state import (
     TuningJournal,
     replay_journal,
 )
+from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.utils.watchdog import RetryPolicy
 
 
@@ -441,6 +442,9 @@ class TuningOrchestrator:
         ) as span:
             while True:
                 try:
+                    chaos_mod.maybe_fail(
+                        "tuning.trial", trial=task.trial.id, rung=task.rung,
+                    )
                     task.report = _as_report(
                         self.trial_fn(task.trial.params, resource, warm)
                     )

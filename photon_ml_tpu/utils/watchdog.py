@@ -20,6 +20,7 @@ jaxlib internals just to isinstance them is brittle across versions.
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
 from typing import Callable, Optional, Sequence, TypeVar
 
@@ -107,9 +108,29 @@ class RetryPolicy:
     backoff_multiplier: float = 2.0
     max_backoff_seconds: float = 300.0
     extra_patterns: Sequence[str] = ()
+    #: "none" = the deterministic exponential above; "decorrelated" =
+    #: AWS-style decorrelated jitter (sleep ~ U[base, 3·previous sleep],
+    #: capped).  Parallel clients sharing one backoff schedule retry in
+    #: lockstep and re-overload whatever just failed (the thundering
+    #: herd — exactly the tuning orchestrator's W parallel trials after
+    #: a coordinator blip); jitter decorrelates them.  The RNG is
+    #: injected at run_with_retries (tests pass a seeded random.Random).
+    jitter: str = "none"
+
+    def __post_init__(self):
+        if self.jitter not in ("none", "decorrelated"):
+            raise ValueError(
+                f"jitter must be 'none' or 'decorrelated', got "
+                f"{self.jitter!r}"
+            )
 
     def classify(self, exc: BaseException) -> Classification:
         """Verdict + the pattern that decided it (see Classification)."""
+        if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+            # A user interrupt / deliberate exit is NEVER retryable, no
+            # matter what its message says (SystemExit("UNAVAILABLE: ..")
+            # from a CLI guard must not put the process back to work).
+            return Classification(False, type(exc).__name__, "interrupt")
         msg = str(exc).lower()
         # Deterministic-failure markers veto everything, including the
         # type-name fallback: an XlaRuntimeError carrying
@@ -129,7 +150,28 @@ class RetryPolicy:
     def is_transient(self, exc: BaseException) -> bool:
         return self.classify(exc).transient
 
-    def backoff(self, attempt: int) -> float:
+    def backoff(
+        self,
+        attempt: int,
+        rng: Optional[random.Random] = None,
+        previous: Optional[float] = None,
+    ) -> float:
+        """Seconds to sleep before retrying after failure ``attempt``.
+
+        With ``jitter="none"`` (or no RNG supplied): the deterministic
+        capped exponential.  With ``jitter="decorrelated"`` and an RNG:
+        ``min(cap, U[base, 3·previous])`` where ``previous`` is the last
+        delay actually slept (``base`` on the first retry) — each
+        client's schedule random-walks away from its peers' instead of
+        colliding at base·2^k.
+        """
+        if self.jitter == "decorrelated" and rng is not None:
+            prev = self.backoff_seconds if previous is None else previous
+            hi = max(self.backoff_seconds, 3.0 * prev)
+            return min(
+                self.max_backoff_seconds,
+                rng.uniform(self.backoff_seconds, hi),
+            )
         return min(
             self.backoff_seconds * self.backoff_multiplier**attempt,
             self.max_backoff_seconds,
@@ -142,6 +184,7 @@ def run_with_retries(
     logger=None,
     sleep: Callable[[float], None] = time.sleep,
     stats: Optional[RetryStats] = None,
+    rng: Optional[random.Random] = None,
 ) -> T:
     """Run ``fn(attempt)`` until it returns, retrying transient failures.
 
@@ -156,11 +199,22 @@ def run_with_retries(
     sleeps.  Each classify/backoff/give-up decision is also emitted as a
     ``watchdog.attempt`` telemetry event and counted on the
     ``watchdog_retries`` metric.
+
+    ``rng`` drives decorrelated-jitter backoff when the policy enables
+    it (``jitter="decorrelated"``); pass a seeded ``random.Random`` for
+    deterministic tests.  Omitted with jitter enabled, a fresh RNG is
+    created — production callers get real decorrelation by default.
+    Note ``KeyboardInterrupt``/``SystemExit`` are BaseExceptions: they
+    propagate without ever reaching classification, and ``classify``
+    refuses them explicitly for callers that classify on their own.
     """
     tel = telemetry_mod.current()
     if stats is None:
         stats = RetryStats()
+    if rng is None and policy.jitter != "none":
+        rng = random.Random()
     attempt = 0
+    prev_delay: Optional[float] = None
     while True:
         stats.attempts += 1
         try:
@@ -168,7 +222,10 @@ def run_with_retries(
         except Exception as exc:  # noqa: BLE001 — classified below
             verdict = policy.classify(exc)
             retrying = verdict.transient and attempt < policy.max_retries
-            delay = policy.backoff(attempt) if retrying else None
+            delay = (
+                policy.backoff(attempt, rng=rng, previous=prev_delay)
+                if retrying else None
+            )
             stats.gave_up = verdict.transient and not retrying
             stats.failures.append({
                 "attempt": attempt,
@@ -196,6 +253,7 @@ def run_with_retries(
                 raise
             stats.retries += 1
             stats.sleep_seconds += delay
+            prev_delay = delay
             tel.counter("watchdog_retries").inc()
             if logger is not None:
                 logger.warning(

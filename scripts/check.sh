@@ -28,15 +28,25 @@ env JAX_PLATFORMS=cpu python -m photon_ml_tpu.serving --selfcheck
 echo "== tuning selfcheck (JAX_PLATFORMS=cpu) =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.tuning --selfcheck
 
+# The chaos selfcheck runs the scripted kill/resume/degrade scenario:
+# a streamed GLM grid and a GAME CD run killed mid-flight resume
+# bitwise-identically through the watchdog, a mid-pass streaming fault
+# tears down cleanly, a device-lost fault degrades serving with zero
+# request errors and the breaker re-promotes, and checkpoint corruption
+# falls back / raises pointed errors (docs/robustness.md).
+echo "== chaos selfcheck (JAX_PLATFORMS=cpu) =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.chaos --selfcheck
+
 echo "== tier-1 tests (JAX_PLATFORMS=cpu) =="
 if [[ "${1:-}" == "--fast" ]]; then
   # Streaming-parity smoke rides the fast lane: a tiny 4-chunk store,
   # asserting the windowed-async pipeline is BIT-IDENTICAL to the
   # depth=1 serial baseline (value/grad, hvp, scores) — the invariant
-  # every other streamed number rests on.
+  # every other streamed number rests on.  test_chaos's kill/resume
+  # boundary matrices are the fast recovery smoke.
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_watchdog.py \
-    tests/test_serving.py tests/test_tuning.py \
+    tests/test_serving.py tests/test_tuning.py tests/test_chaos.py \
     "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
     -m 'not slow' -q -p no:cacheprovider
 fi

@@ -1,0 +1,702 @@
+"""Chaos harness tests: deterministic fault injection + verified recovery.
+
+The bar (ISSUE 6): recovery is PROVEN by killing runs mid-flight, not
+asserted.  Crash-at-every-boundary matrices drive the GLM λ-grid and the
+GAME CD loop through scripted kills at EVERY checkpoint boundary and
+require the resumed result to be bitwise identical to the uninterrupted
+one; the serving tests require a lost device to degrade (zero request
+errors) and the breaker to re-promote.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu import chaos
+from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.chaos import CircuitBreaker
+from photon_ml_tpu.io.checkpoint import (
+    CoordinateDescentCheckpointer,
+    GridCheckpointer,
+)
+from photon_ml_tpu.optim.problem import (
+    GlmOptimizationConfig,
+    GlmOptimizationProblem,
+    OptimizerConfig,
+)
+from photon_ml_tpu.optim.regularization import RegularizationContext
+from photon_ml_tpu.utils.watchdog import (
+    RetryPolicy,
+    RetryStats,
+    run_with_retries,
+)
+
+
+def _bitwise_equal(a, b):
+    a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+    return a.shape == b.shape and a.tobytes() == b.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan mechanics
+# ---------------------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_disabled_is_noop(self):
+        assert chaos.current_plan() is None
+        chaos.maybe_fail("grid.point", reg_weight=1.0)  # no plan: no-op
+
+    def test_unknown_site_refused(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            chaos.FaultSpec(site="no.such.site")
+
+    def test_bad_spec_fields_refused(self):
+        with pytest.raises(ValueError, match="action"):
+            chaos.FaultSpec(site="grid.point", action="explode")
+        with pytest.raises(ValueError, match="exception"):
+            chaos.FaultSpec(site="grid.point", exception="KeyboardInterrupt")
+        with pytest.raises(ValueError, match="count"):
+            chaos.FaultSpec(site="grid.point", count=0)
+
+    def test_occurrence_targeting_and_window(self):
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="grid.point", at=2, count=2),
+        ])
+        fired = []
+        with plan:
+            for i in range(6):
+                try:
+                    chaos.maybe_fail("grid.point", i=i)
+                except chaos.InjectedFault:
+                    fired.append(i)
+        assert fired == [2, 3]
+        assert plan.occurrences("grid.point") == 6
+        assert [f["occurrence"] for f in plan.fired_at("grid.point")] == [2, 3]
+
+    def test_forever_window(self):
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="cd.iteration", at=1, count=-1),
+        ])
+        with plan:
+            chaos.maybe_fail("cd.iteration")  # occurrence 0: clean
+            for _ in range(3):
+                with pytest.raises(chaos.InjectedFault):
+                    chaos.maybe_fail("cd.iteration")
+
+    def test_counts_survive_reinstall(self):
+        """The kill/resume idiom: the same plan object re-installed (or
+        left installed across a watchdog retry) keeps counting, so an
+        armed occurrence fires ONCE and the resumed run sails past."""
+        plan = chaos.FaultPlan([chaos.FaultSpec(site="grid.point", at=0)])
+        with plan:
+            with pytest.raises(chaos.InjectedFault):
+                chaos.maybe_fail("grid.point")
+        with plan:
+            chaos.maybe_fail("grid.point")  # occurrence 1: clean
+
+    def test_delay_action(self):
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(
+                site="serving.batch", action="delay", delay_seconds=0.01
+            ),
+        ])
+        import time
+
+        with plan:
+            t0 = time.perf_counter()
+            chaos.maybe_fail("serving.batch")
+            assert time.perf_counter() - t0 >= 0.01
+
+    def test_json_round_trip(self):
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="grid.point", at=1,
+                            exception="InjectedDeviceLost"),
+            chaos.FaultSpec(site="serving.device", action="delay",
+                            delay_seconds=0.5),
+        ])
+        plan2 = chaos.FaultPlan.from_json(plan.to_json())
+        assert plan2.faults == plan.faults
+        with pytest.raises(ValueError, match="unknown fault site"):
+            chaos.FaultPlan.from_json(json.dumps([{"site": "nope"}]))
+
+    def test_exclusive_installation(self):
+        a = chaos.FaultPlan([])
+        b = chaos.FaultPlan([])
+        with a:
+            with pytest.raises(RuntimeError, match="already installed"):
+                b.install()
+        b.install()
+        b.uninstall()
+
+    def test_default_message_is_watchdog_transient(self):
+        spec = chaos.FaultSpec(site="tuning.trial")
+        exc = spec.build_exception(0)
+        verdict = RetryPolicy().classify(exc)
+        assert verdict.transient and verdict.matched == "UNAVAILABLE"
+
+    def test_injection_counted_in_telemetry(self):
+        with telemetry_mod.Telemetry(enabled=True, sinks=[]) as tel:
+            plan = chaos.FaultPlan([
+                chaos.FaultSpec(site="grid.point", at=0),
+            ])
+            with plan:
+                with pytest.raises(chaos.InjectedFault):
+                    chaos.maybe_fail("grid.point")
+            assert tel.counter("chaos_faults_injected").value == 1
+
+    def test_thread_safe_occurrence_counting(self):
+        plan = chaos.FaultPlan([])
+        with plan:
+            threads = [
+                threading.Thread(
+                    target=lambda: [
+                        chaos.maybe_fail("prefetch.pack") for _ in range(200)
+                    ]
+                )
+                for _ in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert plan.occurrences("prefetch.pack") == 800
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_state_machine(self):
+        clock = [0.0]
+        br = CircuitBreaker(cooldown_seconds=10.0, clock=lambda: clock[0])
+        assert br.state == chaos.CLOSED and br.allow_request()
+        br.record_failure()
+        assert br.state == chaos.OPEN
+        assert not br.allow_request()  # cooldown not elapsed
+        clock[0] = 9.9
+        assert not br.allow_request()
+        clock[0] = 10.0
+        assert br.allow_request()  # admits THE probe
+        assert br.state == chaos.HALF_OPEN
+        br.record_failure()  # probe failed: re-open, cooldown restarts
+        assert br.state == chaos.OPEN
+        assert not br.allow_request()
+        clock[0] = 20.0
+        assert br.allow_request()
+        br.record_success()
+        assert br.state == chaos.CLOSED
+        assert br.reclosures == 1 and br.opens == 2 and br.probes == 2
+
+    def test_failure_threshold(self):
+        clock = [0.0]
+        br = CircuitBreaker(
+            cooldown_seconds=1.0, failure_threshold=3,
+            clock=lambda: clock[0],
+        )
+        br.record_failure()
+        br.record_failure()
+        assert br.state == chaos.CLOSED  # under threshold
+        br.record_success()  # resets the consecutive run
+        br.record_failure()
+        br.record_failure()
+        assert br.state == chaos.CLOSED
+        br.record_failure()
+        assert br.state == chaos.OPEN
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            CircuitBreaker(cooldown_seconds=-1.0)
+        with pytest.raises(ValueError):
+            CircuitBreaker(failure_threshold=0)
+
+
+# ---------------------------------------------------------------------------
+# Crash-at-every-boundary: GLM λ grid
+# ---------------------------------------------------------------------------
+
+def _glm_fixture():
+    rng = np.random.default_rng(5)
+    X = sp.csr_matrix(rng.normal(size=(200, 8)).astype(np.float32))
+    w_true = rng.normal(size=8).astype(np.float32)
+    y = (np.asarray(X @ w_true).ravel() > 0).astype(np.float32)
+    from photon_ml_tpu.data.dataset import make_glm_data
+
+    data = make_glm_data(X, y)
+    problem = GlmOptimizationProblem(
+        "logistic",
+        GlmOptimizationConfig(
+            optimizer=OptimizerConfig(max_iters=30),
+            regularization=RegularizationContext.l2(),
+        ),
+    )
+    return problem, data
+
+
+class TestGridCrashEveryBoundary:
+    def test_resume_bitwise_at_every_boundary(self, tmp_path):
+        """Kill after EVERY grid-point checkpoint; each resumed grid must
+        be bitwise identical to the uninterrupted one (mirrors
+        test_tuning's every-abort-point journal tests, driven through
+        the chaos harness + the watchdog)."""
+        problem, data = _glm_fixture()
+        lams = [10.0, 1.0, 0.1]
+        full = problem.run_grid(data, lams)
+        ref = {lam: np.asarray(m.coefficients.means) for lam, m, _ in full}
+
+        for boundary in range(len(lams)):
+            ckpt = GridCheckpointer(str(tmp_path / f"b{boundary}"))
+            plan = chaos.FaultPlan([
+                chaos.FaultSpec(site="grid.point", at=boundary),
+            ])
+
+            def train(attempt, ckpt=ckpt):
+                solved = ckpt.load() if attempt else {}
+                acc = dict(solved)
+
+                def on_solved(lam, w):
+                    acc[lam] = np.asarray(w)
+                    ckpt.save(acc)
+
+                return problem.run_grid(
+                    data, lams, solved=solved, on_solved=on_solved
+                )
+
+            stats = RetryStats()
+            with plan:
+                resumed = run_with_retries(
+                    train, RetryPolicy(max_retries=1),
+                    sleep=lambda s: None, stats=stats,
+                )
+            assert stats.retries == 1
+            assert len(plan.fired_at("grid.point")) == 1
+            restored = sum(1 for _, _, r in resumed if r is None)
+            assert restored == boundary + 1  # solved-before-kill λs skip
+            for lam, model, _ in resumed:
+                assert _bitwise_equal(ref[lam], model.coefficients.means), (
+                    f"boundary {boundary}, λ={lam}: resumed grid diverged"
+                )
+
+    def test_non_transient_kill_propagates(self, tmp_path):
+        """A fault NOT matching the transient vocabulary must not be
+        retried — the watchdog hands it straight up."""
+        problem, data = _glm_fixture()
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(
+                site="grid.point",
+                message="INVALID_ARGUMENT: chaos says no",
+            ),
+        ])
+        with plan:
+            with pytest.raises(chaos.InjectedFault):
+                run_with_retries(
+                    lambda a: problem.run_grid(data, [1.0]),
+                    RetryPolicy(max_retries=3),
+                    sleep=lambda s: None,
+                )
+        assert len(plan.fired_at("grid.point")) == 1  # no retry happened
+
+
+# ---------------------------------------------------------------------------
+# Crash-at-every-boundary: GAME coordinate descent
+# ---------------------------------------------------------------------------
+
+def _game_fixture(seed=13, n=300, n_users=10):
+    rng = np.random.default_rng(seed)
+    user_effect = rng.normal(scale=2.0, size=n_users)
+    Xg = rng.normal(size=(n, 3)).astype(np.float32)
+    users = rng.integers(n_users, size=n)
+    margin = 1.3 * Xg[:, 0] - 0.7 * Xg[:, 1] + user_effect[users]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+    shards = {
+        "global": sp.csr_matrix(Xg),
+        "userFeatures": sp.csr_matrix(np.ones((n, 1), np.float32)),
+    }
+    ids = {"userId": np.array([f"u{u}" for u in users])}
+    return shards, ids, y
+
+
+def _game_configs():
+    from photon_ml_tpu.game.estimator import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+
+    opt = GlmOptimizationConfig(
+        optimizer=OptimizerConfig(max_iters=25, tolerance=1e-7),
+        regularization=RegularizationContext.l2(),
+    )
+    return {
+        "fixed": FixedEffectCoordinateConfig(
+            feature_shard="global", optimization=opt, reg_weight=0.5
+        ),
+        "per_user": RandomEffectCoordinateConfig(
+            feature_shard="userFeatures", entity_key="userId",
+            optimization=opt, reg_weight=0.5,
+        ),
+    }
+
+
+class TestCdCrashEveryBoundary:
+    N_ITERS = 3
+
+    def test_resume_bitwise_at_every_boundary(self, tmp_path):
+        from photon_ml_tpu.game.estimator import GameEstimator
+
+        shards, ids, y = _game_fixture()
+        model_full, hist_full = GameEstimator(
+            "logistic", _game_configs(), n_iterations=self.N_ITERS
+        ).fit(shards, ids, y)
+        w_full = np.asarray(model_full["fixed"].model.coefficients.means)
+        cf = model_full["per_user"].coefficients
+
+        for boundary in range(self.N_ITERS):
+            ck = CoordinateDescentCheckpointer(str(tmp_path / f"b{boundary}"))
+            plan = chaos.FaultPlan([
+                chaos.FaultSpec(site="cd.iteration", at=boundary),
+            ])
+
+            def attempt(a, ck=ck):
+                return GameEstimator(
+                    "logistic", _game_configs(), n_iterations=self.N_ITERS
+                ).fit(shards, ids, y, checkpointer=ck)
+
+            stats = RetryStats()
+            with plan:
+                model_res, hist_res = run_with_retries(
+                    attempt, RetryPolicy(max_retries=1),
+                    sleep=lambda s: None, stats=stats,
+                )
+            assert stats.retries == 1
+            w_res = np.asarray(
+                model_res["fixed"].model.coefficients.means
+            )
+            assert _bitwise_equal(w_full, w_res), (
+                f"boundary {boundary}: fixed-effect coefficients diverged"
+            )
+            cr = model_res["per_user"].coefficients
+            assert set(cf) == set(cr)
+            for k in cf:
+                assert _bitwise_equal(cf[k][1], cr[k][1]), (
+                    f"boundary {boundary}: per-entity {k} diverged"
+                )
+            assert len(hist_res) == len(hist_full)
+
+
+# ---------------------------------------------------------------------------
+# Streaming pipeline faults: teardown, propagation, no leaks
+# ---------------------------------------------------------------------------
+
+def _small_stream(n=160, d=10, chunk_rows=40):
+    from photon_ml_tpu.data.streaming import make_streaming_glm_data
+
+    rng = np.random.default_rng(11)
+    X = sp.random(n, d, density=0.5, random_state=2, format="csr",
+                  dtype=np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    return make_streaming_glm_data(X, y, chunk_rows=chunk_rows,
+                                   use_pallas=False)
+
+
+class TestStreamingFaults:
+    @pytest.mark.parametrize(
+        "site", ["prefetch.pack", "prefetch.transfer", "staging.put",
+                 "streaming.carry_sync"],
+    )
+    def test_fault_propagates_and_next_pass_is_clean(self, site):
+        """A fault on ANY pipeline stage surfaces on the caller thread,
+        tears the pack/transfer threads down without leaking them, and
+        the next clean pass over the same objective is bit-identical to
+        a never-faulted pass (donated accumulators uncorrupted)."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.optim.streaming import StreamingObjective
+
+        stream = _small_stream()
+        sobj = StreamingObjective("logistic", stream)
+        w = jnp.zeros((stream.n_features,), jnp.float32)
+        v0, g0 = sobj.value_and_grad(w, 1.0)
+        v0, g0 = np.asarray(v0), np.asarray(g0)
+
+        with telemetry_mod.Telemetry(enabled=True, sinks=[]) as tel:
+            plan = chaos.FaultPlan([chaos.FaultSpec(site=site, at=1)])
+            with plan:
+                with pytest.raises(chaos.InjectedFault):
+                    sobj.value_and_grad(w, 1.0)
+            assert len(plan.fired_at(site)) == 1
+            assert tel.counter("prefetch_thread_leak").value == 0
+
+        v1, g1 = sobj.value_and_grad(w, 1.0)
+        assert _bitwise_equal(v0, np.asarray(v1))
+        assert _bitwise_equal(g0, np.asarray(g1))
+
+    def test_streamed_grid_kill_resume_bitwise(self, tmp_path):
+        """The streamed flavor of the grid boundary matrix (one boundary
+        — the full matrix runs on the resident path above; the selfcheck
+        covers a second streamed boundary)."""
+        from photon_ml_tpu.optim.streaming import streaming_run_grid
+
+        stream = _small_stream()
+        problem = GlmOptimizationProblem(
+            "logistic",
+            GlmOptimizationConfig(
+                optimizer=OptimizerConfig(max_iters=20),
+                regularization=RegularizationContext.l2(),
+            ),
+        )
+        lams = [2.0, 0.5]
+        full = streaming_run_grid(problem, stream, lams)
+        ref = {lam: np.asarray(m.coefficients.means) for lam, m, _ in full}
+
+        ckpt = GridCheckpointer(str(tmp_path / "sg"))
+        plan = chaos.FaultPlan([chaos.FaultSpec(site="grid.point", at=0)])
+
+        def train(attempt):
+            solved = ckpt.load() if attempt else {}
+            acc = dict(solved)
+
+            def on_solved(lam, w):
+                acc[lam] = np.asarray(w)
+                ckpt.save(acc)
+
+            return streaming_run_grid(
+                problem, stream, lams, solved=solved, on_solved=on_solved
+            )
+
+        with plan:
+            resumed = run_with_retries(
+                train, RetryPolicy(max_retries=1), sleep=lambda s: None
+            )
+        for lam, model, _ in resumed:
+            assert _bitwise_equal(ref[lam], model.coefficients.means)
+
+
+class TestPrefetchThreadLeak:
+    def test_wedged_thread_counted_not_silent(self, monkeypatch):
+        """A pipeline thread that outlives the join timeout is COUNTED
+        (prefetch_thread_leak) — the old code returned as if nothing
+        happened.  Here the transfer thread wedges inside put() while
+        the consumer's failure is propagating, so the original exception
+        keeps priority and the leak lands on the counter."""
+        import time
+
+        from photon_ml_tpu.data import prefetch as prefetch_mod
+
+        monkeypatch.setattr(prefetch_mod, "JOIN_TIMEOUT_SECONDS", 0.01)
+        release = threading.Event()
+
+        def put(item):
+            if item == 1:
+                release.wait(5.0)  # wedged until the test releases it
+            return item
+
+        def consume(k, dev):
+            raise ValueError("consumer dies while transfer is wedged")
+
+        with telemetry_mod.Telemetry(enabled=True, sinks=[]) as tel:
+            with pytest.raises(ValueError, match="consumer dies"):
+                prefetch_mod.run_prefetched(
+                    3, lambda k: k, put, consume, depth=2,
+                )
+            # Give the wedge a beat to be observed as alive by join().
+            assert tel.counter("prefetch_thread_leak").value >= 1
+        release.set()
+        time.sleep(0.05)  # let the daemon thread drain before exit
+
+    def test_healthy_pipeline_counts_no_leak(self):
+        from photon_ml_tpu.data import prefetch as prefetch_mod
+
+        with telemetry_mod.Telemetry(enabled=True, sinks=[]) as tel:
+            seen = []
+            prefetch_mod.run_prefetched(
+                4, lambda k: k, lambda x: x,
+                lambda k, dev: seen.append(k), depth=2,
+            )
+            assert seen == [0, 1, 2, 3]
+            assert tel.counter("prefetch_thread_leak").value == 0
+
+
+# ---------------------------------------------------------------------------
+# Serving: degrade on device loss, zero errors, breaker re-promotion
+# ---------------------------------------------------------------------------
+
+def _serving_runtime(**cfg_kw):
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+    workload = SyntheticWorkload(n_entities=24, seed=9)
+    cfg_kw.setdefault("max_batch_size", 4)
+    cfg_kw.setdefault("hot_entities", 8)
+    cfg_kw.setdefault("breaker_cooldown_s", 0.0)
+    runtime = ScoringRuntime(
+        workload.model, workload.index_maps, RuntimeConfig(**cfg_kw)
+    )
+    return workload, runtime
+
+
+class TestServingDegrade:
+    def test_device_lost_degrades_and_repromotes(self):
+        workload, runtime = _serving_runtime()
+        rows = [runtime.parse_request(workload.request(i)) for i in range(10)]
+        ref = np.asarray(
+            [runtime.score_rows([r])[0][0] for r in rows], np.float32
+        )
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="serving.device", at=0, count=3,
+                            exception="InjectedDeviceLost"),
+        ])
+        got = np.zeros(len(rows), np.float32)
+        degraded_during = []
+        with plan:
+            for i, r in enumerate(rows):
+                m, mu = runtime.score_rows([r])
+                got[i] = m[0]
+                degraded_during.append(runtime.degraded)
+        assert degraded_during[0] is True  # first fault flips the flag
+        assert runtime.degraded is False  # fault cleared: re-promoted
+        assert runtime.breaker.state == chaos.CLOSED
+        assert runtime.degraded_batches == 3
+        assert runtime.repromotions == 1
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_open_breaker_skips_device_entirely(self):
+        """While OPEN (cooldown pending), batches go straight to the host
+        path — the dead device is not probed per batch."""
+        workload, runtime = _serving_runtime(breaker_cooldown_s=1e9)
+        rows = [runtime.parse_request(workload.request(i)) for i in range(6)]
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="serving.device", at=0, count=-1,
+                            exception="InjectedDeviceLost"),
+        ])
+        with plan:
+            for r in rows:
+                runtime.score_rows([r])
+        # Only the FIRST batch touched the device seam; the breaker held
+        # the other five off it.
+        assert plan.occurrences("serving.device") == 1
+        assert runtime.degraded and runtime.breaker.state == chaos.OPEN
+        assert runtime.degraded_batches == 6
+
+    def test_non_transient_device_error_propagates(self):
+        """A programming error on the device path must NOT degrade —
+        masking it as availability would hide real bugs."""
+        workload, runtime = _serving_runtime()
+        row = runtime.parse_request(workload.request(0))
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="serving.device",
+                            message="INVALID_ARGUMENT: shape mismatch"),
+        ])
+        with plan:
+            with pytest.raises(chaos.InjectedFault):
+                runtime.score_rows([row])
+        assert not runtime.degraded
+
+    def test_service_healthz_and_stats_carry_degraded(self):
+        from photon_ml_tpu.serving.batcher import BatcherConfig
+        from photon_ml_tpu.serving.service import ScoringService
+
+        workload, runtime = _serving_runtime(breaker_cooldown_s=1e9)
+        service = ScoringService(runtime, BatcherConfig(
+            max_batch_size=4, max_wait_us=0, max_queue=16,
+        ))
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="serving.device", at=0, count=-1,
+                            exception="InjectedDeviceLost"),
+        ])
+        with service, plan:
+            assert service.healthz()["degraded"] is False
+            result = service.score(workload.request(0))
+            assert "error" not in result
+            hz = service.healthz()
+            assert hz["degraded"] is True and hz["status"] == "degraded"
+            assert hz["breaker"] == chaos.OPEN
+            st = service.stats()
+            assert st["runtime"]["degraded"] is True
+            assert st["runtime"]["breaker"]["state"] == chaos.OPEN
+
+    def test_batcher_site_fails_requests_cleanly(self):
+        """A fault at the serving.batch seam (before the runtime is
+        reached) rides the batcher's per-request failure path: futures
+        get the exception, counters classify it transient."""
+        from photon_ml_tpu.serving.batcher import BatcherConfig
+        from photon_ml_tpu.serving.service import ScoringService
+
+        workload, runtime = _serving_runtime()
+        service = ScoringService(runtime, BatcherConfig(
+            max_batch_size=4, max_wait_us=0, max_queue=16,
+        ))
+        plan = chaos.FaultPlan([chaos.FaultSpec(site="serving.batch")])
+        with service, plan:
+            fut = service.submit(workload.request(0))
+            with pytest.raises(chaos.InjectedFault):
+                fut.result(timeout=10)
+            stats = service.batcher.stats()
+            assert stats["failed"] == 1
+            assert stats["failed_transient"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Tuning: injected trial faults ride the executor's retry vocabulary
+# ---------------------------------------------------------------------------
+
+class TestTuningTrialFaults:
+    def test_transient_trial_fault_retries_in_place(self, tmp_path):
+        from photon_ml_tpu.tuning.executor import (
+            TuningConfig,
+            TuningOrchestrator,
+        )
+        from photon_ml_tpu.tuning.scheduler import GridProposer, SearchSpace
+        from photon_ml_tpu.tuning.state import TuningJournal
+
+        space = SearchSpace.create([(0.0, 1.0)])
+        journal = TuningJournal(str(tmp_path))
+        plan = chaos.FaultPlan([chaos.FaultSpec(site="tuning.trial", at=1)])
+        with plan:
+            res = TuningOrchestrator(
+                space, lambda p, r, w: float(p[0]),
+                GridProposer(space, [[0.1], [0.5], [0.9]]),
+                TuningConfig(
+                    max_trials=3, workers=1,
+                    retry=RetryPolicy(max_retries=1),
+                    sleep=lambda s: None,
+                ),
+                journal,
+            ).run()
+        journal.close()
+        assert res.completed == 3 and res.failed == 0
+        assert sum(t["retries"] for t in res.trials) == 1
+        assert len(plan.fired_at("tuning.trial")) == 1
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint save-boundary kill through the chaos site
+# ---------------------------------------------------------------------------
+
+class TestCheckpointSaveKill:
+    def test_kill_before_rename_preserves_previous(self, tmp_path):
+        ck = GridCheckpointer(str(tmp_path))
+        ck.save({1.0: np.ones(3, np.float32)})
+        plan = chaos.FaultPlan([chaos.FaultSpec(site="checkpoint.save")])
+        with plan:
+            with pytest.raises(chaos.InjectedFault):
+                ck.save({1.0: np.ones(3, np.float32),
+                         0.5: np.zeros(3, np.float32)})
+        # The published checkpoint is still the previous complete one.
+        assert sorted(ck.load()) == [1.0]
+
+    def test_restore_site_fires(self, tmp_path):
+        ck = GridCheckpointer(str(tmp_path))
+        ck.save({1.0: np.ones(3, np.float32)})
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(
+                site="checkpoint.restore",
+                message="UNAVAILABLE: injected restore-path failure",
+            ),
+        ])
+        with plan:
+            with pytest.raises(chaos.InjectedFault):
+                ck.load()
+        assert sorted(ck.load()) == [1.0]  # clean restore afterwards

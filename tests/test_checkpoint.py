@@ -459,6 +459,116 @@ class TestCheckpointFormatCompat:
         assert loaded["states"]["re"][0].shape == (2, 2)
 
 
+class TestCheckpointHardening:
+    """ISSUE 6 satellite: torn/corrupt checkpoints raise a pointed
+    CheckpointCorruptError instead of a raw zipfile/OSError, payloads
+    carry sha256 checksums, and keep-last-K retention falls back to the
+    newest verifiable generation."""
+
+    def _grid(self, tmp_path, **kw):
+        ck = GridCheckpointer(str(tmp_path), **kw)
+        ck.save({1.0: np.ones(4, np.float32)})
+        ck.save({1.0: np.ones(4, np.float32),
+                 0.5: np.full(4, 2.0, np.float32)})
+        return ck
+
+    def test_truncated_file_raises_pointed_error(self, tmp_path):
+        from photon_ml_tpu.io.checkpoint import CheckpointCorruptError
+
+        ck = GridCheckpointer(str(tmp_path), keep_last=1)
+        ck.save({1.0: np.ones(4, np.float32)})
+        with open(ck.path, "r+b") as f:
+            f.truncate(16)
+        with pytest.raises(CheckpointCorruptError) as ei:
+            ck.load()
+        assert ck.path in str(ei.value)
+        assert "truncated or torn" in str(ei.value)
+
+    def test_checksum_mismatch_raises_pointed_error(self, tmp_path):
+        """Flip payload bytes INSIDE an otherwise well-formed npz: the
+        zip layer stays readable, only the sha256 catches it."""
+        from photon_ml_tpu.io.checkpoint import (
+            CheckpointCorruptError,
+            _CHECKSUM_KEY,
+            _atomic_savez,
+        )
+
+        ck = GridCheckpointer(str(tmp_path), keep_last=1)
+        # Re-save with a tampered array but the ORIGINAL digest.
+        ck.save({1.0: np.ones(4, np.float32)})
+        with np.load(ck.path) as z:
+            arrays = {k: z[k] for k in z.files}
+        digest = arrays.pop(_CHECKSUM_KEY)
+        arrays["w__0"] = arrays["w__0"] + 1.0  # bit rot
+        arrays[_CHECKSUM_KEY] = digest
+        import io as io_mod
+
+        buf = io_mod.BytesIO()
+        np.savez(buf, **arrays)
+        with open(ck.path, "wb") as f:
+            f.write(buf.getvalue())
+        with pytest.raises(CheckpointCorruptError) as ei:
+            ck.load()
+        assert "checksum mismatch" in str(ei.value)
+        assert ck.path in str(ei.value)
+
+    def test_retention_rotates_and_falls_back(self, tmp_path):
+        ck = self._grid(tmp_path, keep_last=2)
+        assert os.path.exists(ck.path + ".1")
+        # Newest torn -> previous generation loads (one interval lost).
+        with open(ck.path, "r+b") as f:
+            f.truncate(8)
+        assert sorted(ck.load()) == [1.0]
+
+    def test_retention_depth_respected(self, tmp_path):
+        ck = GridCheckpointer(str(tmp_path), keep_last=3)
+        for i in range(5):
+            ck.save({float(i): np.full(2, i, np.float32)})
+        retained = sorted(os.listdir(str(tmp_path)))
+        assert retained == [
+            "grid_checkpoint.npz", "grid_checkpoint.npz.1",
+            "grid_checkpoint.npz.2",
+        ]
+        # Generations are newest-first: path=4, .1=3, .2=2.
+        assert list(ck.load()) == [4.0]
+        with open(ck.path, "r+b") as f:
+            f.truncate(8)
+        assert list(ck.load()) == [3.0]
+
+    def test_clear_removes_all_generations(self, tmp_path):
+        ck = self._grid(tmp_path, keep_last=2)
+        ck.clear()
+        assert os.listdir(str(tmp_path)) == []
+        assert ck.load() == {}
+
+    def test_cd_checkpointer_fallback(self, tmp_path):
+        ck = CoordinateDescentCheckpointer(str(tmp_path), keep_last=2)
+        total = np.arange(4, dtype=np.float32)
+        ck.save(1, total, {"a": np.ones(4, np.float32)},
+                {"a": np.arange(2, dtype=np.float32)}, [])
+        ck.save(2, total, {"a": np.ones(4, np.float32)},
+                {"a": np.arange(2, dtype=np.float32)}, [])
+        with open(ck.path, "r+b") as f:
+            f.truncate(8)
+        got = ck.load()
+        assert got is not None and got["iteration"] == 1
+
+    def test_legacy_unchecksummed_file_loads(self, tmp_path):
+        """Files written before the checksum era (no __checksum__ entry)
+        still load — unverified, not rejected."""
+        ck = GridCheckpointer(str(tmp_path), keep_last=1)
+        import json as json_mod
+
+        arrays = {
+            "w__0": np.ones(3, np.float32),
+            "__meta__": np.asarray(json_mod.dumps({"lambdas": [1.0]})),
+        }
+        os.makedirs(str(tmp_path), exist_ok=True)
+        with open(ck.path, "wb") as f:
+            np.savez(f, **arrays)
+        np.testing.assert_array_equal(ck.load()[1.0], arrays["w__0"])
+
+
 class TestGameGridCheckpointer:
     def _mini_model_and_maps(self):
         import jax.numpy as jnp

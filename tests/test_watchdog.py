@@ -57,6 +57,50 @@ class TestRetryPolicy:
         assert p.backoff(1) == 6.0
         assert p.backoff(2) == 10.0  # capped
 
+    def test_interrupts_never_retryable(self):
+        """KeyboardInterrupt/SystemExit are refused as transient even
+        when their message screams the transient vocabulary — a user
+        interrupt must never put the process back to work."""
+        p = RetryPolicy(extra_patterns=("UNAVAILABLE",))
+        c = p.classify(KeyboardInterrupt("UNAVAILABLE: device lost"))
+        assert not c.transient and c.source == "interrupt"
+        c = p.classify(SystemExit("UNAVAILABLE: bye"))
+        assert not c.transient and c.source == "interrupt"
+
+    def test_jitter_validation(self):
+        with pytest.raises(ValueError, match="jitter"):
+            RetryPolicy(jitter="full")
+
+    def test_decorrelated_jitter_bounds_and_determinism(self):
+        import random
+
+        p = RetryPolicy(
+            backoff_seconds=1.0, max_backoff_seconds=30.0,
+            jitter="decorrelated",
+        )
+        # Seeded RNG -> the whole jittered schedule is reproducible.
+        seq = []
+        rng = random.Random(7)
+        prev = None
+        for attempt in range(6):
+            d = p.backoff(attempt, rng=rng, previous=prev)
+            lo, hi = 1.0, max(1.0, 3.0 * (prev if prev is not None else 1.0))
+            assert lo <= d <= min(30.0, hi)
+            seq.append(d)
+            prev = d
+        rng2 = random.Random(7)
+        prev = None
+        for attempt, want in enumerate(seq):
+            got = p.backoff(attempt, rng=rng2, previous=prev)
+            assert got == want
+            prev = got
+
+    def test_jitter_none_ignores_rng(self):
+        import random
+
+        p = RetryPolicy(backoff_seconds=2.0)
+        assert p.backoff(1, rng=random.Random(0)) == 4.0
+
 
 class TestRunWithRetries:
     def test_retries_then_succeeds(self):
@@ -108,6 +152,37 @@ class TestRunWithRetries:
 
         with pytest.raises(RuntimeError):
             run_with_retries(fn, RetryPolicy(), sleep=lambda s: None)
+
+    def test_decorrelated_jitter_schedule_is_seeded(self):
+        """Two runs with the same seeded RNG sleep the identical jittered
+        schedule; the recorded delays stay inside the decorrelated
+        envelope ([base, 3·previous], capped)."""
+        import random
+
+        def fn(attempt):
+            if attempt < 3:
+                raise RuntimeError("UNAVAILABLE: flaky")
+            return attempt
+
+        policy = RetryPolicy(
+            max_retries=5, backoff_seconds=1.0, max_backoff_seconds=5.0,
+            jitter="decorrelated",
+        )
+
+        def delays(seed):
+            slept = []
+            run_with_retries(
+                fn, policy, sleep=slept.append, rng=random.Random(seed)
+            )
+            return slept
+
+        a, b = delays(42), delays(42)
+        assert a == b and len(a) == 3
+        assert a != delays(43)  # a different seed decorrelates
+        prev = 1.0
+        for d in a:
+            assert 1.0 <= d <= min(5.0, max(1.0, 3.0 * prev))
+            prev = d
 
 
 class TestClassification:
