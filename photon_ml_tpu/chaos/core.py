@@ -298,6 +298,14 @@ class FaultPlan:
         if spec.action == "delay":
             time.sleep(spec.delay_seconds)
             return
+        # Forensics before the kill: the flight-recorder ring is dumped
+        # with the chaos.fault record just emitted as its LAST event, so
+        # every fault-injection test doubles as a forensics test
+        # (telemetry/recorder.py).  The event window at the moment of
+        # injection is exactly what a real crash would have left behind.
+        telemetry_mod.dump_flight_recorder(
+            reason=f"chaos:{site}@{occurrence}"
+        )
         raise spec.build_exception(occurrence)
 
 

@@ -89,6 +89,7 @@ class TransferStats:
     consumer_stall_seconds: float = 0.0
     passes: int = 0  # completed pipeline runs
     max_live: int = 0  # high-water of concurrently-live device items
+    max_live_bytes: int = 0  # high-water of live device BYTES (HBM bound)
 
     @property
     def gbps(self) -> float:
@@ -130,7 +131,10 @@ class _ProducerFailure:
         self.exc = exc
 
 
-def _publish_pass(stats: TransferStats, before: tuple, run_max: int) -> None:
+def _publish_pass(
+    stats: TransferStats, before: tuple, run_max: int,
+    run_max_bytes: int = 0,
+) -> None:
     """Feed this pass's TransferStats DELTAS into the process telemetry
     registry (PR 1 left the stats a dead-end dataclass unless a caller
     printed them).  Counters accumulate correctly across every stream in
@@ -171,6 +175,10 @@ def _publish_pass(stats: TransferStats, before: tuple, run_max: int) -> None:
         tel.gauge("prefetch_dispatch_chunk_seconds").set(d_disp / d_chunks)
         tel.gauge("prefetch_consume_chunk_seconds").set(d_cons / d_chunks)
     tel.gauge("prefetch_max_live").set(run_max)
+    # HBM accounting (ROADMAP item 1's measurement foundation): the
+    # pass's high-water of transferred-not-yet-consumed device bytes —
+    # what the depth bound actually pinned, in bytes rather than items.
+    tel.gauge("hbm_live_peak_bytes").set(run_max_bytes)
     tel.event(
         "prefetch.pass",
         chunks=d_chunks,
@@ -182,6 +190,7 @@ def _publish_pass(stats: TransferStats, before: tuple, run_max: int) -> None:
         consumer_stalls=stats.consumer_stalls - cs0,
         producer_stalls=stats.producer_stalls - ps0,
         max_live=run_max,
+        max_live_bytes=run_max_bytes,
     )
 
 
@@ -230,13 +239,28 @@ def run_prefetched(
     abort = threading.Event()
     live_lock = threading.Lock()
     live = 0
+    live_bytes = 0
     run_max = 0
+    run_max_bytes = 0
+    # HBM accounting gauges, resolved ONCE per pass (no-op metrics when
+    # the hub is disabled, so the per-chunk cost stays one locked set):
+    # live device bytes this pipeline currently pins, and how full the
+    # prefetch ring is (1.0 = transfers are keeping `depth` items ahead).
+    tel = telemetry_mod.current()
+    ctx = tel.current_context()
+    g_live = tel.gauge("hbm_live_bytes")
+    g_occ = tel.gauge("prefetch_ring_occupancy_ratio")
 
-    def _bump(delta: int) -> None:
-        nonlocal live, run_max
+    def _bump(delta: int, nbytes: int) -> None:
+        nonlocal live, live_bytes, run_max, run_max_bytes
         with live_lock:
             live += delta
+            live_bytes += nbytes
             run_max = max(run_max, live)
+            run_max_bytes = max(run_max_bytes, live_bytes)
+            lb, occ = live_bytes, live / depth
+        g_live.set(lb)
+        g_occ.set(occ)
 
     def _handoff_put(item) -> bool:
         while not abort.is_set():
@@ -250,23 +274,28 @@ def run_prefetched(
     def _packer() -> None:
         # Stage 1: host materialization only — no device calls, so a slow
         # pack never gates the link and a slow link never gates the pack
-        # (up to the hand-off bound).
+        # (up to the hand-off bound).  The attached trace context parents
+        # this thread's per-pass span under the caller's span, so the
+        # Perfetto view nests the pack track inside the streamed solve.
         try:
-            for k in range(n_items):
-                if abort.is_set():
-                    return
-                chaos_mod.maybe_fail("prefetch.pack", item=k)
-                t0 = time.perf_counter()
-                host = get_item(k)
-                stats.pack_seconds += time.perf_counter() - t0
-                nbytes = sum(
-                    leaf.nbytes
-                    for leaf in jax.tree_util.tree_leaves(host)
-                    if hasattr(leaf, "nbytes")
-                )
-                if not _handoff_put((k, host, nbytes)):
-                    return
-                del host
+            with tel.attach(ctx), tel.span(
+                "prefetch.pack_stage", items=n_items
+            ):
+                for k in range(n_items):
+                    if abort.is_set():
+                        return
+                    chaos_mod.maybe_fail("prefetch.pack", item=k)
+                    t0 = time.perf_counter()
+                    host = get_item(k)
+                    stats.pack_seconds += time.perf_counter() - t0
+                    nbytes = sum(
+                        leaf.nbytes
+                        for leaf in jax.tree_util.tree_leaves(host)
+                        if hasattr(leaf, "nbytes")
+                    )
+                    if not _handoff_put((k, host, nbytes)):
+                        return
+                    del host
         except BaseException as exc:  # surfaced on the caller thread
             # In order: the failure rides the hand-off queue behind the
             # items that packed successfully, so the consumer sees items
@@ -278,44 +307,47 @@ def run_prefetched(
         # on the transferred arrays' readiness happen HERE, where they
         # block nobody but the (already link-bound) transfer stream.
         try:
-            for _ in range(n_items):
-                item = None
-                while not abort.is_set():
-                    try:
-                        item = handoff.get(timeout=0.05)
-                        break
-                    except queue.Empty:
-                        pass
-                if item is None:
-                    return
-                if isinstance(item, _ProducerFailure):
-                    q.put(item)
-                    return
-                k, host, nbytes = item
-                if not permits.acquire(blocking=False):
+            with tel.attach(ctx), tel.span(
+                "prefetch.transfer_stage", items=n_items
+            ):
+                for _ in range(n_items):
+                    item = None
+                    while not abort.is_set():
+                        try:
+                            item = handoff.get(timeout=0.05)
+                            break
+                        except queue.Empty:
+                            pass
+                    if item is None:
+                        return
+                    if isinstance(item, _ProducerFailure):
+                        q.put(item)
+                        return
+                    k, host, nbytes = item
+                    if not permits.acquire(blocking=False):
+                        t0 = time.perf_counter()
+                        while not permits.acquire(timeout=0.05):
+                            if abort.is_set():
+                                return
+                        stats.producer_stalls += 1
+                        stats.producer_stall_seconds += (
+                            time.perf_counter() - t0
+                        )
+                    if abort.is_set():
+                        return
+                    chaos_mod.maybe_fail("prefetch.transfer", item=k)
                     t0 = time.perf_counter()
-                    while not permits.acquire(timeout=0.05):
-                        if abort.is_set():
-                            return
-                    stats.producer_stalls += 1
-                    stats.producer_stall_seconds += (
-                        time.perf_counter() - t0
-                    )
-                if abort.is_set():
-                    return
-                chaos_mod.maybe_fail("prefetch.transfer", item=k)
-                t0 = time.perf_counter()
-                dev = put(host)
-                stats.dispatch_seconds += time.perf_counter() - t0
-                for leaf in jax.tree_util.tree_leaves(dev):
-                    if hasattr(leaf, "block_until_ready"):
-                        leaf.block_until_ready()
-                stats.h2d_seconds += time.perf_counter() - t0
-                stats.bytes += nbytes
-                stats.chunks += 1
-                _bump(+1)
-                q.put((k, dev))
-                del dev, host, item
+                    dev = put(host)
+                    stats.dispatch_seconds += time.perf_counter() - t0
+                    for leaf in jax.tree_util.tree_leaves(dev):
+                        if hasattr(leaf, "block_until_ready"):
+                            leaf.block_until_ready()
+                    stats.h2d_seconds += time.perf_counter() - t0
+                    stats.bytes += nbytes
+                    stats.chunks += 1
+                    _bump(+1, nbytes)
+                    q.put((k, dev, nbytes))
+                    del dev, host, item
         except BaseException as exc:  # surfaced on the caller thread
             q.put(_ProducerFailure(exc))
 
@@ -336,7 +368,7 @@ def run_prefetched(
                 item = q.get()
             if isinstance(item, _ProducerFailure):
                 raise item.exc
-            k, dev = item
+            k, dev, nbytes = item
             t0 = time.perf_counter()
             consume(k, dev)
             stats.consume_seconds += time.perf_counter() - t0
@@ -345,7 +377,7 @@ def run_prefetched(
             # here would let a freed permit admit chunk k+depth while
             # chunk k's buffer still cannot be collected.
             del dev, item
-            _bump(-1)
+            _bump(-1, -nbytes)
             permits.release()
     except BaseException:
         abort.set()
@@ -361,7 +393,6 @@ def run_prefetched(
             # entirely; now it is counted, and raised when this pass was
             # otherwise about to succeed (an already-propagating failure
             # keeps priority — the count still records the leak).
-            tel = telemetry_mod.current()
             tel.counter("prefetch_thread_leak").inc(len(leaked))
             tel.event("prefetch.thread_leak", threads=leaked)
             if sys.exc_info()[0] is None:
@@ -379,5 +410,6 @@ def run_prefetched(
                 break
     stats.passes += 1
     stats.max_live = max(stats.max_live, run_max)
-    _publish_pass(stats, stats_before, run_max)
+    stats.max_live_bytes = max(stats.max_live_bytes, run_max_bytes)
+    _publish_pass(stats, stats_before, run_max, run_max_bytes)
     return run_max

@@ -320,6 +320,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "in the output dir, summary in the log). 'off' reduces every "
         "instrumented site to one branch",
     )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="expose the live ops plane on this port while the run is "
+        "in flight (/metrics Prometheus exposition, /snapshot JSON, "
+        "/healthz); 0 binds an ephemeral port; omit to disable",
+    )
+    p.add_argument(
+        "--metrics-interval-s",
+        type=float,
+        default=1.0,
+        help="interval of the metrics_ts.jsonl time-series sampler "
+        "(live registry snapshots in the output dir; 0 disables)",
+    )
     add_compile_cache_arg(p)
     return p
 
@@ -335,7 +350,12 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             logger=logger,
             enabled=args.telemetry != "off",
         )
-        with tel, tel.span("run", driver="game_training_driver"):
+        with tel, tel.span(
+            "run", driver="game_training_driver"
+        ), telemetry_mod.mount_ops_plane(
+            tel, port=args.metrics_port,
+            interval_s=args.metrics_interval_s, logger=logger,
+        ):
             return _run_impl(args, logger, tel)
 
 

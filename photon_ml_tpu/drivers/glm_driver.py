@@ -219,6 +219,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "in the output dir, summary in the log). 'off' reduces every "
         "instrumented site to one branch",
     )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="expose the live ops plane on this port while the run is "
+        "in flight (/metrics Prometheus exposition, /snapshot JSON, "
+        "/healthz); 0 binds an ephemeral port; omit to disable",
+    )
+    p.add_argument(
+        "--metrics-interval-s",
+        type=float,
+        default=1.0,
+        help="interval of the metrics_ts.jsonl time-series sampler "
+        "(live registry snapshots in the output dir; 0 disables)",
+    )
     add_compile_cache_arg(p)
     return p
 
@@ -338,7 +353,12 @@ def _run(args) -> dict:
             logger=logger,
             enabled=args.telemetry != "off",
         )
-        with tel, tel.span("run", driver="glm_driver", task=args.task):
+        with tel, tel.span(
+            "run", driver="glm_driver", task=args.task
+        ), telemetry_mod.mount_ops_plane(
+            tel, port=args.metrics_port,
+            interval_s=args.metrics_interval_s, logger=logger,
+        ):
             return _run_impl(args, logger, tel)
 
 

@@ -57,6 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from photon_ml_tpu import telemetry as telemetry_mod
 from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.data.prefetch import TransferStats, run_prefetched
 from photon_ml_tpu.data.streaming import StreamingGlmData
@@ -686,8 +687,12 @@ class StreamingObjective:
         window = 0 if self.prefetch_depth == 1 else self.prefetch_depth
         carry_box = [tuple(init)]
         ring: collections.deque = collections.deque()
+        ring_peak = 0
+        stats = self.transfer_stats
+        bytes0, chunks0 = stats.bytes, stats.chunks
 
         def consume(i, dev):
+            nonlocal ring_peak
             chaos_mod.maybe_fail("streaming.carry_sync", item=i)
             carry_box[0] = progs[i](
                 *carry_box[0], *args, items_off[i], dev
@@ -695,10 +700,14 @@ class StreamingObjective:
             ring.append(carry_box[0][0])
             if len(ring) > window:
                 jax.block_until_ready(ring.popleft())
+            # Post-sync occupancy: dispatched-but-unexecuted programs
+            # still pinning their chunk buffers (the popped handle just
+            # proved its chunk executed).
+            ring_peak = max(ring_peak, len(ring))
 
-        run_prefetched(
+        run_max = run_prefetched(
             n_items, get_host, self._put, consume,
-            depth=self.prefetch_depth, stats=self.transfer_stats,
+            depth=self.prefetch_depth, stats=stats,
         )
         if ring:
             # Drain: the carry chain is sequential, so the LAST handle's
@@ -706,6 +715,23 @@ class StreamingObjective:
             # buffer is collectable) before the pass returns.
             jax.block_until_ready(ring[-1])
             ring.clear()
+        # HBM accounting for the carry window (docs/telemetry.md "HBM
+        # accounting"): a dispatched-but-unexecuted program pins its
+        # chunk's buffers beyond the prefetch permit, so the pass's true
+        # staged-buffer residency peak is (live transfers + window
+        # occupancy) x per-chunk staged bytes — the measured counterpart
+        # of the documented <= 2·depth·chunk bound, and the number
+        # ROADMAP item 1's working-set cache must beat.  One gauge write
+        # per PASS, nothing per chunk.
+        tel = telemetry_mod.current()
+        if tel.enabled:
+            d_chunks = stats.chunks - chunks0
+            if d_chunks > 0:
+                chunk_bytes = (stats.bytes - bytes0) / d_chunks
+                tel.gauge("hbm_stream_chunk_bytes").set(int(chunk_bytes))
+                tel.gauge("hbm_stream_window_peak_bytes").set(
+                    int((run_max + ring_peak) * chunk_bytes)
+                )
         return carry_box[0]
 
     def _acc_init(self, batch: int | None):

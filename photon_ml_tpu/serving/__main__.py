@@ -81,6 +81,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="telemetry output dir (selfcheck defaults to a tempdir)",
     )
     p.add_argument("--telemetry", choices=["on", "off"], default="on")
+    p.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="expose the live ops plane on this port (/metrics "
+        "Prometheus exposition, /snapshot JSON, /healthz); 0 binds an "
+        "ephemeral port; omit to disable",
+    )
+    p.add_argument(
+        "--metrics-interval-s", type=float, default=1.0,
+        help="metrics_ts.jsonl sampling interval when --output-dir is "
+        "set (0 disables the time series)",
+    )
     return p
 
 
@@ -293,7 +304,15 @@ def main(argv=None) -> int:
         run_name="serving",
         sinks=None if args.output_dir else [],
     )
-    with tel:
+    with tel, telemetry_mod.mount_ops_plane(
+        tel, port=args.metrics_port, interval_s=args.metrics_interval_s
+    ) as plane:
+        if plane.port is not None:
+            print(
+                f"metrics on http://127.0.0.1:{plane.port} "
+                "(/metrics /snapshot /healthz)",
+                flush=True,
+            )
         service, workload = _make_service(args)
         if args.loadgen:
             from photon_ml_tpu.serving import loadgen

@@ -18,8 +18,12 @@ classification vocabulary so clients can reuse its retry discipline:
 - **Deadlines**: a request that waited past its ``timeout_ms`` fails with
   :class:`DeadlineExceededError` ("DEADLINE_EXCEEDED: ..." — transient).
 - Every failure is classified through the batcher's ``RetryPolicy``
-  (``classify(exc)``) and counted as transient vs permanent in both the
-  internal stats and the telemetry registry.
+  (``classify(exc)``) and counted as transient vs permanent.
+
+Counting has ONE source of truth: with a telemetry hub enabled the
+registry carries every count (``stats()`` derives the /stats view from
+the same snapshot /metrics exposes); only with telemetry disabled does
+the batcher maintain its own minimal mirror so /stats still answers.
 """
 
 from __future__ import annotations
@@ -71,6 +75,10 @@ class _Pending:
     future: Future
     t_submit: float
     deadline: Optional[float]  # perf_counter seconds, None = no deadline
+    #: submitter's trace context — the dispatch thread's serving.batch
+    #: span parents to it, so a request's wait + batch execution nest
+    #: under the span that submitted it (cross-thread tracing).
+    ctx: Optional[tuple] = None
 
 
 _STOP = object()
@@ -96,8 +104,10 @@ class MicroBatcher:
         self._queue: "queue.Queue" = queue.Queue(maxsize=cfg.max_queue)
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
-        # Internal counters mirror telemetry but survive a disabled hub
-        # (the /stats endpoint reads these).
+        # Internal counters exist ONLY for the telemetry-disabled path:
+        # with a hub enabled, the registry is the single source of truth
+        # and stats() derives every count from it (mirror drift is
+        # structurally impossible because the mirror is never written).
         self._counts = {
             "submitted": 0,
             "completed": 0,
@@ -148,6 +158,7 @@ class MicroBatcher:
             future=Future(),
             t_submit=now,
             deadline=None if timeout is None else now + timeout / 1e3,
+            ctx=tel.current_context(),
         )
         try:
             self._queue.put_nowait(pending)
@@ -209,21 +220,33 @@ class MicroBatcher:
                 live.append(p)
         if not live:
             return
+        # Cross-thread trace propagation: the batch executes on the
+        # dispatch thread, but its span parents to the FIRST live
+        # request's submitting span (batch-mates ride along as the rows
+        # count) — a request's end-to-end latency reads as one nested
+        # tree in Perfetto instead of orphaned root spans.
+        ctx = next((p.ctx for p in live if p.ctx is not None), None)
         try:
-            chaos_mod.maybe_fail("serving.batch", rows=len(live))
-            margins, means = self.runtime.score_rows([p.row for p in live])
+            with tel.attach(ctx), tel.span(
+                "serving.batch", rows=len(live)
+            ):
+                chaos_mod.maybe_fail("serving.batch", rows=len(live))
+                margins, means = self.runtime.score_rows(
+                    [p.row for p in live]
+                )
         except Exception as exc:  # noqa: BLE001 — classified + surfaced
             for p in live:
                 self._fail(p, exc)
             return
         done = time.perf_counter()
         bucket = self.runtime.bucket_for(len(live))
-        with self._lock:
-            self._counts["batches"] += 1
-            self._counts["completed"] += len(live)
-            self._counts["max_batch_rows"] = max(
-                self._counts["max_batch_rows"], len(live)
-            )
+        if not tel.enabled:
+            with self._lock:
+                self._counts["batches"] += 1
+                self._counts["completed"] += len(live)
+                self._counts["max_batch_rows"] = max(
+                    self._counts["max_batch_rows"], len(live)
+                )
         tel.histogram("serving_batch_rows").observe(len(live))
         tel.gauge("serving_batch_occupancy").set(len(live) / bucket)
         for i, p in enumerate(live):
@@ -253,11 +276,18 @@ class MicroBatcher:
 
     def _fail(self, p: _Pending, exc: BaseException) -> None:
         self._count("failed")
+        telemetry_mod.current().counter(
+            "serving_failed_requests_total"
+        ).inc()
         self._classify(exc)
         if p.future.set_running_or_notify_cancel():
             p.future.set_exception(exc)
 
     def _count(self, key: str, n: int = 1) -> None:
+        # Disabled-hub mirror only — see __init__; with a hub installed
+        # the registry carries the count and this is a no-op.
+        if telemetry_mod.current().enabled:
+            return
         with self._lock:
             self._counts[key] += n
 
@@ -266,9 +296,40 @@ class MicroBatcher:
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
+    #: stats key → how to derive it from the telemetry snapshot.  The
+    #: batch aggregates come from the serving_batch_rows histogram: one
+    #: observation per dispatched batch, value = live rows, so count =
+    #: batches, sum = completed rows, max = max_batch_rows.
+    _HUB_COUNTERS = {
+        "submitted": "serving_requests_total",
+        "rejected": "serving_rejected_total",
+        "expired": "serving_deadline_expired_total",
+        "failed": "serving_failed_requests_total",
+        "failed_transient": "serving_failures_transient_total",
+        "failed_permanent": "serving_failures_permanent_total",
+    }
+
     def stats(self) -> dict:
-        with self._lock:
-            counts = dict(self._counts)
+        tel = telemetry_mod.current()
+        if tel.enabled:
+            # Single source of truth: derive every count from the hub's
+            # registry (the same numbers /metrics exposes).  Note the
+            # registry is process-wide — two batchers under one hub sum.
+            snap = tel.metrics.snapshot()
+            counters = snap["counters"]
+            hist = snap["histograms"].get("serving_batch_rows") or {}
+            counts = {
+                key: counters.get(name, 0)
+                for key, name in self._HUB_COUNTERS.items()
+            }
+            counts["batches"] = hist.get("count", 0)
+            counts["completed"] = int(hist.get("sum") or 0)
+            counts["max_batch_rows"] = int(hist.get("max") or 0)
+            counts["source"] = "telemetry"
+        else:
+            with self._lock:
+                counts = dict(self._counts)
+            counts["source"] = "internal"
         counts["queue_depth"] = self._queue.qsize()
         counts["max_queue"] = self.config.max_queue
         counts["max_batch_size"] = self.config.max_batch_size

@@ -25,6 +25,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from photon_ml_tpu.telemetry import Histogram
 from photon_ml_tpu.serving.batcher import RejectedError
 
 
@@ -43,10 +44,24 @@ class LoadReport:
     def throughput_rps(self) -> float:
         return self.completed / self.wall_seconds if self.wall_seconds else 0.0
 
+    def latency_histogram(self) -> Histogram:
+        """The latencies folded into a telemetry histogram — the same
+        bucket grid and quantile estimator the live /metrics exposition
+        uses, so a loadgen report and a scraped
+        ``serving_request_latency_seconds`` quantile are directly
+        comparable (cached; build cost paid once)."""
+        hist = getattr(self, "_hist", None)
+        if hist is None:
+            hist = Histogram(threading.Lock())
+            for v in self.latencies_ms:
+                hist.observe(v)
+            self._hist = hist
+        return hist
+
     def percentile_ms(self, q: float) -> Optional[float]:
         if len(self.latencies_ms) == 0:
             return None
-        return float(np.percentile(self.latencies_ms, q))
+        return float(self.latency_histogram().quantile(q / 100.0))
 
     def snapshot(self) -> dict:
         return {

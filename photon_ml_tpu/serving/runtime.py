@@ -236,6 +236,16 @@ class ScoringRuntime:
         self.degraded_batches = 0
         self.device_failures = 0
         self.repromotions = 0
+        # HBM accounting: the hot tables are the serving path's device-
+        # resident working set — (capacity+1) x dim f32 per random
+        # coordinate, allocated up front (LRU inserts overwrite rows,
+        # they never grow the table).
+        self.hot_table_bytes = sum(
+            (c.hot.capacity + 1) * c.hot.dim * 4 for c in self.random
+        )
+        telemetry_mod.current().gauge(
+            "hbm_serving_hot_table_bytes"
+        ).set(self.hot_table_bytes)
         if self.config.warmup:
             self.warm_up()
 
@@ -566,6 +576,10 @@ class ScoringRuntime:
         # through the cold path; the next request finds the entity hot).
         for c, key, vec in promotions:
             c.hot.insert(key, vec)
+        if promotions:
+            tel.gauge("serving_hot_resident_rows").set(
+                sum(c.hot.size for c in self.random)
+            )
         with self._lock:
             self.batches += 1
             self.rows_scored += n

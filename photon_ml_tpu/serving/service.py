@@ -18,9 +18,11 @@ still owns all scoring, so concurrency is safe by construction):
   "latency_ms"}`` or ``{"error", "kind"}``.  A fully-rejected call
   returns 429, a fully-expired one 504, bad input 400.
 - ``GET /healthz`` — liveness + model identity.
-- ``GET /stats`` — runtime + batcher counters (works with telemetry
-  disabled; the telemetry registry carries the same numbers when a hub
-  is installed).
+- ``GET /stats`` — runtime + batcher counters.  With a telemetry hub
+  enabled the batcher block is DERIVED from the hub's registry (the
+  ``"source": "telemetry"`` field says so) — one source of truth with
+  the /metrics exposition; with telemetry disabled a minimal internal
+  mirror answers instead (``"source": "internal"``).
 """
 
 from __future__ import annotations

@@ -9,7 +9,19 @@ validates the outputs:
 - ``trace.json`` parses as a Chrome trace-event ARRAY whose span events
   have the required ph/ts/dur/pid/tid fields and whose parent links
   resolve;
-- ``metrics.json`` round-trips the registry snapshot.
+- ``metrics.json`` round-trips the registry snapshot;
+- **ops plane**: the time-series sampler wrote ≥ 2 monotone-timestamped
+  snapshots to ``metrics_ts.jsonl`` carrying a live HBM-bytes gauge,
+  the embedded exporter's ``/metrics`` output PARSES as Prometheus text
+  exposition (and ``/snapshot`` as JSON), and the exporter thread joins
+  cleanly on close;
+- **flight recorder**: an injected chaos fault (``serving.batch`` via a
+  scripted FaultPlan) dumps ``flightrecorder.json`` whose last-N events
+  END at the fault site's ``chaos.fault`` record.
+
+``--lint-metrics`` runs the metric-name lint (telemetry/lint.py) over
+the package source instead: duplicate-kind registrations and
+non-conforming ``<subsystem>_<name>_<unit>`` names fail the check.
 
 Exit status 0 on success; nonzero with a diagnostic on any failure —
 CI-greppable, device-free (never imports jax).
@@ -27,9 +39,11 @@ import time
 
 
 def _build_synthetic_run(out_dir: str) -> dict:
-    from photon_ml_tpu.telemetry import Telemetry
+    from photon_ml_tpu.telemetry import Telemetry, mount_ops_plane
 
+    info: dict = {}
     with Telemetry(output_dir=out_dir, run_name="selfcheck") as tel:
+        plane = mount_ops_plane(tel, port=0, interval_s=0.02)
         with tel.span("run", driver="selfcheck"):
             for it in range(2):
                 with tel.span("cd_iteration", iteration=it):
@@ -48,15 +62,22 @@ def _build_synthetic_run(out_dir: str) -> dict:
                     "checkpoint.save", iteration=it, path="<synthetic>"
                 )
 
+            ctx = tel.current_context()
+
             def producer():
-                # Cross-thread spans root their own stacks (the h2d
-                # prefetch producer's shape).
-                for k in range(3):
-                    with tel.span("chunk", index=k):
-                        time.sleep(0.0005)
-                    tel.histogram("h2d_chunk_seconds").observe(0.0005)
-                tel.gauge("h2d_gbps").set(1.25)
-                tel.counter("h2d_bytes_total").inc(3 * 1024)
+                # Cross-thread spans ATTACH the spawning span's context
+                # (the h2d prefetch producer's shape) so the Perfetto
+                # view nests the producer track under the run.
+                with tel.attach(ctx):
+                    for k in range(3):
+                        with tel.span("chunk", index=k):
+                            time.sleep(0.0005)
+                        tel.histogram("stream_chunk_seconds").observe(
+                            0.0005
+                        )
+                        tel.gauge("hbm_live_bytes").set((k + 1) * 1024)
+                    tel.gauge("h2d_gbps").set(1.25)
+                    tel.counter("h2d_bytes_total").inc(3 * 1024)
 
             t = threading.Thread(target=producer, name="h2d-prefetch")
             t.start()
@@ -65,8 +86,44 @@ def _build_synthetic_run(out_dir: str) -> dict:
                 "watchdog.attempt", attempt=0, outcome="ok",
                 exception=None,
             )
+
+            # Injected chaos fault → flight-recorder dump ending at the
+            # fault site (chaos/core.py imports no jax; this stays a
+            # device-free check).
+            from photon_ml_tpu import chaos
+
+            with chaos.FaultPlan([chaos.FaultSpec(site="serving.batch")]):
+                try:
+                    chaos.maybe_fail("serving.batch", rows=4)
+                    info["fault_raised"] = False
+                except chaos.InjectedFault:
+                    info["fault_raised"] = True
+
+            # Let the interval sampler take >= 2 samples past the start
+            # sample, then scrape the live endpoints.
+            time.sleep(0.08)
+            import urllib.request
+
+            port = plane.port
+            for route, key in (
+                ("/metrics", "prom_text"),
+                ("/snapshot", "snapshot_body"),
+                ("/healthz", "healthz_body"),
+            ):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{route}", timeout=10
+                ) as resp:
+                    info[key] = resp.read().decode()
+                    info[key + "_status"] = resp.status
         snap = tel.snapshot()
-    return snap
+        exporter = plane.exporter
+        plane.close()
+        info["exporter_alive_after_close"] = exporter.alive
+        info["sampler_alive_after_close"] = (
+            plane.sampler is not None and plane.sampler.alive
+        )
+    info["snapshot"] = snap
+    return info
 
 
 def validate_outputs(out_dir: str, snapshot: dict) -> list[str]:
@@ -166,24 +223,158 @@ def validate_outputs(out_dir: str, snapshot: dict) -> list[str]:
     return failures
 
 
+def validate_ops_plane(out_dir: str, info: dict) -> list[str]:
+    """Validate the live ops plane's outputs: the time-series file, the
+    Prometheus exposition scraped while the run was live, the exporter's
+    thread lifecycle, and the chaos-fault flight-recorder dump."""
+    from photon_ml_tpu.telemetry.exporter import parse_prometheus_text
+    from photon_ml_tpu.telemetry.timeseries import read_series
+
+    failures: list[str] = []
+
+    # -- metrics_ts.jsonl: >= 2 monotone snapshots w/ live HBM gauge -------
+    ts_path = os.path.join(out_dir, "metrics_ts.jsonl")
+    if not os.path.exists(ts_path):
+        failures.append(f"missing time series: {ts_path}")
+    else:
+        series = read_series(ts_path)
+        if len(series) < 2:
+            failures.append(
+                f"metrics_ts.jsonl has {len(series)} snapshots, need >= 2"
+            )
+        for key in ("seq", "t_mono"):
+            vals = [rec.get(key) for rec in series]
+            if any(b <= a for a, b in zip(vals, vals[1:])):
+                failures.append(
+                    f"metrics_ts.jsonl {key} not strictly increasing: "
+                    f"{vals}"
+                )
+        if series and "hbm_live_bytes" not in (
+            series[-1].get("gauges") or {}
+        ):
+            failures.append(
+                "metrics_ts.jsonl final snapshot lacks the live "
+                "hbm_live_bytes gauge"
+            )
+
+    # -- /metrics parses as Prometheus exposition --------------------------
+    prom = info.get("prom_text")
+    if not prom:
+        failures.append("/metrics returned no body")
+    else:
+        try:
+            parsed = parse_prometheus_text(prom)
+        except ValueError as e:
+            failures.append(f"/metrics exposition unparseable: {e}")
+            parsed = {}
+        for family in ("hbm_live_bytes", "solver_iterations"):
+            if (family, "") not in parsed:
+                failures.append(
+                    f"/metrics lacks the {family} family"
+                )
+        if not any(
+            name == "stream_chunk_seconds" and 'quantile="0.5"' in labels
+            for name, labels in parsed
+        ):
+            failures.append(
+                "/metrics lacks histogram quantile samples "
+                "(stream_chunk_seconds{quantile=...})"
+            )
+
+    # -- /snapshot + /healthz are JSON -------------------------------------
+    for key in ("snapshot_body", "healthz_body"):
+        body = info.get(key)
+        if not body:
+            failures.append(f"{key.split('_')[0]} endpoint returned nothing")
+            continue
+        try:
+            json.loads(body)
+        except json.JSONDecodeError as e:
+            failures.append(f"{key} is not JSON: {e}")
+
+    # -- exporter/sampler thread lifecycle ---------------------------------
+    if info.get("exporter_alive_after_close"):
+        failures.append("exporter thread still alive after close()")
+    if info.get("sampler_alive_after_close"):
+        failures.append("sampler thread still alive after stop()")
+
+    # -- flight recorder: dump ends at the injected fault site -------------
+    if not info.get("fault_raised"):
+        failures.append("chaos fault did not raise (plan mis-armed?)")
+    fr_path = os.path.join(out_dir, "flightrecorder.json")
+    if not os.path.exists(fr_path):
+        failures.append(f"missing flight-recorder dump: {fr_path}")
+    else:
+        with open(fr_path) as f:
+            try:
+                dump = json.load(f)
+            except json.JSONDecodeError as e:
+                failures.append(f"flightrecorder.json unparseable: {e}")
+                dump = {}
+        events = dump.get("events") or []
+        if not events:
+            failures.append("flightrecorder.json holds no events")
+        else:
+            last = events[-1]
+            if last.get("name") != "chaos.fault" or (
+                (last.get("attrs") or {}).get("site") != "serving.batch"
+            ):
+                failures.append(
+                    "flightrecorder.json does not END at the fault "
+                    f"site: last event {last.get('name')!r} "
+                    f"attrs={last.get('attrs')}"
+                )
+        if dump.get("n_events", 0) > dump.get("capacity", 0):
+            failures.append(
+                "flight recorder dumped more events than its capacity"
+            )
+        if not str(dump.get("reason") or "").startswith("chaos"):
+            failures.append(
+                f"flight-recorder dump reason {dump.get('reason')!r} "
+                "does not name the chaos fault"
+            )
+    return failures
+
+
+def _run_and_validate(out_dir: str) -> list[str]:
+    info = _build_synthetic_run(out_dir)
+    failures = validate_outputs(out_dir, info["snapshot"])
+    failures.extend(validate_ops_plane(out_dir, info))
+    return failures
+
+
 def selfcheck(keep_dir: str | None = None) -> int:
     if keep_dir is not None:
         os.makedirs(keep_dir, exist_ok=True)
         out_dir = keep_dir
-        snap = _build_synthetic_run(out_dir)
-        failures = validate_outputs(out_dir, snap)
+        failures = _run_and_validate(out_dir)
     else:
         with tempfile.TemporaryDirectory() as td:
             out_dir = td
-            snap = _build_synthetic_run(out_dir)
-            failures = validate_outputs(out_dir, snap)
+            failures = _run_and_validate(out_dir)
     if failures:
         for f in failures:
             print(f"telemetry selfcheck FAIL: {f}", file=sys.stderr)
         return 1
     print(
         "telemetry selfcheck OK: events.jsonl + trace.json + metrics.json "
+        "+ metrics_ts.jsonl + /metrics exposition + flightrecorder.json "
         f"valid ({out_dir})"
+    )
+    return 0
+
+
+def lint_metrics() -> int:
+    from photon_ml_tpu.telemetry.lint import lint_source
+
+    n_names, problems = lint_source()
+    if problems:
+        for p_ in problems:
+            print(f"metric lint FAIL: {p_}", file=sys.stderr)
+        return 1
+    print(
+        f"metric lint OK: {n_names} metric names conform "
+        "(<subsystem>_<name>_<unit>, one kind per name)"
     )
     return 0
 
@@ -192,8 +383,14 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m photon_ml_tpu.telemetry")
     p.add_argument(
         "--selfcheck", action="store_true",
-        help="emit a synthetic span tree through every sink and validate "
-        "the outputs",
+        help="emit a synthetic span tree through every sink + the live "
+        "ops plane (time-series sampler, /metrics exporter, chaos-fault "
+        "flight recorder) and validate every output",
+    )
+    p.add_argument(
+        "--lint-metrics", action="store_true",
+        help="scan the package source for metric registrations and "
+        "enforce the naming convention + one-kind-per-name",
     )
     p.add_argument(
         "--keep-dir",
@@ -201,6 +398,8 @@ def main(argv=None) -> int:
         "instead of a throwaway tempdir",
     )
     args = p.parse_args(argv)
+    if args.lint_metrics:
+        return lint_metrics()
     if args.selfcheck:
         return selfcheck(args.keep_dir)
     p.print_help()

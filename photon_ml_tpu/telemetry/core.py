@@ -25,12 +25,15 @@ as shape/dtype placeholders, never materialized).
 
 from __future__ import annotations
 
+import bisect
+import contextlib
 import itertools
 import json
 import math
 import os
 import threading
 import time
+import uuid
 from typing import Optional
 
 
@@ -107,10 +110,30 @@ class Gauge:
             self.value = v
 
 
-class Histogram:
-    """Streaming summary of observed values (count/sum/min/max/last)."""
+#: Log-spaced histogram bucket upper bounds: ~10 per decade over
+#: 1e-9 .. 1e10 — wide enough for nanosecond latencies through terabyte
+#: counts without per-histogram configuration.  Bucket resolution bounds
+#: the quantile error: a bound is ≤ 1.26x its predecessor, and
+#: :meth:`Histogram.quantile` interpolates inside the bucket, so
+#: quantiles land within a few percent of the exact order statistic.
+_BUCKET_MANTISSAS = (1.0, 1.25, 1.6, 2.0, 2.5, 3.15, 4.0, 5.0, 6.3, 8.0)
+BUCKET_BOUNDS = tuple(
+    m * 10.0 ** e for e in range(-9, 11) for m in _BUCKET_MANTISSAS
+)
 
-    __slots__ = ("_lock", "count", "sum", "min", "max", "last")
+
+class Histogram:
+    """Streaming summary of observed values.
+
+    Tracks count/sum/min/max/last exactly plus a fixed log-spaced bucket
+    grid (:data:`BUCKET_BOUNDS`) that supports :meth:`quantile` without
+    retaining observations — the ad-hoc ``np.percentile`` over saved
+    sample lists this replaces kept O(n) host memory per metric.
+    Values ≤ 0 land in the underflow bucket and quantiles clamp to the
+    exact observed min/max.
+    """
+
+    __slots__ = ("_lock", "count", "sum", "min", "max", "last", "_buckets")
 
     def __init__(self, lock: threading.Lock):
         self._lock = lock
@@ -119,15 +142,51 @@ class Histogram:
         self.min = None
         self.max = None
         self.last = None
+        self._buckets = [0] * (len(BUCKET_BOUNDS) + 1)
 
     def observe(self, v) -> None:
         v = float(v)
+        idx = bisect.bisect_left(BUCKET_BOUNDS, v)
         with self._lock:
             self.count += 1
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
             self.last = v
+            self._buckets[idx] += 1
+
+    def _quantile_locked(self, q: float) -> Optional[float]:
+        if self.count == 0:
+            return None
+        if q <= 0.0:
+            return self.min
+        if q >= 1.0:
+            return self.max
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self._buckets):
+            if c == 0:
+                continue
+            prev = cum
+            cum += c
+            if cum >= target:
+                lo = BUCKET_BOUNDS[i - 1] if i > 0 else self.min
+                hi = (
+                    BUCKET_BOUNDS[i] if i < len(BUCKET_BOUNDS) else self.max
+                )
+                lo = max(lo, self.min)
+                hi = min(hi, self.max)
+                if hi <= lo:
+                    return lo
+                return lo + (target - prev) / c * (hi - lo)
+        return self.max
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Approximate q-quantile (q in [0, 1]) from the bucket grid,
+        linearly interpolated within the covering bucket; None when the
+        histogram is empty.  Exact at the min/max endpoints."""
+        with self._lock:
+            return self._quantile_locked(q)
 
     def summary(self) -> dict:
         with self._lock:
@@ -138,6 +197,9 @@ class Histogram:
                 "max": self.max,
                 "mean": self.sum / self.count if self.count else None,
                 "last": self.last,
+                "p50": self._quantile_locked(0.5),
+                "p90": self._quantile_locked(0.9),
+                "p99": self._quantile_locked(0.99),
             }
 
 
@@ -180,6 +242,17 @@ class MetricsRegistry:
         with self._lock:
             m = table.get(name)
             if m is None:
+                for kind, other in (
+                    ("counter", self._counters),
+                    ("gauge", self._gauges),
+                    ("histogram", self._histograms),
+                ):
+                    if other is not table and name in other:
+                        raise ValueError(
+                            f"metric {name!r} is already registered as a "
+                            f"{kind}; one name = one kind (the Prometheus "
+                            "exposition cannot represent both)"
+                        )
                 m = table[name] = cls(self._lock)
             return m
 
@@ -257,7 +330,14 @@ class Span:
     def __enter__(self) -> "Span":
         hub = self._hub
         stack = hub._span_stack()
-        self.parent_id = stack[-1].span_id if stack else None
+        # Parent: the innermost span on THIS thread, else an attached
+        # cross-thread context (hub.attach) — how the prefetch pack/
+        # transfer threads, the serving dispatch thread, and the tuning
+        # workers nest under the span that spawned their work.
+        self.parent_id = (
+            stack[-1].span_id if stack
+            else getattr(hub._local, "inherit", None)
+        )
         self.span_id = next(hub._ids)
         self._tid = threading.get_ident()
         stack.append(self)
@@ -325,6 +405,10 @@ class Telemetry:
         self.output_dir = output_dir
         self._epoch_perf = time.perf_counter()
         self._epoch_wall = time.time()
+        #: process-unique trace id: spans/events carry it implicitly (one
+        #: hub = one trace); the meta record publishes it so traces from
+        #: several processes can be correlated after a Perfetto merge.
+        self.trace_id = uuid.uuid4().hex[:16]
         self._ids = itertools.count(1)
         self._local = threading.local()
         self._emit_lock = threading.Lock()
@@ -334,6 +418,7 @@ class Telemetry:
         if sinks is None:
             sinks = []
             if enabled and output_dir is not None:
+                from photon_ml_tpu.telemetry.recorder import FlightRecorder
                 from photon_ml_tpu.telemetry.sinks import (
                     ChromeTraceSink,
                     JsonlSink,
@@ -347,6 +432,9 @@ class Telemetry:
                 sinks.append(
                     ChromeTraceSink(os.path.join(output_dir, "trace.json"))
                 )
+                # Always-on forensics ring: bounded memory, dumped only
+                # on crash / watchdog-fatal / injected chaos fault.
+                sinks.append(FlightRecorder())
                 if logger is not None:
                     sinks.append(LoggerSummarySink(logger))
         self._sinks = list(sinks)
@@ -357,6 +445,7 @@ class Telemetry:
                 "ts": 0.0,
                 "wall_epoch": self._epoch_wall,
                 "pid": os.getpid(),
+                "trace": self.trace_id,
             })
 
     # -- state ---------------------------------------------------------------
@@ -370,6 +459,38 @@ class Telemetry:
         if stack is None:
             stack = self._local.stack = []
         return stack
+
+    # -- trace-context propagation -------------------------------------------
+    def current_context(self) -> Optional[tuple]:
+        """``(trace_id, span_id)`` of this thread's innermost span — the
+        handle a caller passes to :meth:`attach` on another thread so
+        work it farms out nests under the span that requested it.  None
+        when the hub is inactive or no span is open."""
+        if not self.active:
+            return None
+        stack = self._span_stack()
+        if stack:
+            return (self.trace_id, stack[-1].span_id)
+        inherit = getattr(self._local, "inherit", None)
+        if inherit is not None:
+            return (self.trace_id, inherit)
+        return None
+
+    @contextlib.contextmanager
+    def attach(self, ctx: Optional[tuple]):
+        """Adopt ``ctx`` (a :meth:`current_context` capture) as this
+        thread's parent for spans/events opened while attached.  No-op
+        for None / inactive hubs, so threads attach unconditionally at
+        one-branch cost when telemetry is off."""
+        if ctx is None or not self.active:
+            yield self
+            return
+        prev = getattr(self._local, "inherit", None)
+        self._local.inherit = ctx[1]
+        try:
+            yield self
+        finally:
+            self._local.inherit = prev
 
     # -- recording -----------------------------------------------------------
     def span(self, name: str, **attrs):
@@ -387,7 +508,10 @@ class Telemetry:
             "type": "event",
             "name": name,
             "ts": time.perf_counter() - self._epoch_perf,
-            "parent": stack[-1].span_id if stack else None,
+            "parent": (
+                stack[-1].span_id if stack
+                else getattr(self._local, "inherit", None)
+            ),
             "tid": threading.get_ident(),
         }
         if attrs:
@@ -411,6 +535,41 @@ class Telemetry:
                 except Exception:
                     # Observability must never sink the job it observes.
                     pass
+
+    # -- flight recorder -----------------------------------------------------
+    @property
+    def recorder(self):
+        """The hub's :class:`~photon_ml_tpu.telemetry.recorder.
+        FlightRecorder` sink, or None (only hubs built with an
+        ``output_dir`` install one by default)."""
+        from photon_ml_tpu.telemetry.recorder import FlightRecorder
+
+        for sink in self._sinks:
+            if isinstance(sink, FlightRecorder):
+                return sink
+        return None
+
+    def dump_flight_recorder(
+        self, reason: str, path: Optional[str] = None
+    ) -> Optional[str]:
+        """Write the flight-recorder ring to ``flightrecorder.json`` in
+        the output dir (or ``path``); returns the path, or None when no
+        recorder/destination exists.  Never raises — forensics must not
+        mask the failure being recorded."""
+        rec = self.recorder
+        if rec is None:
+            return None
+        if path is None:
+            if self.output_dir is None:
+                return None
+            path = os.path.join(self.output_dir, "flightrecorder.json")
+        try:
+            return rec.dump(
+                path, reason=reason, wall_epoch=self._epoch_wall,
+                trace=self.trace_id,
+            )
+        except Exception:
+            return None
 
     # -- snapshot / shutdown -------------------------------------------------
     def snapshot(self) -> dict:
@@ -455,9 +614,16 @@ class Telemetry:
         self._restore_token = set_current(self)
         return self
 
-    def __exit__(self, *exc) -> bool:
+    def __exit__(self, exc_type, exc, tb) -> bool:
         set_current(self._restore_token)
         self._restore_token = None
+        if exc_type is not None and not self._closed:
+            # Crash forensics: the last-N events leading into the
+            # failure, dumped before sinks close (drivers run context-
+            # managed, so every crashed run leaves flightrecorder.json).
+            self.dump_flight_recorder(
+                reason=f"crash: {exc_type.__name__}: {exc}"[:300]
+            )
         self.close()
         return False
 
@@ -487,3 +653,11 @@ def set_current(hub: Optional[Telemetry]) -> Telemetry:
         prev = _current
         _current = hub if hub is not None else NULL
         return prev
+
+
+def dump_flight_recorder(reason: str, path=None) -> Optional[str]:
+    """Dump the process-current hub's flight recorder (see
+    :meth:`Telemetry.dump_flight_recorder`).  The chaos injector and the
+    watchdog's fatal path call this so every deliberate or fatal failure
+    leaves its trailing event window on disk."""
+    return current().dump_flight_recorder(reason, path)

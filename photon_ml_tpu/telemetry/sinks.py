@@ -51,25 +51,39 @@ class ChromeTraceSink(Sink):
     trace carries the end-of-run numbers.  The buffer is bounded: a
     runaway emitter degrades to a truncated trace (with a drop marker),
     never to unbounded host memory.
+
+    Timestamps are WALL-ANCHORED by default: the hub's monotonic ``ts``
+    offsets are shifted by the ``wall_epoch`` the meta record carries,
+    so traces from several processes (a training driver + its tuning
+    workers + a serving sidecar) concatenate into ONE merged Perfetto
+    timeline with correct relative placement.  ``anchor_wall=False``
+    keeps the raw run-relative offsets.
     """
 
     MAX_RECORDS = 500_000
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, anchor_wall: bool = True):
         self.path = path
+        self.anchor_wall = anchor_wall
         self._records: list[dict] = []
         self._dropped = 0
         self._pid = os.getpid()
+        self._wall_epoch = 0.0
 
     def emit(self, record: dict) -> None:
+        if record.get("type") == "meta" and record.get("wall_epoch"):
+            self._wall_epoch = float(record["wall_epoch"])
         if len(self._records) >= self.MAX_RECORDS:
             self._dropped += 1
             return
         self._records.append(record)
 
+    def _anchor(self) -> float:
+        return self._wall_epoch if self.anchor_wall else 0.0
+
     def _convert(self, record: dict) -> dict | None:
         kind = record.get("type")
-        ts_us = record.get("ts", 0.0) * 1e6
+        ts_us = (record.get("ts", 0.0) + self._anchor()) * 1e6
         base = {
             "name": record.get("name", "?"),
             "pid": self._pid,

@@ -88,6 +88,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="skip the per-record journal fsync (faster, "
                    "crash-safety reduced to flush)")
     p.add_argument("--telemetry", choices=["on", "off"], default="on")
+    p.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="expose the live ops plane on this port (/metrics "
+        "Prometheus exposition, /snapshot JSON); 0 = ephemeral; omit "
+        "to disable",
+    )
+    p.add_argument(
+        "--metrics-interval-s", type=float, default=1.0,
+        help="metrics_ts.jsonl sampling interval (0 disables)",
+    )
     return p
 
 
@@ -470,7 +480,12 @@ def run_search(args) -> dict:
             logger=logger,
             enabled=args.telemetry != "off",
         )
-        with tel, tel.span("run", driver="tuning", mode=args.driver):
+        with tel, tel.span(
+            "run", driver="tuning", mode=args.driver
+        ), telemetry_mod.mount_ops_plane(
+            tel, port=args.metrics_port,
+            interval_s=args.metrics_interval_s, logger=logger,
+        ):
             fit_once, space = _build_search(args)
             asha = None
             if args.asha:

@@ -321,6 +321,9 @@ class TuningOrchestrator:
     # -- main loop ----------------------------------------------------------
     def run(self, resume: bool = False) -> TuningResult:
         tel = telemetry_mod.current()
+        # Worker threads attach this context so every tuning.trial span
+        # parents to the search's own span instead of rooting loose.
+        self._trace_ctx = tel.current_context()
         ready: list[_Task] = []
         inflight: list[_Task] = []
         if resume:
@@ -432,7 +435,7 @@ class TuningOrchestrator:
         warm = self._warm_start(task)
         attempt = 0
         t0 = time.perf_counter()
-        with tel.span(
+        with tel.attach(getattr(self, "_trace_ctx", None)), tel.span(
             "tuning.trial",
             trial=task.trial.id,
             rung=task.rung,

@@ -250,6 +250,15 @@ def run_with_retries(
                 backoff_seconds=delay,
             )
             if not retrying:
+                # Watchdog-fatal: the run is about to die for good —
+                # freeze the event window (telemetry/recorder.py; no-op
+                # without a recorder-equipped hub).
+                telemetry_mod.dump_flight_recorder(
+                    reason=(
+                        "watchdog-fatal: "
+                        f"{type(exc).__name__}: {exc}"
+                    )[:300]
+                )
                 raise
             stats.retries += 1
             stats.sleep_seconds += delay

@@ -14,6 +14,13 @@ cd "$(dirname "$0")/.."
 echo "== telemetry selfcheck =="
 python -m photon_ml_tpu.telemetry --selfcheck
 
+# Metric-name lint: every registered metric name in the source tree
+# conforms to <subsystem>_<name>_<unit> and no name is used as two
+# different kinds (telemetry/lint.py; legacy names are grandfathered
+# explicitly there).
+echo "== telemetry metric-name lint =="
+python -m photon_ml_tpu.telemetry --lint-metrics
+
 # The serving selfcheck builds a synthetic GAME model, serves concurrent
 # HTTP requests, and verifies batched results are bit-identical to
 # single-request scoring (plus the telemetry snapshot contents).
@@ -45,7 +52,8 @@ if [[ "${1:-}" == "--fast" ]]; then
   # every other streamed number rests on.  test_chaos's kill/resume
   # boundary matrices are the fast recovery smoke.
   exec env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_telemetry.py tests/test_watchdog.py \
+    tests/test_telemetry.py tests/test_ops_plane.py \
+    tests/test_watchdog.py \
     tests/test_serving.py tests/test_tuning.py tests/test_chaos.py \
     "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
     -m 'not slow' -q -p no:cacheprovider
