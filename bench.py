@@ -1124,7 +1124,7 @@ def bench_serving() -> dict:
          f"{snap['latency_p99_ms']} ms, mean batch "
          f"{mean_batch and round(mean_batch, 1)} rows, hot hit rate "
          f"{hot['hit_rate'] and round(hot['hit_rate'], 3)}")
-    return {
+    out = {
         "serving_throughput_rps": snap["throughput_rps"],
         "serving_latency_p50_ms": snap["latency_p50_ms"],
         "serving_latency_p99_ms": snap["latency_p99_ms"],
@@ -1139,6 +1139,89 @@ def bench_serving() -> dict:
             None if hot["hit_rate"] is None else round(hot["hit_rate"], 4)
         ),
     }
+    out.update(_bench_serving_scenarios(workload))
+    return out
+
+
+def _bench_serving_scenarios(workload) -> dict:
+    """Scripted HA scenarios against a 2-replica supervisor: per-scenario
+    p50/p99 + error counts.  The replica-kill and swap-under-load
+    scenarios must complete with ZERO failed requests — that is the HA
+    acceptance gate, reported (not asserted) here so a regression shows
+    up in the bench diff."""
+    import tempfile
+
+    from photon_ml_tpu.io.game_store import save_game_model
+    from photon_ml_tpu.serving import loadgen
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService
+    from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+    from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+    rate = 150.0 if SMALL else 400.0
+    rt_cfg = RuntimeConfig(max_batch_size=32, hot_entities=1024)
+
+    def factory() -> ScoringRuntime:
+        return ScoringRuntime(
+            workload.model, workload.index_maps, rt_cfg
+        )
+
+    def make_request(i: int, phase) -> dict:
+        if phase.entity_pool is None:
+            return workload.request(i)
+        # Skew shift: draw the entity from the phase's fraction range of
+        # the entity space (disjoint ranges churn the LRU hot set).
+        lo, hi = phase.entity_pool
+        req = workload.request(i)
+        span = max(1, int((hi - lo) * workload.n_entities))
+        req["ids"][workload.entity_key] = (
+            f"u{int(lo * workload.n_entities) + i % span}"
+        )
+        return req
+
+    out: dict = {}
+    with tempfile.TemporaryDirectory(prefix="bench_serving_swap_") as td:
+        v2 = SyntheticWorkload(
+            n_entities=workload.n_entities, fixed_dim=workload.fixed_dim,
+            re_dim=workload.re_dim, seed=10,
+        )
+        v2_dir = os.path.join(td, "v2")
+        _log("serving: saving swap-target model...")
+        save_game_model(v2.model, v2.index_maps, v2_dir)
+        for name, scenario in loadgen.SCENARIOS.items():
+            supervisor = ReplicaSupervisor(
+                factory, n_replicas=2, probe_interval_s=0.1
+            )
+            service = ScoringService(supervisor, BatcherConfig(
+                max_batch_size=32, max_wait_us=1000, max_queue=1024,
+            ))
+            with service:
+                actions = {
+                    "swap": lambda svc=service: svc.reload(
+                        v2_dir
+                    ).to_dict(),
+                    "kill_replica": lambda sup=supervisor: {
+                        "killed": sup.kill_replica(0).rid
+                    },
+                }
+                report = loadgen.run_scenario(
+                    service.submit, make_request, scenario,
+                    base_rate_rps=rate, actions=actions,
+                )
+            snap = report.snapshot()
+            _log(
+                f"serving scenario {name}: {report.completed} ok / "
+                f"{report.rejected} shed / {report.errors} errors, p50 "
+                f"{snap['latency_p50_ms']} ms p99 {snap['latency_p99_ms']}"
+                " ms"
+            )
+            out[f"serving_scenario_{name}_p50_ms"] = snap["latency_p50_ms"]
+            out[f"serving_scenario_{name}_p99_ms"] = snap["latency_p99_ms"]
+            out[f"serving_scenario_{name}_completed"] = report.completed
+            out[f"serving_scenario_{name}_rejected"] = report.rejected
+            out[f"serving_scenario_{name}_errors"] = report.errors
+    return out
 
 
 def bench_tuning() -> dict:

@@ -132,6 +132,19 @@ KNOWN_SITES = {
         "here simulates a lost accelerator and must flip the runtime "
         "into degraded host-side scoring"
     ),
+    "serving.replica": (
+        "supervisor routing, before a request is handed to the chosen "
+        "replica (serving/supervisor.py) — a fault here simulates that "
+        "replica crashing; the supervisor must mark it down and "
+        "re-route/resubmit with zero failed requests"
+    ),
+    "serving.swap": (
+        "model hot-swap critical section (serving/swap.py): touched at "
+        "stage 'load' (before the background load), 'prepare' (loaded+"
+        "warmed, before the atomic commit) and 'verify' (committed, "
+        "before the post-swap probe) — a fault must abort or roll back "
+        "with the previous version still serving"
+    ),
     "tuning.trial": (
         "worker thread, before a tuning trial's fit runs "
         "(tuning/executor.py)"

@@ -21,7 +21,11 @@ import numpy as np
 from photon_ml_tpu.data.index_map import IndexMap, feature_key
 from photon_ml_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
 from photon_ml_tpu.io import avro
-from photon_ml_tpu.io.model_store import load_glm_model, save_glm_model
+from photon_ml_tpu.io.model_store import (
+    _warn_unverified,
+    load_glm_model,
+    save_glm_model,
+)
 
 RANDOM_EFFECT_MODEL_SCHEMA = {
     "type": "record",
@@ -156,7 +160,10 @@ def load_game_model(directory: str) -> tuple[GameModel, dict]:
 
     Models saved with manifest fingerprints are verified per coordinate
     (random-effect checksums here, fixed-effect sidecars inside
-    ``load_glm_model``); pre-fingerprint directories load unverified."""
+    ``load_glm_model``); pre-fingerprint directories load unverified —
+    with a pointed warning per unverified coordinate and the
+    ``model_load_unverified_total`` counter (tampering on such a model
+    is undetectable, so the condition must be visible on /metrics)."""
     with open(os.path.join(directory, "metadata.json")) as f:
         manifest = json.load(f)
     fingerprints = manifest.get("fingerprints") or {}
@@ -198,6 +205,10 @@ def load_game_model(directory: str) -> tuple[GameModel, dict]:
                         f"{path}: {len(records)} entities on disk, "
                         f"fingerprint says {fp['n_entities']}"
                     )
+            else:
+                _warn_unverified(
+                    path, "no fingerprint in the metadata.json manifest"
+                )
             imap = index_maps[coord["feature_shard"]]
             table = {}
             var_table: dict = {}

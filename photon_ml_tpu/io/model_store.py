@@ -65,6 +65,25 @@ def coefficient_checksum(entry_lists) -> str:
     return h.hexdigest()
 
 
+def _warn_unverified(path: str, why: str) -> None:
+    """A model loading WITHOUT fingerprint verification is a quiet hole
+    in the tamper story (a flipped bit serves wrong scores with no
+    error) — make it loud: a pointed warning for the operator reading
+    logs plus ``model_load_unverified_total`` for the fleet dashboard.
+    Re-save with the current writer to get a sidecar."""
+    import warnings
+
+    from photon_ml_tpu import telemetry as telemetry_mod
+
+    telemetry_mod.current().counter("model_load_unverified_total").inc()
+    warnings.warn(
+        f"{path}: loading UNVERIFIED ({why}); content tampering or "
+        "truncation cannot be detected on this model — re-save it with "
+        "the current writer to attach a fingerprint sidecar",
+        stacklevel=3,
+    )
+
+
 def glm_fingerprint(task: str, feature_count: int, record: dict) -> dict:
     return {
         "version": 1,
@@ -141,15 +160,20 @@ def save_glm_model(
 def verify_glm_fingerprint(
     path: str, task: str, record: dict, index_map: Optional[IndexMap]
 ) -> Optional[dict]:
-    """Check file content against the save-time fingerprint sidecar (a
-    no-op when no sidecar exists).  Returns the fingerprint when one was
-    verified."""
+    """Check file content against the save-time fingerprint sidecar.
+    Returns the fingerprint when one was verified; a pre-fingerprint
+    file (no sidecar) loads UNVERIFIED — loudly: a pointed warning plus
+    the ``model_load_unverified_total`` counter, so a fleet serving
+    unverifiable models is visible on /metrics, not just in a log
+    nobody tails."""
     meta_path = path + ".meta.json"
     if not os.path.exists(meta_path):
+        _warn_unverified(path, "no .meta.json fingerprint sidecar")
         return None
     with open(meta_path) as f:
         fingerprint = json.load(f).get("fingerprint")
     if not fingerprint:
+        _warn_unverified(meta_path, "sidecar carries no fingerprint")
         return None
     actual = coefficient_checksum([record["means"], record["variances"]])
     if actual != fingerprint.get("coefficient_checksum"):

@@ -18,10 +18,16 @@ TPU-native analogue of that request path over the batch stack:
   to the nearest bucket, per-request futures, admission control and
   deadline timeouts classified through ``utils/watchdog``.
 - :mod:`~photon_ml_tpu.serving.service` — ``ScoringService`` (in-process
-  API) and a stdlib ``ThreadingHTTPServer`` JSON endpoint
-  (``/score``, ``/healthz``, ``/stats``).
+  API) and a stdlib ``ThreadingHTTPServer`` JSON endpoint (``/score``,
+  ``/reload``, ``/healthz``, ``/livez``, ``/readyz``, ``/stats``).
+- :mod:`~photon_ml_tpu.serving.supervisor` — ``ReplicaSupervisor``: N
+  replicas behind one listener, health probes, request resubmission,
+  decorrelated-jitter restarts (the HA story; docs/serving.md).
+- :mod:`~photon_ml_tpu.serving.swap` — ``HotSwapper``: zero-downtime
+  model hot-swap with verified one-step rollback.
 - :mod:`~photon_ml_tpu.serving.loadgen` — closed/open-loop load
-  generators (used by ``--loadgen`` and ``bench.py bench_serving``).
+  generators plus scripted scenarios (diurnal ramp, skew shift,
+  swap-under-load, replica-kill; ``bench.py bench_serving``).
 
 ``python -m photon_ml_tpu.serving --selfcheck`` builds a synthetic GAME
 model, serves concurrent HTTP requests, and verifies batched results are
@@ -46,6 +52,14 @@ _LAZY = {
     "ScoringService": ("photon_ml_tpu.serving.service", "ScoringService"),
     "start_http_server": (
         "photon_ml_tpu.serving.service", "start_http_server",
+    ),
+    "ReplicaSupervisor": (
+        "photon_ml_tpu.serving.supervisor", "ReplicaSupervisor",
+    ),
+    "HotSwapper": ("photon_ml_tpu.serving.swap", "HotSwapper"),
+    "SwapResult": ("photon_ml_tpu.serving.swap", "SwapResult"),
+    "SwapInProgressError": (
+        "photon_ml_tpu.serving.swap", "SwapInProgressError",
     ),
 }
 

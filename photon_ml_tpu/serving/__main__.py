@@ -13,6 +13,13 @@ requests through the real HTTP endpoint, and verifies:
   nonzero batch-occupancy gauge;
 - /healthz and /stats answer.
 
+A second, high-availability pass then runs a 2-replica supervisor under
+open-loop load while (a) one replica is killed and restarts and (b) the
+model is hot-swapped v1 -> v2 over HTTP ``/reload`` and a TAMPERED model
+directory is rejected with an automatic rollback — asserting ZERO failed
+requests throughout and a monotone ``serving_model_version`` in
+metrics.json.
+
 Serve a saved model::
 
     python -m photon_ml_tpu.serving --model-dir /tmp/game_out --port 8080
@@ -33,6 +40,7 @@ import os
 import sys
 import tempfile
 import threading
+import urllib.error
 import urllib.request
 
 
@@ -63,6 +71,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--hot-entities", type=int, default=1024,
         help="per-coordinate LRU hot-set capacity (device-resident rows)",
+    )
+    p.add_argument(
+        "--replicas", type=int, default=1,
+        help="run this many supervised scoring replicas behind the "
+        "listener (>1 enables the HA path: health probes, automatic "
+        "restarts, request resubmission; docs/serving.md)",
     )
     p.add_argument(
         "--timeout-ms", type=float, default=None,
@@ -107,22 +121,33 @@ def _make_service(args):
         from photon_ml_tpu.serving.synthetic import SyntheticWorkload
 
         workload = SyntheticWorkload(n_entities=args.synthetic)
-        runtime = ScoringRuntime(
-            workload.model, workload.index_maps, rt_cfg
-        )
+
+        def factory() -> ScoringRuntime:
+            return ScoringRuntime(
+                workload.model, workload.index_maps, rt_cfg
+            )
     elif args.model_dir:
         workload = None
-        runtime = ScoringRuntime.load(args.model_dir, rt_cfg)
+
+        def factory() -> ScoringRuntime:
+            return ScoringRuntime.load(args.model_dir, rt_cfg)
     else:
         raise SystemExit(
             "one of --selfcheck / --model-dir / --synthetic is required"
         )
-    service = ScoringService(runtime, BatcherConfig(
+    batcher_cfg = BatcherConfig(
         max_batch_size=args.max_batch_size,
         max_wait_us=args.max_wait_us,
         max_queue=args.max_queue,
         default_timeout_ms=args.timeout_ms,
-    ))
+    )
+    if args.replicas > 1:
+        from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+
+        unit = ReplicaSupervisor(factory, n_replicas=args.replicas)
+    else:
+        unit = factory()
+    service = ScoringService(unit, batcher_cfg)
     return service, workload
 
 
@@ -272,6 +297,213 @@ def run_selfcheck(out_dir: str) -> list[str]:
     return failures
 
 
+def run_selfcheck_ha(out_dir: str) -> list[str]:
+    """High-availability pass: replica kill + hot-swap + tampered-model
+    rollback under open-loop load, zero failed requests.  Returns
+    failure strings (empty = pass)."""
+    import shutil
+    import time
+
+    from photon_ml_tpu import telemetry as telemetry_mod
+    from photon_ml_tpu.io.game_store import save_game_model
+    from photon_ml_tpu.serving import loadgen
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService, start_http_server
+    from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+    from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+    failures: list[str] = []
+    # Two model versions with identical shard shapes (so the same request
+    # stream scores on both), one tampered copy of v2.
+    v1 = SyntheticWorkload(n_entities=64, seed=3)
+    v2 = SyntheticWorkload(n_entities=64, seed=4)
+    models_dir = os.path.join(out_dir, "models")
+    v1_dir = os.path.join(models_dir, "v1")
+    v2_dir = os.path.join(models_dir, "v2")
+    bad_dir = os.path.join(models_dir, "v2-tampered")
+    save_game_model(v1.model, v1.index_maps, v1_dir)
+    save_game_model(v2.model, v2.index_maps, v2_dir)
+    shutil.copytree(v2_dir, bad_dir)
+    bad_avro = os.path.join(
+        bad_dir, "random-effect", "per_entity", "coefficients.avro"
+    )
+    with open(bad_avro, "r+b") as f:
+        f.seek(-64, os.SEEK_END)
+        byte = f.read(1)
+        f.seek(-64, os.SEEK_END)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+    rt_cfg = RuntimeConfig(max_batch_size=8, hot_entities=16)
+
+    def factory() -> ScoringRuntime:
+        return ScoringRuntime.load(v1_dir, rt_cfg)
+
+    with telemetry_mod.Telemetry(
+        output_dir=out_dir, run_name="serving-selfcheck-ha"
+    ) as tel:
+        supervisor = ReplicaSupervisor(
+            factory, n_replicas=2, probe_interval_s=0.1
+        )
+        service = ScoringService(supervisor, BatcherConfig(
+            max_batch_size=8, max_wait_us=2_000, max_queue=256,
+        ))
+        versions: list[int] = []
+        with service:
+            server, _ = start_http_server(service, port=0)
+            port = server.server_address[1]
+            base = f"http://127.0.0.1:{port}"
+            try:
+                def http(method: str, route: str, body=None):
+                    req = urllib.request.Request(
+                        base + route,
+                        method=method,
+                        data=None if body is None else
+                        json.dumps(body).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    try:
+                        with urllib.request.urlopen(req, timeout=30) as r:
+                            return r.status, json.loads(r.read())
+                    except urllib.error.HTTPError as e:
+                        return e.code, json.loads(e.read())
+
+                def script() -> None:
+                    # Fires while the open loop below is running.
+                    try:
+                        time.sleep(0.4)
+                        versions.append(service.swapper.version)
+                        # A burst straight into the queues right before
+                        # the kill guarantees in-flight work on the dying
+                        # replica — the resubmission path, not just the
+                        # routing-exclusion path, must be exercised.
+                        burst = [
+                            service.submit(v1.request(50_000 + j))
+                            for j in range(64)
+                        ]
+                        supervisor.kill_replica(0)
+                        for bf in burst:
+                            try:
+                                bf.result(timeout=30)
+                            except Exception as exc:  # noqa: BLE001
+                                failures.append(
+                                    "burst request failed after replica "
+                                    f"kill: {exc!r}"
+                                )
+                                break
+                        deadline = time.monotonic() + 10
+                        while (
+                            supervisor.healthy_count < 2
+                            and time.monotonic() < deadline
+                        ):
+                            time.sleep(0.05)
+                        if supervisor.healthy_count < 2:
+                            failures.append(
+                                "killed replica did not restart within "
+                                "10 s"
+                            )
+                        status, swapped = http(
+                            "POST", "/reload", {"model_dir": v2_dir}
+                        )
+                        if status != 200 or swapped["status"] != "swapped":
+                            failures.append(
+                                f"/reload v2 -> HTTP {status} {swapped}"
+                            )
+                        versions.append(service.swapper.version)
+                        status, rolled = http(
+                            "POST", "/reload", {"model_dir": bad_dir}
+                        )
+                        if status != 422 or \
+                                rolled["status"] != "rolled_back":
+                            failures.append(
+                                "/reload tampered dir -> HTTP "
+                                f"{status} {rolled} (expected 422 "
+                                "rolled_back)"
+                            )
+                        versions.append(service.swapper.version)
+                    except Exception as exc:  # noqa: BLE001
+                        failures.append(f"HA script failed: {exc!r}")
+
+                script_thread = threading.Thread(
+                    target=script, daemon=True
+                )
+                script_thread.start()
+                report = loadgen.open_loop(
+                    service.submit, v1.request,
+                    rate_rps=120.0, duration_s=4.0,
+                )
+                script_thread.join(timeout=30)
+                if report.errors or report.rejected:
+                    failures.append(
+                        f"HA load saw {report.errors} errors and "
+                        f"{report.rejected} rejections (expected 0/0) "
+                        f"across {report.completed} requests"
+                    )
+                if report.completed < 100:
+                    failures.append(
+                        f"HA load completed only {report.completed} "
+                        "requests; the pass did not exercise the path"
+                    )
+                if versions != sorted(versions):
+                    failures.append(
+                        f"model_version went backwards: {versions}"
+                    )
+                if service.swapper.version != 2:
+                    failures.append(
+                        "expected model_version 2 after swap + rejected "
+                        f"tamper, got {service.swapper.version}"
+                    )
+                for route, want in (("/livez", 200), ("/readyz", 200)):
+                    status, _body = http("GET", route)
+                    if status != want:
+                        failures.append(
+                            f"{route} -> HTTP {status}, expected {want}"
+                        )
+                status, health = http("GET", "/healthz")
+                if health.get("status") != "ok":
+                    failures.append(f"/healthz after HA pass: {health}")
+            finally:
+                server.shutdown()
+                server.server_close()
+        snap = tel.snapshot()
+
+    counters = snap["counters"]
+    gauges = snap["gauges"]
+    for name, minimum in (
+        ("serving_swaps_total", 1),
+        ("serving_rollbacks_total", 1),
+        ("serving_replica_restarts_total", 1),
+        ("serving_resubmitted_total", 1),
+    ):
+        if counters.get(name, 0) < minimum:
+            failures.append(
+                f"{name} = {counters.get(name, 0)}, expected >= {minimum}"
+            )
+    metrics_path = os.path.join(out_dir, "metrics.json")
+    if not os.path.exists(metrics_path):
+        failures.append(f"missing {metrics_path}")
+    else:
+        with open(metrics_path) as f:
+            on_disk = json.load(f)
+        if on_disk.get("gauges", {}).get("serving_model_version") != 2:
+            failures.append(
+                "metrics.json serving_model_version = "
+                f"{on_disk.get('gauges', {}).get('serving_model_version')!r}"
+                ", expected 2"
+            )
+    if not failures:
+        print(
+            "serving HA selfcheck: replica kill + v1->v2 hot swap + "
+            "tampered-model rollback under load, 0 failed requests "
+            f"(restarts {counters.get('serving_replica_restarts_total')}, "
+            f"resubmitted {counters.get('serving_resubmitted_total')}, "
+            f"swaps {counters.get('serving_swaps_total')}, rollbacks "
+            f"{counters.get('serving_rollbacks_total')}, final version "
+            f"{gauges.get('serving_model_version')})"
+        )
+    return failures
+
+
 # ---------------------------------------------------------------------------
 # Entry point
 # ---------------------------------------------------------------------------
@@ -280,14 +512,24 @@ def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
 
     if args.selfcheck:
+        def both(root: str) -> list[str]:
+            # Separate output dirs: each pass owns its Telemetry hub and
+            # its metrics.json (the HA assertions read ha/metrics.json).
+            single, ha = (
+                os.path.join(root, "single"), os.path.join(root, "ha")
+            )
+            os.makedirs(single, exist_ok=True)
+            os.makedirs(ha, exist_ok=True)
+            return run_selfcheck(single) + run_selfcheck_ha(ha)
+
         if args.output_dir:
             os.makedirs(args.output_dir, exist_ok=True)
-            failures = run_selfcheck(args.output_dir)
+            failures = both(args.output_dir)
         else:
             with tempfile.TemporaryDirectory(
                 prefix="photon_serving_selfcheck_"
             ) as td:
-                failures = run_selfcheck(td)
+                failures = both(td)
         if failures:
             print("serving selfcheck FAILED:", file=sys.stderr)
             for f in failures:
@@ -304,59 +546,68 @@ def main(argv=None) -> int:
         run_name="serving",
         sinks=None if args.output_dir else [],
     )
-    with tel, telemetry_mod.mount_ops_plane(
-        tel, port=args.metrics_port, interval_s=args.metrics_interval_s
-    ) as plane:
-        if plane.port is not None:
-            print(
-                f"metrics on http://127.0.0.1:{plane.port} "
-                "(/metrics /snapshot /healthz)",
-                flush=True,
-            )
+    with tel:
         service, workload = _make_service(args)
-        if args.loadgen:
-            from photon_ml_tpu.serving import loadgen
+        plane_ctx = telemetry_mod.mount_ops_plane(
+            tel, port=args.metrics_port,
+            interval_s=args.metrics_interval_s,
+            readiness=service.readiness,
+        )
+        with plane_ctx as plane:
+            if plane.port is not None:
+                print(
+                    f"metrics on http://127.0.0.1:{plane.port} "
+                    "(/metrics /snapshot /healthz /livez /readyz)",
+                    flush=True,
+                )
+            return _run_service(args, service, workload)
 
-            if workload is None:
-                from photon_ml_tpu.serving.synthetic import SyntheticWorkload
 
-                workload = SyntheticWorkload(n_entities=10_000)
-            with service:
-                if args.loadgen == "closed":
-                    report = loadgen.closed_loop(
-                        service.submit, workload.request,
-                        clients=args.clients, duration_s=args.duration,
-                    )
-                else:
-                    report = loadgen.open_loop(
-                        service.submit, workload.request,
-                        rate_rps=args.rate, duration_s=args.duration,
-                    )
-            print(json.dumps({
-                "loadgen": report.snapshot(),
-                "stats": service.stats(),
-            }, indent=2))
-            return 0
+def _run_service(args, service, workload) -> int:
+    if args.loadgen:
+        from photon_ml_tpu.serving import loadgen
 
-        from photon_ml_tpu.serving.service import start_http_server
+        if workload is None:
+            from photon_ml_tpu.serving.synthetic import SyntheticWorkload
 
+            workload = SyntheticWorkload(n_entities=10_000)
         with service:
-            server, thread = start_http_server(
-                service, host=args.host, port=args.port
-            )
-            host, port = server.server_address[:2]
-            print(
-                f"serving on http://{host}:{port} "
-                f"(/score /healthz /stats); Ctrl-C to stop",
-                flush=True,
-            )
-            try:
-                thread.join()
-            except KeyboardInterrupt:
-                print("shutting down")
-            finally:
-                server.shutdown()
-                server.server_close()
+            if args.loadgen == "closed":
+                report = loadgen.closed_loop(
+                    service.submit, workload.request,
+                    clients=args.clients, duration_s=args.duration,
+                )
+            else:
+                report = loadgen.open_loop(
+                    service.submit, workload.request,
+                    rate_rps=args.rate, duration_s=args.duration,
+                )
+        print(json.dumps({
+            "loadgen": report.snapshot(),
+            "stats": service.stats(),
+        }, indent=2))
+        return 0
+
+    from photon_ml_tpu.serving.service import start_http_server
+
+    with service:
+        server, thread = start_http_server(
+            service, host=args.host, port=args.port
+        )
+        host, port = server.server_address[:2]
+        print(
+            f"serving on http://{host}:{port} "
+            f"(/score /reload /healthz /livez /readyz /stats); "
+            "Ctrl-C to stop",
+            flush=True,
+        )
+        try:
+            thread.join()
+        except KeyboardInterrupt:
+            print("shutting down")
+        finally:
+            server.shutdown()
+            server.server_close()
     return 0
 
 

@@ -20,6 +20,24 @@ classification vocabulary so clients can reuse its retry discipline:
 - Every failure is classified through the batcher's ``RetryPolicy``
   (``classify(exc)``) and counted as transient vs permanent.
 
+**Tiered load shedding** — the binary queue-full reject is only the
+backstop.  Admission is evaluated per submit against three tiers driven
+by queue depth (watermark fractions of ``max_queue``) and the observed
+p99 of the live ``serving_request_latency_seconds`` histogram:
+
+- tier 0 **accept** — depth below ``shed_watermark``: everything admits.
+- tier 1 **shed** — depth ≥ ``shed_watermark``, or observed p99 over
+  ``p99_slo_ms``: ``priority="low"`` rows and over-deadline work (a
+  deadline budget smaller than the current p99 — it would expire in the
+  queue anyway) are rejected; normal/high traffic still admits.
+- tier 2 **reject** — depth ≥ ``reject_watermark``: everything but
+  supervisor health probes (``bypass_admission=True``) is rejected.
+
+Shed rejections raise :class:`RejectedError` (UNAVAILABLE → HTTP 429,
+transient) and count on ``serving_shed_total`` (+ per-reason counters);
+every tier TRANSITION is journaled as a ``serving.shed_tier`` telemetry
+event with the depth/p99 evidence that drove it (docs/serving.md).
+
 Counting has ONE source of truth: with a telemetry hub enabled the
 registry carries every count (``stats()`` derives the /stats view from
 the same snapshot /metrics exposes); only with telemetry disabled does
@@ -51,6 +69,11 @@ class DeadlineExceededError(TimeoutError):
     """The request's deadline passed before (or while) it was scored."""
 
 
+#: admission tiers, in escalation order (module docstring).
+TIER_ACCEPT, TIER_SHED, TIER_REJECT = 0, 1, 2
+TIER_NAMES = ("accept", "shed", "reject")
+
+
 @dataclasses.dataclass(frozen=True)
 class BatcherConfig:
     """Coalescing knobs (model/bucket knobs live on RuntimeConfig)."""
@@ -67,6 +90,21 @@ class BatcherConfig:
     max_queue: int = 256
     #: default per-request deadline; None = no deadline.
     default_timeout_ms: Optional[float] = None
+    #: queue-depth fraction at which tier 1 (shed low-priority /
+    #: over-deadline work) engages.
+    shed_watermark: float = 0.5
+    #: queue-depth fraction at which tier 2 (reject everything but
+    #: probes) engages; the queue-full RejectedError stays the backstop.
+    reject_watermark: float = 0.9
+    #: latency SLO: an observed request p99 above this escalates
+    #: admission to at least tier 1.  None disables the latency signal
+    #: (depth watermarks still apply); it also needs an enabled
+    #: telemetry hub — the p99 is read from the live
+    #: ``serving_request_latency_seconds`` histogram.
+    p99_slo_ms: Optional[float] = None
+    #: how often (seconds) the p99 estimate is refreshed; between
+    #: refreshes a submit pays one queue-depth read and comparisons.
+    admission_interval_s: float = 0.1
 
 
 @dataclasses.dataclass
@@ -98,12 +136,25 @@ class MicroBatcher:
             cfg = dataclasses.replace(
                 cfg, max_batch_size=runtime.buckets[-1]
             )
+        if not (0.0 < cfg.shed_watermark <= cfg.reject_watermark <= 1.0):
+            raise ValueError(
+                "need 0 < shed_watermark <= reject_watermark <= 1, got "
+                f"{cfg.shed_watermark} / {cfg.reject_watermark}"
+            )
+        # NOTE ``self.runtime`` is re-read at every dispatch: plain
+        # attribute assignment is the hot-swap commit point
+        # (serving/swap.py) — atomic under the GIL, no lock needed.
         self.runtime = runtime
         self.config = cfg
         self.policy = policy or RetryPolicy()
         self._queue: "queue.Queue" = queue.Queue(maxsize=cfg.max_queue)
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        # Admission-control state: current tier + the cached p99 read
+        # (refreshed at most every admission_interval_s).
+        self._tier = TIER_ACCEPT
+        self._p99_ms: Optional[float] = None
+        self._p99_refresh_t = 0.0
         # Internal counters exist ONLY for the telemetry-disabled path:
         # with a hub enabled, the registry is the single source of truth
         # and stats() derives every count from it (mirror drift is
@@ -112,6 +163,10 @@ class MicroBatcher:
             "submitted": 0,
             "completed": 0,
             "rejected": 0,
+            "shed": 0,
+            "shed_low_priority": 0,
+            "shed_deadline": 0,
+            "tier_transitions": 0,
             "expired": 0,
             "failed": 0,
             "failed_transient": 0,
@@ -135,14 +190,120 @@ class MicroBatcher:
         self._queue.put(_STOP)
         self._thread.join(timeout=timeout)
         self._thread = None
+        # Fail anything that raced past admission after the _STOP went
+        # in — nothing will ever dispatch it.  Transient vocabulary, not
+        # RejectedError: a supervisor treats this as the BATCHER's fault
+        # and resubmits the row to a peer replica.
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is _STOP:
+                continue
+            if item.future.set_running_or_notify_cancel():
+                item.future.set_exception(RuntimeError(
+                    "UNAVAILABLE: batcher stopped before dispatch; "
+                    "retry with backoff"
+                ))
+
+    # -- admission control (any thread) ------------------------------------
+    def _observed_p99_ms(self, now: float) -> Optional[float]:
+        """Cached read of the live request-latency p99, in ms; None when
+        the SLO signal is off, the hub is disabled, or no observations
+        exist yet."""
+        if self.config.p99_slo_ms is None:
+            return None
+        if now >= self._p99_refresh_t:
+            self._p99_refresh_t = now + self.config.admission_interval_s
+            hist = telemetry_mod.current().histogram(
+                "serving_request_latency_seconds"
+            )
+            quantile = getattr(hist, "quantile", None)
+            p99_s = None if quantile is None else quantile(0.99)
+            self._p99_ms = None if p99_s is None else p99_s * 1e3
+        return self._p99_ms
+
+    def admission_tier(self, now: Optional[float] = None) -> int:
+        """The current admission tier (module docstring): max of the
+        depth-watermark tier and the p99-SLO tier."""
+        if now is None:
+            now = time.perf_counter()
+        frac = self._queue.qsize() / self.config.max_queue
+        if frac >= self.config.reject_watermark:
+            tier = TIER_REJECT
+        elif frac >= self.config.shed_watermark:
+            tier = TIER_SHED
+        else:
+            tier = TIER_ACCEPT
+        p99 = self._observed_p99_ms(now)
+        if (
+            tier < TIER_SHED
+            and p99 is not None
+            and p99 > self.config.p99_slo_ms
+        ):
+            tier = TIER_SHED
+        return tier
+
+    def _note_tier(self, tier: int) -> None:
+        """Journal a tier transition: gauge + counter + telemetry event
+        carrying the evidence (depth, p99) that drove it."""
+        with self._lock:
+            prev = self._tier
+            if tier == prev:
+                return
+            self._tier = tier
+        self._count("tier_transitions")
+        tel = telemetry_mod.current()
+        tel.counter("serving_tier_transitions_total").inc()
+        tel.gauge("serving_shed_tier").set(tier)
+        tel.event(
+            "serving.shed_tier",
+            tier=TIER_NAMES[tier],
+            previous=TIER_NAMES[prev],
+            queue_depth=self._queue.qsize(),
+            max_queue=self.config.max_queue,
+            p99_ms=self._p99_ms,
+        )
+
+    def _shed(self, reason: str, detail: str) -> RejectedError:
+        self._count("shed")
+        tel = telemetry_mod.current()
+        tel.counter("serving_shed_total").inc()
+        if reason == "reject_tier":
+            # The reject tier refuses ALL non-probe traffic — that is
+            # the same verdict the pre-tier queue-full backstop gave, so
+            # it keeps feeding the legacy rejection counters.
+            self._count("rejected")
+            tel.counter("serving_rejected_total").inc()
+        if reason == "low_priority":
+            self._count("shed_low_priority")
+            tel.counter("serving_shed_low_priority_total").inc()
+        elif reason == "deadline":
+            self._count("shed_deadline")
+            tel.counter("serving_shed_deadline_total").inc()
+        exc = RejectedError(
+            f"UNAVAILABLE: load shed ({detail}); retry with backoff"
+        )
+        self._classify(exc)
+        return exc
 
     # -- submission (any thread) -------------------------------------------
-    def submit(self, row, timeout_ms: Optional[float] = None) -> Future:
+    def submit(
+        self,
+        row,
+        timeout_ms: Optional[float] = None,
+        bypass_admission: bool = False,
+    ) -> Future:
         """Enqueue one request; returns its future.
 
-        Raises :class:`RejectedError` immediately when the queue is full
-        — admission control is synchronous so the caller can shed load
-        (HTTP 429) without waiting on a future.
+        Raises :class:`RejectedError` immediately when the tiered
+        admission controller sheds the row or the queue is full —
+        admission control is synchronous so the caller can shed load
+        (HTTP 429) without waiting on a future.  ``bypass_admission``
+        skips the tier check (NOT the queue-full backstop): supervisor
+        health probes must keep flowing under overload, or shedding
+        would read as replica death and trigger a restart storm.
         """
         tel = telemetry_mod.current()
         timeout = (
@@ -153,6 +314,32 @@ class MicroBatcher:
         if timeout is None:
             timeout = self.config.default_timeout_ms
         now = time.perf_counter()
+        tier = self.admission_tier(now)
+        self._note_tier(tier)
+        if tier > TIER_ACCEPT and not bypass_admission:
+            if tier >= TIER_REJECT:
+                raise self._shed(
+                    "reject_tier",
+                    f"admission tier {TIER_NAMES[tier]}, queue "
+                    f"{self._queue.qsize()}/{self.config.max_queue}",
+                )
+            priority = getattr(row, "priority", "normal")
+            if priority == "low":
+                raise self._shed(
+                    "low_priority",
+                    "low-priority request at admission tier shed",
+                )
+            if (
+                timeout is not None
+                and self._p99_ms is not None
+                and timeout < self._p99_ms
+            ):
+                raise self._shed(
+                    "deadline",
+                    f"deadline budget {timeout:.0f} ms is under the "
+                    f"observed p99 {self._p99_ms:.0f} ms; it would "
+                    "expire in the queue",
+                )
         pending = _Pending(
             row=row,
             future=Future(),
@@ -204,6 +391,9 @@ class MicroBatcher:
 
     def _dispatch(self, batch: list) -> None:
         tel = telemetry_mod.current()
+        # One read per dispatch: the whole batch scores against a single
+        # runtime even if a hot-swap commits mid-dispatch (swap.py).
+        runtime = self.runtime
         tel.gauge("serving_queue_depth").set(self._queue.qsize())
         now = time.perf_counter()
         live = []
@@ -231,7 +421,7 @@ class MicroBatcher:
                 "serving.batch", rows=len(live)
             ):
                 chaos_mod.maybe_fail("serving.batch", rows=len(live))
-                margins, means = self.runtime.score_rows(
+                margins, means = runtime.score_rows(
                     [p.row for p in live]
                 )
         except Exception as exc:  # noqa: BLE001 — classified + surfaced
@@ -239,7 +429,7 @@ class MicroBatcher:
                 self._fail(p, exc)
             return
         done = time.perf_counter()
-        bucket = self.runtime.bucket_for(len(live))
+        bucket = runtime.bucket_for(len(live))
         if not tel.enabled:
             with self._lock:
                 self._counts["batches"] += 1
@@ -303,6 +493,10 @@ class MicroBatcher:
     _HUB_COUNTERS = {
         "submitted": "serving_requests_total",
         "rejected": "serving_rejected_total",
+        "shed": "serving_shed_total",
+        "shed_low_priority": "serving_shed_low_priority_total",
+        "shed_deadline": "serving_shed_deadline_total",
+        "tier_transitions": "serving_tier_transitions_total",
         "expired": "serving_deadline_expired_total",
         "failed": "serving_failed_requests_total",
         "failed_transient": "serving_failures_transient_total",
@@ -334,4 +528,7 @@ class MicroBatcher:
         counts["max_queue"] = self.config.max_queue
         counts["max_batch_size"] = self.config.max_batch_size
         counts["max_wait_us"] = self.config.max_wait_us
+        with self._lock:
+            counts["tier"] = TIER_NAMES[self._tier]
+        counts["model_version"] = getattr(self.runtime, "model_version", 1)
         return counts

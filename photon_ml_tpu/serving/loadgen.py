@@ -12,6 +12,24 @@ Two disciplines, both driving ``ScoringService.submit``:
   (coordinated omission).  Arrivals that find the queue full count as
   rejections, which is the admission-control design working as intended.
 
+On top of the two disciplines, **scripted scenarios** (:func:`run_scenario`
+over the :data:`SCENARIOS` catalog) chain open-loop phases with varying
+rate, entity skew, and mid-phase ACTIONS (hot-swap, replica kill) — the
+repeatable "a bad day in serving" scripts that ``bench_serving`` and the
+HA selfcheck replay:
+
+- ``diurnal``      — rate ramps up 4x and back down (the daily curve);
+  admission tiers should engage at the peak and release after.
+- ``skew_shift``   — the hot entity set jumps to a disjoint pool
+  mid-run; the LRU hot tables churn and re-converge.
+- ``swap_under_load``   — a model hot-swap commits mid-phase while
+  traffic flows; zero failed requests expected.
+- ``replica_kill`` — a replica is killed mid-phase; the supervisor
+  resubmits and restarts; zero failed requests expected.
+
+Per-phase and whole-run p50/p99 come from the same shared
+``telemetry.Histogram.quantile`` the live exposition uses.
+
 Used by ``python -m photon_ml_tpu.serving --loadgen ...`` and by
 ``bench.py``'s ``bench_serving`` section.
 """
@@ -204,4 +222,193 @@ def open_loop(
         rejected=counts[1],
         errors=counts[2],
         latencies_ms=np.asarray(latencies),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Scripted scenarios
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ScenarioPhase:
+    """One open-loop segment of a scenario."""
+
+    name: str
+    duration_s: float
+    #: offered load = ``base_rate_rps * rate_multiplier``.
+    rate_multiplier: float = 1.0
+    #: fraction range ``(lo, hi)`` of the entity space this phase draws
+    #: from; the caller's ``make_request(i, phase)`` interprets it (a
+    #: disjoint range across phases is the hot-set skew shift).
+    entity_pool: Optional[tuple[float, float]] = None
+    #: action fired DURING the phase (``"swap"`` / ``"kill_replica"`` /
+    #: any key the caller wires), resolved via ``run_scenario(actions=)``.
+    action: Optional[str] = None
+    #: when within the phase the action fires (fraction of duration) —
+    #: far enough in that traffic is flowing, far enough from the end
+    #: that the aftermath is measured.
+    action_at_frac: float = 0.25
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    description: str
+    phases: list
+
+
+@dataclasses.dataclass
+class ScenarioReport:
+    """Per-phase + whole-run summary of one scenario replay."""
+
+    scenario: str
+    phases: list  # (phase_name, LoadReport) pairs
+    actions: dict  # action name -> result (or error string)
+
+    @property
+    def completed(self) -> int:
+        return sum(r.completed for _, r in self.phases)
+
+    @property
+    def rejected(self) -> int:
+        return sum(r.rejected for _, r in self.phases)
+
+    @property
+    def errors(self) -> int:
+        return sum(r.errors for _, r in self.phases)
+
+    def percentile_ms(self, q: float) -> Optional[float]:
+        latencies = [
+            r.latencies_ms for _, r in self.phases if len(r.latencies_ms)
+        ]
+        if not latencies:
+            return None
+        merged = LoadReport(
+            mode="merged", wall_seconds=0.0, completed=self.completed,
+            rejected=self.rejected, errors=self.errors,
+            latencies_ms=np.concatenate(latencies),
+        )
+        return merged.percentile_ms(q)
+
+    def snapshot(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "errors": self.errors,
+            "latency_p50_ms": _round(self.percentile_ms(50)),
+            "latency_p99_ms": _round(self.percentile_ms(99)),
+            "actions": self.actions,
+            "phases": {
+                name: report.snapshot() for name, report in self.phases
+            },
+        }
+
+
+#: The scenario catalog ``bench_serving`` iterates.  Durations are short
+#: (seconds) — these are repeatable scripts, not endurance runs; scale
+#: offered load through ``base_rate_rps``.
+SCENARIOS = {
+    "diurnal": Scenario(
+        "diurnal",
+        "rate ramps 0.5x -> 2x -> 0.5x, the compressed daily curve",
+        [
+            ScenarioPhase("night", 1.0, rate_multiplier=0.5),
+            ScenarioPhase("morning", 1.0, rate_multiplier=1.0),
+            ScenarioPhase("peak", 1.0, rate_multiplier=2.0),
+            ScenarioPhase("evening", 1.0, rate_multiplier=0.5),
+        ],
+    ),
+    "skew_shift": Scenario(
+        "skew_shift",
+        "hot entity set jumps to a disjoint pool mid-run (LRU churn)",
+        [
+            ScenarioPhase("pool_a", 1.5, entity_pool=(0.0, 0.3)),
+            ScenarioPhase("pool_b", 1.5, entity_pool=(0.7, 1.0)),
+        ],
+    ),
+    "swap_under_load": Scenario(
+        "swap_under_load",
+        "model hot-swap commits while traffic flows; zero errors expected",
+        [
+            ScenarioPhase("warm", 1.0),
+            ScenarioPhase("swap", 2.0, action="swap"),
+            ScenarioPhase("after", 1.0),
+        ],
+    ),
+    "replica_kill": Scenario(
+        "replica_kill",
+        "a replica dies mid-phase; resubmission + restart, zero errors "
+        "expected",
+        [
+            ScenarioPhase("warm", 1.0),
+            ScenarioPhase("kill", 2.0, action="kill_replica"),
+            ScenarioPhase("after", 1.0),
+        ],
+    ),
+}
+
+
+def run_scenario(
+    submit: Callable,
+    make_request: Callable,
+    scenario: Scenario,
+    base_rate_rps: float = 100.0,
+    actions: Optional[dict] = None,
+    timeout_s: float = 30.0,
+    seed: int = 0,
+) -> ScenarioReport:
+    """Replay ``scenario`` phase by phase against ``submit``.
+
+    ``make_request(i, phase)`` builds the i-th request of a phase (use
+    ``phase.entity_pool`` for skew).  ``actions`` maps an action name to
+    a zero-arg callable; a phase's action fires on a helper thread
+    ``action_at_frac`` into the phase, so the load keeps flowing while
+    the swap/kill happens — that concurrency is the whole point.  An
+    action named by a phase but not wired raises ValueError up front
+    (silently skipping it would report a scenario that never ran)."""
+    actions = actions or {}
+    for phase in scenario.phases:
+        if phase.action is not None and phase.action not in actions:
+            raise ValueError(
+                f"scenario {scenario.name!r} phase {phase.name!r} needs "
+                f"action {phase.action!r}; wire it via run_scenario("
+                "actions={...})"
+            )
+    phase_reports: list = []
+    action_results: dict = {}
+    for pi, phase in enumerate(scenario.phases):
+        action_thread = None
+        if phase.action is not None:
+            fn = actions[phase.action]
+            delay = phase.duration_s * phase.action_at_frac
+
+            def fire(fn=fn, delay=delay, key=phase.action):
+                time.sleep(delay)
+                try:
+                    action_results[key] = fn()
+                except Exception as exc:  # noqa: BLE001 — report, not crash
+                    action_results[key] = (
+                        f"ERROR {type(exc).__name__}: {exc}"
+                    )
+
+            action_thread = threading.Thread(
+                target=fire, name=f"scenario-{phase.action}", daemon=True
+            )
+            action_thread.start()
+        report = open_loop(
+            submit,
+            lambda i, phase=phase: make_request(i, phase),
+            rate_rps=base_rate_rps * phase.rate_multiplier,
+            duration_s=phase.duration_s,
+            timeout_s=timeout_s,
+            seed=seed + pi,
+        )
+        if action_thread is not None:
+            action_thread.join(timeout=timeout_s)
+        phase_reports.append((phase.name, report))
+    return ScenarioReport(
+        scenario=scenario.name,
+        phases=phase_reports,
+        actions=action_results,
     )

@@ -95,6 +95,11 @@ def _host_mean(task: str, margins: np.ndarray) -> np.ndarray:
     return margins.astype(np.float32)
 
 
+#: request priorities the admission controller understands; "low" work is
+#: the first tier shed under load (serving/batcher.py).
+PRIORITIES = ("low", "normal", "high")
+
+
 @dataclasses.dataclass
 class Row:
     """One parsed scoring request."""
@@ -103,6 +108,7 @@ class Row:
     ids: dict  # entity-key name -> str entity id (or absent)
     offset: float = 0.0
     timeout_ms: Optional[float] = None
+    priority: str = "normal"  # one of PRIORITIES
 
 
 class _HotTable:
@@ -193,6 +199,15 @@ class ScoringRuntime:
         self.config = config or RuntimeConfig()
         if self.config.max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
+        # Version identity for the hot-swap machinery (serving/swap.py):
+        # the initially-loaded model is version 1; every successful swap
+        # stamps a strictly greater number.  ``ready`` is the READINESS
+        # half of the health split (/readyz): False until the bucket
+        # ladder is warm, so a load balancer never routes at a runtime
+        # that would compile on the request path.
+        self.model_version = 1
+        self.model_path: Optional[str] = None
+        self.ready = False
         self.model = model
         self.index_maps = index_maps or {}
         self.task = model.task
@@ -248,6 +263,7 @@ class ScoringRuntime:
         ).set(self.hot_table_bytes)
         if self.config.warmup:
             self.warm_up()
+        self.ready = True
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -276,13 +292,15 @@ class ScoringRuntime:
         imaps = {shard: index_map} if index_map is not None else {}
         return cls(game, imaps, config)
 
-    @classmethod
-    def load(
-        cls, path: str, config: Optional[RuntimeConfig] = None
-    ) -> "ScoringRuntime":
-        """Load a saved model: a GAME model directory (either the
-        directory holding ``metadata.json`` or a driver output dir with a
-        ``models/`` subdir) or a GLM ``.avro`` file."""
+    @staticmethod
+    def load_model(path: str) -> tuple[GameModel, dict]:
+        """Read a saved model off disk: a GAME model directory (either
+        the directory holding ``metadata.json`` or a driver output dir
+        with a ``models/`` subdir) or a GLM ``.avro`` file.  Returns
+        ``(GameModel, index_maps)`` — fingerprint sidecars are verified
+        by the stores (a tampered payload raises before anything is
+        served).  The hot-swap path loads ONCE through here and builds
+        one runtime per replica from the shared host-side model."""
         if os.path.isdir(path):
             from photon_ml_tpu.io.game_store import load_game_model
 
@@ -290,12 +308,25 @@ class ScoringRuntime:
                 nested = os.path.join(path, "models")
                 if os.path.exists(os.path.join(nested, "metadata.json")):
                     path = nested
-            model, index_maps = load_game_model(path)
-            return cls(model, index_maps, config)
+            return load_game_model(path)
         from photon_ml_tpu.io.model_store import load_glm_model
 
         glm, imap = load_glm_model(path)
-        return cls.from_glm_model(glm, imap, config=config)
+        game = GameModel(
+            models={"fixed": FixedEffectModel(glm, "features")},
+            task=glm.task,
+        )
+        return game, {"features": imap}
+
+    @classmethod
+    def load(
+        cls, path: str, config: Optional[RuntimeConfig] = None
+    ) -> "ScoringRuntime":
+        """Load a saved model (see :meth:`load_model`) into a runtime."""
+        model, index_maps = cls.load_model(path)
+        runtime = cls(model, index_maps, config)
+        runtime.model_path = path
+        return runtime
 
     # -- warmup ------------------------------------------------------------
     def _abstract_args(self, bucket: int) -> tuple:
@@ -381,12 +412,24 @@ class ScoringRuntime:
             if value is not None:
                 ids[str(key)] = str(value)
         timeout = obj.get("timeout_ms")
+        priority = obj.get("priority", "normal")
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}"
+            )
         return Row(
             features=features,
             ids=ids,
             offset=float(obj.get("offset") or 0.0),
             timeout_ms=None if timeout is None else float(timeout),
+            priority=priority,
         )
+
+    def probe_row(self) -> Row:
+        """A minimal valid request (offset-only) — what health probes and
+        swap verification score.  Scores 0 margin on any model; the point
+        is exercising the whole dispatch → kernel → future path."""
+        return self.parse_request({})
 
     # -- scoring -----------------------------------------------------------
     def bucket_for(self, n: int) -> int:
@@ -610,6 +653,9 @@ class ScoringRuntime:
             }
         return {
             "task": self.task,
+            "model_version": self.model_version,
+            "model_path": self.model_path,
+            "ready": self.ready,
             "buckets": list(self.buckets),
             "coordinates": {
                 "fixed": [c.name for c in self.fixed],

@@ -1,28 +1,47 @@
 """ScoringService: the in-process API and the stdlib HTTP endpoint.
 
-``ScoringService`` composes a :class:`~photon_ml_tpu.serving.runtime.
-ScoringRuntime` with a :class:`~photon_ml_tpu.serving.batcher.MicroBatcher`
-and is the one object callers touch:
+``ScoringService`` is the one object callers touch.  It composes either
+a single :class:`~photon_ml_tpu.serving.runtime.ScoringRuntime` with a
+:class:`~photon_ml_tpu.serving.batcher.MicroBatcher`, or — for
+high-availability serving — a :class:`~photon_ml_tpu.serving.supervisor.
+ReplicaSupervisor` running N replicas behind the same listener:
 
-    with ScoringService(runtime) as svc:
+    with ScoringService(runtime) as svc:          # single runtime
         fut = svc.submit({"dense": {"global": [...]}, "ids": {...}})
         result = svc.score({...})            # blocking convenience
         many = svc.score_many([{...}, ...])  # coalesces naturally
 
+    sup = ReplicaSupervisor(factory, n_replicas=3)
+    with ScoringService(sup) as svc:              # HA: same API
+        ...
+
+Either way the service carries a :class:`~photon_ml_tpu.serving.swap.
+HotSwapper` — ``svc.reload(model_dir)`` rolls every live runtime onto a
+new model version with verified rollback (see serving/swap.py).
+
 ``start_http_server(svc, port)`` exposes the same API over a stdlib
-``ThreadingHTTPServer`` (one thread per connection; the dispatch thread
-still owns all scoring, so concurrency is safe by construction):
+``ThreadingHTTPServer`` (one thread per connection; dispatch threads
+still own all scoring, so concurrency is safe by construction):
 
 - ``POST /score`` — ``{"rows": [...]}`` or a single request object;
   responds ``{"results": [...]}`` with per-row ``{"score", "mean",
   "latency_ms"}`` or ``{"error", "kind"}``.  A fully-rejected call
   returns 429, a fully-expired one 504, bad input 400.
-- ``GET /healthz`` — liveness + model identity.
-- ``GET /stats`` — runtime + batcher counters.  With a telemetry hub
-  enabled the batcher block is DERIVED from the hub's registry (the
-  ``"source": "telemetry"`` field says so) — one source of truth with
-  the /metrics exposition; with telemetry disabled a minimal internal
-  mirror answers instead (``"source": "internal"``).
+- ``POST /reload`` — ``{"model_dir": ...}`` swaps to a new model
+  (``{"rollback": true}`` is the one-step manual rollback).  200 on
+  swap, 409 while another swap runs, 422 when the swap rolled back,
+  503 when deferred (degraded target).
+- ``GET /healthz`` — the RICH health view: status ``stopped`` /
+  ``not_ready`` / ``degraded`` / ``ok``, model version, replica states.
+- ``GET /livez`` — pure liveness: 200 whenever the process answers.
+- ``GET /readyz`` — pure readiness: 200 only when traffic should route
+  here; 503 with ``"not_ready"`` during startup warmup, mid-swap, and
+  when no healthy replica exists.  Load balancers watch THIS, not
+  /healthz (a warming server is alive but must not receive traffic).
+- ``GET /stats`` — runtime/supervisor + batcher + swap counters.  With
+  a telemetry hub enabled the batcher block is DERIVED from the hub's
+  registry (the ``"source": "telemetry"`` field says so) — one source
+  of truth with the /metrics exposition.
 """
 
 from __future__ import annotations
@@ -40,29 +59,52 @@ from photon_ml_tpu.serving.batcher import (
     RejectedError,
 )
 from photon_ml_tpu.serving.runtime import Row, ScoringRuntime
+from photon_ml_tpu.serving.swap import HotSwapper, SwapInProgressError
 
 
 class ScoringService:
-    """Runtime + batcher, started/stopped as one unit."""
+    """Runtime(+batcher) or supervisor, started/stopped as one unit."""
 
     def __init__(
         self,
-        runtime: ScoringRuntime,
+        runtime,
         batcher_config: Optional[BatcherConfig] = None,
         policy=None,
     ):
-        self.runtime = runtime
-        self.batcher = MicroBatcher(runtime, batcher_config, policy=policy)
+        from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+
+        if isinstance(runtime, ReplicaSupervisor):
+            self.supervisor: Optional[ReplicaSupervisor] = runtime
+            if batcher_config is not None:
+                self.supervisor.batcher_config = batcher_config
+            self.runtime = None
+            self.batcher = None
+        else:
+            self.supervisor = None
+            self.runtime = runtime
+            self.batcher = MicroBatcher(
+                runtime, batcher_config, policy=policy
+            )
+        self.swapper = HotSwapper(
+            self._swap_targets, on_commit=self._on_swap_commit
+        )
         self._started = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ScoringService":
-        self.batcher.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
+        else:
+            self.batcher.start()
         self._started = True
+        self.swapper.adopt_version(self.current_runtime)
         return self
 
     def stop(self) -> None:
-        self.batcher.stop()
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        else:
+            self.batcher.stop()
         self._started = False
 
     def __enter__(self) -> "ScoringService":
@@ -72,16 +114,58 @@ class ScoringService:
         self.stop()
         return False
 
+    # -- hot swap ----------------------------------------------------------
+    @property
+    def current_runtime(self):
+        """The runtime serving NOW (post-swap it differs from the one the
+        service was constructed with)."""
+        if self.supervisor is not None:
+            return self.supervisor._any_runtime()
+        return self.batcher.runtime
+
+    def _swap_targets(self) -> list:
+        if self.supervisor is not None:
+            return self.supervisor.swap_targets()
+        return [self.batcher]
+
+    def _on_swap_commit(
+        self, model, index_maps, config, version, path
+    ) -> None:
+        if self.supervisor is not None:
+            self.supervisor.on_swap_commit(
+                model, index_maps, config, version, path
+            )
+        else:
+            self.runtime = self.batcher.runtime
+
+    def reload(
+        self, model_dir: Optional[str] = None, rollback: bool = False
+    ):
+        """Hot-swap to the model at ``model_dir`` (or roll back one
+        step).  Returns a :class:`~photon_ml_tpu.serving.swap.
+        SwapResult`; raises SwapInProgressError on concurrent reloads
+        and ValueError when neither argument is given."""
+        if rollback:
+            return self.swapper.rollback()
+        if not model_dir:
+            raise ValueError(
+                "reload needs 'model_dir' (or 'rollback': true)"
+            )
+        return self.swapper.swap(model_dir)
+
     # -- scoring -----------------------------------------------------------
     def submit(self, request, timeout_ms: Optional[float] = None) -> Future:
         """Parse + enqueue one request (dict or pre-parsed Row); returns
-        the future.  Raises RejectedError on a full queue and ValueError
-        on malformed input."""
-        row = (
-            request
-            if isinstance(request, Row)
-            else self.runtime.parse_request(request)
-        )
+        the future.  Raises RejectedError on a full queue or load shed
+        and ValueError on malformed input."""
+        if isinstance(request, Row):
+            row = request
+        elif self.supervisor is not None:
+            row = self.supervisor.parse_request(request)
+        else:
+            row = self.current_runtime.parse_request(request)
+        if self.supervisor is not None:
+            return self.supervisor.submit(row, timeout_ms=timeout_ms)
         return self.batcher.submit(row, timeout_ms=timeout_ms)
 
     def score(self, request, timeout: Optional[float] = 30.0) -> dict:
@@ -110,29 +194,80 @@ class ScoringService:
         return slots
 
     # -- observability -----------------------------------------------------
+    def readiness(self) -> tuple[bool, str]:
+        """The /readyz verdict: should a load balancer route traffic
+        here RIGHT NOW?  False during startup warmup, mid-swap, and
+        with zero healthy replicas — distinct from liveness (/livez)
+        and from degraded (still serving, via the host path)."""
+        if not self._started:
+            return False, "not started"
+        if self.swapper.in_progress:
+            return False, "model swap in progress"
+        if self.supervisor is not None:
+            if not self.supervisor.ready:
+                return False, "no healthy ready replica"
+            return True, "ok"
+        runtime = self.current_runtime
+        if not getattr(runtime, "ready", True):
+            return False, "runtime warming up"
+        return True, "ok"
+
     def healthz(self) -> dict:
         # "degraded" ≠ down: requests still succeed through the host cold
-        # path (runtime docstring); status stays distinguishable so a
-        # load balancer can shed-or-keep by policy, not by guessing.
-        degraded = self.runtime.degraded
-        return {
+        # path (runtime docstring); "not_ready" ≠ dead: the process is
+        # alive but should not receive NEW traffic (warmup / mid-swap).
+        # Statuses stay distinguishable so a load balancer can shed-or-
+        # keep by policy, not by guessing.
+        runtime = self.current_runtime
+        degraded = (
+            self.supervisor.degraded if self.supervisor is not None
+            else getattr(runtime, "degraded", False)
+        )
+        ready, ready_reason = self.readiness()
+        out = {
             "status": (
                 "stopped" if not self._started
+                else "not_ready" if not ready
                 else "degraded" if degraded
                 else "ok"
             ),
+            "ready": ready,
+            "ready_reason": ready_reason,
             "degraded": degraded,
-            "breaker": self.runtime.breaker.state,
-            "task": self.runtime.task,
-            "coordinates": self.runtime.stats()["coordinates"],
-            "buckets": list(self.runtime.buckets),
+            "model_version": self.swapper.version,
+            "model_path": self.swapper.model_path,
+            "swap_in_progress": self.swapper.in_progress,
         }
+        if self.supervisor is not None:
+            sup = self.supervisor.stats()
+            out["replicas"] = sup["replicas"]
+            out["healthy_replicas"] = sup["healthy"]
+        if runtime is not None and isinstance(runtime, ScoringRuntime):
+            out.update({
+                "breaker": runtime.breaker.state,
+                "task": runtime.task,
+                "coordinates": runtime.stats()["coordinates"],
+                "buckets": list(runtime.buckets),
+            })
+        return out
 
     def stats(self) -> dict:
-        return {
-            "runtime": self.runtime.stats(),
-            "batcher": self.batcher.stats(),
-        }
+        out = {"swap": self.swapper.stats()}
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor.stats()
+            targets = self.supervisor.swap_targets()
+            if targets:
+                # NOTE with a telemetry hub the batcher block is derived
+                # from the process-wide registry — it aggregates across
+                # replicas by construction.
+                out["batcher"] = targets[0].stats()
+            runtime = self.current_runtime
+            if isinstance(runtime, ScoringRuntime):
+                out["runtime"] = runtime.stats()
+        else:
+            out["runtime"] = self.current_runtime.stats()
+            out["batcher"] = self.batcher.stats()
+        return out
 
 
 def _error_kind(exc: BaseException) -> str:
@@ -160,6 +295,9 @@ _KIND_STATUS = {
     "internal": 500,
 }
 
+#: swap outcome → HTTP status for POST /reload (module docstring).
+_SWAP_STATUS = {"swapped": 200, "rolled_back": 422, "deferred": 503}
+
 
 class _Handler(BaseHTTPRequestHandler):
     service: ScoringService  # set on the server class per instance
@@ -179,20 +317,35 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes ------------------------------------------------------------
     def do_GET(self) -> None:  # noqa: N802 — stdlib casing
+        service = self.server.service
         if self.path == "/healthz":
-            self._send_json(200, self.server.service.healthz())
+            self._send_json(200, service.healthz())
+        elif self.path == "/livez":
+            self._send_json(200, {"status": "alive"})
+        elif self.path == "/readyz":
+            ready, reason = service.readiness()
+            self._send_json(200 if ready else 503, {
+                "status": "ready" if ready else "not_ready",
+                "reason": reason,
+            })
         elif self.path == "/stats":
-            self._send_json(200, self.server.service.stats())
+            self._send_json(200, service.stats())
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", "0"))
+        return json.loads(self.rfile.read(length) or b"{}")
+
     def do_POST(self) -> None:  # noqa: N802 — stdlib casing
+        if self.path == "/reload":
+            self._do_reload()
+            return
         if self.path != "/score":
             self._send_json(404, {"error": f"no route {self.path}"})
             return
         try:
-            length = int(self.headers.get("Content-Length", "0"))
-            obj = json.loads(self.rfile.read(length) or b"{}")
+            obj = self._read_body()
             rows = obj["rows"] if isinstance(obj, dict) and "rows" in obj \
                 else [obj]
             if not isinstance(rows, list) or not rows:
@@ -210,6 +363,25 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             status = 200  # partial failure reports per-row
         self._send_json(status, {"results": results})
+
+    def _do_reload(self) -> None:
+        try:
+            obj = self._read_body()
+            if not isinstance(obj, dict):
+                raise ValueError("reload body must be a JSON object")
+            result = self.server.service.reload(
+                model_dir=obj.get("model_dir"),
+                rollback=bool(obj.get("rollback")),
+            )
+        except SwapInProgressError as exc:
+            self._send_json(409, {"error": str(exc)})
+            return
+        except (ValueError, KeyError, TypeError) as exc:
+            self._send_json(400, {"error": f"bad request: {exc}"})
+            return
+        self._send_json(
+            _SWAP_STATUS.get(result.status, 500), result.to_dict()
+        )
 
 
 class _Server(ThreadingHTTPServer):

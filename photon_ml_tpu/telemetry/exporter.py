@@ -11,7 +11,14 @@ serving endpoint) that any long-running process mounts behind a
 - ``GET /snapshot`` — the full registry snapshot as JSON (includes the
   non-numeric gauges Prometheus cannot carry) plus run identity
   (``trace``, ``wall_epoch``, ``pid``).
-- ``GET /healthz``  — liveness: ``{"status": "ok", ...}``.
+- ``GET /healthz``  — liveness: ``{"status": "ok", ...}``.  Always
+  "ok" while the process answers — the exporter is alive iff it serves.
+- ``GET /livez``    — alias of the same liveness verdict.
+- ``GET /readyz``   — readiness, DISTINCT from liveness: when the
+  mounting process supplied a ``readiness`` callable (the serving CLI
+  passes ``ScoringService.readiness``), 503 ``"not_ready"`` during
+  startup warmup / mid-swap / zero healthy replicas; without one, ready
+  iff serving (matching /healthz).  Load balancers route on THIS.
 
 ``mount_ops_plane`` is the one-call composition the drivers, the tuning
 orchestrator, and the serving CLI use: time-series sampler
@@ -150,7 +157,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(
                 200, json.dumps(snap).encode(), "application/json"
             )
-        elif self.path == "/healthz":
+        elif self.path in ("/healthz", "/livez"):
             self._send(200, json.dumps({
                 "status": "ok",
                 "pid": os.getpid(),
@@ -158,6 +165,23 @@ class _Handler(BaseHTTPRequestHandler):
                 "uptime_s": round(
                     time.time() - hub._epoch_wall, 3
                 ),
+            }).encode(), "application/json")
+        elif self.path == "/readyz":
+            ready, reason = True, "ok"
+            readiness = self.server.exporter.readiness
+            if readiness is not None:
+                try:
+                    verdict = readiness()
+                    # accept a bare bool or a (bool, reason) tuple
+                    if isinstance(verdict, tuple):
+                        ready, reason = verdict
+                    else:
+                        ready, reason = bool(verdict), ""
+                except Exception as exc:  # noqa: BLE001 — fail not-ready
+                    ready, reason = False, f"readiness check failed: {exc}"
+            self._send(200 if ready else 503, json.dumps({
+                "status": "ready" if ready else "not_ready",
+                "reason": reason,
             }).encode(), "application/json")
         else:
             self._send(
@@ -175,9 +199,14 @@ class _Server(ThreadingHTTPServer):
 class MetricsExporter:
     """HTTP exposition of one hub's registry; start/close lifecycle."""
 
-    def __init__(self, hub, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self, hub, host: str = "127.0.0.1", port: int = 0, readiness=None
+    ):
         self.hub = hub
         self.host = host
+        #: optional ``() -> bool | (bool, reason)`` behind /readyz; None
+        #: keeps the pre-split behavior (ready iff serving).
+        self.readiness = readiness
         self._requested_port = port
         self._server: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
@@ -234,7 +263,7 @@ class OpsPlane:
         if logger is not None and exporter is not None:
             logger.info(
                 "metrics exporter on http://%s:%d (/metrics /snapshot "
-                "/healthz)", exporter.host, exporter.port,
+                "/healthz /livez /readyz)", exporter.host, exporter.port,
             )
 
     @property
@@ -264,6 +293,7 @@ def mount_ops_plane(
     host: str = "127.0.0.1",
     ts_path: Optional[str] = None,
     logger=None,
+    readiness=None,
 ) -> OpsPlane:
     """Mount the live ops plane on ``hub``: a metrics_ts.jsonl sampler
     (when the hub has an output dir and ``interval_s > 0``) and the HTTP
@@ -280,5 +310,7 @@ def mount_ops_plane(
         if not sampler.enabled:
             sampler = None
         if port is not None and port >= 0:
-            exporter = MetricsExporter(hub, host=host, port=port).start()
+            exporter = MetricsExporter(
+                hub, host=host, port=port, readiness=readiness
+            ).start()
     return OpsPlane(sampler, exporter, logger=logger)

@@ -26,13 +26,14 @@ SUBSYSTEMS = frozenset({
     "h2d", "hbm", "prefetch", "stream", "streaming", "staging",
     "solver", "cd", "grid", "game", "glm", "watchdog", "checkpoint",
     "chaos", "serving", "tuning", "compile", "run", "telemetry",
-    "evaluation",
+    "evaluation", "model",
 })
 
 #: Last name token: what the value measures.
 UNITS = frozenset({
     "total", "seconds", "bytes", "ratio", "gbps", "rows", "ms",
-    "count", "entries", "iterations", "retries", "depth",
+    "count", "entries", "iterations", "retries", "depth", "version",
+    "tier",
 })
 
 #: Pre-convention names (PRs 1-6), grandfathered verbatim.  Do NOT add

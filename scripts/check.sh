@@ -21,9 +21,12 @@ python -m photon_ml_tpu.telemetry --selfcheck
 echo "== telemetry metric-name lint =="
 python -m photon_ml_tpu.telemetry --lint-metrics
 
-# The serving selfcheck builds a synthetic GAME model, serves concurrent
-# HTTP requests, and verifies batched results are bit-identical to
-# single-request scoring (plus the telemetry snapshot contents).
+# The serving selfcheck runs two passes: the single-runtime pass builds
+# a synthetic GAME model, serves concurrent HTTP requests, and verifies
+# batched results are bit-identical to single-request scoring (plus the
+# telemetry snapshot contents); the HA pass kills one of two replicas
+# and hot-swaps v1->v2 under live load (plus a tampered-model rollback),
+# gating on ZERO failed requests and a monotone serving_model_version.
 echo "== serving selfcheck (JAX_PLATFORMS=cpu) =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.serving --selfcheck
 
@@ -54,7 +57,8 @@ if [[ "${1:-}" == "--fast" ]]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_ops_plane.py \
     tests/test_watchdog.py \
-    tests/test_serving.py tests/test_tuning.py tests/test_chaos.py \
+    tests/test_serving.py tests/test_serving_ha.py \
+    tests/test_tuning.py tests/test_chaos.py \
     "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
     -m 'not slow' -q -p no:cacheprovider
 fi

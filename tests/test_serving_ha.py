@@ -1,0 +1,768 @@
+"""High-availability serving tests (ISSUE 9).
+
+The load-bearing contracts:
+
+- a scripted replica kill costs ZERO failed requests (resubmission);
+- a model hot-swap under concurrent traffic is invisible: every score is
+  bit-identical to EITHER the pre-swap or the post-swap single-runtime
+  reference, never a mix within one row;
+- a tampered model directory (payload or ``.meta.json`` sidecar) rolls
+  back automatically with the previous version still serving;
+- swap while degraded DEFERS (the pinned decision — see
+  serving/swap.py);
+- the tiered admission controller sheds low-priority and over-deadline
+  work before rejecting everything, and journals tier transitions;
+- liveness (/livez) and readiness (/readyz) are distinct verdicts.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import chaos
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.io.game_store import save_game_model
+from photon_ml_tpu.serving.batcher import (
+    BatcherConfig,
+    MicroBatcher,
+    RejectedError,
+    TIER_ACCEPT,
+    TIER_REJECT,
+    TIER_SHED,
+)
+from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+from photon_ml_tpu.serving.service import ScoringService, start_http_server
+from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+from photon_ml_tpu.serving.swap import HotSwapper, SwapInProgressError
+from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+
+@pytest.fixture(scope="module")
+def workload():
+    # No unknown entities: requests must parse/score identically on any
+    # replica and across model versions.
+    return SyntheticWorkload(n_entities=32, seed=7)
+
+
+@pytest.fixture(scope="module")
+def workload_v2():
+    # Same shard shapes as `workload`, different coefficients — a request
+    # stream valid on both, scoring differently.
+    return SyntheticWorkload(n_entities=32, seed=8)
+
+
+def _runtime(workload, **kwargs):
+    cfg = RuntimeConfig(**{"max_batch_size": 8, "hot_entities": 8, **kwargs})
+    return ScoringRuntime(workload.model, workload.index_maps, cfg)
+
+
+def _reference(workload, requests):
+    """Scores from a fresh single runtime, one row at a time."""
+    runtime = _runtime(workload)
+    return np.asarray(
+        [
+            runtime.score_rows([runtime.parse_request(r)])[0][0]
+            for r in requests
+        ],
+        np.float32,
+    )
+
+
+def _wait_until(predicate, timeout_s=10.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# Replica supervision
+# ---------------------------------------------------------------------------
+
+class TestSupervisor:
+    def test_kill_replica_zero_failed_requests(self, workload):
+        sup = ReplicaSupervisor(
+            lambda: _runtime(workload), n_replicas=2,
+            probe_interval_s=0.05,
+        )
+        with sup:
+            requests = [workload.request(i) for i in range(48)]
+            rows = [sup.parse_request(r) for r in requests]
+            futures = [sup.submit(r) for r in rows[:24]]
+            sup.kill_replica(0)
+            futures += [sup.submit(r) for r in rows[24:]]
+            results = [f.result(timeout=30) for f in futures]
+            assert all(np.isfinite(r["score"]) for r in results)
+            # The killed replica restarts and rejoins.
+            assert _wait_until(lambda: sup.healthy_count == 2), (
+                sup.stats()
+            )
+            assert sup.stats()["replicas"][0]["restarts"] == 1
+
+    def test_kill_costs_zero_errors_under_load(self, workload):
+        from photon_ml_tpu.serving import loadgen
+
+        sup = ReplicaSupervisor(
+            lambda: _runtime(workload), n_replicas=2,
+            probe_interval_s=0.05,
+        )
+        service = ScoringService(sup)
+        with service:
+            killer = threading.Timer(
+                0.3, lambda: sup.kill_replica(1)
+            )
+            killer.start()
+            report = loadgen.open_loop(
+                service.submit, workload.request,
+                rate_rps=150.0, duration_s=1.5,
+            )
+            killer.join()
+        assert report.errors == 0, report.snapshot()
+        assert report.rejected == 0, report.snapshot()
+        assert report.completed > 50
+
+    def test_chaos_replica_site_reroutes(self, workload):
+        """A FaultPlan-scripted kill at the routing seam: the victim is
+        marked down, the request resubmits and still succeeds."""
+        sup = ReplicaSupervisor(
+            lambda: _runtime(workload), n_replicas=2,
+            probe_interval_s=10.0,  # keep probes out of the script
+        )
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="serving.replica", at=0),
+        ])
+        with sup:
+            row = sup.parse_request(workload.request(0))
+            with plan:
+                result = sup.submit(row).result(timeout=30)
+            assert np.isfinite(result["score"])
+            assert plan.fired and \
+                plan.fired[0]["site"] == "serving.replica"
+            assert sup.healthy_count == 1  # victim awaits restart
+
+    def test_probes_detect_poisoned_replica_and_restart(self, workload):
+        sup = ReplicaSupervisor(
+            lambda: _runtime(workload), n_replicas=2,
+            probe_interval_s=0.05, probe_failure_threshold=2,
+        )
+        with sup:
+            class _Wedged:
+                degraded = False
+
+                def score_rows(self, rows):
+                    raise RuntimeError("UNAVAILABLE: wedged")
+
+                def bucket_for(self, n):
+                    return n
+
+            sup.replicas[0].batcher.runtime = _Wedged()
+            assert _wait_until(
+                lambda: sup.replicas[0].restarts >= 1
+            ), sup.stats()
+            assert sup.healthy_count == 2 or _wait_until(
+                lambda: sup.healthy_count == 2
+            )
+
+    def test_restart_backoff_is_decorrelated_jitter(self, workload):
+        """Consecutive restart delays follow the watchdog's decorrelated
+        walk: within [base, 3*previous] and capped."""
+        from photon_ml_tpu.utils.watchdog import RetryPolicy
+
+        policy = RetryPolicy(
+            backoff_seconds=0.1, max_backoff_seconds=5.0,
+            jitter="decorrelated",
+        )
+        sup = ReplicaSupervisor(
+            lambda: _runtime(workload), n_replicas=1,
+            restart_policy=policy,
+        )
+        # Exercise the scheduling math without starting threads.
+        import random
+
+        rng = random.Random(42)
+        prev = None
+        for attempt in range(6):
+            delay = policy.backoff(attempt, rng=rng, previous=prev)
+            assert 0.1 <= delay <= 5.0
+            if prev is not None:
+                assert delay <= max(3 * prev, 0.1) + 1e-9
+            prev = delay
+        assert sup.restart_policy.jitter == "decorrelated"
+
+    def test_no_healthy_replica_rejects_transiently(self, workload):
+        sup = ReplicaSupervisor(
+            lambda: _runtime(workload), n_replicas=1,
+            probe_interval_s=10.0,
+            restart_policy=__import__(
+                "photon_ml_tpu.utils.watchdog", fromlist=["RetryPolicy"]
+            ).RetryPolicy(backoff_seconds=30.0),
+        )
+        with sup:
+            row = sup.parse_request(workload.request(0))
+            sup.kill_replica(0)
+            with pytest.raises(RejectedError):
+                sup.submit(row).result(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Hot swap + rollback
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def model_dirs(tmp_path, workload, workload_v2):
+    v1 = str(tmp_path / "v1")
+    v2 = str(tmp_path / "v2")
+    save_game_model(workload.model, workload.index_maps, v1)
+    save_game_model(workload_v2.model, workload_v2.index_maps, v2)
+    return v1, v2
+
+
+class TestHotSwap:
+    def test_swap_bit_parity_under_concurrent_traffic(
+        self, workload, workload_v2, model_dirs, tmp_path
+    ):
+        """Every score observed during a hot swap matches EITHER the
+        pre-swap or the post-swap single-runtime reference, bitwise —
+        no request ever sees a half-swapped runtime."""
+        _v1, v2_dir = model_dirs
+        requests = [workload.request(i) for i in range(16)]
+        ref_v1 = _reference(workload, requests)
+        ref_v2 = _reference(workload_v2, requests)
+        assert ref_v1.tobytes() != ref_v2.tobytes()
+
+        service = ScoringService(_runtime(workload))
+        scores: list[tuple[int, np.float32]] = []
+        errors: list[str] = []
+        stop = threading.Event()
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                try:
+                    r = service.score(requests[i % 16], timeout=30)
+                    scores.append((i % 16, np.float32(r["score"])))
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(repr(exc))
+                i += 1
+
+        with service:
+            threads = [
+                threading.Thread(target=traffic) for _ in range(3)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.2)
+            result = service.reload(v2_dir)
+            time.sleep(0.2)
+            stop.set()
+            for t in threads:
+                t.join()
+        assert result.status == "swapped", result
+        assert result.version_after == 2
+        assert not errors, errors[:3]
+        assert len(scores) > 20
+        for idx, score in scores:
+            assert score.tobytes() in (
+                np.float32(ref_v1[idx]).tobytes(),
+                np.float32(ref_v2[idx]).tobytes(),
+            ), f"request {idx} scored {score!r}, matching neither version"
+        # Post-swap: everything scores as v2.
+        with service:
+            post = np.asarray(
+                [
+                    np.float32(service.score(r)["score"])
+                    for r in requests
+                ],
+                np.float32,
+            )
+        assert post.tobytes() == ref_v2.tobytes()
+
+    def test_tampered_payload_rolls_back_with_zero_errors(
+        self, workload, model_dirs, tmp_path
+    ):
+        v1_dir, v2_dir = model_dirs
+        bad_dir = str(tmp_path / "bad")
+        shutil.copytree(v2_dir, bad_dir)
+        # Swap in v1's payload under v2's fingerprints: the file is
+        # structurally valid avro, only the CONTENT is wrong — exactly
+        # what a silent corruption or botched copy looks like.
+        rel = os.path.join("random-effect", "per_entity", "coefficients.avro")
+        shutil.copyfile(
+            os.path.join(v1_dir, rel), os.path.join(bad_dir, rel)
+        )
+        requests = [workload.request(i) for i in range(8)]
+        ref = _reference(workload, requests)
+        service = ScoringService(_runtime(workload))
+        with service:
+            result = service.reload(bad_dir)
+            assert result.status == "rolled_back", result
+            assert result.stage in ("load", "prepare")
+            assert result.version_after == 1
+            assert "checksum" in result.reason
+            got = np.asarray(
+                [np.float32(service.score(r)["score"]) for r in requests],
+                np.float32,
+            )
+        assert got.tobytes() == ref.tobytes()  # v1 still serving
+
+    def test_tampered_meta_sidecar_rolls_back(
+        self, workload, model_dirs, tmp_path
+    ):
+        _v1, v2_dir = model_dirs
+        bad_dir = str(tmp_path / "badmeta")
+        shutil.copytree(v2_dir, bad_dir)
+        meta = os.path.join(
+            bad_dir, "fixed-effect", "fixed", "coefficients.avro.meta.json"
+        )
+        with open(meta) as f:
+            payload = json.load(f)
+        payload["fingerprint"]["coefficient_checksum"] = "0" * 64
+        with open(meta, "w") as f:
+            json.dump(payload, f)
+        service = ScoringService(_runtime(workload))
+        with service:
+            result = service.reload(bad_dir)
+        assert result.status == "rolled_back", result
+        assert service.swapper.version == 1
+
+    def test_swap_while_degraded_defers(self, workload, model_dirs):
+        """The pinned decision: no swap commits through a degraded
+        runtime; the result is 'deferred' and nothing changes."""
+        _v1, v2_dir = model_dirs
+        service = ScoringService(_runtime(workload))
+        with service:
+            service.batcher.runtime.degraded = True
+            result = service.reload(v2_dir)
+            assert result.status == "deferred", result
+            assert service.swapper.version == 1
+            service.batcher.runtime.degraded = False
+            assert service.reload(v2_dir).status == "swapped"
+
+    def test_chaos_verify_stage_rolls_back_post_commit(
+        self, workload, model_dirs
+    ):
+        """A fault AFTER the commit (verify stage = occurrence 2 of
+        serving.swap) restores the previous runtimes."""
+        _v1, v2_dir = model_dirs
+        requests = [workload.request(i) for i in range(8)]
+        ref = _reference(workload, requests)
+        service = ScoringService(_runtime(workload))
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="serving.swap", at=2),
+        ])
+        with service:
+            with plan:
+                result = service.reload(v2_dir)
+            assert result.status == "rolled_back", result
+            assert result.stage == "verify"
+            assert service.swapper.version == 1
+            got = np.asarray(
+                [np.float32(service.score(r)["score"]) for r in requests],
+                np.float32,
+            )
+        assert got.tobytes() == ref.tobytes()
+        assert [f["site"] for f in plan.fired] == ["serving.swap"]
+
+    def test_manual_rollback_and_version_monotonicity(
+        self, workload, workload_v2, model_dirs
+    ):
+        _v1, v2_dir = model_dirs
+        requests = [workload.request(i) for i in range(4)]
+        ref_v1 = _reference(workload, requests)
+        service = ScoringService(_runtime(workload))
+        with service:
+            assert service.reload(v2_dir).version_after == 2
+            back = service.reload(rollback=True)
+            assert back.status == "rolled_back"
+            assert back.version_after == 1
+            got = np.asarray(
+                [np.float32(service.score(r)["score"]) for r in requests],
+                np.float32,
+            )
+            assert got.tobytes() == ref_v1.tobytes()
+            # Version numbers are never reused: the next swap is v3.
+            assert service.reload(v2_dir).version_after == 3
+
+    def test_concurrent_swap_raises_in_progress(self, workload, model_dirs):
+        _v1, v2_dir = model_dirs
+        service = ScoringService(_runtime(workload))
+        with service:
+            assert service.swapper._swap_lock.acquire(blocking=False)
+            try:
+                with pytest.raises(SwapInProgressError):
+                    service.reload(v2_dir)
+            finally:
+                service.swapper._swap_lock.release()
+
+    def test_supervisor_swap_rolls_all_replicas(
+        self, workload, workload_v2, model_dirs
+    ):
+        _v1, v2_dir = model_dirs
+        requests = [workload.request(i) for i in range(8)]
+        ref_v2 = _reference(workload_v2, requests)
+        sup = ReplicaSupervisor(
+            lambda: _runtime(workload), n_replicas=2,
+            probe_interval_s=0.05,
+        )
+        service = ScoringService(sup)
+        with service:
+            result = service.reload(v2_dir)
+            assert result.status == "swapped"
+            assert result.targets == 2
+            got = np.asarray(
+                [np.float32(service.score(r)["score"]) for r in requests],
+                np.float32,
+            )
+            assert got.tobytes() == ref_v2.tobytes()
+            # Restarts come back on the committed version.
+            sup.kill_replica(0)
+            assert _wait_until(lambda: sup.healthy_count == 2)
+            versions = {
+                r["model_version"] for r in sup.stats()["replicas"]
+            }
+            assert versions == {2}, sup.stats()
+
+
+# ---------------------------------------------------------------------------
+# Tiered admission control
+# ---------------------------------------------------------------------------
+
+def _idle_batcher(workload, **cfg_kwargs):
+    """A batcher whose dispatch thread is NOT running — queue depth is
+    fully controlled by the test."""
+    runtime = _runtime(workload)
+    cfg = BatcherConfig(**{
+        "max_batch_size": 8, "max_queue": 20, "max_wait_us": 1000,
+        **cfg_kwargs,
+    })
+    return MicroBatcher(runtime, cfg), runtime
+
+
+class TestTieredAdmission:
+    def test_accept_below_watermarks(self, workload):
+        batcher, runtime = _idle_batcher(workload)
+        row = runtime.parse_request(workload.request(0))
+        batcher.submit(row)
+        assert batcher.admission_tier() == TIER_ACCEPT
+
+    def test_low_priority_shed_at_shed_tier(self, workload):
+        batcher, runtime = _idle_batcher(
+            workload, shed_watermark=0.25, reject_watermark=0.9
+        )
+        normal = runtime.parse_request(workload.request(0))
+        low = runtime.parse_request(
+            {**workload.request(1), "priority": "low"}
+        )
+        for _ in range(6):  # depth 6/20 = 0.3 >= 0.25
+            batcher.submit(normal)
+        assert batcher.admission_tier() == TIER_SHED
+        with pytest.raises(RejectedError, match="load shed"):
+            batcher.submit(low)
+        # Normal-priority traffic still flows at the shed tier.
+        batcher.submit(normal)
+
+    def test_reject_tier_sheds_everything(self, workload):
+        batcher, runtime = _idle_batcher(
+            workload, shed_watermark=0.2, reject_watermark=0.5
+        )
+        row = runtime.parse_request(workload.request(0))
+        for _ in range(10):  # depth 10/20 = 0.5
+            batcher.submit(row)
+        assert batcher.admission_tier() == TIER_REJECT
+        with pytest.raises(RejectedError, match="load shed"):
+            batcher.submit(row)
+
+    def test_bypass_admission_flows_at_reject_tier(self, workload):
+        batcher, runtime = _idle_batcher(
+            workload, shed_watermark=0.2, reject_watermark=0.5
+        )
+        row = runtime.parse_request(workload.request(0))
+        for _ in range(10):
+            batcher.submit(row)
+        assert batcher.admission_tier() == TIER_REJECT
+        batcher.submit(row, bypass_admission=True)  # probes keep flowing
+
+    def test_p99_slo_breach_sheds_over_deadline_work(self, workload):
+        with telemetry.Telemetry(sinks=[]) as tel:
+            hist = tel.histogram("serving_request_latency_seconds")
+            for _ in range(100):
+                hist.observe(0.5)  # p99 ~ 500 ms
+            batcher, runtime = _idle_batcher(
+                workload, p99_slo_ms=100.0, admission_interval_s=0.0
+            )
+            row = runtime.parse_request(workload.request(0))
+            assert batcher.admission_tier() == TIER_SHED
+            with pytest.raises(RejectedError, match="p99"):
+                # Deadline budget far under the observed p99: it would
+                # expire in the queue — shed it now.
+                batcher.submit(row, timeout_ms=10.0)
+            batcher.submit(row, timeout_ms=5_000.0)  # enough budget
+
+    def test_tier_transitions_are_journaled(self, workload):
+        with telemetry.Telemetry(sinks=[]) as tel:
+            batcher, runtime = _idle_batcher(
+                workload, shed_watermark=0.25, reject_watermark=0.9
+            )
+            row = runtime.parse_request(workload.request(0))
+            for _ in range(6):
+                batcher.submit(row)
+            with pytest.raises(RejectedError):
+                batcher.submit(
+                    runtime.parse_request(
+                        {**workload.request(1), "priority": "low"}
+                    )
+                )
+            snap = tel.snapshot()
+            assert snap["counters"]["serving_tier_transitions_total"] >= 1
+            assert snap["counters"]["serving_shed_total"] >= 1
+            assert snap["counters"]["serving_shed_low_priority_total"] >= 1
+            assert snap["gauges"]["serving_shed_tier"] == TIER_SHED
+            assert batcher.stats()["tier"] == "shed"
+
+    def test_priority_validation(self, workload):
+        runtime = _runtime(workload)
+        with pytest.raises(ValueError, match="priority"):
+            runtime.parse_request(
+                {**workload.request(0), "priority": "urgent"}
+            )
+
+    def test_watermark_validation(self, workload):
+        runtime = _runtime(workload)
+        with pytest.raises(ValueError):
+            MicroBatcher(runtime, BatcherConfig(
+                shed_watermark=0.9, reject_watermark=0.5
+            ))
+
+
+# ---------------------------------------------------------------------------
+# Liveness / readiness split
+# ---------------------------------------------------------------------------
+
+def _get(port, route):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{route}", timeout=10
+        ) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestHealthSplit:
+    def test_livez_readyz_routes(self, workload):
+        service = ScoringService(_runtime(workload))
+        with service:
+            server, _ = start_http_server(service, port=0)
+            port = server.server_address[1]
+            try:
+                assert _get(port, "/livez")[0] == 200
+                status, body = _get(port, "/readyz")
+                assert (status, body["status"]) == (200, "ready")
+                status, health = _get(port, "/healthz")
+                assert health["status"] == "ok"
+                assert health["model_version"] == 1
+
+                # Mid-swap: alive but NOT ready.
+                service.swapper.in_progress = True
+                try:
+                    assert _get(port, "/livez")[0] == 200
+                    status, body = _get(port, "/readyz")
+                    assert (status, body["status"]) == (503, "not_ready")
+                    assert _get(port, "/healthz")[1]["status"] == \
+                        "not_ready"
+                finally:
+                    service.swapper.in_progress = False
+
+                # Warming runtime: same split.
+                service.batcher.runtime.ready = False
+                try:
+                    status, body = _get(port, "/readyz")
+                    assert (status, body["status"]) == (503, "not_ready")
+                    assert _get(port, "/livez")[0] == 200
+                finally:
+                    service.batcher.runtime.ready = True
+            finally:
+                server.shutdown()
+                server.server_close()
+
+    def test_reload_endpoint_over_http(
+        self, workload, model_dirs, tmp_path
+    ):
+        _v1, v2_dir = model_dirs
+        service = ScoringService(_runtime(workload))
+        with service:
+            server, _ = start_http_server(service, port=0)
+            port = server.server_address[1]
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/reload",
+                    data=json.dumps({"model_dir": v2_dir}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    body = json.loads(resp.read())
+                    assert resp.status == 200
+                assert body["status"] == "swapped"
+                assert body["version_after"] == 2
+                assert _get(port, "/healthz")[1]["model_version"] == 2
+            finally:
+                server.shutdown()
+                server.server_close()
+
+    def test_exporter_readiness_split(self):
+        from photon_ml_tpu.telemetry.exporter import MetricsExporter
+
+        verdict = {"ready": False}
+        with telemetry.Telemetry(sinks=[]) as tel:
+            exporter = MetricsExporter(
+                tel, port=0,
+                readiness=lambda: (verdict["ready"], "warming up"),
+            ).start()
+            try:
+                port = exporter.port
+                # Liveness stays "ok" regardless (pre-split semantics).
+                assert _get(port, "/healthz")[1]["status"] == "ok"
+                assert _get(port, "/livez")[1]["status"] == "ok"
+                status, body = _get(port, "/readyz")
+                assert (status, body["status"]) == (503, "not_ready")
+                assert body["reason"] == "warming up"
+                verdict["ready"] = True
+                assert _get(port, "/readyz")[0] == 200
+            finally:
+                exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# Unverified legacy loads (satellite: io stores)
+# ---------------------------------------------------------------------------
+
+class TestUnverifiedLoads:
+    def test_glm_without_sidecar_warns_and_counts(self, tmp_path):
+        from photon_ml_tpu.data.index_map import IndexMap, feature_key
+        from photon_ml_tpu.io.model_store import (
+            load_glm_model, save_glm_model,
+        )
+        from photon_ml_tpu.models.glm import (
+            Coefficients, GeneralizedLinearModel,
+        )
+
+        imap = IndexMap.build([feature_key(f"f{i}", "") for i in range(4)])
+        glm = GeneralizedLinearModel(
+            Coefficients(means=np.ones(4, np.float32)), "logistic"
+        )
+        path = str(tmp_path / "legacy.avro")
+        save_glm_model(glm, imap, path)
+        os.remove(path + ".meta.json")  # pre-fingerprint file
+        with telemetry.Telemetry(sinks=[]) as tel:
+            with pytest.warns(UserWarning, match="UNVERIFIED"):
+                load_glm_model(path)
+            snap = tel.snapshot()
+            assert snap["counters"]["model_load_unverified_total"] == 1
+
+    def test_game_dir_without_fingerprints_warns(
+        self, tmp_path, workload
+    ):
+        from photon_ml_tpu.io.game_store import load_game_model
+
+        directory = str(tmp_path / "legacy_game")
+        save_game_model(workload.model, workload.index_maps, directory)
+        # Strip the manifest fingerprints AND the GLM sidecars: the
+        # pre-fingerprint on-disk layout.
+        meta_path = os.path.join(directory, "metadata.json")
+        with open(meta_path) as f:
+            manifest = json.load(f)
+        del manifest["fingerprints"]
+        with open(meta_path, "w") as f:
+            json.dump(manifest, f)
+        for root, _dirs, files in os.walk(directory):
+            for name in files:
+                if name.endswith(".meta.json"):
+                    os.remove(os.path.join(root, name))
+        with telemetry.Telemetry(sinks=[]) as tel:
+            with pytest.warns(UserWarning, match="UNVERIFIED"):
+                load_game_model(directory)
+            # One count per unverified coordinate (fixed + random).
+            assert (
+                tel.snapshot()["counters"]["model_load_unverified_total"]
+                == 2
+            )
+
+    def test_verified_load_stays_silent(self, tmp_path, workload):
+        import warnings as warnings_mod
+
+        from photon_ml_tpu.io.game_store import load_game_model
+
+        directory = str(tmp_path / "verified_game")
+        save_game_model(workload.model, workload.index_maps, directory)
+        with telemetry.Telemetry(sinks=[]) as tel:
+            with warnings_mod.catch_warnings():
+                warnings_mod.simplefilter("error")
+                load_game_model(directory)
+            assert (
+                tel.snapshot()["counters"].get(
+                    "model_load_unverified_total", 0
+                ) == 0
+            )
+
+
+# ---------------------------------------------------------------------------
+# Loadgen scenarios
+# ---------------------------------------------------------------------------
+
+class TestScenarios:
+    def test_catalog_has_the_issue_scenarios(self):
+        from photon_ml_tpu.serving import loadgen
+
+        assert set(loadgen.SCENARIOS) >= {
+            "diurnal", "skew_shift", "swap_under_load", "replica_kill",
+        }
+
+    def test_unwired_action_raises_up_front(self):
+        from photon_ml_tpu.serving import loadgen
+
+        with pytest.raises(ValueError, match="kill_replica"):
+            loadgen.run_scenario(
+                lambda row: None, lambda i, phase: {},
+                loadgen.SCENARIOS["replica_kill"],
+            )
+
+    def test_scenario_runs_phases_and_fires_action(self, workload):
+        from photon_ml_tpu.serving import loadgen
+
+        service = ScoringService(_runtime(workload))
+        fired = []
+        scenario = loadgen.Scenario("mini", "test", [
+            loadgen.ScenarioPhase("a", 0.3, rate_multiplier=1.0),
+            loadgen.ScenarioPhase(
+                "b", 0.3, action="poke", entity_pool=(0.5, 1.0)
+            ),
+        ])
+        pools = []
+
+        def make_request(i, phase):
+            pools.append(phase.entity_pool)
+            return workload.request(i)
+
+        with service:
+            report = loadgen.run_scenario(
+                service.submit, make_request, scenario,
+                base_rate_rps=60.0,
+                actions={"poke": lambda: fired.append(1) or "ok"},
+            )
+        assert [name for name, _ in report.phases] == ["a", "b"]
+        assert fired == [1]
+        assert report.actions == {"poke": "ok"}
+        assert report.errors == 0
+        assert (0.5, 1.0) in pools
+        snap = report.snapshot()
+        assert snap["phases"]["a"]["completed"] > 0
+        assert snap["latency_p99_ms"] is not None
