@@ -1,0 +1,76 @@
+"""Project-wide invariant checker: static rules + runtime sanitizers.
+
+``python -m photon_ml_tpu.analysis --check`` runs every rule over the
+package (exit 0 = clean); ``--list-rules`` / ``--explain RULE`` document
+them; ``--update-baseline`` regenerates the grandfather list.  The
+runtime half (lock-order tracking, thread-leak sentinel) lives in
+:mod:`photon_ml_tpu.analysis.sanitizers` and is imported lazily — the
+static checker never imports jax or telemetry.
+
+Rule families:
+
+- concurrency (rules_concurrency.py): thread-lifecycle,
+  lock-blocking-call, wall-clock-interval
+- jax (rules_jax.py): donated-buffer-reuse, jit-side-effect,
+  unseeded-rng
+- registry (rules_registry.py): chaos-site-sync, metric-naming
+"""
+
+from __future__ import annotations
+
+from photon_ml_tpu.analysis import (
+    rules_concurrency,
+    rules_jax,
+    rules_registry,
+)
+from photon_ml_tpu.analysis.engine import (
+    Baseline,
+    CheckReport,
+    Finding,
+    Rule,
+    SourceTree,
+    default_baseline_path,
+    default_roots,
+    run_check,
+    run_rules,
+)
+
+#: Every rule, in --list-rules order (family, then id).
+ALL_RULES: list[Rule] = [
+    *rules_concurrency.RULES,
+    *rules_jax.RULES,
+    *rules_registry.RULES,
+]
+
+RULES_BY_ID: dict[str, Rule] = {r.id: r for r in ALL_RULES}
+
+
+def check(
+    roots=None,
+    repo_root=None,
+    baseline_path=None,
+    rules=None,
+) -> CheckReport:
+    """Run the full rule set (or ``rules``) and return a CheckReport."""
+    return run_check(
+        ALL_RULES if rules is None else rules,
+        roots=roots,
+        repo_root=repo_root,
+        baseline_path=baseline_path,
+    )
+
+
+__all__ = [
+    "ALL_RULES",
+    "RULES_BY_ID",
+    "Baseline",
+    "CheckReport",
+    "Finding",
+    "Rule",
+    "SourceTree",
+    "check",
+    "default_baseline_path",
+    "default_roots",
+    "run_check",
+    "run_rules",
+]

@@ -1,0 +1,415 @@
+"""JAX purity and donation rules.
+
+The streamed solvers stake bit-identical resume on two properties no
+generic linter checks:
+
+- ``donated-buffer-reuse``: ``jax.jit(..., donate_argnums=...)`` hands
+  the argument buffer to XLA — reading it after the call returns stale
+  or deleted memory (jax raises at best, silently reuses at worst).
+  optim/streaming.py's whole carry discipline exists because of this;
+  the rule polices every OTHER donation site against the same mistake.
+- ``jit-side-effect``: a Python side effect (telemetry write,
+  ``maybe_fail``, ``print``, flight-recorder dump) inside a jitted
+  function body runs ONCE at trace time, not per step — the metric
+  silently flatlines and the chaos site never fires after the first
+  call.  Side effects belong at the call site, outside the program.
+- ``unseeded-rng``: module-global numpy RNG (``np.random.*``) and
+  unseeded generators in package code are determinism hazards — the
+  resume/replay contracts (chaos selfcheck, tuning journal) assume a
+  run's randomness is fully determined by recorded seeds.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from photon_ml_tpu.analysis.engine import (
+    Finding,
+    PyFile,
+    Rule,
+    SourceTree,
+    dotted_name,
+    kwarg,
+)
+
+# ---------------------------------------------------------------------------
+# shared: find jitted functions in a file
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = {"jax.jit", "jit"}
+
+
+def _jit_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if name in _JIT_NAMES:
+        return True
+    # functools.partial(jax.jit, ...) used as a decorator factory
+    if name in ("functools.partial", "partial") and node.args:
+        return dotted_name(node.args[0]) in _JIT_NAMES
+    return False
+
+
+def _donated_positions(call: ast.Call) -> tuple[int, ...]:
+    v = kwarg(call, "donate_argnums")
+    if v is None:
+        return ()
+    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+        return (v.value,)
+    if isinstance(v, (ast.Tuple, ast.List)):
+        out = []
+        for elt in v.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()  # dynamic (self._donate[kind]): positions unknown
+
+
+def _function_defs(pf: PyFile) -> dict[str, list[ast.FunctionDef]]:
+    defs: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(pf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def _jitted_bodies(pf: PyFile) -> list[ast.AST]:
+    """Function bodies that become jitted programs: decorated defs plus
+    defs/lambdas passed positionally to jax.jit(...)."""
+    bodies: list[ast.AST] = []
+    defs = _function_defs(pf)
+    for node in ast.walk(pf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if (
+                    dotted_name(dec) in _JIT_NAMES
+                    or (isinstance(dec, ast.Call) and _jit_call(dec))
+                ):
+                    bodies.append(node)
+        if isinstance(node, ast.Call) and _jit_call(node):
+            args = node.args
+            # functools.partial(jax.jit, ...) has no fn argument yet
+            if dotted_name(node.func) in ("functools.partial", "partial"):
+                continue
+            if not args:
+                continue
+            target = args[0]
+            if isinstance(target, ast.Lambda):
+                bodies.append(target)
+            else:
+                tname = dotted_name(target)
+                if tname and "." not in tname:
+                    bodies.extend(defs.get(tname, []))
+    return bodies
+
+
+# ---------------------------------------------------------------------------
+# jit-side-effect
+# ---------------------------------------------------------------------------
+
+_EFFECT_CALLEES = {
+    "print", "maybe_fail", "dump_flight_recorder",
+}
+_EFFECT_METHODS = {
+    # telemetry hub surface: metric writes + events + spans
+    "inc", "set", "observe", "event", "span",
+}
+_EFFECT_DOTTED_PREFIXES = ("telemetry", "chaos")
+
+
+def _check_jit_side_effect(tree: SourceTree) -> Iterable[Finding]:
+    for pf in tree.files:
+        if pf.tree is None:
+            continue
+        for body in _jitted_bodies(pf):
+            for node in ast.walk(body):
+                if node is body or not isinstance(node, ast.Call):
+                    continue
+                # nested defs inside the jitted body are still traced
+                callee = dotted_name(node.func)
+                attr = (
+                    node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None
+                )
+                effect = None
+                if callee in _EFFECT_CALLEES:
+                    effect = f"{callee}()"
+                elif attr in _EFFECT_CALLEES:
+                    effect = f".{attr}()"
+                elif attr in _EFFECT_METHODS:
+                    # receiver may be a name chain (tel.event) or a
+                    # chained call (tel.counter('x').inc())
+                    inner = node.func.value
+                    if isinstance(inner, ast.Call):
+                        recv = dotted_name(inner.func) or ""
+                    else:
+                        recv = dotted_name(inner) or ""
+                    if any(
+                        part.startswith(_EFFECT_DOTTED_PREFIXES)
+                        or part in ("tel", "hub")
+                        for part in recv.split(".")
+                    ):
+                        effect = (
+                            f"{recv}().{attr}()"
+                            if isinstance(inner, ast.Call)
+                            else f"{recv}.{attr}()"
+                        )
+                if effect:
+                    yield Finding(
+                        "jit-side-effect", pf.relpath, node.lineno,
+                        f"Python side effect {effect} inside a jitted "
+                        "function body: it runs once at trace time, not "
+                        "per execution — move it to the call site, "
+                        "outside the program",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# donated-buffer-reuse
+# ---------------------------------------------------------------------------
+
+def _donating_bindings(pf: PyFile) -> dict[str, tuple[int, ...]]:
+    """name (var or self-attr) -> donated positions, for assignments
+    like ``self._proj_jit = jax.jit(f, donate_argnums=(0, 1))``."""
+    out: dict[str, tuple[int, ...]] = {}
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        if not _jit_call(node.value):
+            continue
+        pos = _donated_positions(node.value)
+        if not pos:
+            continue
+        name = dotted_name(node.targets[0])
+        if name:
+            out[name] = pos
+    return out
+
+
+def _assigned_names(stmt: ast.stmt) -> set[str]:
+    out: set[str] = set()
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+        targets = [stmt.target]
+    for t in targets:
+        if isinstance(t, ast.Tuple):
+            for elt in t.elts:
+                n = dotted_name(elt)
+                if n:
+                    out.add(n)
+        else:
+            n = dotted_name(t)
+            if n:
+                out.add(n)
+    return out
+
+
+def _check_donated_reuse(tree: SourceTree) -> Iterable[Finding]:
+    for pf in tree.files:
+        if pf.tree is None:
+            continue
+        donors = _donating_bindings(pf)
+        if not donors:
+            continue
+        for fn in ast.walk(pf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # statements of this function in source order (shallow walk
+            # is enough: the donation discipline is per-scope)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name not in donors:
+                    continue
+                donated_args = {
+                    dotted_name(node.args[i])
+                    for i in donors[name] if i < len(node.args)
+                }
+                donated_args.discard(None)
+                if not donated_args:
+                    continue
+                call_stmt = node
+                for anc in pf.parent_chain(node):
+                    if isinstance(anc, ast.stmt):
+                        call_stmt = anc
+                        break
+                # names rebound by the very statement making the call
+                # (``g = prog(g, x)``) are safe immediately
+                rebound = _assigned_names(call_stmt)
+                at_risk = donated_args - rebound
+                if not at_risk:
+                    continue
+                for later in ast.walk(fn):
+                    if (
+                        isinstance(later, ast.Name)
+                        and isinstance(later.ctx, ast.Load)
+                        and later.id in at_risk
+                        and later.lineno > call_stmt.lineno
+                    ):
+                        # a rebinding between call and use clears it
+                        if _rebound_between(
+                            fn, later.id, call_stmt.lineno, later.lineno
+                        ):
+                            continue
+                        yield Finding(
+                            "donated-buffer-reuse", pf.relpath,
+                            later.lineno,
+                            f"{later.id!r} was donated to "
+                            f"{name}(donate_argnums=...) and is read "
+                            "after the call: the buffer belongs to XLA "
+                            "now (deleted or reused) — rebind the name "
+                            "from the call's result or stop donating it",
+                        )
+                        at_risk.discard(later.id)
+
+
+def _rebound_between(
+    fn: ast.AST, name: str, after_line: int, before_line: int
+) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.stmt) and (
+            after_line < node.lineno < before_line
+        ):
+            if name in _assigned_names(node):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# unseeded-rng
+# ---------------------------------------------------------------------------
+
+#: np.random constructors that are fine WHEN SEEDED.
+_RNG_CONSTRUCTORS = {"default_rng", "RandomState", "Random"}
+#: np.random attribute names that are not RNG draws at all.
+_RNG_NEUTRAL = {
+    "Generator", "SeedSequence", "PCG64", "Philox", "BitGenerator",
+    "get_state", "set_state",
+}
+_NP_RANDOM_PREFIXES = ("np.random.", "numpy.random.")
+_STDLIB_RANDOM_FNS = {
+    "random.random", "random.uniform", "random.randint", "random.choice",
+    "random.shuffle", "random.sample", "random.expovariate",
+    "random.gauss", "random.normalvariate", "random.randrange",
+    "random.seed",
+}
+
+
+def _check_unseeded_rng(tree: SourceTree) -> Iterable[Finding]:
+    for pf in tree.files:
+        if pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            tail = name.rsplit(".", 1)[-1]
+            if name.startswith(_NP_RANDOM_PREFIXES):
+                if tail in _RNG_NEUTRAL:
+                    continue
+                if tail in _RNG_CONSTRUCTORS:
+                    if node.args or node.keywords:
+                        continue  # seeded (or explicitly configured)
+                    yield Finding(
+                        "unseeded-rng", pf.relpath, node.lineno,
+                        f"unseeded {name}(): randomness not determined "
+                        "by a recorded seed — pass a seed so runs "
+                        "replay (the chaos/tuning resume contracts "
+                        "assume it)",
+                    )
+                    continue
+                yield Finding(
+                    "unseeded-rng", pf.relpath, node.lineno,
+                    f"module-global numpy RNG {name}(): shared mutable "
+                    "state across threads and call sites — use a "
+                    "np.random.default_rng(seed) instance plumbed from "
+                    "the caller",
+                )
+            elif name in _STDLIB_RANDOM_FNS:
+                yield Finding(
+                    "unseeded-rng", pf.relpath, node.lineno,
+                    f"module-global stdlib RNG {name}(): shared mutable "
+                    "state; use a seeded random.Random(seed) instance",
+                )
+            elif name == "random.Random" and not (
+                node.args or node.keywords
+            ):
+                yield Finding(
+                    "unseeded-rng", pf.relpath, node.lineno,
+                    "unseeded random.Random(): randomness not "
+                    "determined by a recorded seed — plumb a seeded or "
+                    "injectable rng",
+                )
+
+
+RULES = [
+    Rule(
+        id="donated-buffer-reuse",
+        family="jax",
+        summary="no read of a buffer after it was donated to a "
+                "jit(donate_argnums=...) call",
+        explain=(
+            "jax.jit(f, donate_argnums=...) transfers ownership of the "
+            "named arguments' buffers to XLA: the program may write its "
+            "outputs into them.  Reading the donated Python reference "
+            "after the call is use-after-free — jax raises "
+            "'buffer has been deleted' at best; under some backends it "
+            "aliases silently.  The rule tracks assignments of donating "
+            "programs (`self._p = jax.jit(f, donate_argnums=(0,))`), "
+            "finds their call sites, and flags loads of donated "
+            "argument names after the call unless the name was rebound "
+            "(the `g = prog(g, x)` carry idiom optim/streaming.py "
+            "documents).  Dynamic donation tables "
+            "(`donate_argnums=self._donate[kind]`) are invisible to "
+            "static analysis — those paths are covered by "
+            "TestPipelineParity's donation-safety tests instead."
+        ),
+        fn=_check_donated_reuse,
+    ),
+    Rule(
+        id="jit-side-effect",
+        family="jax",
+        summary="no Python side effects (telemetry, maybe_fail, print) "
+                "inside jitted function bodies",
+        explain=(
+            "A jitted function body executes as Python exactly once per "
+            "compilation (trace time).  A telemetry counter bumped "
+            "there increments once and then flatlines; a chaos "
+            "maybe_fail() site fires during tracing and never again — "
+            "the fault schedule silently stops matching occurrence "
+            "indices.  The rule finds jit-bound bodies (decorated defs, "
+            "defs/lambdas passed to jax.jit) and flags calls to print, "
+            "maybe_fail, dump_flight_recorder, and telemetry metric/"
+            "event/span methods inside them.  Fix: hoist the effect to "
+            "the call site (game/descent.py bumps its iteration "
+            "histogram AROUND the program call, never inside)."
+        ),
+        fn=_check_jit_side_effect,
+    ),
+    Rule(
+        id="unseeded-rng",
+        family="jax",
+        summary="no module-global or unseeded RNG in package code "
+                "(determinism hazard)",
+        explain=(
+            "Bit-for-bit resume (chaos selfcheck) and journal replay "
+            "(tuning) require every random draw to be derived from a "
+            "recorded seed.  np.random.<fn>() draws from hidden global "
+            "state shared across threads — two interleavings produce "
+            "two histories.  The rule flags module-global numpy and "
+            "stdlib random calls, and unseeded default_rng()/"
+            "RandomState()/random.Random() constructions.  Intentional "
+            "nondeterminism (watchdog/supervisor restart jitter, which "
+            "is injectable for tests) carries a baseline entry saying "
+            "so."
+        ),
+        fn=_check_unseeded_rng,
+    ),
+]

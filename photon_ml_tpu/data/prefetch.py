@@ -60,6 +60,7 @@ from typing import Callable
 import jax
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.analysis import sanitizers
 from photon_ml_tpu.chaos import core as chaos_mod
 
 #: how long the caller waits for the background threads after a pass (a
@@ -237,7 +238,7 @@ def run_prefetched(
     q: queue.Queue = queue.Queue()
     permits = threading.Semaphore(depth)
     abort = threading.Event()
-    live_lock = threading.Lock()
+    live_lock = sanitizers.tracked(threading.Lock(), "prefetch.live")
     live = 0
     live_bytes = 0
     run_max = 0

@@ -35,6 +35,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.analysis import sanitizers
 from photon_ml_tpu.data.game_reader import read_game_avro
 from photon_ml_tpu.evaluation.suite import EvaluationSuite
 from photon_ml_tpu.game.estimator import (
@@ -217,7 +218,7 @@ def make_fit_once(
         else None
     )
     pools: dict[int, list] = {}
-    pool_lock = threading.Lock()
+    pool_lock = sanitizers.tracked(threading.Lock(), "game.checkout_pool")
 
     def _checkout(resource: int):
         n_iter = int(resource) if resource else 1

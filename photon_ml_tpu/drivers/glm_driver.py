@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.analysis import sanitizers
 from photon_ml_tpu.data import libsvm
 from photon_ml_tpu.data.dataset import make_glm_data
 from photon_ml_tpu.data.index_map import INTERCEPT_KEY, IndexMap
@@ -280,7 +281,7 @@ def make_fit_once(
     data = make_glm_data(X_train, y_train)
     y_val = np.asarray(y_val)
     problems: dict[int, GlmOptimizationProblem] = {}
-    lock = threading.Lock()
+    lock = sanitizers.tracked(threading.Lock(), "glm.problem_cache")
 
     def _problem(iters: int) -> GlmOptimizationProblem:
         # One problem (= one jitted solver) per distinct iteration

@@ -216,13 +216,17 @@ def run_selfcheck(out_dir: str) -> list[str]:
                             errs.append(f"client {t}: {exc}")
 
                     threads = [
-                        threading.Thread(target=client, args=(t,))
+                        threading.Thread(
+                            target=client, args=(t,), daemon=True
+                        )
                         for t in range(4)
                     ]
-                    for t in threads:
-                        t.start()
-                    for t in threads:
-                        t.join()
+                    try:
+                        for t in threads:
+                            t.start()
+                    finally:
+                        for t in threads:
+                            t.join()
                     failures.extend(errs)
 
                     served = np.zeros(n_requests, np.float32)

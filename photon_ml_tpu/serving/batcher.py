@@ -54,6 +54,7 @@ from concurrent.futures import Future
 from typing import Optional
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.analysis import sanitizers
 from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.utils.watchdog import RetryPolicy
 
@@ -149,7 +150,9 @@ class MicroBatcher:
         self.policy = policy or RetryPolicy()
         self._queue: "queue.Queue" = queue.Queue(maxsize=cfg.max_queue)
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = sanitizers.tracked(
+            threading.Lock(), "serving.batcher"
+        )
         # Admission-control state: current tier + the cached p99 read
         # (refreshed at most every admission_interval_s).
         self._tier = TIER_ACCEPT

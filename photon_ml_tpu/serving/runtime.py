@@ -46,6 +46,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.analysis import sanitizers
 from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.chaos.breaker import CircuitBreaker
 from photon_ml_tpu.game.model import (
@@ -237,7 +238,10 @@ class ScoringRuntime:
         self.batches = 0
         self.rows_scored = 0
         self.warmup_compiles = 0
-        self._lock = threading.Lock()  # stats snapshot vs dispatch thread
+        # stats snapshot vs dispatch thread
+        self._lock = sanitizers.tracked(
+            threading.Lock(), "serving.runtime"
+        )
         # Graceful degradation: device-lost flips scoring onto the host
         # cold path; the breaker guards re-promotion (module docstring).
         self.degraded = False

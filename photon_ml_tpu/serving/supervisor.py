@@ -55,6 +55,7 @@ from concurrent.futures import Future
 from typing import Callable, Optional
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.analysis import sanitizers
 from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.serving.batcher import (
     BatcherConfig,
@@ -131,7 +132,9 @@ class ReplicaSupervisor:
         self._rng = rng or random.Random()
         self._clock = clock
         self.replicas: list[_Replica] = []
-        self._lock = threading.Lock()
+        self._lock = sanitizers.tracked(
+            threading.Lock(), "serving.supervisor"
+        )
         self._rr = 0
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None

@@ -53,6 +53,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.analysis import sanitizers
 from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
 
@@ -102,7 +103,9 @@ class HotSwapper:
         self._targets_fn = targets_fn
         self._on_commit = on_commit
         self.probe_timeout_s = probe_timeout_s
-        self._swap_lock = threading.Lock()
+        self._swap_lock = sanitizers.tracked(
+            threading.Lock(), "serving.swap"
+        )
         #: readiness hook: True between /reload accept and commit+verify.
         self.in_progress = False
         self.version = 1

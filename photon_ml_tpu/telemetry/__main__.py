@@ -79,7 +79,9 @@ def _build_synthetic_run(out_dir: str) -> dict:
                     tel.gauge("h2d_gbps").set(1.25)
                     tel.counter("h2d_bytes_total").inc(3 * 1024)
 
-            t = threading.Thread(target=producer, name="h2d-prefetch")
+            t = threading.Thread(
+                target=producer, name="h2d-prefetch", daemon=True
+            )
             t.start()
             t.join()
             tel.event(

@@ -163,7 +163,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "pid": os.getpid(),
                 "trace": hub.trace_id,
                 "uptime_s": round(
-                    time.time() - hub._epoch_wall, 3
+                    time.perf_counter() - hub._epoch_perf, 3
                 ),
             }).encode(), "application/json")
         elif self.path == "/readyz":

@@ -1,151 +1,36 @@
-"""Metric-name lint: one name = one kind, `<subsystem>_<name>_<unit>`.
+"""Metric-name lint — thin compatibility shim.
 
-Two enforcement layers:
-
-- **Runtime** (telemetry/core.py ``MetricsRegistry``): registering one
-  name as two different kinds raises immediately — a counter and a
-  gauge sharing a name cannot both exist in a Prometheus exposition,
-  and the bug would otherwise surface as silently-wrong scraped data.
-- **Source lint** (this module, ``python -m photon_ml_tpu.telemetry
-  --lint-metrics``, wired into scripts/check.sh): scans the package for
-  string-literal metric registrations and checks every name against the
-  convention — lowercase snake_case, a known subsystem prefix, a known
-  unit suffix — plus cross-file kind consistency.  Names that predate
-  the convention are grandfathered in :data:`LEGACY_NAMES` (burn the
-  list down, never grow it: new metrics must conform).
+The implementation moved to :mod:`photon_ml_tpu.analysis.rules_registry`
+as the ``metric-naming`` rule of the project-wide invariant checker
+(``python -m photon_ml_tpu.analysis --check``); this module re-exports
+the old surface so ``python -m photon_ml_tpu.telemetry --lint-metrics``
+and existing imports keep working unchanged.
 """
 
 from __future__ import annotations
 
-import os
-import re
-from typing import Optional
-
-#: First name token: which subsystem emits the metric.
-SUBSYSTEMS = frozenset({
-    "h2d", "hbm", "prefetch", "stream", "streaming", "staging",
-    "solver", "cd", "grid", "game", "glm", "watchdog", "checkpoint",
-    "chaos", "serving", "tuning", "compile", "run", "telemetry",
-    "evaluation", "model",
-})
-
-#: Last name token: what the value measures.
-UNITS = frozenset({
-    "total", "seconds", "bytes", "ratio", "gbps", "rows", "ms",
-    "count", "entries", "iterations", "retries", "depth", "version",
-    "tier",
-})
-
-#: Pre-convention names (PRs 1-6), grandfathered verbatim.  Do NOT add
-#: to this list — rename or conform instead; each entry is a pending
-#: rename chore.
-LEGACY_NAMES = frozenset({
-    "chaos_faults_injected",
-    "checkpoint_corruptions",
-    "checkpoint_fallbacks",
-    "checkpoint_restores",
-    "checkpoint_saves",
-    "compile_cache_warmup_compiles",
-    "consumer_stall_seconds",
-    "consumer_stalls",
-    "producer_stall_seconds",
-    "producer_stalls",
-    "prefetch_max_live",
-    "prefetch_passes",
-    "prefetch_thread_leak",
-    "scored_rows",
-    "serving_batch_occupancy",
-    "serving_degraded",
-    "tuning_best_metric",
-    "tuning_trials_completed",
-    "tuning_trials_failed",
-    "tuning_trials_pruned",
-    "tuning_trials_started",
-})
-
-_NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
-_CALL_RE = re.compile(r"\.(counter|gauge|histogram)\(\s*\"([a-z0-9_]+)\"")
-
-
-def lint_name(name: str, kind: Optional[str] = None) -> list[str]:
-    """Issues with one metric name (empty list = conforming)."""
-    if name in LEGACY_NAMES:
-        return []
-    issues = []
-    if not _NAME_RE.match(name):
-        issues.append(
-            f"{name!r}: not lowercase snake_case with >= 2 tokens"
-        )
-        return issues
-    tokens = name.split("_")
-    if tokens[0] not in SUBSYSTEMS:
-        issues.append(
-            f"{name!r}: unknown subsystem prefix {tokens[0]!r} "
-            f"(known: {sorted(SUBSYSTEMS)})"
-        )
-    if tokens[-1] not in UNITS:
-        issues.append(
-            f"{name!r}: unknown unit suffix {tokens[-1]!r} "
-            f"(known: {sorted(UNITS)})"
-        )
-    return issues
+from photon_ml_tpu.analysis.engine import SourceTree
+from photon_ml_tpu.analysis.rules_registry import (  # noqa: F401
+    LEGACY_NAMES,
+    SUBSYSTEMS,
+    UNITS,
+    lint_name,
+    lint_source,
+    scan_tree,
+)
 
 
 def scan_source(roots=None) -> list[tuple[str, str, str, int]]:
-    """String-literal metric registrations across the package source:
-    ``(name, kind, file, lineno)``.  Dynamically-built names (f-strings)
-    are invisible here — the runtime kind check still covers them."""
-    if roots is None:
-        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        roots = [pkg, os.path.join(os.path.dirname(pkg), "bench.py")]
-    hits: list[tuple[str, str, str, int]] = []
-    files: list[str] = []
-    for root in roots:
-        if os.path.isfile(root):
-            files.append(root)
-            continue
-        for dirpath, _dirnames, filenames in os.walk(root):
-            if "__pycache__" in dirpath:
-                continue
-            files.extend(
-                os.path.join(dirpath, f)
-                for f in filenames if f.endswith(".py")
-            )
-    for path in sorted(files):
-        if os.path.abspath(path) == os.path.abspath(__file__):
-            continue
-        with open(path, encoding="utf-8") as f:
-            for lineno, line in enumerate(f, 1):
-                for m in _CALL_RE.finditer(line):
-                    hits.append((m.group(2), m.group(1), path, lineno))
-    return hits
+    """Old entry point: ``(name, kind, relpath, lineno)`` hits over the
+    default roots (package + bench.py) or explicit ``roots``."""
+    return scan_tree(SourceTree(roots=roots))
 
 
-def lint_source(roots=None) -> tuple[int, list[str]]:
-    """Lint every registration the source scan finds.
-
-    Returns ``(n_names, problems)`` — naming violations plus any name
-    registered as two different kinds anywhere in the tree.
-    """
-    hits = scan_source(roots)
-    problems: list[str] = []
-    kinds: dict[str, dict[str, tuple[str, int]]] = {}
-    for name, kind, path, lineno in hits:
-        kinds.setdefault(name, {}).setdefault(kind, (path, lineno))
-    for name in sorted(kinds):
-        by_kind = kinds[name]
-        if len(by_kind) > 1:
-            sites = ", ".join(
-                f"{kind} at {os.path.relpath(path)}:{lineno}"
-                for kind, (path, lineno) in sorted(by_kind.items())
-            )
-            problems.append(
-                f"{name!r} registered as multiple kinds: {sites}"
-            )
-        kind = next(iter(by_kind))
-        for issue in lint_name(name, kind):
-            path, lineno = by_kind[kind]
-            problems.append(
-                f"{issue} (first seen {os.path.relpath(path)}:{lineno})"
-            )
-    return len(kinds), problems
+__all__ = [
+    "LEGACY_NAMES",
+    "SUBSYSTEMS",
+    "UNITS",
+    "lint_name",
+    "lint_source",
+    "scan_source",
+]

@@ -37,6 +37,7 @@ from typing import Optional
 
 import numpy as np
 
+from photon_ml_tpu.analysis import sanitizers
 from photon_ml_tpu.io.checkpoint import _atomic_savez, fsync_file
 
 JOURNAL_VERSION = 1
@@ -76,7 +77,7 @@ class TuningJournal:
         self.path = os.path.join(directory, self.FILENAME)
         self.fsync = fsync
         self.abort_after = abort_after
-        self._lock = threading.Lock()
+        self._lock = sanitizers.tracked(threading.Lock(), "tuning.journal")
         self._f = None
         self._written = 0
 

@@ -16,10 +16,17 @@ python -m photon_ml_tpu.telemetry --selfcheck
 
 # Metric-name lint: every registered metric name in the source tree
 # conforms to <subsystem>_<name>_<unit> and no name is used as two
-# different kinds (telemetry/lint.py; legacy names are grandfathered
-# explicitly there).
+# different kinds (now the analysis/ metric-naming rule; this entry
+# point is a thin alias kept for muscle memory).
 echo "== telemetry metric-name lint =="
 python -m photon_ml_tpu.telemetry --lint-metrics
+
+# Project-wide invariant checker (docs/analysis.md): thread lifecycle /
+# lock discipline / wall-clock hygiene, JAX donation + purity, chaos-
+# site and metric-name registry sync.  Device-free, AST-only, ~2 s;
+# fails on any finding outside the committed baseline.
+echo "== analysis invariant check =="
+python -m photon_ml_tpu.analysis --check
 
 # The serving selfcheck runs two passes: the single-runtime pass builds
 # a synthetic GAME model, serves concurrent HTTP requests, and verifies

@@ -1,0 +1,778 @@
+"""Invariant-checker tests: every rule fires on a violating fixture and
+stays silent on the conforming twin; suppression and baseline mechanics
+behave; the real tree is clean; and the runtime sanitizers detect a
+scripted lock-order inversion and a leaked thread (ISSUE 10).
+
+Fixtures are tiny source trees written to tmp_path — the checker runs on
+files, never imports them, so the fixtures are free to be wrong on
+purpose (which is also why ``tests/`` is excluded from the default scan
+roots).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.analysis import (
+    ALL_RULES,
+    RULES_BY_ID,
+    Baseline,
+    SourceTree,
+    check,
+    run_rules,
+)
+from photon_ml_tpu.analysis import sanitizers
+from photon_ml_tpu.analysis.__main__ import main as analysis_main
+from photon_ml_tpu.analysis.engine import default_roots
+
+
+def _tree(tmp_path, source: str, name: str = "mod.py") -> SourceTree:
+    path = tmp_path / name
+    path.write_text(source)
+    return SourceTree(roots=[str(path)], repo_root=str(tmp_path))
+
+
+def _findings(tmp_path, rule_id: str, source: str):
+    tree = _tree(tmp_path, source)
+    return [
+        f for f in run_rules(tree, [RULES_BY_ID[rule_id]])
+        if not tree.files[0].is_suppressed(f.rule, f.line)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# concurrency rules
+# ---------------------------------------------------------------------------
+
+class TestThreadLifecycle:
+    def test_flags_unjoined_non_daemon(self, tmp_path):
+        found = _findings(tmp_path, "thread-lifecycle", (
+            "import threading\n"
+            "def go(fn):\n"
+            "    t = threading.Thread(target=fn)\n"
+            "    t.start()\n"
+        ))
+        assert len(found) == 1
+        assert "never joined" in found[0].message
+        assert found[0].line == 3
+
+    def test_flags_happy_path_only_join(self, tmp_path):
+        found = _findings(tmp_path, "thread-lifecycle", (
+            "import threading\n"
+            "def go(fn, risky):\n"
+            "    t = threading.Thread(target=fn)\n"
+            "    t.start()\n"
+            "    risky()\n"
+            "    t.join()\n"
+        ))
+        assert len(found) == 1
+        assert "happy path" in found[0].message
+
+    def test_flags_unbound_creation(self, tmp_path):
+        found = _findings(tmp_path, "thread-lifecycle", (
+            "import threading\n"
+            "def go(fns):\n"
+            "    for fn in fns:\n"
+            "        threading.Thread(target=fn).start()\n"
+        ))
+        assert len(found) == 1
+        assert "without a binding" in found[0].message
+
+    def test_accepts_daemon(self, tmp_path):
+        assert _findings(tmp_path, "thread-lifecycle", (
+            "import threading\n"
+            "def go(fn):\n"
+            "    t = threading.Thread(target=fn, daemon=True)\n"
+            "    t.start()\n"
+        )) == []
+
+    def test_accepts_join_in_finally(self, tmp_path):
+        assert _findings(tmp_path, "thread-lifecycle", (
+            "import threading\n"
+            "def go(fn, risky):\n"
+            "    t = threading.Thread(target=fn)\n"
+            "    t.start()\n"
+            "    try:\n"
+            "        risky()\n"
+            "    finally:\n"
+            "        t.join()\n"
+        )) == []
+
+    def test_accepts_lifecycle_stop_pattern(self, tmp_path):
+        # start() and join() in different methods: MicroBatcher's shape.
+        assert _findings(tmp_path, "thread-lifecycle", (
+            "import threading\n"
+            "class Owner:\n"
+            "    def start(self, fn):\n"
+            "        self._t = threading.Thread(target=fn)\n"
+            "        self._t.start()\n"
+            "    def stop(self):\n"
+            "        self._t.join()\n"
+        )) == []
+
+
+class TestLockBlockingCall:
+    def test_flags_sleep_under_lock(self, tmp_path):
+        found = _findings(tmp_path, "lock-blocking-call", (
+            "import threading, time\n"
+            "lock = threading.Lock()\n"
+            "def slow():\n"
+            "    with lock:\n"
+            "        time.sleep(1.0)\n"
+        ))
+        assert len(found) == 1
+        assert "time.sleep()" in found[0].message
+
+    def test_flags_join_under_tracked_lock(self, tmp_path):
+        # tracked(...) wrappers still count as locks.
+        found = _findings(tmp_path, "lock-blocking-call", (
+            "import threading\n"
+            "from photon_ml_tpu.analysis import sanitizers\n"
+            "lock = sanitizers.tracked(threading.Lock(), 'w')\n"
+            "def bad(t):\n"
+            "    with lock:\n"
+            "        t.join()\n"
+        ))
+        assert len(found) == 1
+        assert "thread join while holding lock" in found[0].message
+
+    def test_flags_device_sync_and_fsync(self, tmp_path):
+        found = _findings(tmp_path, "lock-blocking-call", (
+            "import os, threading\n"
+            "lock = threading.Lock()\n"
+            "def bad(x, fd):\n"
+            "    with lock:\n"
+            "        x.block_until_ready()\n"
+            "        os.fsync(fd)\n"
+        ))
+        assert len(found) == 2
+
+    def test_accepts_sleep_outside_lock(self, tmp_path):
+        assert _findings(tmp_path, "lock-blocking-call", (
+            "import threading, time\n"
+            "lock = threading.Lock()\n"
+            "def ok():\n"
+            "    with lock:\n"
+            "        x = 1\n"
+            "    time.sleep(0.1)\n"
+            "    return x\n"
+        )) == []
+
+
+class TestWallClockInterval:
+    def test_flags_interval_math(self, tmp_path):
+        found = _findings(tmp_path, "wall-clock-interval", (
+            "import time\n"
+            "def lat(t0):\n"
+            "    return time.time() - t0\n"
+        ))
+        assert len(found) == 1
+        assert "monotonic" in found[0].message
+
+    def test_flags_bare_latency_assignment(self, tmp_path):
+        assert len(_findings(tmp_path, "wall-clock-interval", (
+            "import time\n"
+            "def stamp():\n"
+            "    t_start = time.time()\n"
+            "    return t_start\n"
+        ))) == 1
+
+    def test_accepts_wall_anchoring(self, tmp_path):
+        assert _findings(tmp_path, "wall-clock-interval", (
+            "import time\n"
+            "def anchor():\n"
+            "    epoch_wall = time.time()\n"
+            "    meta = {'wall_epoch': time.time()}\n"
+            "    rec(wall_epoch=time.time())\n"
+            "    return epoch_wall, meta\n"
+        )) == []
+
+
+# ---------------------------------------------------------------------------
+# jax rules
+# ---------------------------------------------------------------------------
+
+class TestDonatedBufferReuse:
+    def test_flags_read_after_donate(self, tmp_path):
+        found = _findings(tmp_path, "donated-buffer-reuse", (
+            "import jax\n"
+            "prog = jax.jit(lambda a, b: a + b, donate_argnums=(0,))\n"
+            "def step(g, x):\n"
+            "    out = prog(g, x)\n"
+            "    return g + out\n"
+        ))
+        assert len(found) == 1
+        assert "donated" in found[0].message
+        assert found[0].line == 5
+
+    def test_accepts_carry_rebinding(self, tmp_path):
+        # optim/streaming's `g = prog(g, x)` idiom.
+        assert _findings(tmp_path, "donated-buffer-reuse", (
+            "import jax\n"
+            "prog = jax.jit(lambda a, b: a + b, donate_argnums=(0,))\n"
+            "def step(g, x):\n"
+            "    g = prog(g, x)\n"
+            "    return g\n"
+        )) == []
+
+    def test_accepts_rebind_before_use(self, tmp_path):
+        assert _findings(tmp_path, "donated-buffer-reuse", (
+            "import jax\n"
+            "prog = jax.jit(lambda a, b: a + b, donate_argnums=(0,))\n"
+            "def step(g, x, fresh):\n"
+            "    out = prog(g, x)\n"
+            "    g = fresh()\n"
+            "    return g + out\n"
+        )) == []
+
+    def test_dynamic_donation_is_skipped(self, tmp_path):
+        # donate_argnums=self._donate[kind]: positions unknown, no flag.
+        assert _findings(tmp_path, "donated-buffer-reuse", (
+            "import jax\n"
+            "class S:\n"
+            "    def build(self, f, kind):\n"
+            "        self._p = jax.jit(f, donate_argnums=self._d[kind])\n"
+            "    def step(self, g, x):\n"
+            "        out = self._p(g, x)\n"
+            "        return g + out\n"
+        )) == []
+
+
+class TestJitSideEffect:
+    def test_flags_telemetry_in_decorated_body(self, tmp_path):
+        found = _findings(tmp_path, "jit-side-effect", (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, tel):\n"
+            "    tel.counter('cd_steps_total').inc()\n"
+            "    return x * 2\n"
+        ))
+        assert len(found) == 1
+        assert "trace time" in found[0].message
+
+    def test_flags_maybe_fail_in_jitted_def(self, tmp_path):
+        found = _findings(tmp_path, "jit-side-effect", (
+            "import jax\n"
+            "from photon_ml_tpu.chaos import maybe_fail\n"
+            "def step(x):\n"
+            "    maybe_fail('cd.iteration')\n"
+            "    return x + 1\n"
+            "prog = jax.jit(step)\n"
+        ))
+        assert len(found) == 1
+        assert "maybe_fail()" in found[0].message
+
+    def test_accepts_effect_at_call_site(self, tmp_path):
+        # game/descent.py's shape: effects AROUND the program call.
+        assert _findings(tmp_path, "jit-side-effect", (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * 2\n"
+            "def drive(x, tel):\n"
+            "    y = f(x)\n"
+            "    tel.counter('cd_steps_total').inc()\n"
+            "    return y\n"
+        )) == []
+
+
+class TestUnseededRng:
+    def test_flags_module_global_numpy(self, tmp_path):
+        found = _findings(tmp_path, "unseeded-rng", (
+            "import numpy as np\n"
+            "def jitter():\n"
+            "    return np.random.uniform()\n"
+        ))
+        assert len(found) == 1
+        assert "module-global numpy RNG" in found[0].message
+
+    def test_flags_unseeded_constructors(self, tmp_path):
+        found = _findings(tmp_path, "unseeded-rng", (
+            "import random\n"
+            "import numpy as np\n"
+            "a = np.random.default_rng()\n"
+            "b = random.Random()\n"
+        ))
+        assert len(found) == 2
+
+    def test_accepts_seeded(self, tmp_path):
+        assert _findings(tmp_path, "unseeded-rng", (
+            "import random\n"
+            "import numpy as np\n"
+            "a = np.random.default_rng(23)\n"
+            "b = random.Random(7)\n"
+            "c = np.random.SeedSequence(5)\n"
+        )) == []
+
+
+# ---------------------------------------------------------------------------
+# registry rules
+# ---------------------------------------------------------------------------
+
+class TestChaosSiteSync:
+    CORE = (
+        "KNOWN_SITES = {\n"
+        "    'a.one': 'first seam',\n"
+        "    'a.two': 'second seam',\n"
+        "}\n"
+    )
+
+    def _tree(self, tmp_path, user_src: str) -> SourceTree:
+        core = tmp_path / "photon_ml_tpu" / "chaos" / "core.py"
+        core.parent.mkdir(parents=True)
+        core.write_text(self.CORE)
+        user = tmp_path / "photon_ml_tpu" / "user.py"
+        user.write_text(user_src)
+        return SourceTree(
+            roots=[str(tmp_path / "photon_ml_tpu")],
+            repo_root=str(tmp_path),
+        )
+
+    def test_flags_both_directions(self, tmp_path):
+        tree = self._tree(tmp_path, (
+            "from photon_ml_tpu import chaos\n"
+            "def f(k):\n"
+            "    chaos.maybe_fail('a.one', item=k)\n"
+            "    chaos.maybe_fail('a.rogue', item=k)\n"
+        ))
+        found = run_rules(tree, [RULES_BY_ID["chaos-site-sync"]])
+        msgs = sorted(f.message for f in found)
+        assert len(found) == 2
+        assert "'a.two' is registered" in msgs[0]
+        assert "'a.rogue' is not in chaos/core.py" in msgs[1]
+
+    def test_silent_when_in_sync(self, tmp_path):
+        tree = self._tree(tmp_path, (
+            "from photon_ml_tpu import chaos\n"
+            "def f(k):\n"
+            "    chaos.maybe_fail('a.one', item=k)\n"
+            "    chaos.maybe_fail('a.two', item=k)\n"
+        ))
+        assert run_rules(tree, [RULES_BY_ID["chaos-site-sync"]]) == []
+
+
+class TestMetricNaming:
+    def test_flags_bad_names_and_kind_conflict(self, tmp_path):
+        found = _findings(tmp_path, "metric-naming", (
+            "def f(tel):\n"
+            "    tel.counter(\"bogus_thing_total\").inc()\n"
+            "    tel.gauge(\"serving_thing_blobs\").set(1)\n"
+            "    tel.counter(\"serving_dual_total\").inc()\n"
+            "    tel.gauge(\"serving_dual_total\").set(2)\n"
+        ))
+        msgs = " | ".join(f.message for f in found)
+        assert "unknown subsystem prefix" in msgs
+        assert "unknown unit suffix" in msgs
+        assert "multiple kinds" in msgs
+
+    def test_silent_on_conforming_and_legacy(self, tmp_path):
+        assert _findings(tmp_path, "metric-naming", (
+            "def f(tel):\n"
+            "    tel.gauge(\"hbm_live_bytes\").set(0)\n"
+            "    tel.counter(\"chaos_faults_injected\").inc()\n"
+        )) == []
+
+    def test_lint_metrics_alias_still_works(self, capsys):
+        from photon_ml_tpu.telemetry.__main__ import lint_metrics
+
+        assert lint_metrics() == 0
+        assert "metric lint OK" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline mechanics
+# ---------------------------------------------------------------------------
+
+class TestSuppression:
+    SRC = (
+        "import time\n"
+        "def lat(t0):\n"
+        "    return time.time() - t0{inline}\n"
+    )
+
+    def test_inline_suppression(self, tmp_path):
+        src = self.SRC.format(
+            inline="  # photon: disable=wall-clock-interval"
+        )
+        assert _findings(tmp_path, "wall-clock-interval", src) == []
+
+    def test_preceding_comment_line_covers_next(self, tmp_path):
+        assert _findings(tmp_path, "wall-clock-interval", (
+            "import time\n"
+            "def lat(t0):\n"
+            "    # photon: disable=wall-clock-interval\n"
+            "    return time.time() - t0\n"
+        )) == []
+
+    def test_disable_all(self, tmp_path):
+        src = self.SRC.format(inline="  # photon: disable=all")
+        assert _findings(tmp_path, "wall-clock-interval", src) == []
+
+    def test_other_rule_suppression_does_not_cover(self, tmp_path):
+        src = self.SRC.format(inline="  # photon: disable=unseeded-rng")
+        assert len(_findings(tmp_path, "wall-clock-interval", src)) == 1
+
+
+class TestBaseline:
+    SRC = (
+        "import time\n"
+        "def lat(t0):\n"
+        "    return time.time() - t0\n"
+    )
+
+    def _check(self, tmp_path, baseline_path=None):
+        (tmp_path / "mod.py").write_text(self.SRC)
+        return check(
+            roots=[str(tmp_path / "mod.py")],
+            repo_root=str(tmp_path),
+            baseline_path=baseline_path,
+            rules=[RULES_BY_ID["wall-clock-interval"]],
+        )
+
+    def test_unbaselined_finding_fails(self, tmp_path):
+        report = self._check(tmp_path)
+        assert not report.ok
+        assert len(report.findings) == 1
+
+    def test_baselined_finding_passes_and_line_drift_survives(
+        self, tmp_path
+    ):
+        report = self._check(tmp_path)
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps({"entries": [{
+            "rule": f.rule, "path": f.path, "message": f.message,
+            "justification": "test grandfather",
+        } for f in report.findings]}))
+        report2 = self._check(tmp_path, baseline_path=str(bl))
+        assert report2.ok and report2.baselined == 1
+        # shift the finding down two lines: key has no line number
+        (tmp_path / "mod.py").write_text("# pad\n# pad\n" + self.SRC)
+        report3 = check(
+            roots=[str(tmp_path / "mod.py")], repo_root=str(tmp_path),
+            baseline_path=str(bl),
+            rules=[RULES_BY_ID["wall-clock-interval"]],
+        )
+        assert report3.ok and report3.baselined == 1
+
+    def test_justification_required(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps({"entries": [{
+            "rule": "wall-clock-interval", "path": "mod.py",
+            "message": "anything",
+        }]}))
+        with pytest.raises(ValueError, match="justification"):
+            Baseline.load(str(bl))
+
+    def test_stale_entries_reported(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps({"entries": [{
+            "rule": "wall-clock-interval", "path": "gone.py",
+            "message": "was fixed long ago",
+            "justification": "old",
+        }]}))
+        (tmp_path / "mod.py").write_text("x = 1\n")
+        report = check(
+            roots=[str(tmp_path / "mod.py")], repo_root=str(tmp_path),
+            baseline_path=str(bl),
+            rules=[RULES_BY_ID["wall-clock-interval"]],
+        )
+        assert report.ok  # stale entries warn, not fail
+        assert len(report.stale_baseline) == 1
+
+    def test_write_carries_justifications_forward(self, tmp_path):
+        report = self._check(tmp_path)
+        old = Baseline([{
+            "rule": f.rule, "path": f.path, "message": f.message,
+            "justification": "kept across rewrites",
+        } for f in report.findings])
+        out = tmp_path / "new_baseline.json"
+        Baseline.write(str(out), report.findings, old)
+        data = json.loads(out.read_text())
+        assert data["entries"][0]["justification"] == (
+            "kept across rewrites"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the real tree + CLI
+# ---------------------------------------------------------------------------
+
+class TestRealTree:
+    def test_package_is_clean(self):
+        report = check()
+        assert report.parse_errors == []
+        assert report.findings == [], "\n".join(
+            str(f) for f in report.findings
+        )
+        assert report.stale_baseline == []
+        # the committed baseline stays small and justified
+        assert report.baselined <= 10
+
+    def test_default_roots_exclude_tests(self):
+        roots = default_roots()
+        assert not any(r.endswith("tests") for r in roots)
+
+    def test_cli_check_exit_codes(self, tmp_path, capsys):
+        assert analysis_main(["--check"]) == 0
+        capsys.readouterr()
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import time\ndef lat(t0):\n    return time.time() - t0\n"
+        )
+        empty_bl = tmp_path / "bl.json"
+        empty_bl.write_text('{"entries": []}')
+        assert analysis_main([
+            "--check", "--root", str(bad), "--baseline", str(empty_bl),
+        ]) == 1
+        out = capsys.readouterr().out
+        assert "wall-clock-interval" in out and "FAILED" in out
+
+    def test_cli_list_and_explain(self, capsys):
+        assert analysis_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ALL_RULES:
+            assert rule.id in out
+        assert analysis_main(["--explain", "donated-buffer-reuse"]) == 0
+        assert "use-after-free" in capsys.readouterr().out
+        assert analysis_main(["--explain", "nope"]) == 1
+
+    def test_cli_update_baseline_roundtrip(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import time\ndef lat(t0):\n    return time.time() - t0\n"
+        )
+        bl = tmp_path / "bl.json"
+        assert analysis_main([
+            "--update-baseline", "--root", str(bad),
+            "--baseline", str(bl),
+        ]) == 0
+        capsys.readouterr()
+        data = json.loads(bl.read_text())
+        assert len(data["entries"]) == 1
+        # fresh entries carry the TODO placeholder the loader refuses
+        assert "TODO" in data["entries"][0]["justification"]
+        with pytest.raises(ValueError):
+            Baseline.load(str(bl))
+        data["entries"][0]["justification"] = "grandfathered in test"
+        bl.write_text(json.dumps(data))
+        assert analysis_main([
+            "--check", "--root", str(bad), "--baseline", str(bl),
+        ]) == 0
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizers
+# ---------------------------------------------------------------------------
+
+class TestLockOrderSanitizer:
+    def test_detects_scripted_inversion(self):
+        with sanitizers.LockOrderSanitizer() as san:
+            a = sanitizers.tracked(threading.Lock(), "order.a")
+            b = sanitizers.tracked(threading.Lock(), "order.b")
+            with a:
+                with b:
+                    pass
+
+            def inverted():
+                with b:
+                    with a:
+                        pass
+
+            t = threading.Thread(target=inverted, daemon=True)
+            t.start()
+            t.join()
+        assert len(san.reports) == 1
+        rep = san.reports[0]
+        assert rep["kind"] == "lock-order-inversion"
+        assert rep["cycle"] == ["order.a", "order.b", "order.a"]
+
+    def test_consistent_order_is_silent(self):
+        with sanitizers.LockOrderSanitizer() as san:
+            a = sanitizers.tracked(threading.Lock(), "same.a")
+            b = sanitizers.tracked(threading.Lock(), "same.b")
+
+            def nested():
+                with a:
+                    with b:
+                        pass
+
+            threads = [
+                threading.Thread(target=nested, daemon=True)
+                for _ in range(4)
+            ]
+            try:
+                for t in threads:
+                    t.start()
+            finally:
+                for t in threads:
+                    t.join()
+            nested()
+        assert san.reports == []
+
+    def test_strict_mode_raises(self):
+        with sanitizers.LockOrderSanitizer(strict=True):
+            a = sanitizers.tracked(threading.Lock(), "strict.a")
+            b = sanitizers.tracked(threading.Lock(), "strict.b")
+            with a:
+                with b:
+                    pass
+            with pytest.raises(sanitizers.LockOrderViolation):
+                with b:
+                    with a:
+                        pass
+
+    def test_three_lock_transitive_cycle(self):
+        with sanitizers.LockOrderSanitizer() as san:
+            a = sanitizers.tracked(threading.Lock(), "tri.a")
+            b = sanitizers.tracked(threading.Lock(), "tri.b")
+            c = sanitizers.tracked(threading.Lock(), "tri.c")
+            with a, b:
+                pass
+            with b, c:
+                pass
+            with c, a:  # closes a -> b -> c -> a
+                pass
+        assert len(san.reports) == 1
+        assert san.reports[0]["cycle"][0] == san.reports[0]["cycle"][-1]
+
+    def test_disabled_path_returns_raw_lock(self):
+        raw = threading.Lock()
+        assert sanitizers.tracked(raw, "raw") is raw
+
+    def test_uninstalled_tracked_lock_is_passthrough(self):
+        with sanitizers.LockOrderSanitizer():
+            tl = sanitizers.tracked(threading.Lock(), "late")
+        # sanitizer gone: TrackedLock still works, records nothing
+        with tl:
+            assert tl.locked()
+        assert not tl.locked()
+
+    def test_try_acquire_failure_unwinds(self):
+        with sanitizers.LockOrderSanitizer() as san:
+            tl = sanitizers.tracked(threading.Lock(), "try.a")
+            other = sanitizers.tracked(threading.Lock(), "try.b")
+            assert tl.acquire(blocking=False)
+            assert not tl.acquire(blocking=False)  # held: must unwind
+            tl.release()
+            # had the failed acquire leaked a stack entry, this nesting
+            # would record try.a -> try.b and the reverse would report
+            with other:
+                with tl:
+                    pass
+            with tl:
+                pass
+        assert san.reports == []
+
+    def test_report_deduped_per_pair(self):
+        with sanitizers.LockOrderSanitizer() as san:
+            a = sanitizers.tracked(threading.Lock(), "dup.a")
+            b = sanitizers.tracked(threading.Lock(), "dup.b")
+            with a, b:
+                pass
+            for _ in range(5):
+                with b, a:
+                    pass
+        assert len(san.reports) == 1
+
+    def test_inversion_bumps_counter_and_dumps_recorder(self, tmp_path):
+        with telemetry_mod.Telemetry(output_dir=str(tmp_path)) as tel:
+            with sanitizers.LockOrderSanitizer():
+                a = sanitizers.tracked(threading.Lock(), "fr.a")
+                b = sanitizers.tracked(threading.Lock(), "fr.b")
+                with a, b:
+                    pass
+                with b, a:
+                    pass
+            assert (
+                tel.counter(
+                    "analysis_lock_order_reports_total"
+                ).value == 1
+            )
+        dump = os.path.join(str(tmp_path), "flightrecorder.json")
+        assert os.path.exists(dump)
+        with open(dump) as f:
+            data = json.load(f)
+        assert data["reason"].startswith("lockorder:")
+
+
+class TestThreadLeakSentinel:
+    def test_detects_leaked_thread(self):
+        stop = threading.Event()
+        try:
+            with sanitizers.ThreadLeakSentinel(grace_s=0.2) as sentinel:
+                threading.Thread(
+                    target=stop.wait, name="leaky-worker", daemon=True
+                ).start()
+            assert sentinel.leaked == ["leaky-worker"]
+        finally:
+            stop.set()
+
+    def test_joined_threads_are_clean(self):
+        with sanitizers.ThreadLeakSentinel(grace_s=1.0) as sentinel:
+            t = threading.Thread(target=lambda: None, daemon=True)
+            t.start()
+            t.join()
+        assert sentinel.leaked == []
+
+    def test_allow_prefix(self):
+        stop = threading.Event()
+        try:
+            with sanitizers.ThreadLeakSentinel(
+                grace_s=0.2, allow=("exporter-",)
+            ) as sentinel:
+                threading.Thread(
+                    target=stop.wait, name="exporter-http", daemon=True
+                ).start()
+            assert sentinel.leaked == []
+        finally:
+            stop.set()
+
+    def test_strict_raises(self):
+        stop = threading.Event()
+        try:
+            with pytest.raises(sanitizers.ThreadLeakError):
+                with sanitizers.ThreadLeakSentinel(
+                    grace_s=0.2, strict=True
+                ):
+                    threading.Thread(
+                        target=stop.wait, name="strict-leak",
+                        daemon=True,
+                    ).start()
+        finally:
+            stop.set()
+
+    def test_grace_covers_slow_finish(self):
+        with sanitizers.ThreadLeakSentinel(grace_s=2.0) as sentinel:
+            threading.Thread(
+                target=lambda: time.sleep(0.1), daemon=True
+            ).start()
+        assert sentinel.leaked == []
+
+
+class TestSanitizedSubsystems:
+    """The wired production locks run clean under an installed
+    sanitizer: a streamed prefetch pass exercises prefetch.live with
+    witness tracking on and reports nothing."""
+
+    @pytest.mark.parametrize("depth", [1, 2])
+    def test_streamed_pass_clean_under_sanitizer(self, depth):
+        import numpy as np
+
+        from photon_ml_tpu.data.prefetch import run_prefetched
+
+        items = [np.full((4,), k, np.float32) for k in range(6)]
+        consumed = []
+        with sanitizers.LockOrderSanitizer(strict=True) as san:
+            run_prefetched(
+                len(items),
+                get_item=lambda k: items[k],
+                put=lambda host: host + 1,
+                consume=lambda k, dev: consumed.append((k, dev)),
+                depth=depth,
+            )
+        assert [k for k, _ in consumed] == list(range(6))
+        assert san.reports == []
