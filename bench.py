@@ -1239,13 +1239,14 @@ def bench_serving() -> dict:
     )
     _log(f"serving: {snap['throughput_rps']} rps over {clients} closed-"
          f"loop clients, p50 {snap['latency_p50_ms']} ms / p99 "
-         f"{snap['latency_p99_ms']} ms, mean batch "
-         f"{mean_batch and round(mean_batch, 1)} rows, hot hit rate "
-         f"{hot['hit_rate'] and round(hot['hit_rate'], 3)}")
+         f"{snap['latency_p99_ms']} ms / p99.9 {snap['latency_p999_ms']} "
+         f"ms, mean batch {mean_batch and round(mean_batch, 1)} rows, "
+         f"hot hit rate {hot['hit_rate'] and round(hot['hit_rate'], 3)}")
     out = {
         "serving_throughput_rps": snap["throughput_rps"],
         "serving_latency_p50_ms": snap["latency_p50_ms"],
         "serving_latency_p99_ms": snap["latency_p99_ms"],
+        "serving_latency_p999_ms": snap["latency_p999_ms"],
         "serving_completed": report.completed,
         "serving_rejected": report.rejected,
         "serving_clients": clients,
@@ -1258,6 +1259,7 @@ def bench_serving() -> dict:
         ),
     }
     out.update(_bench_serving_scenarios(workload))
+    out.update(_bench_serving_process(workload))
     return out
 
 
@@ -1308,6 +1310,15 @@ def _bench_serving_scenarios(workload) -> dict:
         _log("serving: saving swap-target model...")
         save_game_model(v2.model, v2.index_maps, v2_dir)
         for name, scenario in loadgen.SCENARIOS.items():
+            wired = {"swap", "kill_replica"}
+            if any(
+                p.action is not None and p.action not in wired
+                for p in scenario.phases
+            ):
+                # Process-only scenarios (worker_kill) run in
+                # _bench_serving_process against a worker pool;
+                # run_scenario refuses unwired actions by design.
+                continue
             supervisor = ReplicaSupervisor(
                 factory, n_replicas=2, probe_interval_s=0.1
             )
@@ -1336,10 +1347,76 @@ def _bench_serving_scenarios(workload) -> dict:
             )
             out[f"serving_scenario_{name}_p50_ms"] = snap["latency_p50_ms"]
             out[f"serving_scenario_{name}_p99_ms"] = snap["latency_p99_ms"]
+            out[f"serving_scenario_{name}_p999_ms"] = (
+                snap["latency_p999_ms"]
+            )
             out[f"serving_scenario_{name}_completed"] = report.completed
             out[f"serving_scenario_{name}_rejected"] = report.rejected
             out[f"serving_scenario_{name}_errors"] = report.errors
     return out
+
+
+def _bench_serving_process(workload) -> dict:
+    """Process-mode HA gate: the ``worker_kill`` scenario delivers a real
+    SIGKILL to a worker process while ≥120 rps flows through a 2-worker
+    pool-backed supervisor.  The acceptance gate is zero errors AND zero
+    rejections across the whole scenario (the pipe-EOF resubmission path
+    absorbing the crash), reported as an explicit boolean so a
+    regression is unmissable in the bench diff, alongside the tail
+    latency (p99.9) the kill window costs."""
+    from photon_ml_tpu.serving import loadgen
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.procpool import WorkerPool
+    from photon_ml_tpu.serving.runtime import RuntimeConfig
+    from photon_ml_tpu.serving.service import ScoringService
+    from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+
+    rate = 120.0 if SMALL else 240.0
+    _log("serving: publishing model to shared memory (process mode)...")
+    pool = WorkerPool(
+        workload.model, workload.index_maps,
+        runtime_config=RuntimeConfig(
+            max_batch_size=32, hot_entities=1024
+        ),
+    )
+    supervisor = ReplicaSupervisor(
+        pool=pool, n_replicas=2, probe_interval_s=0.1
+    )
+    service = ScoringService(supervisor, BatcherConfig(
+        max_batch_size=32, max_wait_us=1000, max_queue=1024,
+    ))
+    scenario = loadgen.SCENARIOS["worker_kill"]
+    with service:
+        report = loadgen.run_scenario(
+            service.submit,
+            lambda i, phase: workload.request(i),
+            scenario,
+            base_rate_rps=rate,
+            actions={
+                "kill_worker": lambda: {
+                    "killed": supervisor.kill_replica(0).rid
+                },
+            },
+        )
+    snap = report.snapshot()
+    zero_failed = report.errors == 0 and report.rejected == 0
+    _log(
+        f"serving process-mode worker_kill @ {rate:g} rps: "
+        f"{report.completed} ok / {report.rejected} shed / "
+        f"{report.errors} errors, p99 {snap['latency_p99_ms']} ms "
+        f"p99.9 {snap['latency_p999_ms']} ms, zero-failed gate "
+        f"{'PASS' if zero_failed else 'FAIL'}"
+    )
+    return {
+        "serving_proc_worker_kill_rate_rps": rate,
+        "serving_proc_worker_kill_p50_ms": snap["latency_p50_ms"],
+        "serving_proc_worker_kill_p99_ms": snap["latency_p99_ms"],
+        "serving_proc_worker_kill_p999_ms": snap["latency_p999_ms"],
+        "serving_proc_worker_kill_completed": report.completed,
+        "serving_proc_worker_kill_rejected": report.rejected,
+        "serving_proc_worker_kill_errors": report.errors,
+        "serving_proc_worker_kill_zero_failed": zero_failed,
+    }
 
 
 def bench_tuning() -> dict:

@@ -3,14 +3,14 @@
 ``python -m photon_ml_tpu.analysis --check`` runs every rule over the
 package (exit 0 = clean); ``--list-rules`` / ``--explain RULE`` document
 them; ``--update-baseline`` regenerates the grandfather list.  The
-runtime half (lock-order tracking, thread-leak sentinel) lives in
-:mod:`photon_ml_tpu.analysis.sanitizers` and is imported lazily — the
-static checker never imports jax or telemetry.
+runtime half (lock-order tracking, thread- and process-leak sentinels)
+lives in :mod:`photon_ml_tpu.analysis.sanitizers` and is imported
+lazily — the static checker never imports jax or telemetry.
 
 Rule families:
 
 - concurrency (rules_concurrency.py): thread-lifecycle,
-  lock-blocking-call, wall-clock-interval
+  process-lifecycle, lock-blocking-call, wall-clock-interval
 - jax (rules_jax.py): donated-buffer-reuse, jit-side-effect,
   unseeded-rng
 - registry (rules_registry.py): chaos-site-sync, metric-naming

@@ -10,6 +10,11 @@ the conventions machine-checked:
   first time an exception lands between ``start()`` and ``join()``.
   The repo convention after the prefetch-leak incident (PR 6) is:
   every background thread is ``daemon=True`` AND joined by its owner.
+- ``process-lifecycle``: the same discipline one isolation level up —
+  every ``multiprocessing.Process`` / ``subprocess.Popen`` is
+  join()ed/wait()ed on every exit path AND carries a terminate/kill
+  escalation, because a leaked child outlives the interpreter and pins
+  shared memory; a wedged one hangs shutdown behind an unbounded reap.
 - ``lock-blocking-call``: a blocking call (sleep, network, thread join,
   device transfer, future result, fsync) while holding a
   ``threading.Lock`` turns a micro-critical-section into a convoy —
@@ -127,6 +132,91 @@ def _check_thread_lifecycle(tree: SourceTree) -> Iterable[Finding]:
                     "happy path: an exception between start() and join() "
                     "leaks it and wedges interpreter exit; join in a "
                     "finally: block or pass daemon=True",
+                )
+
+
+# ---------------------------------------------------------------------------
+# process-lifecycle
+# ---------------------------------------------------------------------------
+
+#: process-constructor name -> the call that reaps it.  ``subprocess.run``
+#: / ``call`` / ``check_output`` wait internally and are exempt.
+_PROC_KINDS = {"Process": "join", "Popen": "wait"}
+
+
+def _process_kind(callee: Optional[str]) -> Optional[str]:
+    """'Process' for multiprocessing.Process / ctx.Process /
+    mp.get_context(...).Process, 'Popen' for subprocess.Popen — matched
+    on the final attribute so spawn-context construction counts too."""
+    if not callee:
+        return None
+    base = callee.rsplit(".", 1)[-1]
+    return base if base in _PROC_KINDS else None
+
+
+def _check_process_lifecycle(tree: SourceTree) -> Iterable[Finding]:
+    """A child process needs MORE than a thread: ``join``/``wait`` on
+    every exit path (else zombies accumulate), AND a ``terminate()`` or
+    ``kill()`` escalation reachable somewhere (else a wedged child hangs
+    its owner's shutdown forever — a thread can at worst wedge exit, a
+    process also pins shared memory and sockets past the interpreter)."""
+    for pf in tree.files:
+        if pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _process_kind(dotted_name(node.func))
+            if kind is None:
+                continue
+            reap = _PROC_KINDS[kind]
+            name = _thread_target_name(pf, node)
+            if name is None:
+                yield Finding(
+                    "process-lifecycle", pf.relpath, node.lineno,
+                    f"{kind} created without a binding that could be "
+                    f"{reap}ed/terminated; bind it and reap it on every "
+                    "exit path",
+                )
+                continue
+            reaps = _method_calls_on(pf, name, reap)
+            if not reaps:
+                yield Finding(
+                    "process-lifecycle", pf.relpath, node.lineno,
+                    f"{kind} {name!r} is never {reap}ed in this file: "
+                    "an unreaped child is a zombie and its exit status "
+                    f"is lost; {reap} it on every exit path",
+                )
+                continue
+            if not (
+                _method_calls_on(pf, name, "terminate")
+                or _method_calls_on(pf, name, "kill")
+            ):
+                yield Finding(
+                    "process-lifecycle", pf.relpath, node.lineno,
+                    f"{kind} {name!r} is {reap}ed but never "
+                    "terminate()d/kill()ed: a wedged child makes the "
+                    f"{reap} wait forever; escalate "
+                    f"{reap}(timeout) -> terminate -> kill on shutdown",
+                )
+                continue
+            # Exception safety, same discipline as thread-lifecycle: the
+            # reap runs in a finally, or lives in a different method than
+            # the one that launched the child (stop()/close() pattern).
+            starts = _method_calls_on(pf, name, "start") or [node]
+            start_fns = {pf.enclosing_function(c) for c in starts}
+            for r in reaps:
+                if _in_finally(pf, r):
+                    break
+                if pf.enclosing_function(r) not in start_fns:
+                    break
+            else:
+                yield Finding(
+                    "process-lifecycle", pf.relpath, node.lineno,
+                    f"{kind} {name!r} is {reap}ed only on the happy "
+                    "path: an exception after launch leaks the child "
+                    f"(and whatever it maps); {reap} in a finally: "
+                    "block or from a lifecycle stop()/close()",
                 )
 
 
@@ -324,6 +414,32 @@ RULES = [
             "function."
         ),
         fn=_check_thread_lifecycle,
+    ),
+    Rule(
+        id="process-lifecycle",
+        family="concurrency",
+        summary="every multiprocessing.Process / subprocess.Popen is "
+                "join()ed/wait()ed on every exit path AND has a "
+                "terminate/kill escalation",
+        explain=(
+            "thread-lifecycle, one isolation level up — and stricter, "
+            "because a leaked child process outlives the interpreter "
+            "and pins shared-memory segments and sockets, and an "
+            "unreaped one is a zombie.  The rule matches constructors "
+            "by final attribute (multiprocessing.Process, ctx.Process "
+            "from a spawn context, subprocess.Popen; subprocess.run/"
+            "call/check_output wait internally and are exempt) and "
+            "requires: a binding; a join() (Process) or wait() (Popen) "
+            "somewhere in the file, exception-safe (in a finally:, or "
+            "in a different method than the launch — the stop()/close() "
+            "lifecycle split); and a terminate() or kill() call so a "
+            "WEDGED child cannot hang shutdown behind an unbounded "
+            "reap.  serving/procpool.py's stop() — shutdown frame, "
+            "join(timeout), then terminate+join and kill+join in a "
+            "finally: — is the model.  Runtime counterpart: "
+            "sanitizers.ProcessLeakSentinel."
+        ),
+        fn=_check_process_lifecycle,
     ),
     Rule(
         id="lock-blocking-call",

@@ -22,6 +22,12 @@ counterpart of the ``thread-lifecycle`` static rule, catching leaks
 from code paths the AST cannot prove (wedged daemons, leaked pool
 workers).
 
+**Process-leak sentinel**: the same contract one isolation level up —
+any child process spawned inside the scope (serving worker processes)
+must be gone at exit.  A leaked process is worse than a leaked thread:
+it pins shared-memory model segments and sockets, and survives the
+parent interpreter.  Runtime counterpart of ``process-lifecycle``.
+
 Cost contract (mirrors chaos/core.py): with no sanitizer installed,
 ``tracked()`` returns the RAW lock — zero added cost on the hot path,
 cheaper than chaos's one-branch contract.  Locks created WHILE a
@@ -35,6 +41,7 @@ selfcheck do).
 
 from __future__ import annotations
 
+import multiprocessing
 import threading
 import time
 from typing import Iterable, Optional
@@ -50,6 +57,11 @@ class LockOrderViolation(RuntimeError):
 class ThreadLeakError(RuntimeError):
     """Raised (strict mode) when threads created inside a sentinel
     scope are still alive at scope exit."""
+
+
+class ProcessLeakError(RuntimeError):
+    """Raised (strict mode) when child processes spawned inside a
+    sentinel scope are still alive at scope exit."""
 
 
 class LockOrderSanitizer:
@@ -314,5 +326,73 @@ class ThreadLeakSentinel:
                     f"sentinel scope are still alive {self.grace_s}s "
                     "after exit: a background thread leaked past its "
                     "owner's lifecycle"
+                )
+        return False
+
+
+class ProcessLeakSentinel:
+    """Context manager: any CHILD PROCESS spawned inside the scope must
+    be gone by exit — the runtime counterpart of the
+    ``process-lifecycle`` static rule, and the serving worker pool's
+    shutdown acceptance gate (a leaked worker pins its shared-memory
+    mapping and a socket, not just a thread stack).
+
+    Mirrors :class:`ThreadLeakSentinel`: ``allow`` lists process-name
+    prefixes that may outlive the scope, ``leaked`` holds offending
+    process names after exit, ``strict=True`` raises
+    :class:`ProcessLeakError` unless the body is already unwinding an
+    exception.  The grace default is longer than the thread sentinel's —
+    a worker draining its batcher is finishing real scoring work.
+    Polling uses ``multiprocessing.active_children()``, which also reaps
+    finished children, so a passed scope leaves no zombies either."""
+
+    def __init__(
+        self,
+        grace_s: float = 10.0,
+        allow: Iterable[str] = (),
+        strict: bool = False,
+    ):
+        self.grace_s = grace_s
+        self.allow = tuple(allow)
+        self.strict = strict
+        self.leaked: list[str] = []
+        self._before: set[Optional[int]] = set()
+
+    def __enter__(self) -> "ProcessLeakSentinel":
+        self._before = {
+            p.pid for p in multiprocessing.active_children()
+        }
+        return self
+
+    def _new_alive(self) -> list:
+        return [
+            p for p in multiprocessing.active_children()
+            if p.pid not in self._before
+            and p.is_alive()
+            and not p.name.startswith(self.allow)
+        ]
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        deadline = time.monotonic() + self.grace_s
+        alive = self._new_alive()
+        while alive and time.monotonic() < deadline:
+            time.sleep(0.05)
+            alive = self._new_alive()
+        if alive:
+            self.leaked = sorted(
+                f"{p.name}(pid={p.pid})" for p in alive
+            )
+            tel = telemetry_mod.current()
+            tel.counter("analysis_process_leak_total").inc(len(alive))
+            tel.event("analysis.process_leak", processes=self.leaked)
+            telemetry_mod.dump_flight_recorder(
+                reason=f"processleak:{','.join(self.leaked)}"
+            )
+            if self.strict and exc_type is None:
+                raise ProcessLeakError(
+                    f"child process(es) {self.leaked} spawned inside "
+                    f"the sentinel scope are still alive {self.grace_s}s "
+                    "after exit: a worker leaked past its owner's "
+                    "lifecycle (and pins its shared-memory mappings)"
                 )
         return False

@@ -138,6 +138,14 @@ KNOWN_SITES = {
         "replica crashing; the supervisor must mark it down and "
         "re-route/resubmit with zero failed requests"
     ),
+    "serving.worker": (
+        "process-pool routing, before a request is framed to the chosen "
+        "worker process (serving/procpool.py) — a fault here SIGKILLs "
+        "the routed worker for real before raising, so the scripted "
+        "crash exercises the actual death-mid-batch path: pipe EOF, "
+        "transient failure of in-flight rows, supervisor resubmission "
+        "with zero failed requests, jittered respawn"
+    ),
     "serving.swap": (
         "model hot-swap critical section (serving/swap.py): touched at "
         "stage 'load' (before the background load), 'prepare' (loaded+"

@@ -27,7 +27,15 @@ TPU-native analogue of that request path over the batch stack:
   model hot-swap with verified one-step rollback.
 - :mod:`~photon_ml_tpu.serving.loadgen` — closed/open-loop load
   generators plus scripted scenarios (diurnal ramp, skew shift,
-  swap-under-load, replica-kill; ``bench.py bench_serving``).
+  swap-under-load, replica-kill, worker-kill; ``bench.py
+  bench_serving``).
+- :mod:`~photon_ml_tpu.serving.procpool` /
+  :mod:`~photon_ml_tpu.serving.worker` /
+  :mod:`~photon_ml_tpu.serving.shm_model` — crash-isolated worker
+  PROCESSES behind the same supervisor seams: the model published once
+  into POSIX shared memory with verified (sha256) attach, framed
+  request/heartbeat protocol, cross-process hot swap
+  (``--workers N``; docs/serving.md "Process mode").
 
 ``python -m photon_ml_tpu.serving --selfcheck`` builds a synthetic GAME
 model, serves concurrent HTTP requests, and verifies batched results are
@@ -56,6 +64,9 @@ _LAZY = {
     "ReplicaSupervisor": (
         "photon_ml_tpu.serving.supervisor", "ReplicaSupervisor",
     ),
+    "WorkerPool": ("photon_ml_tpu.serving.procpool", "WorkerPool"),
+    "ProcessReplica": ("photon_ml_tpu.serving.procpool", "ProcessReplica"),
+    "ModelMapError": ("photon_ml_tpu.serving.shm_model", "ModelMapError"),
     "HotSwapper": ("photon_ml_tpu.serving.swap", "HotSwapper"),
     "SwapResult": ("photon_ml_tpu.serving.swap", "SwapResult"),
     "SwapInProgressError": (

@@ -20,6 +20,15 @@ directory is rejected with an automatic rollback — asserting ZERO failed
 requests throughout and a monotone ``serving_model_version`` in
 metrics.json.
 
+Process mode (``--selfcheck --workers 2``) runs the same contracts
+against CRASH-ISOLATED worker processes attached to one shared-memory
+model publication: score parity with in-process scoring, a real SIGKILL
+mid-load with zero failed requests, a cross-process hot swap + rollback
+(bit-identical on both sides), a ``serving_shared_segment_bytes`` gauge
+at one publication (not N copies), and a leak-free shutdown under a
+strict :class:`ProcessLeakSentinel` with no shared segments left
+mapped.
+
 Serve a saved model::
 
     python -m photon_ml_tpu.serving --model-dir /tmp/game_out --port 8080
@@ -77,6 +86,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="run this many supervised scoring replicas behind the "
         "listener (>1 enables the HA path: health probes, automatic "
         "restarts, request resubmission; docs/serving.md)",
+    )
+    p.add_argument(
+        "--workers", type=int, default=0,
+        help="score in this many crash-isolated worker PROCESSES "
+        "attached to one shared-memory model publication instead of "
+        "in-process replica threads (docs/serving.md#process-mode); "
+        "with --selfcheck, runs the process-mode pass instead of the "
+        "in-process passes",
     )
     p.add_argument(
         "--timeout-ms", type=float, default=None,
@@ -141,7 +158,24 @@ def _make_service(args):
         max_queue=args.max_queue,
         default_timeout_ms=args.timeout_ms,
     )
-    if args.replicas > 1:
+    if args.workers:
+        from photon_ml_tpu.serving.procpool import WorkerPool
+        from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+
+        if workload is not None:
+            model, index_maps, path = (
+                workload.model, workload.index_maps, None
+            )
+        else:
+            from photon_ml_tpu.io.game_store import load_game_model
+
+            model, index_maps = load_game_model(args.model_dir)
+            path = args.model_dir
+        pool = WorkerPool(
+            model, index_maps, runtime_config=rt_cfg, model_path=path
+        )
+        unit = ReplicaSupervisor(pool=pool, n_replicas=args.workers)
+    elif args.replicas > 1:
         from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
 
         unit = ReplicaSupervisor(factory, n_replicas=args.replicas)
@@ -508,6 +542,209 @@ def run_selfcheck_ha(out_dir: str) -> list[str]:
     return failures
 
 
+def run_selfcheck_process(out_dir: str, n_workers: int = 2) -> list[str]:
+    """Process-mode pass: crash-isolated worker processes on a shared
+    model.  Verifies score parity with in-process scoring, zero failed
+    requests through a real SIGKILL under open-loop load, a
+    cross-process hot swap + rollback (bit-identical on both sides),
+    single-publication segment accounting, and a leak-free shutdown.
+    Returns failure strings (empty = pass)."""
+    import time
+
+    import numpy as np
+
+    from photon_ml_tpu import telemetry as telemetry_mod
+    from photon_ml_tpu.analysis.sanitizers import ProcessLeakSentinel
+    from photon_ml_tpu.io.game_store import save_game_model
+    from photon_ml_tpu.serving import loadgen, shm_model
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.procpool import WorkerPool
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService
+    from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+    from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+    failures: list[str] = []
+    n_requests = 24
+    v1 = SyntheticWorkload(n_entities=64, seed=3)
+    v2 = SyntheticWorkload(n_entities=64, seed=4)
+    v2_dir = os.path.join(out_dir, "models", "v2")
+    save_game_model(v2.model, v2.index_maps, v2_dir)
+    rt_cfg = RuntimeConfig(max_batch_size=8, hot_entities=16)
+    requests = [v1.request(i) for i in range(n_requests)]
+
+    def reference(w: SyntheticWorkload) -> np.ndarray:
+        rt = ScoringRuntime(w.model, w.index_maps, rt_cfg)
+        return np.asarray(
+            [
+                rt.score_rows([rt.parse_request(r)])[0][0]
+                for r in requests
+            ],
+            np.float32,
+        )
+
+    ref_v1, ref_v2 = reference(v1), reference(v2)
+
+    def parity(tag: str, want: np.ndarray) -> None:
+        futs = [service.submit(r) for r in requests]
+        got = np.asarray(
+            [np.float32(f.result(timeout=60)["score"]) for f in futs],
+            np.float32,
+        )
+        if got.tobytes() != want.tobytes():
+            bad = int(np.argmax(got != want))
+            failures.append(
+                f"{tag}: worker scores are NOT bit-identical to "
+                f"in-process scoring (first diff row {bad}: "
+                f"{got[bad]!r} vs {want[bad]!r})"
+            )
+
+    def await_healthy(what: str, timeout_s: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while (
+            supervisor.healthy_count < n_workers
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        if supervisor.healthy_count < n_workers:
+            failures.append(
+                f"{what}: only {supervisor.healthy_count}/{n_workers} "
+                f"workers healthy after {timeout_s:.0f} s"
+            )
+
+    with telemetry_mod.Telemetry(
+        output_dir=out_dir, run_name="serving-selfcheck-proc"
+    ) as tel:
+        with ProcessLeakSentinel(grace_s=15.0, strict=True):
+            pool = WorkerPool(
+                v1.model, v1.index_maps, runtime_config=rt_cfg, version=1
+            )
+            supervisor = ReplicaSupervisor(
+                pool=pool, n_replicas=n_workers, probe_interval_s=0.1
+            )
+            service = ScoringService(supervisor, BatcherConfig(
+                max_batch_size=8, max_wait_us=2_000, max_queue=256,
+            ))
+            with service:
+                parity("v1", ref_v1)
+
+                # One publication, N attachments: the parent-side gauge
+                # counts mapped bytes ONCE however many workers attach.
+                published = sum(
+                    seg["nbytes"]
+                    for seg in pool.manifest["segments"].values()
+                )
+                mapped = tel.snapshot()["gauges"].get(
+                    "serving_shared_segment_bytes", 0
+                )
+                if mapped != published:
+                    failures.append(
+                        "serving_shared_segment_bytes = "
+                        f"{mapped}, expected exactly one publication "
+                        f"({published} bytes) for {n_workers} workers"
+                    )
+
+                # Real SIGKILL mid-load: a burst straight into the dying
+                # worker's queue plus an open loop across the kill, zero
+                # failed requests end to end.
+                def script() -> None:
+                    try:
+                        time.sleep(0.4)
+                        burst = [
+                            service.submit(v1.request(50_000 + j))
+                            for j in range(64)
+                        ]
+                        supervisor.kill_replica(0)
+                        for bf in burst:
+                            try:
+                                bf.result(timeout=60)
+                            except Exception as exc:  # noqa: BLE001
+                                failures.append(
+                                    "burst request failed after worker "
+                                    f"SIGKILL: {exc!r}"
+                                )
+                                break
+                        await_healthy("post-SIGKILL respawn")
+                    except Exception as exc:  # noqa: BLE001
+                        failures.append(
+                            f"process script failed: {exc!r}"
+                        )
+
+                script_thread = threading.Thread(
+                    target=script, daemon=True
+                )
+                script_thread.start()
+                report = loadgen.open_loop(
+                    service.submit, v1.request,
+                    rate_rps=120.0, duration_s=4.0,
+                )
+                script_thread.join(timeout=60)
+                if report.errors or report.rejected:
+                    failures.append(
+                        f"process load saw {report.errors} errors and "
+                        f"{report.rejected} rejections (expected 0/0) "
+                        f"across {report.completed} requests"
+                    )
+                if report.completed < 100:
+                    failures.append(
+                        f"process load completed only {report.completed}"
+                        " requests; the pass did not exercise the path"
+                    )
+
+                # Cross-process hot swap, then an operator rollback with
+                # a worker killed in between (the respawned worker has
+                # no retained previous; rollback must still converge).
+                swapped = service.reload(v2_dir)
+                if swapped.status != "swapped":
+                    failures.append(f"process swap v2 -> {swapped}")
+                parity("post-swap v2", ref_v2)
+                if service.swapper.version != 2:
+                    failures.append(
+                        "expected model_version 2 after swap, got "
+                        f"{service.swapper.version}"
+                    )
+                supervisor.kill_replica(1, "post-swap kill")
+                await_healthy("post-swap respawn")
+                rolled = service.swapper.rollback()
+                if rolled.status != "rolled_back":
+                    failures.append(f"process rollback -> {rolled}")
+                await_healthy("rollback convergence")
+                parity("post-rollback v1", ref_v1)
+            leftover = shm_model.live_segments()
+            if leftover:
+                failures.append(
+                    "shared segments still mapped after shutdown: "
+                    f"{leftover}"
+                )
+        snap = tel.snapshot()
+
+    counters = snap["counters"]
+    for name, minimum in (
+        ("serving_replica_restarts_total", 2),
+        ("serving_resubmitted_total", 1),
+        ("serving_swaps_total", 1),
+        ("serving_rollbacks_total", 1),
+    ):
+        if counters.get(name, 0) < minimum:
+            failures.append(
+                f"{name} = {counters.get(name, 0)}, expected >= {minimum}"
+            )
+    if not failures:
+        print(
+            f"serving process selfcheck: {n_workers} worker processes, "
+            f"{n_requests}-row parity x3 (v1, swapped v2, rolled-back "
+            "v1) bit-identical, SIGKILL under 120 rps with 0 failed "
+            "requests "
+            f"({report.completed} completed, restarts "
+            f"{counters.get('serving_replica_restarts_total')}, "
+            f"resubmitted "
+            f"{counters.get('serving_resubmitted_total')}), shared "
+            f"segments {published} bytes mapped once, shutdown "
+            "leak-free"
+        )
+    return failures
+
+
 # ---------------------------------------------------------------------------
 # Entry point
 # ---------------------------------------------------------------------------
@@ -526,14 +763,20 @@ def main(argv=None) -> int:
             os.makedirs(ha, exist_ok=True)
             return run_selfcheck(single) + run_selfcheck_ha(ha)
 
+        def process(root: str) -> list[str]:
+            proc = os.path.join(root, "proc")
+            os.makedirs(proc, exist_ok=True)
+            return run_selfcheck_process(proc, n_workers=args.workers)
+
+        runner = process if args.workers else both
         if args.output_dir:
             os.makedirs(args.output_dir, exist_ok=True)
-            failures = both(args.output_dir)
+            failures = runner(args.output_dir)
         else:
             with tempfile.TemporaryDirectory(
                 prefix="photon_serving_selfcheck_"
             ) as td:
-                failures = both(td)
+                failures = runner(td)
         if failures:
             print("serving selfcheck FAILED:", file=sys.stderr)
             for f in failures:

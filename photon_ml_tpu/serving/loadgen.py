@@ -26,6 +26,9 @@ HA selfcheck replay:
   traffic flows; zero failed requests expected.
 - ``replica_kill`` — a replica is killed mid-phase; the supervisor
   resubmits and restarts; zero failed requests expected.
+- ``worker_kill``  — process-mode only: a worker PROCESS takes a real
+  SIGKILL mid-phase; same zero-failed-requests contract through the
+  pipe-EOF resubmission path.
 
 Per-phase and whole-run p50/p99 come from the same shared
 ``telemetry.Histogram.quantile`` the live exposition uses.
@@ -92,6 +95,7 @@ class LoadReport:
             "latency_p50_ms": _round(self.percentile_ms(50)),
             "latency_p90_ms": _round(self.percentile_ms(90)),
             "latency_p99_ms": _round(self.percentile_ms(99)),
+            "latency_p999_ms": _round(self.percentile_ms(99.9)),
             "latency_max_ms": _round(
                 float(self.latencies_ms.max())
                 if len(self.latencies_ms) else None
@@ -298,6 +302,7 @@ class ScenarioReport:
             "errors": self.errors,
             "latency_p50_ms": _round(self.percentile_ms(50)),
             "latency_p99_ms": _round(self.percentile_ms(99)),
+            "latency_p999_ms": _round(self.percentile_ms(99.9)),
             "actions": self.actions,
             "phases": {
                 name: report.snapshot() for name, report in self.phases
@@ -343,6 +348,16 @@ SCENARIOS = {
         [
             ScenarioPhase("warm", 1.0),
             ScenarioPhase("kill", 2.0, action="kill_replica"),
+            ScenarioPhase("after", 1.0),
+        ],
+    ),
+    "worker_kill": Scenario(
+        "worker_kill",
+        "a worker PROCESS is SIGKILLed mid-phase (process-mode serving); "
+        "pipe EOF -> resubmission -> respawn, zero errors expected",
+        [
+            ScenarioPhase("warm", 1.0),
+            ScenarioPhase("kill", 2.0, action="kill_worker"),
             ScenarioPhase("after", 1.0),
         ],
     ),

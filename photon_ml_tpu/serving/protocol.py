@@ -1,0 +1,100 @@
+"""Length-prefixed request/response framing for serving worker IPC.
+
+One frame = a 4-byte big-endian payload length + a pickled message
+dict.  Both ends of the parent↔worker socketpair speak it
+(serving/procpool.py routes, serving/worker.py serves).  Sends are
+serialized under a lock — the parent's request threads and the
+swapper, and the worker's dispatch callbacks and heartbeat thread, all
+write the same socket — so frames never interleave.  Each side has
+exactly one reader thread, so receives need no lock.
+
+``recv`` returns ``None`` on a clean EOF (peer closed or died); a
+partial frame at EOF raises :class:`ProtocolError` — the caller treats
+both as "worker gone" and fails in-flight work with a transient error
+the supervisor resubmits.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+__all__ = ["FrameConn", "ProtocolError", "MAX_FRAME_BYTES"]
+
+_HEADER = struct.Struct(">I")
+
+#: sanity ceiling, not a tuning knob — a scoring row or a manifest is
+#: kilobytes; a length beyond this means a corrupt or desynced stream.
+MAX_FRAME_BYTES = 256 << 20
+
+
+class ProtocolError(RuntimeError):
+    """The byte stream desynced (oversized length or truncated frame)."""
+
+
+class FrameConn:
+    """One framed, pickling connection over a connected socket."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._closed = False
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+    def send(self, message: Any) -> None:
+        payload = pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
+        if len(payload) > MAX_FRAME_BYTES:
+            raise ProtocolError(
+                f"refusing to send a {len(payload)}-byte frame "
+                f"(cap {MAX_FRAME_BYTES})"
+            )
+        frame = _HEADER.pack(len(payload)) + payload
+        with self._send_lock:
+            self._sock.sendall(frame)
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        chunks = []
+        remaining = n
+        while remaining:
+            chunk = self._sock.recv(min(remaining, 1 << 20))
+            if not chunk:
+                if not chunks:
+                    return None  # clean EOF between frames
+                raise ProtocolError(
+                    f"truncated frame: EOF with {remaining} of {n} "
+                    "bytes unread"
+                )
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        return b"".join(chunks)
+
+    def recv(self) -> Optional[Any]:
+        """Next message, or ``None`` on clean EOF."""
+        header = self._recv_exact(_HEADER.size)
+        if header is None:
+            return None
+        (length,) = _HEADER.unpack(header)
+        if length > MAX_FRAME_BYTES:
+            raise ProtocolError(
+                f"frame length {length} exceeds cap {MAX_FRAME_BYTES}; "
+                "stream is desynced"
+            )
+        payload = self._recv_exact(length)
+        if payload is None:
+            raise ProtocolError("truncated frame: EOF before payload")
+        return pickle.loads(payload)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()

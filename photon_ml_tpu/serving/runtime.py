@@ -112,6 +112,110 @@ class Row:
     priority: str = "normal"  # one of PRIORITIES
 
 
+class RequestParser:
+    """Stateless request validation: shard dims + saved index maps — the
+    ONLY runtime state request parsing reads, split out so the
+    process-backed worker pool can parse in the parent (routing, probe
+    rows) without holding a local :class:`ScoringRuntime`
+    (serving/procpool.py).  ``ScoringRuntime.parse_request`` delegates
+    here, so both serving modes validate identically."""
+
+    def __init__(
+        self, shard_dims: dict, index_maps: Optional[dict] = None
+    ):
+        self.shard_dims = dict(shard_dims)
+        self.index_maps = index_maps or {}
+
+    @classmethod
+    def for_model(
+        cls, model: GameModel, index_maps: Optional[dict] = None
+    ) -> "RequestParser":
+        """Shard dims straight off the model's coordinates — the same
+        derivation ScoringRuntime.__init__ performs."""
+        shard_dims: dict[str, int] = {}
+        for sub in model.models.values():
+            if isinstance(sub, FixedEffectModel):
+                shard_dims[sub.feature_shard] = int(
+                    np.asarray(sub.model.coefficients.means).shape[0]
+                )
+            elif isinstance(sub, RandomEffectModel):
+                shard_dims[sub.feature_shard] = int(sub.n_features)
+            else:
+                raise TypeError(f"unsupported coordinate type: {type(sub)}")
+        return cls(shard_dims, index_maps)
+
+    def parse(self, obj: dict) -> "Row":
+        """Validate one JSON-shaped request into a :class:`Row`.
+
+        ``dense``: shard → full-width float list.  ``features``: shard →
+        named entries (``{"name", "term", "value"}`` dicts or
+        ``[name, term, value]`` triples) resolved through the saved index
+        map — unseen features drop, exactly like batch scoring.
+        """
+        if not isinstance(obj, dict):
+            raise ValueError("request must be a JSON object")
+        features: dict = {}
+        for shard, vec in (obj.get("dense") or {}).items():
+            dim = self.shard_dims.get(shard)
+            if dim is None:
+                raise ValueError(f"unknown feature shard {shard!r}")
+            arr = np.asarray(vec, np.float32)
+            if arr.shape != (dim,):
+                raise ValueError(
+                    f"shard {shard!r} expects {dim} features, got "
+                    f"{arr.shape}"
+                )
+            features[shard] = arr
+        for shard, entries in (obj.get("features") or {}).items():
+            dim = self.shard_dims.get(shard)
+            if dim is None:
+                raise ValueError(f"unknown feature shard {shard!r}")
+            imap = self.index_maps.get(shard)
+            if imap is None:
+                raise ValueError(
+                    f"shard {shard!r} has no saved index map; send "
+                    "'dense' features"
+                )
+            from photon_ml_tpu.data.index_map import feature_key
+
+            arr = features.get(shard)
+            if arr is None:
+                arr = np.zeros(dim, np.float32)
+            for e in entries:
+                if isinstance(e, dict):
+                    name, term, value = (
+                        e.get("name"), e.get("term", ""), e.get("value"),
+                    )
+                else:
+                    name, term, value = e
+                idx = imap.get_index(feature_key(str(name), str(term or "")))
+                if idx >= 0:
+                    arr[idx] = np.float32(value)
+            features[shard] = arr
+        ids = {}
+        for key, value in (obj.get("ids") or {}).items():
+            if value is not None:
+                ids[str(key)] = str(value)
+        timeout = obj.get("timeout_ms")
+        priority = obj.get("priority", "normal")
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}"
+            )
+        return Row(
+            features=features,
+            ids=ids,
+            offset=float(obj.get("offset") or 0.0),
+            timeout_ms=None if timeout is None else float(timeout),
+            priority=priority,
+        )
+
+    def probe_row(self) -> "Row":
+        """A minimal valid request (offset-only) — what health probes
+        and swap verification score."""
+        return self.parse({})
+
+
 class _HotTable:
     """LRU hot set of dense per-entity coefficient rows, device-resident.
 
@@ -233,6 +337,7 @@ class ScoringRuntime:
                 raise TypeError(f"unsupported coordinate type: {type(sub)}")
         if not self.fixed and not self.random:
             raise ValueError("model has no coordinates to serve")
+        self._parser = RequestParser(self.shard_dims, self.index_maps)
         self.buckets = self._bucket_ladder(self.config.max_batch_size)
         self._kernel = kernels_lib.build_bucket_kernel(self._mean_fn)
         self.batches = 0
@@ -364,70 +469,10 @@ class ScoringRuntime:
 
     # -- request parsing ---------------------------------------------------
     def parse_request(self, obj: dict) -> Row:
-        """Validate one JSON-shaped request into a :class:`Row`.
-
-        ``dense``: shard → full-width float list.  ``features``: shard →
-        named entries (``{"name", "term", "value"}`` dicts or
-        ``[name, term, value]`` triples) resolved through the saved index
-        map — unseen features drop, exactly like batch scoring.
-        """
-        if not isinstance(obj, dict):
-            raise ValueError("request must be a JSON object")
-        features: dict = {}
-        for shard, vec in (obj.get("dense") or {}).items():
-            dim = self.shard_dims.get(shard)
-            if dim is None:
-                raise ValueError(f"unknown feature shard {shard!r}")
-            arr = np.asarray(vec, np.float32)
-            if arr.shape != (dim,):
-                raise ValueError(
-                    f"shard {shard!r} expects {dim} features, got "
-                    f"{arr.shape}"
-                )
-            features[shard] = arr
-        for shard, entries in (obj.get("features") or {}).items():
-            dim = self.shard_dims.get(shard)
-            if dim is None:
-                raise ValueError(f"unknown feature shard {shard!r}")
-            imap = self.index_maps.get(shard)
-            if imap is None:
-                raise ValueError(
-                    f"shard {shard!r} has no saved index map; send "
-                    "'dense' features"
-                )
-            from photon_ml_tpu.data.index_map import feature_key
-
-            arr = features.get(shard)
-            if arr is None:
-                arr = np.zeros(dim, np.float32)
-            for e in entries:
-                if isinstance(e, dict):
-                    name, term, value = (
-                        e.get("name"), e.get("term", ""), e.get("value"),
-                    )
-                else:
-                    name, term, value = e
-                idx = imap.get_index(feature_key(str(name), str(term or "")))
-                if idx >= 0:
-                    arr[idx] = np.float32(value)
-            features[shard] = arr
-        ids = {}
-        for key, value in (obj.get("ids") or {}).items():
-            if value is not None:
-                ids[str(key)] = str(value)
-        timeout = obj.get("timeout_ms")
-        priority = obj.get("priority", "normal")
-        if priority not in PRIORITIES:
-            raise ValueError(
-                f"priority must be one of {PRIORITIES}, got {priority!r}"
-            )
-        return Row(
-            features=features,
-            ids=ids,
-            offset=float(obj.get("offset") or 0.0),
-            timeout_ms=None if timeout is None else float(timeout),
-            priority=priority,
-        )
+        """Validate one JSON-shaped request into a :class:`Row` — see
+        :meth:`RequestParser.parse` (the shared implementation both
+        serving modes use)."""
+        return self._parser.parse(obj)
 
     def probe_row(self) -> Row:
         """A minimal valid request (offset-only) — what health probes and

@@ -86,7 +86,9 @@ class ScoringService:
                 runtime, batcher_config, policy=policy
             )
         self.swapper = HotSwapper(
-            self._swap_targets, on_commit=self._on_swap_commit
+            self._swap_targets,
+            on_commit=self._on_swap_commit,
+            on_kill=self._on_swap_kill,
         )
         self._started = False
 
@@ -137,6 +139,17 @@ class ScoringService:
             )
         else:
             self.runtime = self.batcher.runtime
+
+    def _on_swap_kill(self, batcher, reason: str) -> None:
+        # Through the supervisor where there is one: kill_replica marks
+        # the replica down in the same call, so the rollback returns
+        # with supervisor state already reflecting the convergence kill.
+        if self.supervisor is not None:
+            self.supervisor.kill_batcher(batcher, reason)
+            return
+        kill = getattr(batcher, "kill", None)
+        if callable(kill):
+            kill(reason)
 
     def reload(
         self, model_dir: Optional[str] = None, rollback: bool = False

@@ -43,6 +43,16 @@ The supervisor intentionally mirrors ``ScoringService``'s surface
 (``submit`` / ``healthz`` / ``stats`` / ``start`` / ``stop``) so the
 service and HTTP layer compose with either a bare runtime or a
 supervisor — see serving/service.py and docs/serving.md.
+
+**Process mode**: pass ``pool=`` (a
+:class:`~photon_ml_tpu.serving.procpool.WorkerPool`) instead of
+``runtime_factory`` and every replica becomes an OS process mapping the
+pool's shared-memory model — same routing, probing, resubmission, and
+jittered-restart machinery, but the fault domain a probe failure or
+kill costs is a whole process, and ``kill_replica`` delivers a real
+SIGKILL.  The pool's :class:`ProcessReplica` duck-types the
+MicroBatcher surface the supervisor drives, so every seam below stays
+mode-agnostic.
 """
 
 from __future__ import annotations
@@ -104,7 +114,7 @@ class ReplicaSupervisor:
 
     def __init__(
         self,
-        runtime_factory: Callable[[], ScoringRuntime],
+        runtime_factory: Optional[Callable[[], ScoringRuntime]] = None,
         n_replicas: int = 2,
         batcher_config: Optional[BatcherConfig] = None,
         policy: Optional[RetryPolicy] = None,
@@ -114,10 +124,17 @@ class ReplicaSupervisor:
         probe_failure_threshold: int = 2,
         rng: Optional[random.Random] = None,
         clock: Callable[[], float] = time.monotonic,
+        pool=None,
     ):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        if (runtime_factory is None) == (pool is None):
+            raise ValueError(
+                "pass exactly one of runtime_factory (in-process "
+                "replicas) or pool (process workers)"
+            )
         self.runtime_factory = runtime_factory
+        self.pool = pool
         self.n_replicas = n_replicas
         self.batcher_config = batcher_config
         self.policy = policy or RetryPolicy()
@@ -159,11 +176,24 @@ class ReplicaSupervisor:
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
-        if self._probe_thread is not None:
-            self._probe_thread.join(timeout=timeout)
-            self._probe_thread = None
+        thread = self._probe_thread
+        self._probe_thread = None
+        if thread is not None:
+            thread.join(timeout=timeout)
         for rep in self.replicas:
             rep.batcher.stop(timeout=timeout)
+        if self.pool is not None:
+            self.pool.close(timeout=timeout)
+        if thread is not None and thread.is_alive():
+            # The supervision thread outlived the first join: a restart
+            # was mid-spawn when stop() began (a worker spawn takes
+            # seconds on a loaded box).  new_replica on the now-closed
+            # pool refuses — and a spawn that slipped past the close
+            # reaps itself at registration — so the thread exits
+            # promptly; sweep any batcher it installed before noticing.
+            thread.join(timeout=timeout)
+            for rep in self.replicas:
+                rep.batcher.stop(timeout=1.0)
         self._started = False
 
     def __enter__(self) -> "ReplicaSupervisor":
@@ -174,10 +204,15 @@ class ReplicaSupervisor:
         return False
 
     def _build_replica(self, rid: int) -> _Replica:
-        runtime = self.runtime_factory()
-        batcher = MicroBatcher(
-            runtime, self.batcher_config, policy=self.policy
-        ).start()
+        if self.pool is not None:
+            batcher = self.pool.new_replica(
+                rid, self.batcher_config, policy=self.policy
+            )
+        else:
+            runtime = self.runtime_factory()
+            batcher = MicroBatcher(
+                runtime, self.batcher_config, policy=self.policy
+            ).start()
         return _Replica(rid=rid, batcher=batcher)
 
     # -- routing (any thread) ------------------------------------------------
@@ -210,6 +245,11 @@ class ReplicaSupervisor:
         return runtime.parse_request(obj)
 
     def _any_runtime(self):
+        if self.pool is not None:
+            # Parsing is parent-side state in process mode (the pool's
+            # RequestParser) — no worker round-trip, and it stays
+            # available even while every worker is respawning.
+            return self.pool.runtime_view()
         # isinstance filter even on healthy replicas: a just-killed one
         # carries a poison _DeadRuntime for the instant before
         # _mark_down lands, and parsing against it would crash.
@@ -347,9 +387,33 @@ class ReplicaSupervisor:
         transiently — and therefore resubmit to peers — and the replica
         takes the normal drain → backoff → restart path."""
         rep = next(r for r in self.replicas if r.rid == rid)
-        rep.batcher.runtime = _DeadRuntime(reason)
+        kill = getattr(rep.batcher, "kill", None)
+        if callable(kill):
+            # Process mode: an actual SIGKILL.  The worker's death fails
+            # its in-flight rows transiently via the pipe EOF, which is
+            # the same resubmit-to-a-peer path the poison runtime fakes.
+            kill(reason)
+        else:
+            rep.batcher.runtime = _DeadRuntime(reason)
         self._mark_down(rep, reason)
         return rep
+
+    def kill_batcher(
+        self, batcher, reason: str = "scripted kill"
+    ) -> Optional[_Replica]:
+        """:meth:`kill_replica` by batcher identity — the swapper holds
+        batchers, not rids.  Killing through here (instead of
+        ``batcher.kill``) marks the replica down in the same call, so
+        health state never reports a converge-killed worker healthy."""
+        for rep in self.replicas:
+            if rep.batcher is batcher:
+                return self.kill_replica(rep.rid, reason)
+        # Not a current replica (already restarted past it): best-effort
+        # direct kill of the orphaned batcher.
+        kill = getattr(batcher, "kill", None)
+        if callable(kill):
+            kill(reason)
+        return None
 
     # -- supervision thread --------------------------------------------------
     def _probe_loop(self) -> None:
@@ -403,10 +467,16 @@ class ReplicaSupervisor:
     def _restart(self, rep: _Replica) -> None:
         tel = telemetry_mod.current()
         try:
-            runtime = self.runtime_factory()
-            batcher = MicroBatcher(
-                runtime, self.batcher_config, policy=self.policy
-            ).start()
+            if self.pool is not None:
+                batcher = self.pool.new_replica(
+                    rep.rid, self.batcher_config, policy=self.policy
+                )
+                runtime = batcher.runtime
+            else:
+                runtime = self.runtime_factory()
+                batcher = MicroBatcher(
+                    runtime, self.batcher_config, policy=self.policy
+                ).start()
         except Exception as exc:  # noqa: BLE001 — reschedule with backoff
             with self._lock:
                 delay = self.restart_policy.backoff(
@@ -454,6 +524,12 @@ class ReplicaSupervisor:
         NOW-SERVING version, so rebuild the replica factory around the
         committed model.  (A restart racing the commit window may build
         the prior version; its next swap or kill converges it.)"""
+        if self.pool is not None:
+            # Process mode: restarts attach the pool's CURRENT
+            # generation, which the swapper already advanced via
+            # commit_generation — there is no factory to rebuild.
+            return
+
         def factory() -> ScoringRuntime:
             rt = ScoringRuntime(model, index_maps, config)
             rt.model_version = version

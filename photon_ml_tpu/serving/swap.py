@@ -42,6 +42,25 @@ Chaos: the ``serving.swap`` site is touched at stages ``load`` /
 ``prepare`` / ``verify`` (occurrences 0/1/2 per swap attempt), so a
 FaultPlan can script both the abort path (pre-commit) and the rollback
 path (post-commit) — see docs/robustness.md.
+
+**Process mode** (targets are :class:`~photon_ml_tpu.serving.procpool.
+ProcessReplica` stubs): the same four stages run over the worker swap
+protocol.  Load publishes the new model ONCE into shared memory as a
+staged pool generation; prepare asks each worker to attach + warm +
+probe it off its request path (``swap_prepare``); commit is each
+worker's own GIL-atomic ``batcher.runtime`` assignment
+(``swap_commit``); verify scores through each worker's real dispatch
+path from the parent.  Failure anywhere unwinds: staged attachments are
+aborted, committed workers ``swap_rollback``, and the staged segments
+are unlinked.  Success promotes the generation
+(``pool.commit_generation`` — the last TWO generations stay linked, so
+a worker respawned mid-window can still attach) and manual rollback
+walks workers back one step and restores the prior generation.  A
+worker RESTARTED after the commit attached the new generation directly
+and holds no worker-side previous; rollback detects that (the worker
+answers ``rolled_back: false``) and kills it, so it respawns on the
+restored generation — convergence costs one restart, never a wrong
+version left serving.
 """
 
 from __future__ import annotations
@@ -98,10 +117,19 @@ class HotSwapper:
         self,
         targets_fn: Callable[[], Sequence],
         on_commit: Optional[Callable] = None,
+        on_kill: Optional[Callable] = None,
         probe_timeout_s: float = 30.0,
     ):
         self._targets_fn = targets_fn
         self._on_commit = on_commit
+        #: convergence-kill hook: called with (target, reason) when the
+        #: rollback must kill a worker that holds no retained previous.
+        #: A supervisor-backed service routes this through kill_replica
+        #: so the mark-down is SYNCHRONOUS with the rollback — healthz
+        #: never reports the converge-killed worker healthy, and a
+        #: caller that awaits health after rollback() waits for the
+        #: respawn instead of racing stale state.
+        self._on_kill = on_kill
         self.probe_timeout_s = probe_timeout_s
         self._swap_lock = sanitizers.tracked(
             threading.Lock(), "serving.swap"
@@ -116,6 +144,10 @@ class HotSwapper:
         self.model_path: Optional[str] = None
         #: (target, previous_runtime) pairs retained for one-step rollback.
         self._previous: list[tuple] = []
+        #: process-mode rollback token: (pool, version_before) after a
+        #: successful remote swap (the runtimes to restore live in the
+        #: workers and the pool's generation list, not here).
+        self._remote_previous: Optional[tuple] = None
         self.swaps = 0
         self.rollbacks = 0
         self.deferred = 0
@@ -139,7 +171,8 @@ class HotSwapper:
             "swaps": self.swaps,
             "rollbacks": self.rollbacks,
             "deferred": self.deferred,
-            "can_rollback": bool(self._previous),
+            "can_rollback": bool(self._previous)
+            or self._remote_previous is not None,
         }
 
     # -- the swap state machine ----------------------------------------------
@@ -203,6 +236,15 @@ class HotSwapper:
                 targets=len(targets),
             )
 
+        if hasattr(targets[0], "swap_prepare"):
+            # Process mode: the targets are worker stubs; roll them via
+            # the cross-process swap protocol and the pool's
+            # shared-memory generations.
+            return self._swap_remote(
+                targets, model_path, runtime_config,
+                version_before, new_version,
+            )
+
         # Stage 1+2: load + prepare, entirely off the request path — the
         # old runtimes keep serving while this thread builds and warms.
         stage = "load"
@@ -262,6 +304,7 @@ class HotSwapper:
         self._max_version = new_version
         self.model_path = model_path
         self._previous = previous
+        self._remote_previous = None
         self.swaps += 1
         tel.counter("serving_swaps_total").inc()
         tel.gauge("serving_model_version").set(new_version)
@@ -276,6 +319,111 @@ class HotSwapper:
             sample = fresh[0]
             self._on_commit(
                 model, index_maps, sample.config, new_version, model_path
+            )
+        return SwapResult(
+            status="swapped",
+            version_before=version_before,
+            version_after=new_version,
+            model_path=model_path,
+            targets=len(targets),
+        )
+
+    def _swap_remote(
+        self,
+        targets: list,
+        model_path: str,
+        runtime_config: Optional[RuntimeConfig],
+        version_before: int,
+        new_version: int,
+    ) -> SwapResult:
+        """The four swap stages over the worker protocol.  Same chaos
+        occurrences (load=0, prepare=1, verify=2) so every scripted
+        FaultPlan written against in-process swaps scripts this path
+        identically."""
+        tel = telemetry_mod.current()
+        pool = targets[0].pool
+        generation = None
+        prepared: list = []
+        stage = "load"
+        try:
+            chaos_mod.maybe_fail(
+                "serving.swap", stage="load", path=model_path
+            )
+            model, index_maps = ScoringRuntime.load_model(model_path)
+            # ONE shared-memory publication for the whole pool; workers
+            # attach it zero-copy during prepare.
+            generation = pool.publish(
+                model, index_maps, version=new_version, path=model_path
+            )
+            stage = "prepare"
+            for t in targets:
+                t.swap_prepare(generation.manifest, runtime_config)
+                prepared.append(t)
+            chaos_mod.maybe_fail("serving.swap", stage="prepare")
+        except Exception as exc:  # noqa: BLE001 — abort, old version serves
+            for t in prepared:
+                t.swap_abort(new_version)
+            if generation is not None:
+                pool.retire_generation(generation)
+            return self._rolled_back(
+                version_before, model_path, stage,
+                f"{type(exc).__name__}: {exc}"[:300], len(targets),
+            )
+
+        committed: list = []
+        try:
+            for t in targets:
+                t.swap_commit(new_version)
+                committed.append(t)
+            chaos_mod.maybe_fail("serving.swap", stage="verify")
+            for t in targets:
+                fut = t.submit(
+                    generation.parser.probe_row(), bypass_admission=True
+                )
+                result = fut.result(timeout=self.probe_timeout_s)
+                if not np.isfinite(result["score"]):
+                    raise ValueError(
+                        "post-swap probe returned a non-finite score"
+                    )
+        except Exception as exc:  # noqa: BLE001 — roll back, then report
+            for t in committed:
+                try:
+                    t.swap_rollback()
+                except Exception:  # noqa: BLE001 — dead worker respawns
+                    pass           # on the still-current old generation
+            for t in targets:
+                if t not in committed:
+                    t.swap_abort(new_version)
+            pool.retire_generation(generation)
+            return self._rolled_back(
+                version_before, model_path, "verify",
+                f"{type(exc).__name__}: {exc}"[:300], len(targets),
+            )
+
+        # Promote: new generation becomes what restarts attach; the old
+        # one stays linked as the rollback window.
+        pool.commit_generation(generation)
+        self.version = new_version
+        self._max_version = new_version
+        self.model_path = model_path
+        self._previous = []
+        self._remote_previous = (pool, version_before)
+        self.swaps += 1
+        tel.counter("serving_swaps_total").inc()
+        tel.gauge("serving_model_version").set(new_version)
+        tel.event(
+            "serving.swap",
+            version_before=version_before,
+            version_after=new_version,
+            model_path=model_path,
+            targets=len(targets),
+            mode="process",
+        )
+        if self._on_commit is not None:
+            self._on_commit(
+                model, index_maps,
+                runtime_config or pool.runtime_config,
+                new_version, model_path,
             )
         return SwapResult(
             status="swapped",
@@ -325,6 +473,8 @@ class HotSwapper:
             )
         try:
             self.in_progress = True
+            if self._remote_previous is not None:
+                return self._rollback_remote()
             if not self._previous:
                 return SwapResult(
                     status="rolled_back",
@@ -370,3 +520,54 @@ class HotSwapper:
         finally:
             self.in_progress = False
             self._swap_lock.release()
+
+    def _rollback_remote(self) -> SwapResult:
+        """Process-mode manual rollback: each worker restores its
+        retained previous runtime, then the pool drops the rolled-back
+        generation so restarts attach the restored one.  (No
+        ``on_commit`` call — the supervisor's commit hook is a no-op in
+        pool mode, and the restored model object lives only in the
+        workers.)"""
+        pool, _ = self._remote_previous
+        self._remote_previous = None
+        version_before = self.version
+        targets = list(self._targets_fn())
+        stale: list = []
+        for t in targets:
+            try:
+                if not t.swap_rollback():
+                    # Restarted after the commit: no worker-side
+                    # previous to restore.  Converge it below.
+                    stale.append(t)
+            except Exception:  # noqa: BLE001 — a dead worker respawns
+                pass           # on the restored generation below
+        restored = pool.rollback_generation()
+        for t in stale:
+            reason = "no retained previous; respawn on restored generation"
+            if self._on_kill is not None:
+                self._on_kill(t, reason)
+            else:
+                t.kill(reason)
+        self.version = restored.version
+        self.model_path = restored.path
+        self.rollbacks += 1
+        tel = telemetry_mod.current()
+        tel.counter("serving_rollbacks_total").inc()
+        tel.gauge("serving_model_version").set(self.version)
+        tel.event(
+            "serving.rollback",
+            stage="manual",
+            reason="operator-requested rollback",
+            model_path=self.model_path,
+            version=self.version,
+            mode="process",
+        )
+        return SwapResult(
+            status="rolled_back",
+            version_before=version_before,
+            version_after=self.version,
+            model_path=self.model_path,
+            stage="manual",
+            reason="operator-requested rollback",
+            targets=len(targets),
+        )

@@ -1,0 +1,362 @@
+"""Worker process main for process-level serving replicas.
+
+One worker = one OS process with its own fault domain: it attaches the
+pool's shared-memory model (zero-copy, checksum-verified —
+serving/shm_model.py), runs a private :class:`ScoringRuntime` +
+:class:`MicroBatcher`, and speaks the length-prefixed frame protocol
+(serving/protocol.py) over the socketpair its parent spawned it with.
+A native crash, an OOM kill, or a SIGKILL here costs exactly one
+worker; the parent's :class:`~photon_ml_tpu.serving.procpool.
+ProcessReplica` fails the in-flight rows with the watchdog's transient
+vocabulary and the supervisor resubmits them to a peer.
+
+Frames the worker understands (parent → worker)::
+
+    score         {id, row, timeout_ms, bypass}  → result {id, ok, ...}
+    stats         {id}                           → result {id, ok, value}
+    swap_prepare  {manifest, runtime_config?}    → swap_ready | swap_failed
+    swap_commit   {version}                      → swap_done
+    swap_rollback {}                             → swap_done
+    swap_abort    {version}                      (no reply)
+    shutdown      {}                             → bye (after drain)
+
+and emits unprompted ``heartbeat`` frames every
+``heartbeat_interval_s``: liveness + queue depth + model version + a
+mergeable :meth:`~photon_ml_tpu.telemetry.core.MetricsRegistry.
+transport_snapshot` of the worker's private metrics registry, which the
+parent folds into its own registry so /metrics and the admission tiers
+keep a pool-wide view.
+
+Swap discipline (the cross-process half of serving/swap.py): prepare
+attaches + warms the staged model on a helper thread (the recv loop
+keeps answering scores and probes — a seconds-long warmup must not read
+as replica death), commit is the same GIL-atomic ``batcher.runtime``
+assignment as in-process serving and retains the previous runtime for
+exactly one-step rollback.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.serving import shm_model
+from photon_ml_tpu.serving.batcher import (
+    BatcherConfig,
+    DeadlineExceededError,
+    MicroBatcher,
+    RejectedError,
+)
+from photon_ml_tpu.serving.protocol import FrameConn
+from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+
+__all__ = ["worker_main"]
+
+
+def _pin_platform() -> None:
+    """Honor JAX_PLATFORMS before any kernel work: spawned children
+    re-import jax, and an installed accelerator plugin would otherwise
+    win platform selection even with the env var set."""
+    platform = os.environ.get("JAX_PLATFORMS")
+    if not platform:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    except Exception:  # noqa: BLE001 — env pinning is best-effort
+        pass
+
+
+def _error_kind(exc: BaseException) -> str:
+    """Collapse a scoring failure to the protocol's error taxonomy so
+    the parent can reconstruct the SAME exception type — the supervisor
+    type-checks RejectedError/DeadlineExceededError when deciding
+    resubmit-vs-fail."""
+    if isinstance(exc, RejectedError):
+        return "rejected"
+    if isinstance(exc, DeadlineExceededError):
+        return "deadline"
+    return "other"
+
+
+class _WorkerMain:
+    def __init__(
+        self,
+        conn: FrameConn,
+        manifest: dict,
+        worker_id: int,
+        runtime_config: Optional[RuntimeConfig],
+        batcher_config: Optional[BatcherConfig],
+        heartbeat_interval_s: float,
+    ):
+        self._conn = conn
+        self._worker_id = int(worker_id)
+        self._runtime_config = runtime_config or RuntimeConfig()
+        self._batcher_config = batcher_config or BatcherConfig()
+        self._heartbeat_interval_s = float(heartbeat_interval_s)
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._prepare_thread: Optional[threading.Thread] = None
+        # Swap state: version -> (runtime, attachment) staged by prepare;
+        # exactly one (runtime, attachment, version) retained for
+        # one-step rollback after a commit.
+        self._prepared: dict = {}
+        self._previous: Optional[Tuple] = None
+        model, attachment = shm_model.attach_model(manifest)
+        self._runtime = ScoringRuntime(model, {}, self._runtime_config)
+        self._runtime.model_version = int(manifest["version"])
+        self._runtime.model_path = manifest.get("path")
+        self._attachment = attachment
+        self._batcher = MicroBatcher(
+            self._runtime, self._batcher_config
+        ).start()
+
+    # -- plumbing ----------------------------------------------------------
+    def _send(self, message: dict) -> None:
+        try:
+            self._conn.send(message)
+        except Exception:  # noqa: BLE001 — parent gone; wind down
+            self._stop.set()
+
+    def _send_result(self, request_id, future) -> None:
+        exc = future.exception()
+        if exc is None:
+            self._send({
+                "kind": "result", "id": request_id,
+                "ok": True, "value": future.result(),
+            })
+        else:
+            self._send({
+                "kind": "result", "id": request_id, "ok": False,
+                "error": str(exc), "error_kind": _error_kind(exc),
+            })
+
+    # -- heartbeats --------------------------------------------------------
+    def _heartbeat_once(self) -> None:
+        runtime = self._batcher.runtime
+        self._send({
+            "kind": "heartbeat",
+            "worker": self._worker_id,
+            "pid": os.getpid(),
+            "queue_depth": self._batcher.queue_depth,
+            "model_version": getattr(runtime, "model_version", 1),
+            "degraded": getattr(runtime, "degraded", False),
+            "ready": getattr(runtime, "ready", False),
+            "metrics": telemetry_mod.current().metrics.transport_snapshot(),
+        })
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self._heartbeat_interval_s):
+            self._heartbeat_once()
+
+    # -- swap protocol -----------------------------------------------------
+    def _do_prepare(self, manifest: dict, runtime_config) -> None:
+        version = int(manifest.get("version", 0))
+        try:
+            model, attachment = shm_model.attach_model(manifest)
+            runtime = ScoringRuntime(
+                model, {}, runtime_config or self._runtime_config
+            )
+            runtime.model_version = version
+            runtime.model_path = manifest.get("path")
+            margins, _ = runtime.score_rows([runtime.probe_row()])
+            if not np.isfinite(margins[0]):
+                raise ValueError(
+                    f"staged v{version} probe scored non-finite "
+                    f"{margins[0]!r}"
+                )
+        except Exception as exc:  # noqa: BLE001 — verdict crosses the pipe
+            self._send({
+                "kind": "swap_failed", "version": version,
+                "error": f"{type(exc).__name__}: {exc}",
+            })
+            return
+        old = self._prepared.pop(version, None)
+        if old is not None:
+            old[1].close()
+        self._prepared[version] = (runtime, attachment)
+        self._send({"kind": "swap_ready", "version": version})
+
+    def _handle_swap_prepare(self, msg: dict) -> None:
+        if self._prepare_thread is not None:
+            self._prepare_thread.join()
+        self._prepare_thread = threading.Thread(
+            target=self._do_prepare,
+            args=(msg["manifest"], msg.get("runtime_config")),
+            name=f"worker-{self._worker_id}-swap-prepare",
+            daemon=True,
+        )
+        self._prepare_thread.start()
+
+    def _handle_swap_commit(self, msg: dict) -> None:
+        version = int(msg["version"])
+        runtime, attachment = self._prepared.pop(version)
+        if self._previous is not None:
+            self._previous[1].close()
+        self._previous = (
+            self._batcher.runtime, self._attachment,
+            getattr(self._batcher.runtime, "model_version", 1),
+        )
+        # Same commit point as in-process swaps: one GIL-atomic
+        # attribute write; the next dispatch scores on the new model.
+        self._batcher.runtime = runtime
+        self._attachment = attachment
+        self._send({"kind": "swap_done", "version": version})
+
+    def _handle_swap_rollback(self) -> None:
+        if self._previous is None:
+            self._send({
+                "kind": "swap_done",
+                "version": getattr(self._batcher.runtime, "model_version", 1),
+                "rolled_back": False,
+            })
+            return
+        runtime, attachment, version = self._previous
+        self._previous = None
+        retired_attachment = self._attachment
+        self._batcher.runtime = runtime
+        self._attachment = attachment
+        retired_attachment.close()
+        self._send({
+            "kind": "swap_done", "version": version, "rolled_back": True,
+        })
+
+    def _handle_swap_abort(self, msg: dict) -> None:
+        staged = self._prepared.pop(int(msg["version"]), None)
+        if staged is not None:
+            staged[1].close()
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> None:
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop,
+            name=f"worker-{self._worker_id}-heartbeat",
+            daemon=True,
+        )
+        self._hb_thread.start()
+        self._send({
+            "kind": "ready",
+            "worker": self._worker_id,
+            "pid": os.getpid(),
+            "model_version": self._runtime.model_version,
+        })
+        clean = False
+        try:
+            while not self._stop.is_set():
+                message = self._conn.recv()
+                if message is None:
+                    break  # parent closed; wind down without a bye
+                kind = message.get("kind")
+                if kind == "score":
+                    self._handle_score(message)
+                elif kind == "stats":
+                    self._send({
+                        "kind": "result", "id": message.get("id"),
+                        "ok": True, "value": self._stats(),
+                    })
+                elif kind == "swap_prepare":
+                    self._handle_swap_prepare(message)
+                elif kind == "swap_commit":
+                    self._handle_swap_commit(message)
+                elif kind == "swap_rollback":
+                    self._handle_swap_rollback()
+                elif kind == "swap_abort":
+                    self._handle_swap_abort(message)
+                elif kind == "shutdown":
+                    clean = True
+                    break
+        except Exception:  # noqa: BLE001 — desynced stream = wind down
+            pass
+        finally:
+            self._stop.set()
+            if self._prepare_thread is not None:
+                self._prepare_thread.join(timeout=5.0)
+            if self._hb_thread is not None:
+                self._hb_thread.join(timeout=5.0)
+            # Graceful drain: everything already admitted dispatches;
+            # raced rows fail with the transient stopped-batcher verdict
+            # the parent resubmits.
+            self._batcher.stop()
+            if clean:
+                try:
+                    self._conn.send({"kind": "bye"})
+                except Exception:  # noqa: BLE001 — parent may be gone
+                    pass
+            self._conn.close()
+            for staged in self._prepared.values():
+                staged[1].close()
+            if self._previous is not None:
+                self._previous[1].close()
+            self._attachment.close()
+
+    def _handle_score(self, message: dict) -> None:
+        request_id = message.get("id")
+        try:
+            future = self._batcher.submit(
+                message["row"],
+                timeout_ms=message.get("timeout_ms"),
+                bypass_admission=bool(message.get("bypass")),
+            )
+        except Exception as exc:  # noqa: BLE001 — sync admission verdict
+            self._send({
+                "kind": "result", "id": request_id, "ok": False,
+                "error": str(exc), "error_kind": _error_kind(exc),
+            })
+            return
+        future.add_done_callback(partial(self._send_result, request_id))
+
+    def _stats(self) -> dict:
+        stats = self._batcher.stats()
+        stats["worker"] = self._worker_id
+        stats["pid"] = os.getpid()
+        runtime = self._batcher.runtime
+        if isinstance(runtime, ScoringRuntime):
+            stats["runtime"] = runtime.stats()
+        return stats
+
+
+def worker_main(
+    sock,
+    manifest: dict,
+    worker_id: int,
+    runtime_config=None,
+    batcher_config=None,
+    heartbeat_interval_s: float = 0.25,
+) -> None:
+    """Spawn target (module-level so the spawn pickler can import it).
+
+    Installs a private enabled telemetry hub (sink-less: metrics only —
+    the parent's heartbeat merge is this process's event stream),
+    attaches the shared model, and serves frames until shutdown/EOF.
+    Startup failures are reported as a ``fatal`` frame so the parent's
+    spawn raises a pointed error instead of timing out.
+    """
+    _pin_platform()
+    conn = FrameConn(sock)
+    hub = telemetry_mod.Telemetry(
+        enabled=True, sinks=[], run_name=f"serving-worker-{worker_id}"
+    )
+    telemetry_mod.set_current(hub)
+    try:
+        main = _WorkerMain(
+            conn, manifest, worker_id,
+            runtime_config, batcher_config, heartbeat_interval_s,
+        )
+    except BaseException as exc:  # noqa: BLE001 — verdict crosses the pipe
+        try:
+            conn.send({
+                "kind": "fatal",
+                "worker": worker_id,
+                "error": f"{type(exc).__name__}: {exc}",
+            })
+        except Exception:  # noqa: BLE001
+            pass
+        conn.close()
+        raise SystemExit(1)
+    main.run()

@@ -202,6 +202,46 @@ class Histogram:
                 "p99": self._quantile_locked(0.99),
             }
 
+    def transport(self) -> dict:
+        """Raw cross-process form: exact state INCLUDING the bucket
+        vector.  :meth:`summary` interpolates quantiles and cannot be
+        merged; this can — serving worker processes ship it over their
+        metrics pipe and the parent folds it in with
+        :meth:`absorb_delta`."""
+        with self._lock:
+            return {
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min,
+                "max": self.max,
+                "last": self.last,
+                "buckets": list(self._buckets),
+            }
+
+    def absorb_delta(self, new: dict, prev: Optional[dict] = None) -> None:
+        """Fold another process's :meth:`transport` state in as a delta
+        against ``prev`` (the previous snapshot absorbed from the same
+        source): count/sum/buckets add their increments, min/max merge,
+        last adopts the source's latest.  The sender's state is
+        cumulative, so a dropped snapshot loses nothing — the next one
+        carries the missed increments."""
+        prev = prev or {}
+        prev_buckets = prev.get("buckets")
+        with self._lock:
+            self.count += new["count"] - prev.get("count", 0)
+            self.sum += new["sum"] - prev.get("sum", 0.0)
+            for i, c in enumerate(new["buckets"]):
+                self._buckets[i] += c - (prev_buckets[i] if prev_buckets
+                                         else 0)
+            if new["min"] is not None:
+                self.min = (new["min"] if self.min is None
+                            else min(self.min, new["min"]))
+            if new["max"] is not None:
+                self.max = (new["max"] if self.max is None
+                            else max(self.max, new["max"]))
+            if new["last"] is not None:
+                self.last = new["last"]
+
 
 class _NullMetric:
     """Shared no-op metric: one attribute call and out."""
@@ -278,6 +318,45 @@ class MetricsRegistry:
             "gauges": gauges,
             "histograms": {k: h.summary() for k, h in hists.items()},
         }
+
+    def transport_snapshot(self) -> dict:
+        """Mergeable cross-process snapshot: counter/gauge values plus
+        each histogram's raw :meth:`Histogram.transport` state
+        (:meth:`snapshot`'s summaries interpolate quantiles and cannot
+        be merged).  Serving worker processes ship this over their
+        heartbeat pipe; the parent registry folds it in with
+        :meth:`absorb_delta`, so /metrics, /stats, and the admission
+        tiers see one pool-wide view."""
+        with self._lock:
+            counters = {k: c.value for k, c in self._counters.items()}
+            gauges = {k: json_safe(g.value) for k, g in self._gauges.items()}
+            hists = dict(self._histograms)
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {k: h.transport() for k, h in hists.items()},
+        }
+
+    def absorb_delta(self, new: dict, prev: Optional[dict] = None) -> None:
+        """Merge another registry's :meth:`transport_snapshot`: counters
+        add the increment since ``prev`` (the previous snapshot absorbed
+        from the SAME source), gauges adopt the source's latest value,
+        histograms fold their bucket deltas.  Senders keep cumulative
+        state, so the merge is loss-tolerant and idempotent per
+        (snapshot, prev) pair."""
+        if not self.enabled:
+            return
+        prev = prev or {}
+        prev_counters = prev.get("counters", {})
+        for name, value in (new.get("counters") or {}).items():
+            delta = value - prev_counters.get(name, 0)
+            if delta:
+                self.counter(name).inc(delta)
+        for name, value in (new.get("gauges") or {}).items():
+            self.gauge(name).set(value)
+        prev_hists = prev.get("histograms", {})
+        for name, state in (new.get("histograms") or {}).items():
+            self.histogram(name).absorb_delta(state, prev_hists.get(name))
 
 
 # ---------------------------------------------------------------------------

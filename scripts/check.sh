@@ -37,6 +37,15 @@ python -m photon_ml_tpu.analysis --check
 echo "== serving selfcheck (JAX_PLATFORMS=cpu) =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.serving --selfcheck
 
+# The process-mode serving selfcheck runs the same contracts against
+# crash-isolated worker PROCESSES on one shared-memory model: score
+# parity with in-process scoring, a real SIGKILL under open-loop load
+# with zero failed requests, a cross-process hot swap + rollback
+# (bit-identical), single-publication segment accounting, and a
+# leak-free shutdown under a strict ProcessLeakSentinel.
+echo "== serving process-mode selfcheck (JAX_PLATFORMS=cpu) =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.serving --selfcheck --workers 2
+
 # The tuning selfcheck runs a parallel ASHA+GP search on a synthetic
 # GAME workload, kills it mid-flight, resumes from tuning_state.jsonl,
 # and asserts the resumed trial history + journal decision sequence are
@@ -65,6 +74,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     tests/test_telemetry.py tests/test_ops_plane.py \
     tests/test_watchdog.py \
     tests/test_serving.py tests/test_serving_ha.py \
+    tests/test_serving_proc.py \
     tests/test_tuning.py tests/test_chaos.py \
     "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
     -m 'not slow' -q -p no:cacheprovider

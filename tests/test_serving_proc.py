@@ -1,0 +1,631 @@
+"""Process-level serving workers (ISSUE 11).
+
+The load-bearing contracts:
+
+- N worker processes attach ONE shared-memory model publication and
+  score bit-identically to an in-process runtime;
+- a real SIGKILL of a worker mid-stream costs ZERO failed requests
+  (socket EOF -> transient failure -> supervisor resubmission) and the
+  worker respawns;
+- the ``serving.worker`` chaos site kills the routed worker for real,
+  so the scripted crash exercises the actual death path;
+- a cross-process hot swap is bit-identical on both sides, and a
+  rollback converges even when a worker restarted after the commit has
+  no retained previous runtime (one extra restart, never a wrong
+  version left serving);
+- shared-memory attach is verify-or-die: a flipped segment byte or a
+  torn/tampered manifest raises ``ModelMapError`` and counts
+  ``model_map_unverified_total`` — never a silent partial map;
+- shutdown leaks neither processes (strict ``ProcessLeakSentinel``)
+  nor shared segments (``live_segments() == []``).
+"""
+
+import os
+import socket
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import chaos
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.io.game_store import save_game_model
+from photon_ml_tpu.serving import loadgen, shm_model
+from photon_ml_tpu.serving.batcher import BatcherConfig
+from photon_ml_tpu.serving.protocol import (
+    FrameConn,
+    MAX_FRAME_BYTES,
+    ProtocolError,
+)
+from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+from photon_ml_tpu.serving.procpool import WorkerPool
+from photon_ml_tpu.serving.service import ScoringService
+from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+
+@pytest.fixture(scope="module")
+def workload():
+    return SyntheticWorkload(n_entities=32, seed=7)
+
+
+@pytest.fixture(scope="module")
+def workload_v2():
+    # Same shard shapes, different coefficients: one request stream
+    # valid on both versions, scoring differently.
+    return SyntheticWorkload(n_entities=32, seed=8)
+
+
+RT_CFG = dict(max_batch_size=8, hot_entities=8)
+
+
+def _reference(workload, requests):
+    runtime = ScoringRuntime(
+        workload.model, workload.index_maps, RuntimeConfig(**RT_CFG)
+    )
+    return np.asarray(
+        [
+            runtime.score_rows([runtime.parse_request(r)])[0][0]
+            for r in requests
+        ],
+        np.float32,
+    )
+
+
+def _wait_until(predicate, timeout_s=60.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+@pytest.fixture(scope="module")
+def hub():
+    """Metrics-only telemetry hub for the whole module (the pool folds
+    worker heartbeat metrics into the CURRENT hub)."""
+    prev = telemetry.current()
+    tel = telemetry.Telemetry(enabled=True, sinks=[])
+    telemetry.set_current(tel)
+    yield tel
+    telemetry.set_current(prev)
+
+
+@pytest.fixture(scope="module")
+def proc(hub, workload):
+    """One 2-worker pool + supervisor + service shared by the spawn
+    tests below; every test restores the state it perturbs (kills wait
+    for respawn, the swap test rolls back), so ordering is free."""
+    pool = WorkerPool(
+        workload.model, workload.index_maps,
+        runtime_config=RuntimeConfig(**RT_CFG), version=1,
+    )
+    # Generous probe budget: on a 1-CPU container a neighboring test's
+    # worker spawns can stall THIS pool's probe round-trips past the
+    # default timeout and restart a healthy worker mid-test.  A real
+    # kill is still detected instantly (submit to a dead worker raises,
+    # in-flight rows fail on the pipe EOF), so respawns stay fast.
+    supervisor = ReplicaSupervisor(
+        pool=pool, n_replicas=2, probe_interval_s=0.05,
+        probe_timeout_s=60.0, probe_failure_threshold=5,
+    )
+    service = ScoringService(supervisor, BatcherConfig(
+        max_batch_size=8, max_wait_us=2_000, max_queue=256,
+    ))
+    with service:
+        yield types.SimpleNamespace(
+            pool=pool, supervisor=supervisor, service=service
+        )
+
+
+# ---------------------------------------------------------------------------
+# Worker pool: parity, SIGKILL, chaos, accounting
+# ---------------------------------------------------------------------------
+
+class TestWorkerPool:
+    def test_scores_bit_identical_to_in_process(self, proc, workload):
+        requests = [workload.request(i) for i in range(24)]
+        expected = _reference(workload, requests)
+        futures = [proc.service.submit(r) for r in requests]
+        got = np.asarray(
+            [np.float32(f.result(timeout=60)["score"]) for f in futures],
+            np.float32,
+        )
+        assert got.tobytes() == expected.tobytes()
+
+    def test_sigkill_mid_stream_zero_failed_requests(
+        self, proc, workload
+    ):
+        sup = proc.supervisor
+        assert _wait_until(lambda: sup.healthy_count == 2), sup.stats()
+        restarts_before = sum(
+            r["restarts"] for r in sup.stats()["replicas"]
+        )
+        requests = [workload.request(i) for i in range(48)]
+        futures = [proc.service.submit(r) for r in requests[:24]]
+        sup.kill_replica(0)  # SIGKILL: a real process dies mid-batch
+        futures += [proc.service.submit(r) for r in requests[24:]]
+        results = [f.result(timeout=60) for f in futures]
+        assert all(np.isfinite(r["score"]) for r in results)
+        assert _wait_until(lambda: sup.healthy_count == 2), sup.stats()
+        assert sum(
+            r["restarts"] for r in sup.stats()["replicas"]
+        ) == restarts_before + 1
+
+    def test_sigkill_under_open_loop_load_zero_errors(
+        self, proc, workload
+    ):
+        sup = proc.supervisor
+        assert _wait_until(lambda: sup.healthy_count == 2), sup.stats()
+        killer = threading.Timer(0.3, lambda: sup.kill_replica(1))
+        killer.start()
+        report = loadgen.open_loop(
+            proc.service.submit, workload.request,
+            rate_rps=120.0, duration_s=1.5,
+        )
+        killer.join()
+        assert report.errors == 0, report.snapshot()
+        assert report.rejected == 0, report.snapshot()
+        assert report.completed > 50
+        assert _wait_until(lambda: sup.healthy_count == 2), sup.stats()
+
+    def test_chaos_worker_site_kills_for_real_and_reroutes(
+        self, proc, workload
+    ):
+        sup = proc.supervisor
+        assert _wait_until(lambda: sup.healthy_count == 2), sup.stats()
+        def pids():
+            return {
+                getattr(r.batcher.runtime, "pid", None)
+                for r in sup.replicas
+            }
+
+        pids_before = pids()
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="serving.worker", at=0),
+        ])
+        with plan:
+            result = proc.service.submit(
+                workload.request(0)
+            ).result(timeout=60)
+        assert np.isfinite(result["score"])
+        assert plan.fired and plan.fired[0]["site"] == "serving.worker"
+        assert _wait_until(lambda: sup.healthy_count == 2), sup.stats()
+        pids_after = pids()
+        # The scripted fault SIGKILLed a real process: one pid changed.
+        assert pids_after != pids_before
+
+    def test_shared_segments_mapped_once_not_per_worker(
+        self, proc, hub
+    ):
+        published = sum(
+            seg["nbytes"]
+            for gen in proc.pool._generations
+            for seg in gen.manifest["segments"].values()
+        )
+        assert published > 0
+        gauge = hub.snapshot()["gauges"].get(
+            "serving_shared_segment_bytes"
+        )
+        assert gauge == published  # one publication, not x workers
+
+    def test_worker_metrics_fold_into_parent_registry(
+        self, proc, workload, hub
+    ):
+        before = hub.snapshot()["counters"].get(
+            "serving_requests_total", 0
+        )
+        futures = [
+            proc.service.submit(workload.request(i)) for i in range(8)
+        ]
+        for f in futures:
+            f.result(timeout=60)
+        # Heartbeats carry worker-side counter deltas at
+        # heartbeat_interval_s; give two intervals.
+        assert _wait_until(
+            lambda: hub.snapshot()["counters"].get(
+                "serving_requests_total", 0
+            ) >= before + 8,
+            timeout_s=10.0,
+        ), hub.snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# Cross-process hot swap + rollback
+# ---------------------------------------------------------------------------
+
+class TestProcessSwap:
+    def test_swap_and_rollback_bit_identical_with_convergence(
+        self, proc, workload, workload_v2, tmp_path
+    ):
+        v2_dir = str(tmp_path / "v2")
+        save_game_model(workload_v2.model, workload_v2.index_maps, v2_dir)
+        requests = [workload.request(i) for i in range(16)]
+        ref_v1 = _reference(workload, requests)
+        ref_v2 = _reference(workload_v2, requests)
+        sup, service = proc.supervisor, proc.service
+        assert _wait_until(lambda: sup.healthy_count == 2), sup.stats()
+        version_before = service.swapper.version
+
+        def scores():
+            futures = [service.submit(r) for r in requests]
+            return np.asarray(
+                [
+                    np.float32(f.result(timeout=60)["score"])
+                    for f in futures
+                ],
+                np.float32,
+            )
+
+        result = service.reload(v2_dir)
+        assert result.status == "swapped", result
+        assert service.swapper.version == version_before + 1
+        assert scores().tobytes() == ref_v2.tobytes()
+
+        # A worker killed AFTER the commit respawns attached to v2 and
+        # retains no previous runtime; the rollback below must still
+        # converge (that worker is respawned on the restored
+        # generation — one extra restart, never a wrong version).
+        sup.kill_replica(1, "post-swap kill")
+        assert _wait_until(lambda: sup.healthy_count == 2), sup.stats()
+
+        rolled = service.reload(rollback=True)
+        assert rolled.status == "rolled_back", rolled
+        assert _wait_until(lambda: sup.healthy_count == 2), sup.stats()
+        assert scores().tobytes() == ref_v1.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Clean shutdown: no leaked processes, no leaked segments
+# ---------------------------------------------------------------------------
+
+class TestCleanShutdown:
+    def test_stop_leaks_nothing(self, hub, workload):
+        from photon_ml_tpu.analysis.sanitizers import ProcessLeakSentinel
+
+        # The module-scoped pool keeps ITS segments live; this pool's
+        # must all be gone after stop.
+        before = set(shm_model.live_segments())
+        with ProcessLeakSentinel(grace_s=15.0, strict=True):
+            pool = WorkerPool(
+                workload.model, workload.index_maps,
+                runtime_config=RuntimeConfig(**RT_CFG), version=1,
+            )
+            # Generous probe budget for the same reason as the module
+            # fixture: this test's own 2-worker spawn stalls the box,
+            # and a probe timeout here would down/restart a healthy
+            # worker racing the stop() below.
+            supervisor = ReplicaSupervisor(
+                pool=pool, n_replicas=2, probe_interval_s=0.05,
+                probe_timeout_s=60.0, probe_failure_threshold=5,
+            )
+            with supervisor:
+                result = supervisor.submit(
+                    supervisor.parse_request(workload.request(0))
+                ).result(timeout=60)
+                assert np.isfinite(result["score"])
+            assert set(shm_model.live_segments()) == before
+        # Sentinel exit (strict): any surviving worker process raises.
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory publication: verify-or-die attach (no processes)
+# ---------------------------------------------------------------------------
+
+class TestShmModel:
+    def _published(self, workload, **kwargs):
+        manifest = shm_model.publish_model(workload.model, **kwargs)
+        return manifest
+
+    def test_attach_reconstructs_bit_identical_scores(self, workload):
+        manifest = self._published(workload, version=1)
+        try:
+            model, attachment = shm_model.attach_model(manifest)
+            with attachment:
+                runtime = ScoringRuntime(
+                    model, workload.index_maps, RuntimeConfig(**RT_CFG)
+                )
+                requests = [workload.request(i) for i in range(8)]
+                expected = _reference(workload, requests)
+                got = np.asarray(
+                    [
+                        runtime.score_rows(
+                            [runtime.parse_request(r)]
+                        )[0][0]
+                        for r in requests
+                    ],
+                    np.float32,
+                )
+                assert got.tobytes() == expected.tobytes()
+        finally:
+            shm_model.unpublish_model(manifest)
+
+    def test_flipped_segment_byte_fails_checksum(self, workload, hub):
+        manifest = self._published(workload, version=1)
+        try:
+            before = hub.snapshot()["counters"].get(
+                "model_map_unverified_total", 0
+            )
+            name = next(iter(manifest["segments"]))
+            from multiprocessing import shared_memory
+
+            shm = shared_memory.SharedMemory(name=name)
+            try:
+                shm.buf[0] = shm.buf[0] ^ 0xFF
+                with pytest.raises(
+                    shm_model.ModelMapError, match="checksum"
+                ):
+                    shm_model.attach_model(manifest)
+            finally:
+                shm.buf[0] = shm.buf[0] ^ 0xFF  # restore for unlink
+                shm.close()
+            after = hub.snapshot()["counters"].get(
+                "model_map_unverified_total", 0
+            )
+            assert after == before + 1
+        finally:
+            shm_model.unpublish_model(manifest)
+
+    def test_torn_manifest_fails_self_digest(self, workload, hub):
+        manifest = self._published(workload, version=1)
+        try:
+            torn = dict(manifest, version=manifest["version"] + 1)
+            with pytest.raises(shm_model.ModelMapError):
+                shm_model.attach_model(torn)
+            # Tampering a recorded segment digest is also torn — the
+            # self-digest covers it, so the lie is caught before any
+            # byte comparison could be fooled.
+            name = next(iter(manifest["segments"]))
+            lied = {
+                **manifest,
+                "segments": {
+                    **manifest["segments"],
+                    name: {
+                        **manifest["segments"][name],
+                        "sha256": "0" * 64,
+                    },
+                },
+            }
+            with pytest.raises(shm_model.ModelMapError):
+                shm_model.attach_model(lied)
+        finally:
+            shm_model.unpublish_model(manifest)
+
+    def test_stale_manifest_after_unpublish_raises(self, workload):
+        manifest = self._published(workload, version=1)
+        shm_model.unpublish_model(manifest)
+        with pytest.raises(shm_model.ModelMapError):
+            shm_model.attach_model(manifest)
+
+    def test_gauge_tracks_publish_and_unpublish(self, workload, hub):
+        base = hub.snapshot()["gauges"].get(
+            "serving_shared_segment_bytes", 0
+        )
+        manifest = self._published(workload, version=1)
+        published = sum(
+            seg["nbytes"] for seg in manifest["segments"].values()
+        )
+        assert hub.snapshot()["gauges"][
+            "serving_shared_segment_bytes"
+        ] == base + published
+        shm_model.unpublish_model(manifest)
+        assert hub.snapshot()["gauges"][
+            "serving_shared_segment_bytes"
+        ] == base
+
+
+# ---------------------------------------------------------------------------
+# Wire protocol
+# ---------------------------------------------------------------------------
+
+class TestProtocol:
+    def _pair(self):
+        a, b = socket.socketpair()
+        return FrameConn(a), FrameConn(b)
+
+    def test_roundtrip(self):
+        left, right = self._pair()
+        try:
+            payload = {"kind": "score", "id": 7, "row": [1.0, 2.0]}
+            left.send(payload)
+            assert right.recv() == payload
+        finally:
+            left.close()
+            right.close()
+
+    def test_clean_eof_returns_none(self):
+        left, right = self._pair()
+        left.close()
+        try:
+            assert right.recv() is None
+        finally:
+            right.close()
+
+    def test_truncated_frame_raises(self):
+        a, b = socket.socketpair()
+        right = FrameConn(b)
+        try:
+            # A length prefix promising more bytes than ever arrive.
+            a.sendall((1024).to_bytes(4, "big") + b"\x00\x01")
+            a.close()
+            with pytest.raises(ProtocolError):
+                right.recv()
+        finally:
+            right.close()
+
+    def test_oversized_length_refused_at_recv(self):
+        a, b = socket.socketpair()
+        right = FrameConn(b)
+        try:
+            # A forged header promising a frame beyond the cap: refuse
+            # before allocating, the stream is desynced.
+            a.sendall((MAX_FRAME_BYTES + 1).to_bytes(4, "big"))
+            with pytest.raises(ProtocolError, match="cap"):
+                right.recv()
+        finally:
+            a.close()
+            right.close()
+
+
+# ---------------------------------------------------------------------------
+# Metrics transport (heartbeat payloads)
+# ---------------------------------------------------------------------------
+
+class TestMetricsTransport:
+    def test_absorb_delta_folds_counters_gauges_histograms(self):
+        src = telemetry.Telemetry(enabled=True, sinks=[]).metrics
+        dst = telemetry.Telemetry(enabled=True, sinks=[]).metrics
+        src.counter("serving_requests_total").inc(3)
+        src.gauge("serving_queue_depth").set(5)
+        src.histogram("serving_request_latency_seconds").observe(0.01)
+        first = src.transport_snapshot()
+        dst.absorb_delta(first)
+        src.counter("serving_requests_total").inc(2)
+        src.histogram("serving_request_latency_seconds").observe(0.02)
+        second = src.transport_snapshot()
+        dst.absorb_delta(second, first)
+        snap = dst.snapshot()
+        assert snap["counters"]["serving_requests_total"] == 5
+        assert snap["gauges"]["serving_queue_depth"] == 5
+        assert snap["histograms"][
+            "serving_request_latency_seconds"
+        ]["count"] == 2
+
+    def test_absorb_is_delta_not_double_count(self):
+        src = telemetry.Telemetry(enabled=True, sinks=[]).metrics
+        dst = telemetry.Telemetry(enabled=True, sinks=[]).metrics
+        src.counter("serving_rows_scored_total").inc(10)
+        snap1 = src.transport_snapshot()
+        dst.absorb_delta(snap1)
+        # The same cumulative snapshot absorbed again WITH prev is a
+        # no-op — senders keep cumulative state, receivers fold deltas.
+        dst.absorb_delta(snap1, snap1)
+        assert dst.snapshot()["counters"][
+            "serving_rows_scored_total"
+        ] == 10
+
+
+# ---------------------------------------------------------------------------
+# Loadgen catalog + p999
+# ---------------------------------------------------------------------------
+
+class TestLoadgenAdditions:
+    def test_worker_kill_scenario_registered(self):
+        assert "worker_kill" in loadgen.SCENARIOS
+        scenario = loadgen.SCENARIOS["worker_kill"]
+        assert any(
+            phase.action == "kill_worker" for phase in scenario.phases
+        )
+
+    def test_report_snapshot_carries_p999(self):
+        report = loadgen.LoadReport(
+            mode="test", wall_seconds=1.0, completed=3, rejected=0,
+            errors=0, latencies_ms=np.asarray([1.0, 2.0, 100.0]),
+        )
+        snap = report.snapshot()
+        assert "latency_p999_ms" in snap
+        assert snap["latency_p999_ms"] >= snap["latency_p99_ms"] >= \
+            snap["latency_p50_ms"]
+
+
+# ---------------------------------------------------------------------------
+# process-lifecycle static rule
+# ---------------------------------------------------------------------------
+
+GOOD_LIFECYCLE = """
+import multiprocessing
+class Owner:
+    def start(self):
+        self._proc = multiprocessing.get_context("spawn").Process(
+            target=print)
+        self._proc.start()
+    def stop(self):
+        try:
+            self._proc.join(timeout=5)
+        finally:
+            self._proc.terminate()
+            self._proc.join(timeout=2)
+"""
+
+NEVER_REAPED = """
+import multiprocessing
+def go():
+    p = multiprocessing.Process(target=print)
+    p.start()
+"""
+
+HAPPY_PATH_ONLY = """
+import multiprocessing
+def go():
+    p = multiprocessing.Process(target=print)
+    p.start()
+    work()
+    p.join()
+    p.terminate()
+"""
+
+NO_ESCALATION = """
+import subprocess
+def go():
+    p = subprocess.Popen(["true"])
+    p.wait()
+"""
+
+EXEMPT_RUN = """
+import subprocess
+def go():
+    subprocess.run(["true"], check=True)
+"""
+
+
+class TestProcessLifecycleRule:
+    def _findings(self, tmp_path, source):
+        from photon_ml_tpu.analysis import RULES_BY_ID
+        from photon_ml_tpu.analysis.engine import SourceTree, run_rules
+
+        (tmp_path / "case.py").write_text(source)
+        tree = SourceTree(roots=[str(tmp_path)], repo_root=str(tmp_path))
+        return run_rules(tree, [RULES_BY_ID["process-lifecycle"]])
+
+    def test_good_lifecycle_split_is_clean(self, tmp_path):
+        assert self._findings(tmp_path, GOOD_LIFECYCLE) == []
+
+    def test_never_reaped_flagged(self, tmp_path):
+        findings = self._findings(tmp_path, NEVER_REAPED)
+        assert findings and "never joined" in findings[0].message
+
+    def test_happy_path_only_reap_flagged(self, tmp_path):
+        findings = self._findings(tmp_path, HAPPY_PATH_ONLY)
+        assert findings and "happy path" in findings[0].message
+
+    def test_popen_without_escalation_flagged(self, tmp_path):
+        findings = self._findings(tmp_path, NO_ESCALATION)
+        assert findings and "terminate" in findings[0].message
+
+    def test_subprocess_run_exempt(self, tmp_path):
+        assert self._findings(tmp_path, EXEMPT_RUN) == []
+
+    def test_procpool_itself_is_clean(self):
+        from photon_ml_tpu.analysis import RULES_BY_ID
+        from photon_ml_tpu.analysis.engine import SourceTree, run_rules
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        tree = SourceTree(
+            roots=[os.path.join(repo, "photon_ml_tpu", "serving")],
+            repo_root=repo,
+        )
+        assert run_rules(tree, [RULES_BY_ID["process-lifecycle"]]) == []
+
+
+# ---------------------------------------------------------------------------
+# Chaos-site registration
+# ---------------------------------------------------------------------------
+
+def test_serving_worker_site_registered():
+    assert "serving.worker" in chaos.KNOWN_SITES
+    # Construction-time validation still refuses typos.
+    with pytest.raises(ValueError):
+        chaos.FaultSpec(site="serving.wroker")
