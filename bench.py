@@ -34,7 +34,8 @@ the primary metric in the required fields, the other metrics under "extra"
 with their own vs_baseline ratios.
 
 Env knobs: BENCH_SMALL=1 shrinks every workload (CI/smoke); BENCH_ONLY=
-glm|game|driver|stream|serving|tuning|chaos runs a single section.
+glm|game|driver|stream|serving|freshness|tuning|chaos runs a single
+section.
 """
 
 import json
@@ -1419,6 +1420,184 @@ def _bench_serving_process(workload) -> dict:
     }
 
 
+def bench_freshness() -> dict:
+    """Continuous train→serve loop (PR 12): the wall cost of staying
+    fresh.  Two measurements:
+
+    1. The ``freshness`` loadgen scenario against a 2-replica supervised
+       service — an online-refined delta publishes and hot-applies
+       MID-PHASE under open-loop traffic.  Reports p50/p99 and the
+       zero-failed-requests gate (reported, not asserted, so a
+       regression shows in the bench diff) plus the event→servable
+       freshness SLO actually achieved.
+    2. Delta apply vs full reload of the SAME refined model: the delta
+       path's whole point is patching K changed rows instead of
+       rebuilding n_entities tables from disk — both walls and the
+       ratio, over several refine→publish→apply cycles.
+    """
+    import tempfile
+
+    from photon_ml_tpu.freshness.applier import DeltaApplier
+    from photon_ml_tpu.freshness.online import (
+        LabeledEvent,
+        OnlineRefiner,
+        RefinerConfig,
+    )
+    from photon_ml_tpu.freshness.publisher import DeltaPublisher
+    from photon_ml_tpu.io.game_store import save_game_model
+    from photon_ml_tpu.serving import loadgen
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService
+    from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+    from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+    n_entities = 5_000 if SMALL else 20_000
+    n_events = 200
+    n_cycles = 2 if SMALL else 4  # quiet cycles after the scenario one
+    rate = 150.0 if SMALL else 400.0
+    workload = SyntheticWorkload(
+        n_entities=n_entities, fixed_dim=32, re_dim=8, seed=21
+    )
+    rng = np.random.default_rng(22)
+    rt_cfg = RuntimeConfig(max_batch_size=32, hot_entities=1024)
+
+    def drift_events(now_wall: float) -> list:
+        events = []
+        for _ in range(n_events):
+            events.append(LabeledEvent(
+                features={
+                    workload.fixed_shard: rng.normal(
+                        size=workload.fixed_dim
+                    ).astype(np.float32),
+                    workload.re_shard: rng.normal(
+                        size=workload.re_dim
+                    ).astype(np.float32),
+                },
+                ids={
+                    workload.entity_key: f"u{rng.integers(n_entities)}"
+                },
+                label=float(rng.integers(2)),
+                wall_epoch=now_wall,
+            ))
+        return events
+
+    def make_request(i: int, phase) -> dict:
+        req = workload.request(i)
+        if phase.entity_pool is not None:
+            lo, hi = phase.entity_pool
+            span = max(1, int((hi - lo) * n_entities))
+            req["ids"][workload.entity_key] = (
+                f"u{int(lo * n_entities) + i % span}"
+            )
+        return req
+
+    out: dict = {}
+    with tempfile.TemporaryDirectory(prefix="bench_freshness_") as td:
+        v1_dir = os.path.join(td, "v1")
+        _log(f"freshness: saving base model ({n_entities} entities)...")
+        save_game_model(workload.model, workload.index_maps, v1_dir)
+
+        def factory() -> ScoringRuntime:
+            return ScoringRuntime.load(v1_dir, rt_cfg)
+
+        supervisor = ReplicaSupervisor(
+            factory, n_replicas=2, probe_interval_s=0.1
+        )
+        service = ScoringService(supervisor, BatcherConfig(
+            max_batch_size=32, max_wait_us=1000, max_queue=1024,
+        ))
+        publisher = DeltaPublisher(os.path.join(td, "publications"))
+        applier = DeltaApplier(service, publisher.root)
+        base_model, _ = ScoringRuntime.load_model(v1_dir)
+        event_to_servable: list[float] = []
+        apply_walls: list[float] = []
+        delta_rows: list[int] = []
+        # Each cycle warm-starts a refiner from the model the replicas
+        # currently serve (bitwise: the previous cycle's refined model),
+        # so every delta's base checksum matches the live tables.
+        state = {"base": base_model, "event_wall": 0.0, "refiner": None}
+
+        def publish_delta() -> dict:
+            event_wall = time.time()
+            state["event_wall"] = event_wall
+            refiner = OnlineRefiner(state["base"], RefinerConfig(seed=23))
+            refiner.consume(drift_events(event_wall))
+            state["refiner"] = refiner
+            pub = refiner.publish(publisher)
+            delta_rows.append(pub.n_changed_rows)
+            return {"seq": pub.seq, "rows": pub.n_changed_rows}
+
+        def apply_delta_action() -> dict:
+            t0 = time.perf_counter()
+            results = applier.poll_once()
+            apply_walls.append(time.perf_counter() - t0)
+            now_wall = time.time()
+            event_to_servable.append(now_wall - state["event_wall"])
+            state["base"] = state["refiner"].refined_model()
+            return {
+                "applied": [r.status for r in results],
+                "version": service.swapper.version,
+            }
+
+        with service:
+            report = loadgen.run_scenario(
+                service.submit, make_request,
+                loadgen.SCENARIOS["freshness"],
+                base_rate_rps=rate,
+                actions={
+                    "publish_delta": publish_delta,
+                    "apply_delta": apply_delta_action,
+                },
+            )
+            # Quiet cycles: more apply-wall / event→servable samples
+            # without traffic jitter.
+            for _ in range(n_cycles):
+                publish_delta()
+                apply_delta_action()
+            # The honest alternative to the delta path: a FULL disk
+            # reload of the same refined model on the same service.
+            refined_dir = os.path.join(td, "refined")
+            save_game_model(
+                state["base"], workload.index_maps, refined_dir
+            )
+            t0 = time.perf_counter()
+            full = service.reload(refined_dir)
+            full_reload_wall = time.perf_counter() - t0
+        snap = report.snapshot()
+        zero_failed = report.errors == 0 and report.rejected == 0
+        apply_ms = round(float(np.median(apply_walls)) * 1e3, 2)
+        e2s_p50 = round(float(np.percentile(event_to_servable, 50)), 3)
+        e2s_p99 = round(float(np.percentile(event_to_servable, 99)), 3)
+        _log(
+            f"freshness scenario @ {rate:g} rps: {report.completed} ok / "
+            f"{report.rejected} shed / {report.errors} errors, p99 "
+            f"{snap['latency_p99_ms']} ms, zero-failed gate "
+            f"{'PASS' if zero_failed else 'FAIL'}; event→servable p50 "
+            f"{e2s_p50}s p99 {e2s_p99}s; delta apply {apply_ms} ms vs "
+            f"full reload {round(full_reload_wall * 1e3, 1)} ms "
+            f"({full.status})"
+        )
+        out.update({
+            "freshness_scenario_p50_ms": snap["latency_p50_ms"],
+            "freshness_scenario_p99_ms": snap["latency_p99_ms"],
+            "freshness_scenario_completed": report.completed,
+            "freshness_scenario_rejected": report.rejected,
+            "freshness_scenario_errors": report.errors,
+            "freshness_zero_failed": zero_failed,
+            "freshness_event_to_servable_p50_s": e2s_p50,
+            "freshness_event_to_servable_p99_s": e2s_p99,
+            "freshness_delta_apply_ms": apply_ms,
+            "freshness_full_reload_ms": round(full_reload_wall * 1e3, 1),
+            "freshness_reload_speedup": round(
+                full_reload_wall * 1e3 / max(apply_ms, 1e-3), 1
+            ),
+            "freshness_delta_rows_per_cycle": int(np.median(delta_rows)),
+            "freshness_deltas_applied": applier.applied,
+        })
+    return out
+
+
 def bench_tuning() -> dict:
     """Tuning orchestrator (PR 4): sequential vs parallel-4 wall clock of
     the SAME synthetic GLM λ sweep (GridProposer over a fixed λ path, so
@@ -1595,6 +1774,11 @@ def main() -> None:
             extra.update(bench_serving())
         except Exception as e:  # new section: never sink the headline
             extra["serving_throughput_rps"] = f"failed: {e}"
+    if ONLY in ("", "freshness"):
+        try:
+            extra.update(bench_freshness())
+        except Exception as e:  # new section: never sink the headline
+            extra["freshness_delta_apply_ms"] = f"failed: {e}"
     if ONLY in ("", "tuning"):
         try:
             extra.update(bench_tuning())

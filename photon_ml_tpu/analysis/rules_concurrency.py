@@ -275,6 +275,24 @@ def _lock_names(pf: PyFile) -> set[str]:
     return names
 
 
+# ``.join()`` attribute calls that can never block: path joins and
+# string joins on a literal separator.  Everything else named .join()
+# under a lock is treated as a thread join.
+_PATH_JOINS = {"os.path.join", "posixpath.join", "ntpath.join"}
+
+
+def _is_thread_join(call: ast.Call, callee: Optional[str]) -> bool:
+    if callee in _PATH_JOINS:
+        return False
+    if (
+        isinstance(call.func, ast.Attribute)
+        and isinstance(call.func.value, ast.Constant)
+        and isinstance(call.func.value.value, str)
+    ):
+        return False
+    return True
+
+
 def _blocking_reason(callee: Optional[str], attr: Optional[str]
                      ) -> Optional[str]:
     if callee in _BLOCKING_DOTTED:
@@ -310,7 +328,9 @@ def _check_lock_blocking(tree: SourceTree) -> Iterable[Finding]:
                         sub.func.attr
                         if isinstance(sub.func, ast.Attribute) else None
                     )
-                    if attr == _JOIN_ATTR:
+                    if attr == _JOIN_ATTR and _is_thread_join(
+                        sub, callee
+                    ):
                         yield Finding(
                             "lock-blocking-call", pf.relpath, sub.lineno,
                             f"thread join while holding lock "

@@ -38,7 +38,7 @@ SUBSYSTEMS = frozenset({
     "h2d", "hbm", "prefetch", "stream", "streaming", "staging",
     "solver", "cd", "grid", "game", "glm", "watchdog", "checkpoint",
     "chaos", "serving", "tuning", "compile", "run", "telemetry",
-    "evaluation", "model", "analysis",
+    "evaluation", "model", "analysis", "freshness",
 })
 
 #: Last name token: what the value measures.

@@ -157,6 +157,27 @@ KNOWN_SITES = {
         "worker thread, before a tuning trial's fit runs "
         "(tuning/executor.py)"
     ),
+    "publish.delta": (
+        "delta publication boundaries (freshness/publisher.py): stage "
+        "'journal' (begin record written, before the artifact staging "
+        "dir), 'artifact' (artifact staged+digested, before the atomic "
+        "rename publishes it) and 'commit' (artifact published, before "
+        "the commit record) — a crash at any stage must resume exactly, "
+        "never leaving a half-published artifact visible"
+    ),
+    "publish.apply": (
+        "delta hot-apply critical section (serving/swap.py swap_delta): "
+        "touched at stage 'load' (before the artifact is read+verified), "
+        "'prepare' (patched runtime built, before the atomic commit) and "
+        "'verify' (committed, before the post-apply probe) — a fault "
+        "must roll back with the previous version still serving"
+    ),
+    "online.step": (
+        "online refinement, before one entity's SGD/AdaGrad step "
+        "(freshness/online.py) — a fault must abandon the refinement "
+        "pass without corrupting the warm-start model or publishing a "
+        "partial delta"
+    ),
 }
 
 

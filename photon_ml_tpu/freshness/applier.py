@@ -1,0 +1,148 @@
+"""The subscribe side of the publish/subscribe loop.
+
+:class:`DeltaApplier` watches a publication root (the journal a
+:class:`~photon_ml_tpu.freshness.publisher.DeltaPublisher` writes) and
+applies every newly-committed delta to a live
+:class:`~photon_ml_tpu.serving.service.ScoringService` in sequence
+order, via the service's delta reload path (``swap_delta`` — bitwise
+parity, zero dropped requests, one-step rollback).  It reads the
+journal READ-ONLY: a subscriber never repairs or advances the
+publisher's state.
+
+Freshness accounting lives here and in the swapper: the swapper records
+``freshness_event_to_servable_seconds`` at the commit instant; the
+applier keeps the STALENESS gauges current between applies —
+``freshness_model_age_seconds`` is how long ago the newest servable
+event happened, and it grows until the next delta lands (the "model is
+stale — now what?" runbook in docs/freshness.md keys off it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.freshness.publisher import (
+    Publication,
+    read_publications,
+)
+
+
+class DeltaApplier:
+    """Apply committed publications from ``root`` to ``service``.
+
+    Use :meth:`poll_once` synchronously (the selfcheck and tests do) or
+    :meth:`start`/:meth:`stop` for a background polling thread.  A
+    publication whose apply comes back ``rolled_back`` (torn artifact,
+    base mismatch, failed probe) is NOT retried — its sequence number
+    is recorded as failed and the loop moves on, because re-applying
+    the same artifact to the same base deterministically fails the same
+    way; the operator escalates to a full reload (the runbook).
+    """
+
+    def __init__(
+        self,
+        service,
+        root: str,
+        poll_interval_s: float = 0.25,
+    ):
+        self._service = service
+        self.root = root
+        self.poll_interval_s = float(poll_interval_s)
+        self.applied_seq = 0
+        self.applied = 0
+        self.failed: List[int] = []
+        #: wall epoch of the newest event now servable (staleness anchor).
+        self._servable_event_wall: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- synchronous ---------------------------------------------------------
+    def pending(self) -> List[Publication]:
+        """Committed publications not yet applied, in sequence order."""
+        return [
+            p for p in read_publications(self.root)
+            if p.seq > self.applied_seq
+        ]
+
+    def poll_once(self) -> list:
+        """Apply every pending publication; returns their SwapResults
+        (empty when the root has nothing new) and refreshes the
+        staleness gauges either way."""
+        tel = telemetry_mod.current()
+        results = []
+        for pub in self.pending():
+            result = self._service.reload(pub.path, mode="delta")
+            results.append(result)
+            self.applied_seq = pub.seq
+            if result.status == "swapped":
+                self.applied += 1
+                if pub.event_wall_epoch is not None:
+                    self._servable_event_wall = pub.event_wall_epoch
+            else:
+                self.failed.append(pub.seq)
+                tel.counter("freshness_apply_failures_total").inc()
+                tel.event(
+                    "freshness.apply_failed",
+                    seq=pub.seq,
+                    path=pub.path,
+                    stage=result.stage,
+                    reason=result.reason,
+                )
+        self._refresh_staleness()
+        return results
+
+    def _refresh_staleness(self) -> None:
+        if self._servable_event_wall is None:
+            return
+        now_wall = time.time()
+        telemetry_mod.current().gauge(
+            "freshness_model_age_seconds"
+        ).set(max(0.0, now_wall - self._servable_event_wall))
+
+    # -- background ----------------------------------------------------------
+    def start(self) -> "DeltaApplier":
+        if self._thread is not None:
+            raise RuntimeError("applier already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="freshness-applier", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as exc:  # noqa: BLE001 — keep polling
+                # A transient reload refusal (SwapInProgressError from a
+                # concurrent operator /reload) must not kill the loop.
+                telemetry_mod.current().event(
+                    "freshness.poll_error",
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
+            self._stop.wait(self.poll_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self) -> "DeltaApplier":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stats(self) -> dict:
+        return {
+            "root": self.root,
+            "applied_seq": self.applied_seq,
+            "applied": self.applied,
+            "failed": list(self.failed),
+            "servable_event_wall": self._servable_event_wall,
+        }

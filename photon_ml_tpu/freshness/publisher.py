@@ -1,0 +1,307 @@
+"""Crash-safe delta publication.
+
+A publication root is a directory:
+
+    publish_journal.jsonl    append-only decision log (tuning/state.py
+                             discipline: one JSON line per record,
+                             flush+fsync before append returns)
+    delta-<seq>/             published artifacts (delta.py layout)
+    delta-<seq>.staging/     an in-flight write (never read by anyone)
+
+The publish protocol brackets an atomic-rename artifact write with
+journal records, so a kill at ANY instant leaves the root in a state
+the next :class:`DeltaPublisher` (or an explicit :meth:`resume`)
+completes deterministically:
+
+    begin(seq)        journaled first — the staging dir is claimed
+    <stage artifact>  written into delta-<seq>.staging/, self-digested
+    <atomic rename>   delta-<seq>.staging/ -> delta-<seq>/
+    commit(seq)       journaled last — the publication is now visible
+
+Crash before the rename: the staging dir is garbage; resume removes it
+and journals ``abort``.  Crash after the rename but before ``commit``:
+the artifact is complete and verified on disk; resume journals the
+missing ``commit`` — the SAME publication an uninterrupted run would
+have made, never a half-published artifact.  Subscribers only ever see
+``commit``-journaled sequence numbers (:meth:`publications`), so a torn
+publish is invisible to the apply side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import threading
+import time
+from typing import List, Optional
+
+from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.chaos import core as chaos_mod
+from photon_ml_tpu.analysis import sanitizers
+from photon_ml_tpu.freshness.delta import (
+    MANIFEST_FILE,
+    DeltaError,
+    ModelDelta,
+    _read_manifest,
+    write_delta,
+)
+from photon_ml_tpu.io.checkpoint import fsync_file
+
+
+@dataclasses.dataclass(frozen=True)
+class Publication:
+    """One committed delta publication, as subscribers see it."""
+
+    seq: int
+    path: str
+    manifest_sha256: str
+    event_wall_epoch: Optional[float]
+    n_changed_rows: int
+    publish_wall_epoch: float
+
+
+class PublishAborted(RuntimeError):
+    """Raised by the journal's test abort hook to simulate a kill at a
+    deterministic record boundary (tuning/state.py idiom)."""
+
+
+class DeltaPublisher:
+    """Publish :class:`~photon_ml_tpu.freshness.delta.ModelDelta`
+    artifacts into a root directory, crash-safely.
+
+    Thread-safe; one lock serializes publishes (a publication root has
+    one writer — concurrent publishers on one root would race the
+    sequence counter, which the claim-by-journal protocol would surface
+    as a rename failure rather than corruption).
+    """
+
+    JOURNAL = "publish_journal.jsonl"
+
+    def __init__(
+        self,
+        root: str,
+        fsync: bool = True,
+        abort_after: Optional[int] = None,
+    ):
+        self.root = root
+        self.fsync = fsync
+        self.abort_after = abort_after
+        self.path = os.path.join(root, self.JOURNAL)
+        self._lock = sanitizers.tracked(
+            threading.Lock(), "freshness.publisher"
+        )
+        self._f = None
+        self._written = 0
+        os.makedirs(root, exist_ok=True)
+        self.resume()
+
+    # -- journal ------------------------------------------------------------
+    def _append(self, record: dict) -> None:
+        # Caller holds self._lock.
+        if self.abort_after is not None and self._written >= self.abort_after:
+            raise PublishAborted(
+                f"journal abort hook: {self._written} records written"
+            )
+        if self._f is None:
+            self._f = open(self.path, "a")
+        self._f.write(json.dumps(record) + "\n")
+        if self.fsync:
+            fsync_file(self._f)
+        else:
+            self._f.flush()
+        self._written += 1
+
+    def _read(self) -> List[dict]:
+        """Every complete journal record; a torn FINAL line is dropped,
+        a torn line anywhere else raises (not an append-only journal)."""
+        if not os.path.exists(self.path):
+            return []
+        if self._f is not None:
+            self._f.flush()
+        with open(self.path) as f:
+            lines = f.read().splitlines()
+        records = []
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    break
+                raise DeltaError(
+                    f"{self.path}: corrupt journal line {i + 1} (not the "
+                    "tail) — the file was edited or is not an append-only "
+                    "journal; restore it from backup"
+                ) from None
+        return records
+
+    # -- paths --------------------------------------------------------------
+    def _final_dir(self, seq: int) -> str:
+        return os.path.join(self.root, f"delta-{seq:06d}")
+
+    def _staging_dir(self, seq: int) -> str:
+        return self._final_dir(seq) + ".staging"
+
+    # -- resume -------------------------------------------------------------
+    def resume(self) -> List[dict]:
+        """Complete or clean every in-flight publication, exactly as an
+        uninterrupted run would have.  Returns the repair records
+        journaled (empty on a clean root).  Called from ``__init__`` so
+        merely constructing a publisher heals its root."""
+        with self._lock:
+            records = self._read()
+            settled = {
+                r["seq"] for r in records if r["kind"] in ("commit", "abort")
+            }
+            repairs: List[dict] = []
+            max_seq = 0
+            for r in records:
+                max_seq = max(max_seq, r["seq"])
+                if r["kind"] != "begin" or r["seq"] in settled:
+                    continue
+                seq = r["seq"]
+                final, staging = self._final_dir(seq), self._staging_dir(seq)
+                if os.path.exists(
+                    os.path.join(final, MANIFEST_FILE)
+                ):
+                    # Crashed between the atomic rename and the commit
+                    # record: the artifact is complete — verify and
+                    # journal the commit an uninterrupted run would have.
+                    manifest = _read_manifest(final)
+                    repair = {
+                        "kind": "commit",
+                        "seq": seq,
+                        "path": final,
+                        "manifest_sha256": manifest["manifest_sha256"],
+                        "event_wall_epoch": manifest.get("event_wall_epoch"),
+                        "n_changed_rows": _manifest_rows(manifest),
+                        "publish_wall_epoch": r["publish_wall_epoch"],
+                        "resumed": True,
+                    }
+                else:
+                    # Crashed before the rename: nothing was published.
+                    if os.path.isdir(staging):
+                        shutil.rmtree(staging)
+                    repair = {"kind": "abort", "seq": seq, "resumed": True}
+                self._append(repair)
+                repairs.append(repair)
+            self._next_seq = max_seq + 1
+            return repairs
+
+    # -- publish ------------------------------------------------------------
+    def publish(self, delta: ModelDelta) -> Publication:
+        """Write ``delta`` as the next sequenced artifact.  Returns the
+        committed :class:`Publication`.  Raises whatever the chaos
+        harness injects at the ``publish.delta`` boundaries — after
+        which a :meth:`resume` (or the next constructor) settles the
+        root deterministically."""
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            publish_wall = time.time()
+            self._append({
+                "kind": "begin",
+                "seq": seq,
+                "publish_wall_epoch": publish_wall,
+                "event_wall_epoch": delta.event_wall_epoch,
+            })
+            chaos_mod.maybe_fail("publish.delta", stage="journal", seq=seq)
+            staging = self._staging_dir(seq)
+            if os.path.isdir(staging):
+                shutil.rmtree(staging)
+            manifest = write_delta(delta, staging)
+            chaos_mod.maybe_fail("publish.delta", stage="artifact", seq=seq)
+            final = self._final_dir(seq)
+            os.rename(staging, final)
+            chaos_mod.maybe_fail("publish.delta", stage="commit", seq=seq)
+            record = {
+                "kind": "commit",
+                "seq": seq,
+                "path": final,
+                "manifest_sha256": manifest["manifest_sha256"],
+                "event_wall_epoch": delta.event_wall_epoch,
+                "n_changed_rows": delta.n_changed_rows,
+                "publish_wall_epoch": publish_wall,
+            }
+            self._append(record)
+        hub = telemetry_mod.current()
+        hub.counter("freshness_deltas_published_total").inc()
+        hub.counter("freshness_delta_rows").inc(delta.n_changed_rows)
+        hub.counter("freshness_delta_bytes").inc(_artifact_bytes(manifest))
+        return _publication(record)
+
+    def publications(self) -> List[Publication]:
+        """Committed publications in sequence order — the only view
+        subscribers get, so in-flight or aborted publishes are
+        invisible to the apply side."""
+        with self._lock:
+            return [
+                _publication(r)
+                for r in self._read()
+                if r["kind"] == "commit"
+            ]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self) -> "DeltaPublisher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_publications(root: str) -> List[Publication]:
+    """Committed publications under ``root``, in sequence order, without
+    constructing a :class:`DeltaPublisher` (whose constructor RESUMES —
+    i.e. writes).  This is the subscriber entry point: read-only, torn
+    final journal line tolerated, in-flight/aborted publishes invisible.
+    A missing journal is an empty root, not an error."""
+    journal = os.path.join(root, DeltaPublisher.JOURNAL)
+    if not os.path.exists(journal):
+        return []
+    with open(journal) as f:
+        lines = f.read().splitlines()
+    out: List[Publication] = []
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break
+            raise DeltaError(
+                f"{journal}: corrupt journal line {i + 1} (not the tail) "
+                "— the file was edited or is not an append-only journal; "
+                "restore it from backup"
+            ) from None
+        if record["kind"] == "commit":
+            out.append(_publication(record))
+    return out
+
+
+def _manifest_rows(manifest: dict) -> int:
+    # n_changed already counts removals (CoordinateDelta.n_changed).
+    return sum(int(c.get("n_changed", 0)) for c in manifest["coordinates"])
+
+
+def _artifact_bytes(manifest: dict) -> int:
+    return sum(int(c.get("nbytes", 0)) for c in manifest["coordinates"])
+
+
+def _publication(record: dict) -> Publication:
+    return Publication(
+        seq=record["seq"],
+        path=record["path"],
+        manifest_sha256=record["manifest_sha256"],
+        event_wall_epoch=record.get("event_wall_epoch"),
+        n_changed_rows=int(record.get("n_changed_rows", 0)),
+        publish_wall_epoch=record["publish_wall_epoch"],
+    )

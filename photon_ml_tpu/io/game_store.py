@@ -155,6 +155,36 @@ def save_game_model(
         json.dump(manifest, f, indent=2)
 
 
+def read_fingerprints(directory: str) -> dict:
+    """Read per-coordinate fingerprints from ``metadata.json`` WITHOUT
+    loading any coefficient Avro — the cheap HEAD the delta differ
+    (``freshness/delta.py``) and ops tooling use to decide which
+    coordinates changed before paying for a full parse.
+
+    Returns coordinate name → fingerprint dict (``task``,
+    ``feature_count``, ``coefficient_checksum``, and ``n_entities`` for
+    random-effect coordinates).  A legacy directory whose manifest lacks
+    fingerprints (entirely or for some coordinate) raises a pointed
+    error: "unknown" would make a differ treat it as unchanged."""
+    meta_path = os.path.join(directory, "metadata.json")
+    with open(meta_path) as f:
+        manifest = json.load(f)
+    fingerprints = manifest.get("fingerprints") or {}
+    missing = [
+        c["name"] for c in manifest["coordinates"]
+        if c["name"] not in fingerprints
+    ]
+    if missing:
+        raise ValueError(
+            f"{meta_path}: no fingerprint for coordinate(s) "
+            f"{', '.join(repr(m) for m in missing)} — this model predates "
+            "fingerprinting, so its content cannot be compared or "
+            "delta-diffed; re-save it with the current writer "
+            "(save_game_model) to attach fingerprints"
+        )
+    return fingerprints
+
+
 def load_game_model(directory: str) -> tuple[GameModel, dict]:
     """Returns (model, index_maps-by-shard).
 

@@ -26,6 +26,9 @@ HA selfcheck replay:
   traffic flows; zero failed requests expected.
 - ``replica_kill`` — a replica is killed mid-phase; the supervisor
   resubmits and restarts; zero failed requests expected.
+- ``freshness``    — concept drift: the hot pool shifts (as in
+  ``skew_shift``) while an online-refined delta publishes and
+  hot-applies mid-phase (``freshness/``); zero failed requests expected.
 - ``worker_kill``  — process-mode only: a worker PROCESS takes a real
   SIGKILL mid-phase; same zero-failed-requests contract through the
   pipe-EOF resubmission path.
@@ -349,6 +352,23 @@ SCENARIOS = {
             ScenarioPhase("warm", 1.0),
             ScenarioPhase("kill", 2.0, action="kill_replica"),
             ScenarioPhase("after", 1.0),
+        ],
+    ),
+    "freshness": Scenario(
+        "freshness",
+        "concept drift: the hot entity pool shifts mid-run while an "
+        "online-refined delta publishes and hot-applies under load; "
+        "zero errors expected (skew_shift + the freshness loop)",
+        [
+            ScenarioPhase("pool_a", 1.0, entity_pool=(0.0, 0.3)),
+            ScenarioPhase(
+                "drift", 1.5, entity_pool=(0.7, 1.0),
+                action="publish_delta", action_at_frac=0.3,
+            ),
+            ScenarioPhase(
+                "apply", 1.5, entity_pool=(0.7, 1.0),
+                action="apply_delta", action_at_frac=0.25,
+            ),
         ],
     ),
     "worker_kill": Scenario(

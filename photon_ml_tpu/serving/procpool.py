@@ -61,12 +61,18 @@ __all__ = ["WorkerPool", "ProcessReplica"]
 @dataclasses.dataclass
 class _Generation:
     """One published model generation: its shared-memory manifest plus
-    the parent-side parser state needed to serve it."""
+    the parent-side parser state needed to serve it.  The host-side
+    model object is retained so the delta-apply path (serving/swap.py
+    ``swap_delta``) can patch the CURRENT generation parent-side and
+    publish the result as the next one — without it, a delta would have
+    to re-load the base from disk, defeating the point."""
 
     manifest: dict
     parser: RequestParser
     version: int
     path: Optional[str]
+    model: object = None
+    index_maps: Optional[dict] = None
 
 
 class _WorkerRuntimeView:
@@ -409,14 +415,19 @@ class ProcessReplica:
                 return message
 
     def swap_prepare(
-        self, manifest: dict, runtime_config=None, timeout: float = 120.0
+        self, manifest: dict, runtime_config=None,
+        carry_hot: bool = False, timeout: float = 120.0,
     ) -> None:
         """Stage a published generation in the worker: attach + build +
-        warm + probe off the request path; raises on any failure."""
+        warm + probe off the request path; raises on any failure.
+        ``carry_hot`` (the delta-apply path) asks the worker to clone
+        its serving runtime's compiled kernels and hot sets around the
+        attached model instead of rebuilding cold."""
         self._conn.send({
             "kind": "swap_prepare",
             "manifest": manifest,
             "runtime_config": runtime_config,
+            "carry_hot": carry_hot,
         })
         message = self._await_control(
             ("swap_ready", "swap_failed"), timeout,
@@ -527,8 +538,15 @@ class WorkerPool:
         manifest = shm_model.publish_model(model, version=version, path=path)
         parser = RequestParser.for_model(model, index_maps)
         return _Generation(
-            manifest=manifest, parser=parser, version=version, path=path
+            manifest=manifest, parser=parser, version=version, path=path,
+            model=model, index_maps=index_maps,
         )
+
+    def current_model(self) -> tuple:
+        """The host-side ``(model, index_maps)`` of the CURRENT
+        generation — the base the delta-apply path patches."""
+        current = self._current
+        return current.model, current.index_maps
 
     def commit_generation(self, generation: _Generation) -> None:
         """Make a staged generation current.  Keeps the last TWO

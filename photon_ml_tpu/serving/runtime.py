@@ -437,6 +437,59 @@ class ScoringRuntime:
         runtime.model_path = path
         return runtime
 
+    def _kernel_geometry(self) -> tuple:
+        """Everything the compiled bucket ladder is shaped by: task
+        (mean link), bucket sizes, fixed dims, random (dim, capacity)
+        pairs.  Two runtimes with equal geometry can share one jitted
+        kernel object — and with it the already-compiled ladder."""
+        return (
+            self.task,
+            tuple(self.buckets),
+            tuple(int(c.means.shape[0]) for c in self.fixed),
+            tuple((c.hot.dim, c.hot.capacity) for c in self.random),
+        )
+
+    @classmethod
+    def patched(
+        cls,
+        base: "ScoringRuntime",
+        model: GameModel,
+        index_maps: Optional[dict] = None,
+        config: Optional[RuntimeConfig] = None,
+        carry_hot: bool = True,
+    ) -> "ScoringRuntime":
+        """Build a runtime around ``model`` by CLONING ``base``'s
+        compiled identity — the delta-apply fast path (serving/swap.py
+        ``swap_delta``).
+
+        A value-only delta never changes kernel geometry (same task,
+        dims, bucket ladder), so the new runtime adopts ``base``'s
+        jitted kernel object and with it every already-compiled bucket:
+        zero compiles, no warmup wall.  The LRU hot sets are then
+        carried (:func:`carry_hot_sets`) — every row REBUILT from the
+        patched model, never copied from the live device tables (the
+        dispatch thread may be mutating those mid-clone).  Geometry
+        drift (a config change) falls back to a full warmup; the result
+        is correct either way."""
+        cfg = config or base.config
+        rt = cls(
+            model,
+            base.index_maps if index_maps is None else index_maps,
+            dataclasses.replace(cfg, warmup=False),
+        )
+        # Restore the caller-visible config: warmup was suppressed only
+        # for THIS construction; a replica restarted from this config
+        # must still warm its ladder.
+        rt.config = cfg
+        if rt._kernel_geometry() == base._kernel_geometry():
+            rt._kernel = base._kernel
+            rt.warmup_compiles = 0
+        elif cfg.warmup:
+            rt.warm_up()
+        if carry_hot:
+            carry_hot_sets(base, rt)
+        return rt
+
     # -- warmup ------------------------------------------------------------
     def _abstract_args(self, bucket: int) -> tuple:
         import jax
@@ -723,3 +776,50 @@ class ScoringRuntime:
             "repromotions": self.repromotions,
             "breaker": self.breaker.snapshot(),
         }
+
+
+def carry_hot_sets(
+    old: ScoringRuntime, new: ScoringRuntime, retries: int = 3
+) -> int:
+    """Seed ``new``'s LRU hot sets from ``old``'s WITHOUT copying device
+    rows.  Returns the number of rows carried.
+
+    Only the KEY LISTS are snapshotted from the live runtime; every
+    carried row is rebuilt dense from ``new``'s (patched) model and
+    inserted in the old LRU→MRU order.  Copying ``old``'s device table
+    instead would race the dispatch thread (an eviction between the
+    slot snapshot and the table reference would map entity A to entity
+    B's row) and would serve STALE rows for delta-changed entities.
+    Rebuilt rows cost one host gather per coordinate — and the scoring
+    contract (``table[slot] + cold`` keeps hot and cold bit-identical)
+    means a raced, slightly-stale KEY list is harmless: it only changes
+    which entities start hot, never any score bit.
+
+    ``old``'s OrderedDict may be mutated mid-iteration by its dispatch
+    thread (RuntimeError); the snapshot retries, then degrades to an
+    empty carry — cold-starting the hot set is always correct."""
+    carried = 0
+    new_by_name = {c.name: c for c in new.random}
+    for oc in old.random:
+        nc = new_by_name.get(oc.name)
+        if nc is None or nc.hot.capacity == 0:
+            continue
+        keys: list = []
+        for _ in range(max(1, retries)):
+            try:
+                keys = oc.hot.hot_keys()
+                break
+            except RuntimeError:  # dict mutated mid-list(); retry
+                keys = []
+        keys = [k for k in keys if k in nc.model.coefficients]
+        if not keys:
+            continue
+        rows = kernels_lib.dense_coefficient_rows(nc.model, keys)
+        for key, row in zip(keys, rows):
+            nc.hot.insert(key, row)
+        carried += len(keys)
+    if carried:
+        telemetry_mod.current().gauge("serving_hot_resident_rows").set(
+            sum(c.hot.size for c in new.random)
+        )
+    return carried

@@ -152,17 +152,30 @@ class ScoringService:
             kill(reason)
 
     def reload(
-        self, model_dir: Optional[str] = None, rollback: bool = False
+        self,
+        model_dir: Optional[str] = None,
+        rollback: bool = False,
+        mode: str = "full",
     ):
         """Hot-swap to the model at ``model_dir`` (or roll back one
-        step).  Returns a :class:`~photon_ml_tpu.serving.swap.
-        SwapResult`; raises SwapInProgressError on concurrent reloads
-        and ValueError when neither argument is given."""
+        step).  ``mode="delta"`` treats ``model_dir`` as a delta
+        artifact (``freshness/delta.py``) and patches only the changed
+        rows of the serving model — ``POST /reload?mode=delta``.
+        Returns a :class:`~photon_ml_tpu.serving.swap.SwapResult`;
+        raises SwapInProgressError on concurrent reloads and ValueError
+        on a missing path or unknown mode."""
         if rollback:
             return self.swapper.rollback()
         if not model_dir:
             raise ValueError(
                 "reload needs 'model_dir' (or 'rollback': true)"
+            )
+        if mode == "delta":
+            return self.swapper.swap_delta(model_dir)
+        if mode != "full":
+            raise ValueError(
+                f"unknown reload mode {mode!r}; expected 'full' or "
+                "'delta'"
             )
         return self.swapper.swap(model_dir)
 
@@ -351,10 +364,13 @@ class _Handler(BaseHTTPRequestHandler):
         return json.loads(self.rfile.read(length) or b"{}")
 
     def do_POST(self) -> None:  # noqa: N802 — stdlib casing
-        if self.path == "/reload":
-            self._do_reload()
+        # Split the query string off before routing: the reload mode
+        # rides it (POST /reload?mode=delta).
+        path, _, query = self.path.partition("?")
+        if path == "/reload":
+            self._do_reload(query)
             return
-        if self.path != "/score":
+        if path != "/score":
             self._send_json(404, {"error": f"no route {self.path}"})
             return
         try:
@@ -377,14 +393,23 @@ class _Handler(BaseHTTPRequestHandler):
             status = 200  # partial failure reports per-row
         self._send_json(status, {"results": results})
 
-    def _do_reload(self) -> None:
+    def _do_reload(self, query: str = "") -> None:
         try:
             obj = self._read_body()
             if not isinstance(obj, dict):
                 raise ValueError("reload body must be a JSON object")
+            # Mode comes from the query string (?mode=delta) or the
+            # body; the body wins when both are present.
+            mode = "full"
+            for part in query.split("&"):
+                key, _, value = part.partition("=")
+                if key == "mode" and value:
+                    mode = value
+            mode = obj.get("mode", mode)
             result = self.server.service.reload(
                 model_dir=obj.get("model_dir"),
                 rollback=bool(obj.get("rollback")),
+                mode=mode,
             )
         except SwapInProgressError as exc:
             self._send_json(409, {"error": str(exc)})

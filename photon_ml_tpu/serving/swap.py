@@ -335,21 +335,33 @@ class HotSwapper:
         runtime_config: Optional[RuntimeConfig],
         version_before: int,
         new_version: int,
+        site: str = "serving.swap",
+        preloaded: Optional[tuple] = None,
+        carry_hot: bool = False,
+        on_success: Optional[Callable] = None,
     ) -> SwapResult:
         """The four swap stages over the worker protocol.  Same chaos
         occurrences (load=0, prepare=1, verify=2) so every scripted
         FaultPlan written against in-process swaps scripts this path
-        identically."""
+        identically.
+
+        The delta path (:meth:`swap_delta`) rides this same machinery
+        with ``site="publish.apply"``, a ``preloaded`` (model,
+        index_maps) it already patched parent-side (its "load" stage —
+        chaos occurrence 0 — already fired there), and
+        ``carry_hot=True`` so each worker clones its compiled kernels
+        and hot sets instead of rebuilding cold."""
         tel = telemetry_mod.current()
         pool = targets[0].pool
         generation = None
         prepared: list = []
         stage = "load"
         try:
-            chaos_mod.maybe_fail(
-                "serving.swap", stage="load", path=model_path
-            )
-            model, index_maps = ScoringRuntime.load_model(model_path)
+            if preloaded is not None:
+                model, index_maps = preloaded
+            else:
+                chaos_mod.maybe_fail(site, stage="load", path=model_path)
+                model, index_maps = ScoringRuntime.load_model(model_path)
             # ONE shared-memory publication for the whole pool; workers
             # attach it zero-copy during prepare.
             generation = pool.publish(
@@ -357,9 +369,12 @@ class HotSwapper:
             )
             stage = "prepare"
             for t in targets:
-                t.swap_prepare(generation.manifest, runtime_config)
+                t.swap_prepare(
+                    generation.manifest, runtime_config,
+                    carry_hot=carry_hot,
+                )
                 prepared.append(t)
-            chaos_mod.maybe_fail("serving.swap", stage="prepare")
+            chaos_mod.maybe_fail(site, stage="prepare")
         except Exception as exc:  # noqa: BLE001 — abort, old version serves
             for t in prepared:
                 t.swap_abort(new_version)
@@ -375,7 +390,7 @@ class HotSwapper:
             for t in targets:
                 t.swap_commit(new_version)
                 committed.append(t)
-            chaos_mod.maybe_fail("serving.swap", stage="verify")
+            chaos_mod.maybe_fail(site, stage="verify")
             for t in targets:
                 fut = t.submit(
                     generation.parser.probe_row(), bypass_admission=True
@@ -417,8 +432,10 @@ class HotSwapper:
             version_after=new_version,
             model_path=model_path,
             targets=len(targets),
-            mode="process",
+            mode="process" if preloaded is None else "process-delta",
         )
+        if on_success is not None:
+            on_success()
         if self._on_commit is not None:
             self._on_commit(
                 model, index_maps,
@@ -431,6 +448,236 @@ class HotSwapper:
             version_after=new_version,
             model_path=model_path,
             targets=len(targets),
+        )
+
+    # -- the delta path ------------------------------------------------------
+    def swap_delta(
+        self,
+        delta_path: str,
+        runtime_config: Optional[RuntimeConfig] = None,
+    ) -> SwapResult:
+        """Roll every live target onto ``delta_path`` — a delta artifact
+        (``freshness/delta.py``), not a model directory — by patching
+        only the changed rows of the currently-serving model.
+
+        Same contract and state machine as :meth:`swap`: serialized,
+        versioned on the same monotone registry (so one-step
+        :meth:`rollback` after a delta apply restores the pre-delta
+        version exactly like after a full swap), deferred while any
+        target is degraded, and never raises for a failed apply — a
+        torn/tampered artifact or a base-mismatch refusal comes back as
+        status ``"rolled_back"`` with the pointed reason, the old
+        version still serving.  Chaos site ``publish.apply`` fires at
+        stages ``load``/``prepare``/``verify`` (occurrences 0/1/2),
+        mirroring ``serving.swap``.
+
+        In-process targets are cloned via
+        :meth:`ScoringRuntime.patched` — shared compiled kernels, hot
+        sets carried and rebuilt from the patched model — so the apply
+        wall is row-patching, not a cold rebuild.  Process workers ride
+        the same swap protocol with a ``carry_hot`` prepare: the parent
+        patches its host-side copy, publishes ONE new shared-memory
+        generation, and each worker clones its own runtime around the
+        attached tables."""
+        if not self._swap_lock.acquire(blocking=False):
+            raise SwapInProgressError(
+                "a model swap is already in progress; retry after it "
+                "completes"
+            )
+        try:
+            self.in_progress = True
+            return self._swap_delta_locked(delta_path, runtime_config)
+        finally:
+            self.in_progress = False
+            self._swap_lock.release()
+
+    def _swap_delta_locked(
+        self, delta_path: str, runtime_config: Optional[RuntimeConfig]
+    ) -> SwapResult:
+        # Runtime import: freshness imports serving for its applier, so
+        # a module-level import here would be circular.
+        from photon_ml_tpu.freshness.delta import apply_delta, read_delta
+
+        tel = telemetry_mod.current()
+        version_before = self.version
+        new_version = self._max_version + 1
+        targets = list(self._targets_fn())
+        if not targets:
+            return self._rolled_back(
+                version_before, delta_path, "load",
+                "no live targets to apply the delta to", 0,
+            )
+        if any(getattr(t.runtime, "degraded", False) for t in targets):
+            self.deferred += 1
+            tel.counter("serving_swaps_deferred_total").inc()
+            tel.event(
+                "serving.swap_deferred",
+                model_path=delta_path,
+                version=version_before,
+                mode="delta",
+            )
+            return SwapResult(
+                status="deferred",
+                version_before=version_before,
+                version_after=version_before,
+                model_path=delta_path,
+                stage="load",
+                reason="a target runtime is degraded; recover or "
+                "restart it before applying a delta",
+                targets=len(targets),
+            )
+
+        if hasattr(targets[0], "swap_prepare"):
+            # Process mode: patch the parent's host-side copy of the
+            # serving model, then roll the patched model through the
+            # shared swap protocol as a new shm generation.
+            stage = "load"
+            try:
+                chaos_mod.maybe_fail(
+                    "publish.apply", stage="load", path=delta_path
+                )
+                pool = targets[0].pool
+                base_model, index_maps = pool.current_model()
+                delta = read_delta(delta_path)
+                model = apply_delta(base_model, delta)
+            except Exception as exc:  # noqa: BLE001 — refuse, old serves
+                return self._rolled_back(
+                    version_before, delta_path, stage,
+                    f"{type(exc).__name__}: {exc}"[:300], len(targets),
+                )
+            return self._swap_remote(
+                targets, delta_path, runtime_config,
+                version_before, new_version,
+                site="publish.apply",
+                preloaded=(model, index_maps),
+                carry_hot=True,
+                on_success=lambda: self._record_freshness(
+                    delta, new_version, len(targets)
+                ),
+            )
+
+        stage = "load"
+        try:
+            chaos_mod.maybe_fail(
+                "publish.apply", stage="load", path=delta_path
+            )
+            delta = read_delta(delta_path)
+            # Replicas restarted through a factory hold DISTINCT (but
+            # bitwise-equal) model objects; patch once per distinct base
+            # and let apply_delta's whole-base checksum verification
+            # refuse any target that ACTUALLY diverged — that comes back
+            # as a rolled_back with the pointed base-mismatch reason.
+            patched_by_base: dict = {}
+            for t in targets:
+                key = id(t.runtime.model)
+                if key not in patched_by_base:
+                    patched_by_base[key] = apply_delta(
+                        t.runtime.model, delta
+                    )
+            model = patched_by_base[id(targets[0].runtime.model)]
+            index_maps = targets[0].runtime.index_maps
+            stage = "prepare"
+            fresh = []
+            for t in targets:
+                cfg = runtime_config or t.runtime.config
+                rt = ScoringRuntime.patched(
+                    t.runtime,
+                    patched_by_base[id(t.runtime.model)],
+                    t.runtime.index_maps,
+                    cfg,
+                )
+                rt.model_version = new_version
+                rt.model_path = delta_path
+                margins, means = rt.score_rows([rt.probe_row()])
+                if not (
+                    np.isfinite(margins).all() and np.isfinite(means).all()
+                ):
+                    raise ValueError(
+                        "pre-commit verification probe returned "
+                        "non-finite scores"
+                    )
+                fresh.append(rt)
+            chaos_mod.maybe_fail("publish.apply", stage="prepare")
+        except Exception as exc:  # noqa: BLE001 — refuse, old serves
+            return self._rolled_back(
+                version_before, delta_path, stage,
+                f"{type(exc).__name__}: {exc}"[:300], len(targets),
+            )
+
+        # Atomic commit + through-the-dispatch-path verify: identical
+        # discipline to the full swap.
+        previous = [(t, t.runtime) for t in targets]
+        for t, rt in zip(targets, fresh):
+            t.runtime = rt
+        try:
+            chaos_mod.maybe_fail("publish.apply", stage="verify")
+            for t, rt in zip(targets, fresh):
+                fut = t.submit(rt.probe_row(), bypass_admission=True)
+                result = fut.result(timeout=self.probe_timeout_s)
+                if not np.isfinite(result["score"]):
+                    raise ValueError(
+                        "post-apply probe returned a non-finite score"
+                    )
+        except Exception as exc:  # noqa: BLE001 — roll back, then report
+            for t, old in previous:
+                t.runtime = old
+            return self._rolled_back(
+                version_before, delta_path, "verify",
+                f"{type(exc).__name__}: {exc}"[:300], len(targets),
+            )
+
+        self.version = new_version
+        self._max_version = new_version
+        self.model_path = delta_path
+        self._previous = previous
+        self._remote_previous = None
+        self.swaps += 1
+        tel.counter("serving_swaps_total").inc()
+        tel.gauge("serving_model_version").set(new_version)
+        tel.event(
+            "serving.swap",
+            version_before=version_before,
+            version_after=new_version,
+            model_path=delta_path,
+            targets=len(targets),
+            mode="delta",
+        )
+        self._record_freshness(delta, new_version, len(targets))
+        if self._on_commit is not None:
+            sample = fresh[0]
+            self._on_commit(
+                model, index_maps, sample.config, new_version, delta_path
+            )
+        return SwapResult(
+            status="swapped",
+            version_before=version_before,
+            version_after=new_version,
+            model_path=delta_path,
+            targets=len(targets),
+        )
+
+    def _record_freshness(
+        self, delta, new_version: int, targets: int
+    ) -> None:
+        """Delta-apply observability: the moment a delta commits, its
+        newest absorbed event is SERVABLE — the event→servable histogram
+        is the freshness SLO (docs/freshness.md)."""
+        tel = telemetry_mod.current()
+        tel.counter("freshness_deltas_applied_total").inc()
+        tel.counter("freshness_delta_rows").inc(delta.n_changed_rows)
+        tel.gauge("freshness_applied_version").set(new_version)
+        if delta.event_wall_epoch is not None:
+            import time
+
+            now_wall = time.time()
+            tel.histogram("freshness_event_to_servable_seconds").observe(
+                max(0.0, now_wall - delta.event_wall_epoch)
+            )
+        tel.event(
+            "freshness.delta_applied",
+            version=new_version,
+            rows=delta.n_changed_rows,
+            targets=targets,
         )
 
     def _rolled_back(

@@ -14,7 +14,8 @@ Frames the worker understands (parent → worker)::
 
     score         {id, row, timeout_ms, bypass}  → result {id, ok, ...}
     stats         {id}                           → result {id, ok, value}
-    swap_prepare  {manifest, runtime_config?}    → swap_ready | swap_failed
+    swap_prepare  {manifest, runtime_config?, carry_hot?}
+                                                 → swap_ready | swap_failed
     swap_commit   {version}                      → swap_done
     swap_rollback {}                             → swap_done
     swap_abort    {version}                      (no reply)
@@ -156,13 +157,25 @@ class _WorkerMain:
             self._heartbeat_once()
 
     # -- swap protocol -----------------------------------------------------
-    def _do_prepare(self, manifest: dict, runtime_config) -> None:
+    def _do_prepare(
+        self, manifest: dict, runtime_config, carry_hot: bool = False
+    ) -> None:
         version = int(manifest.get("version", 0))
         try:
             model, attachment = shm_model.attach_model(manifest)
-            runtime = ScoringRuntime(
-                model, {}, runtime_config or self._runtime_config
-            )
+            if carry_hot:
+                # Delta apply: clone the SERVING runtime's compiled
+                # kernels and hot sets around the attached model
+                # (ScoringRuntime.patched) — the staged runtime costs
+                # row rebuilds, not a cold compile+warmup pass.
+                runtime = ScoringRuntime.patched(
+                    self._batcher.runtime, model, {},
+                    runtime_config or self._runtime_config,
+                )
+            else:
+                runtime = ScoringRuntime(
+                    model, {}, runtime_config or self._runtime_config
+                )
             runtime.model_version = version
             runtime.model_path = manifest.get("path")
             margins, _ = runtime.score_rows([runtime.probe_row()])
@@ -188,7 +201,10 @@ class _WorkerMain:
             self._prepare_thread.join()
         self._prepare_thread = threading.Thread(
             target=self._do_prepare,
-            args=(msg["manifest"], msg.get("runtime_config")),
+            args=(
+                msg["manifest"], msg.get("runtime_config"),
+                bool(msg.get("carry_hot")),
+            ),
             name=f"worker-{self._worker_id}-swap-prepare",
             daemon=True,
         )

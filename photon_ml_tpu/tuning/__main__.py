@@ -84,6 +84,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="bounded in-place retries of TRANSIENT trial "
                    "failures (watchdog classification)")
     p.add_argument("--no-warm-start", action="store_true")
+    p.add_argument("--warm-start-dir",
+                   help="published model (GLM .avro or GAME dir) whose "
+                   "fixed-effect coefficients seed trials before any "
+                   "completed trial exists — chain a search onto the "
+                   "freshest published model (docs/freshness.md)")
     p.add_argument("--no-fsync", action="store_true",
                    help="skip the per-record journal fsync (faster, "
                    "crash-safety reduced to flush)")
@@ -506,6 +511,7 @@ def run_search(args) -> dict:
                 asha=asha,
                 retry=RetryPolicy(max_retries=args.max_retries),
                 warm_start=not args.no_warm_start,
+                warm_start_dir=args.warm_start_dir,
             )
             journal = TuningJournal(
                 args.output_dir, fsync=not args.no_fsync

@@ -41,6 +41,7 @@ sinks the sweep.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor, wait
 from typing import Callable, Optional
@@ -129,6 +130,11 @@ class TuningConfig:
     asha: Optional[AshaConfig] = None
     retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
     warm_start: bool = True
+    #: published model to SEED warm starts from (a saved GLM ``.avro``
+    #: or a GAME model directory, whose fixed-effect means are used)
+    #: when no completed trial is closer — chains a tuning run onto the
+    #: freshest published model instead of cold-starting trial 1.
+    warm_start_dir: Optional[str] = None
     sleep: Callable[[float], None] = time.sleep
 
     def __post_init__(self):
@@ -207,6 +213,7 @@ class TuningOrchestrator:
             "resource": self.config.resource,
             "max_trials": self.config.max_trials,
             "workers": self.config.workers,
+            "warm_start_dir": self.config.warm_start_dir,
             "wall_epoch": time.time(),
         }
 
@@ -221,7 +228,7 @@ class TuningOrchestrator:
             )
         ours = self._header()
         for key in ("maximize", "proposer", "asha", "resource",
-                    "max_trials", "workers"):
+                    "max_trials", "workers", "warm_start_dir"):
             if header.get(key) != ours[key]:
                 raise ResumeMismatch(
                     f"refusing to resume: journal {key}={header.get(key)!r} "
@@ -415,14 +422,48 @@ class TuningOrchestrator:
     def _warm_start(self, task: _Task) -> Optional[np.ndarray]:
         if task.trial.coefficients is not None:
             return task.trial.coefficients  # own previous rung
-        if not self.config.warm_start or not self._completed_coefs:
+        if not self.config.warm_start:
             return None
-        z = self.space.normalize(task.trial.params)[0]
-        best = min(
-            self._completed_coefs.items(),
-            key=lambda kv: (float(np.sum((kv[1][0] - z) ** 2)), kv[0]),
-        )
-        return best[1][1]
+        if self._completed_coefs:
+            z = self.space.normalize(task.trial.params)[0]
+            best = min(
+                self._completed_coefs.items(),
+                key=lambda kv: (float(np.sum((kv[1][0] - z) ** 2)), kv[0]),
+            )
+            return best[1][1]
+        return self._published_warm_start()
+
+    def _published_warm_start(self) -> Optional[np.ndarray]:
+        """Seed coefficients from ``config.warm_start_dir`` — the
+        freshest PUBLISHED model — used only before any trial of this
+        run has completed (after that, same-search neighbors are the
+        better prior).  Loaded lazily once; a bad explicit path fails
+        the run loudly rather than silently cold-starting."""
+        if self.config.warm_start_dir is None:
+            return None
+        if not hasattr(self, "_published_coefs"):
+            path = self.config.warm_start_dir
+            if os.path.isdir(path):
+                from photon_ml_tpu.io.game_store import load_game_model
+
+                model, _ = load_game_model(path)
+                fixed = [
+                    c.model for c in model.models.values()
+                    if hasattr(c, "model")
+                ]
+                if not fixed:
+                    raise ValueError(
+                        f"warm_start_dir {path!r} is a GAME model with "
+                        "no fixed-effect coordinate — nothing to seed "
+                        "trial coefficients from"
+                    )
+                means = fixed[0].coefficients.means
+            else:
+                from photon_ml_tpu.io.model_store import load_glm_model
+
+                means = load_glm_model(path)[0].coefficients.means
+            self._published_coefs = np.asarray(means, np.float32)
+        return self._published_coefs
 
     def _run_task(self, task: _Task) -> None:
         """Worker thread: run one rung, retrying transient failures in

@@ -63,6 +63,16 @@ env JAX_PLATFORMS=cpu python -m photon_ml_tpu.tuning --selfcheck
 echo "== chaos selfcheck (JAX_PLATFORMS=cpu) =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.chaos --selfcheck
 
+# The freshness selfcheck runs the whole continuous train->serve loop:
+# labeled events from a drifted truth model online-refine the serving
+# model, the refinement delta-publishes crash-safely and hot-applies to
+# a live 2-replica service MID-SCENARIO under open-loop load, gating on
+# zero failed requests, bitwise parity with a full reload of the
+# refined model, one-step rollback, and the event->servable freshness
+# SLO landing in metrics.json (docs/freshness.md).
+echo "== freshness selfcheck (JAX_PLATFORMS=cpu) =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.freshness --selfcheck
+
 echo "== tier-1 tests (JAX_PLATFORMS=cpu) =="
 if [[ "${1:-}" == "--fast" ]]; then
   # Streaming-parity smoke rides the fast lane: a tiny 4-chunk store,
@@ -74,7 +84,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     tests/test_telemetry.py tests/test_ops_plane.py \
     tests/test_watchdog.py \
     tests/test_serving.py tests/test_serving_ha.py \
-    tests/test_serving_proc.py \
+    tests/test_serving_proc.py tests/test_freshness.py \
     tests/test_tuning.py tests/test_chaos.py \
     "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
     -m 'not slow' -q -p no:cacheprovider

@@ -151,6 +151,19 @@ class TestLockBlockingCall:
         ))
         assert len(found) == 2
 
+    def test_accepts_nonblocking_joins_under_lock(self, tmp_path):
+        # os.path.join and "sep".join never block — only thread-like
+        # .join() calls convoy the lock.
+        assert _findings(tmp_path, "lock-blocking-call", (
+            "import os, threading\n"
+            "lock = threading.Lock()\n"
+            "def ok(parts):\n"
+            "    with lock:\n"
+            "        p = os.path.join('a', 'b')\n"
+            "        s = ', '.join(parts)\n"
+            "    return p, s\n"
+        )) == []
+
     def test_accepts_sleep_outside_lock(self, tmp_path):
         assert _findings(tmp_path, "lock-blocking-call", (
             "import threading, time\n"

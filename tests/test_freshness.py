@@ -1,0 +1,762 @@
+"""Continuous train->serve loop tests (ISSUE 12).
+
+The load-bearing contracts:
+
+- a delta diffed from two models and applied back is BITWISE-IDENTICAL
+  to loading the target outright — for a plain GLM and for a
+  multi-coordinate GAME model (modified, added, AND removed entities);
+- a tampered or torn artifact is refused with a pointed error naming
+  the file, and applying against the wrong base refuses BEFORE touching
+  anything (whole-base checksum verification);
+- a publish killed at EVERY record/rename boundary resumes exactly:
+  either the publication is completed (artifact already durable) or
+  cleanly aborted — subscribers never see a half-publish;
+- the serving delta path (``swap_delta``) patches live replicas with
+  shared compiled kernels, rides the version registry (one-step
+  rollback), and rolls back on a bad artifact with the old version
+  still serving — in-process and across process workers;
+- online refinement is deterministic, only touches what the events
+  touched, and publishes through the same artifact path;
+- ``read_fingerprints`` answers cheaply on current stores and points
+  legacy fingerprint-less saves at a re-save;
+- the tuning executor seeds warm starts from an explicitly published
+  model directory.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import chaos
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.freshness.applier import DeltaApplier
+from photon_ml_tpu.freshness.delta import (
+    DeltaBaseMismatchError,
+    DeltaError,
+    DeltaFormatError,
+    apply_delta,
+    diff_game_models,
+    diff_model_dirs,
+    model_table_checksums,
+    read_delta,
+    write_delta,
+)
+from photon_ml_tpu.freshness.online import (
+    LabeledEvent,
+    OnlineRefiner,
+    RefinerConfig,
+)
+from photon_ml_tpu.freshness.publisher import (
+    DeltaPublisher,
+    PublishAborted,
+    read_publications,
+)
+from photon_ml_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.io.game_store import save_game_model
+from photon_ml_tpu.io import game_store, model_store
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.serving.batcher import BatcherConfig
+from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+from photon_ml_tpu.serving.service import ScoringService
+from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+
+@pytest.fixture(scope="module")
+def workload():
+    return SyntheticWorkload(n_entities=32, seed=7)
+
+
+def _perturbed(seed=7, n_entities=32):
+    """A copy of `workload`'s world with 5 modified, 1 added, and 1
+    removed random-effect entity plus shifted fixed means — the shape
+    of a real incremental retrain."""
+    w = SyntheticWorkload(n_entities=n_entities, seed=seed)
+    re = w.model.models["per_entity"]
+    for k in [f"u{i}" for i in range(5)]:
+        cols, vals = re.coefficients[k]
+        re.coefficients[k] = (
+            cols, (vals + np.float32(0.25)).astype(np.float32)
+        )
+    cols = np.arange(w.re_dim, dtype=np.int32)
+    re.coefficients["brand_new"] = (
+        cols, np.full(w.re_dim, 0.5, np.float32)
+    )
+    del re.coefficients[f"u{n_entities - 1}"]
+    fixed = w.model.models["fixed"].model
+    fixed.coefficients.means = (
+        np.asarray(fixed.coefficients.means, np.float32) + np.float32(0.125)
+    )
+    return w
+
+
+def _assert_bitwise_equal(got: GameModel, want: GameModel):
+    assert model_table_checksums(got) == model_table_checksums(want)
+    for name, coord in want.models.items():
+        other = got.models[name]
+        if isinstance(coord, RandomEffectModel):
+            assert set(other.coefficients) == set(coord.coefficients)
+            for k, (cols, vals) in coord.coefficients.items():
+                assert other.coefficients[k][0].tobytes() == cols.tobytes()
+                assert other.coefficients[k][1].tobytes() == vals.tobytes()
+        else:
+            assert (
+                np.asarray(other.model.coefficients.means).tobytes()
+                == np.asarray(coord.model.coefficients.means).tobytes()
+            )
+
+
+class TestDeltaRoundTrip:
+    def test_game_multi_coordinate_bitwise(self, tmp_path, workload):
+        target = _perturbed()
+        delta = diff_game_models(
+            workload.model, target.model, event_wall_epoch=123.0
+        )
+        names = {c.name for c in delta.changed_coordinates}
+        assert names == {"fixed", "per_entity"}
+        ddir = str(tmp_path / "delta")
+        write_delta(delta, ddir)
+        patched = apply_delta(workload.model, read_delta(ddir))
+        _assert_bitwise_equal(patched, target.model)
+        # The base was never mutated (apply builds new objects).
+        assert "brand_new" not in workload.model.models[
+            "per_entity"
+        ].coefficients
+
+    def test_diff_model_dirs_uses_fingerprints(self, tmp_path, workload):
+        target = _perturbed()
+        d1, d2 = str(tmp_path / "v1"), str(tmp_path / "v2")
+        save_game_model(workload.model, workload.index_maps, d1)
+        save_game_model(target.model, target.index_maps, d2)
+        delta = diff_model_dirs(d1, d2, event_wall_epoch=5.0)
+        assert delta.event_wall_epoch == 5.0
+        base, _ = ScoringRuntime.load_model(d1)
+        want, _ = ScoringRuntime.load_model(d2)
+        ddir = str(tmp_path / "delta")
+        write_delta(delta, ddir)
+        _assert_bitwise_equal(apply_delta(base, read_delta(ddir)), want)
+
+    def test_glm_avro_bitwise(self, tmp_path):
+        imap = IndexMap.build(
+            [feature_key(f"f{j}", "") for j in range(6)]
+        )
+        m1 = GeneralizedLinearModel(
+            Coefficients(
+                means=np.arange(1, 7, dtype=np.float32) * np.float32(0.3)
+            ),
+            "logistic",
+        )
+        m2 = GeneralizedLinearModel(
+            Coefficients(
+                means=np.asarray(m1.coefficients.means) + np.float32(0.5)
+            ),
+            "logistic",
+        )
+        p1, p2 = str(tmp_path / "m1.avro"), str(tmp_path / "m2.avro")
+        model_store.save_glm_model(m1, imap, p1)
+        model_store.save_glm_model(m2, imap, p2)
+        delta = diff_model_dirs(p1, p2)
+        ddir = str(tmp_path / "delta")
+        write_delta(delta, ddir)
+        base, _ = ScoringRuntime.load_model(p1)
+        want, _ = ScoringRuntime.load_model(p2)
+        patched = apply_delta(base, read_delta(ddir))
+        _assert_bitwise_equal(patched, want)
+
+    def test_identical_models_make_empty_delta(self, workload):
+        w2 = SyntheticWorkload(n_entities=32, seed=7)
+        delta = diff_game_models(workload.model, w2.model)
+        assert delta.empty and delta.n_changed_rows == 0
+
+    def test_structural_change_refused(self, workload):
+        re = workload.model.models["per_entity"]
+        other = GameModel(
+            models={
+                "fixed": workload.model.models["fixed"],
+                "renamed": re,
+            },
+            task=workload.model.task,
+        )
+        with pytest.raises(DeltaError, match="coordinate"):
+            diff_game_models(workload.model, other)
+
+
+class TestArtifactIntegrity:
+    def _delta_dir(self, tmp_path, workload) -> str:
+        ddir = str(tmp_path / "delta")
+        write_delta(
+            diff_game_models(workload.model, _perturbed().model), ddir
+        )
+        return ddir
+
+    def test_flipped_segment_byte_refused(self, tmp_path, workload):
+        ddir = self._delta_dir(tmp_path, workload)
+        seg = next(
+            os.path.join(ddir, f) for f in os.listdir(ddir)
+            if f.startswith("segment-")
+        )
+        with open(seg, "r+b") as f:
+            f.seek(-8, os.SEEK_END)
+            byte = f.read(1)
+            f.seek(-8, os.SEEK_END)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        with pytest.raises(DeltaFormatError, match=os.path.basename(seg)):
+            read_delta(ddir)
+
+    def test_truncated_manifest_refused(self, tmp_path, workload):
+        ddir = self._delta_dir(tmp_path, workload)
+        manifest = os.path.join(ddir, "delta.json")
+        with open(manifest, "r+") as f:
+            f.truncate(os.path.getsize(manifest) // 2)
+        with pytest.raises(DeltaFormatError):
+            read_delta(ddir)
+
+    def test_edited_manifest_refused(self, tmp_path, workload):
+        ddir = self._delta_dir(tmp_path, workload)
+        manifest = os.path.join(ddir, "delta.json")
+        with open(manifest) as f:
+            body = json.load(f)
+        body["task"] = "poisson"
+        with open(manifest, "w") as f:
+            json.dump(body, f)
+        with pytest.raises(DeltaFormatError, match="digest"):
+            read_delta(ddir)
+
+    def test_wrong_base_refused_before_patching(self, tmp_path, workload):
+        ddir = self._delta_dir(tmp_path, workload)
+        stranger = SyntheticWorkload(n_entities=32, seed=9)
+        before = model_table_checksums(stranger.model)
+        with pytest.raises(DeltaBaseMismatchError, match="DIFFERENT base"):
+            apply_delta(stranger.model, read_delta(ddir))
+        assert model_table_checksums(stranger.model) == before
+
+
+class TestPublisher:
+    def _delta(self, workload):
+        return diff_game_models(
+            workload.model, _perturbed().model, event_wall_epoch=42.0
+        )
+
+    def test_publish_and_read_back(self, tmp_path, workload):
+        root = str(tmp_path / "pubs")
+        with telemetry.Telemetry(sinks=[]):
+            with DeltaPublisher(root) as pub:
+                p = pub.publish(self._delta(workload))
+                assert p.seq == 1 and p.event_wall_epoch == 42.0
+                assert pub.publications() == [p]
+        # Read-only subscriber view agrees without touching the journal.
+        assert read_publications(root) == [p]
+        patched = apply_delta(workload.model, read_delta(p.path))
+        _assert_bitwise_equal(patched, _perturbed().model)
+
+    def test_crash_at_every_chaos_boundary_resumes(
+        self, tmp_path, workload
+    ):
+        # Occurrences 0/1/2 of publish.delta bracket journal-begin,
+        # artifact staging, and the commit record.  A kill at each must
+        # resume to a settled root: completed iff the rename happened.
+        for at, settled_as in ((0, "abort"), (1, "abort"), (2, "commit")):
+            root = str(tmp_path / f"pubs{at}")
+            with telemetry.Telemetry(sinks=[]):
+                plan = chaos.FaultPlan([
+                    chaos.FaultSpec(site="publish.delta", at=at),
+                ])
+                pub = DeltaPublisher(root)
+                with plan:
+                    with pytest.raises(Exception, match="chaos-injected"):
+                        pub.publish(self._delta(workload))
+                pub.close()
+                resumed = DeltaPublisher(root)
+                records = resumed._read()
+                assert records[-1]["kind"] == settled_as, f"at={at}"
+                assert records[-1]["resumed"] is True
+                pubs = resumed.publications()
+                if settled_as == "commit":
+                    assert len(pubs) == 1
+                    patched = apply_delta(
+                        workload.model, read_delta(pubs[0].path)
+                    )
+                    _assert_bitwise_equal(patched, _perturbed().model)
+                else:
+                    assert pubs == []
+                    assert not any(
+                        f.endswith(".staging")
+                        for f in os.listdir(root)
+                    )
+                # The sequence is claimed either way; publishing again
+                # continues past it.
+                p2 = resumed.publish(self._delta(workload))
+                assert p2.seq == 2
+                resumed.close()
+
+    def test_abort_after_journal_record_sweep(self, tmp_path, workload):
+        # The tuning/state.py-style abort hook kills the append itself:
+        # abort_after=0 dies before `begin`, =1 dies on `commit` (the
+        # artifact is already renamed, so resume must COMPLETE it).
+        for abort_after, n_pubs in ((0, 0), (1, 1)):
+            root = str(tmp_path / f"abort{abort_after}")
+            with telemetry.Telemetry(sinks=[]):
+                pub = DeltaPublisher(root, abort_after=abort_after)
+                with pytest.raises(PublishAborted):
+                    pub.publish(self._delta(workload))
+                pub.close()
+                resumed = DeltaPublisher(root)
+                assert len(resumed.publications()) == n_pubs
+                resumed.close()
+
+    def test_mid_file_journal_corruption_raises(self, tmp_path, workload):
+        root = str(tmp_path / "pubs")
+        with telemetry.Telemetry(sinks=[]):
+            with DeltaPublisher(root) as pub:
+                pub.publish(self._delta(workload))
+        journal = os.path.join(root, DeltaPublisher.JOURNAL)
+        lines = open(journal).read().splitlines()
+        lines[0] = lines[0][: len(lines[0]) // 2]
+        with open(journal, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with pytest.raises(DeltaError, match="corrupt journal"):
+            read_publications(root)
+
+
+def _runtime(workload, **kwargs):
+    cfg = RuntimeConfig(
+        **{"max_batch_size": 8, "hot_entities": 8, **kwargs}
+    )
+    return ScoringRuntime(workload.model, workload.index_maps, cfg)
+
+
+def _publish_one(tmp_path, workload):
+    root = str(tmp_path / "pubs")
+    with DeltaPublisher(root) as pub:
+        p = pub.publish(diff_game_models(
+            workload.model, _perturbed().model, event_wall_epoch=1.0
+        ))
+    return root, p
+
+
+class TestSwapDelta:
+    def test_in_process_apply_parity_and_rollback(
+        self, tmp_path, workload
+    ):
+        target = _perturbed()
+        requests = [workload.request(i) for i in range(8)]
+        want = np.asarray(
+            [
+                _runtime(target)
+                .score_rows([_runtime(target).parse_request(r)])[0][0]
+                for r in requests
+            ],
+            np.float32,
+        )
+        with telemetry.Telemetry(sinks=[]):
+            root, p = _publish_one(tmp_path, workload)
+            service = ScoringService(_runtime(workload))
+            with service:
+                before = service.batcher.runtime
+                result = service.reload(p.path, mode="delta")
+                assert result.status == "swapped", result
+                assert service.swapper.version == 2
+                after = service.batcher.runtime
+                # Kernel shared by geometry, no recompiles on the patch.
+                assert after._kernel is before._kernel
+                assert after.warmup_compiles == 0
+                got = np.asarray(
+                    [
+                        np.float32(service.score(r)["score"])
+                        for r in requests
+                    ],
+                    np.float32,
+                )
+                assert got.tobytes() == want.tobytes()
+                rb = service.reload(rollback=True)
+                assert rb.status == "swapped" or rb.version_after == 1
+                assert service.swapper.version == 1
+                assert service.batcher.runtime is before
+
+    def test_distinct_equal_base_objects_apply(self, tmp_path, workload):
+        # Factory-restarted replicas hold different (bitwise-equal)
+        # model objects; the delta still applies to every one.
+        with telemetry.Telemetry(sinks=[]):
+            root, p = _publish_one(tmp_path, workload)
+            v1_dir = str(tmp_path / "v1")
+            save_game_model(workload.model, workload.index_maps, v1_dir)
+            cfg = RuntimeConfig(max_batch_size=8, hot_entities=8)
+            supervisor = ReplicaSupervisor(
+                lambda: ScoringRuntime.load(v1_dir, cfg), n_replicas=2,
+                probe_interval_s=3600.0,
+            )
+            service = ScoringService(supervisor, BatcherConfig(
+                max_batch_size=8, max_wait_us=1_000, max_queue=64,
+            ))
+            with service:
+                models = {
+                    id(r.batcher.runtime.model)
+                    for r in supervisor.replicas
+                }
+                assert len(models) == 2  # genuinely distinct objects
+                result = service.reload(p.path, mode="delta")
+                assert result.status == "swapped", result
+                want = model_table_checksums(_perturbed().model)
+                for rep in supervisor.replicas:
+                    assert model_table_checksums(
+                        rep.batcher.runtime.model
+                    ) == want
+
+    def test_diverged_base_rolls_back(self, tmp_path, workload):
+        with telemetry.Telemetry(sinks=[]):
+            root, p = _publish_one(tmp_path, workload)
+            stranger = SyntheticWorkload(n_entities=32, seed=9)
+            service = ScoringService(_runtime(stranger))
+            with service:
+                result = service.reload(p.path, mode="delta")
+                assert result.status == "rolled_back"
+                assert "base" in result.reason
+                assert service.swapper.version == 1
+
+    def test_tampered_artifact_rolls_back(self, tmp_path, workload):
+        with telemetry.Telemetry(sinks=[]):
+            root, p = _publish_one(tmp_path, workload)
+            seg = next(
+                os.path.join(p.path, f) for f in os.listdir(p.path)
+                if f.startswith("segment-")
+            )
+            with open(seg, "r+b") as f:
+                f.seek(-8, os.SEEK_END)
+                byte = f.read(1)
+                f.seek(-8, os.SEEK_END)
+                f.write(bytes([byte[0] ^ 0xFF]))
+            service = ScoringService(_runtime(workload))
+            with service:
+                result = service.reload(p.path, mode="delta")
+                assert result.status == "rolled_back"
+                assert result.stage == "load"
+                assert service.swapper.version == 1
+
+    def test_chaos_verify_failure_restores(self, tmp_path, workload):
+        requests = [workload.request(i) for i in range(4)]
+        ref = np.asarray(
+            [
+                _runtime(workload)
+                .score_rows([_runtime(workload).parse_request(r)])[0][0]
+                for r in requests
+            ],
+            np.float32,
+        )
+        with telemetry.Telemetry(sinks=[]):
+            root, p = _publish_one(tmp_path, workload)
+            service = ScoringService(_runtime(workload))
+            plan = chaos.FaultPlan([
+                chaos.FaultSpec(site="publish.apply", at=2),
+            ])
+            with service:
+                with plan:
+                    result = service.reload(p.path, mode="delta")
+                assert result.status == "rolled_back"
+                assert result.stage == "verify"
+                assert service.swapper.version == 1
+                got = np.asarray(
+                    [
+                        np.float32(service.score(r)["score"])
+                        for r in requests
+                    ],
+                    np.float32,
+                )
+                assert got.tobytes() == ref.tobytes()
+
+    def test_unknown_reload_mode_raises(self, workload):
+        with telemetry.Telemetry(sinks=[]):
+            service = ScoringService(_runtime(workload))
+            with service:
+                with pytest.raises(ValueError, match="mode"):
+                    service.reload("/nowhere", mode="sideways")
+
+
+class TestApplier:
+    def test_poll_applies_in_order_and_skips_failed(
+        self, tmp_path, workload
+    ):
+        with telemetry.Telemetry(sinks=[]) as tel:
+            root = str(tmp_path / "pubs")
+            middle = _perturbed()
+            final = _perturbed()
+            re = final.model.models["per_entity"]
+            cols, vals = re.coefficients["u0"]
+            re.coefficients["u0"] = (
+                cols, (vals + np.float32(1.0)).astype(np.float32)
+            )
+            with DeltaPublisher(root) as pub:
+                p1 = pub.publish(diff_game_models(
+                    workload.model, middle.model, event_wall_epoch=1.0
+                ))
+                p2 = pub.publish(diff_game_models(
+                    middle.model, final.model, event_wall_epoch=2.0
+                ))
+            service = ScoringService(_runtime(workload))
+            applier = DeltaApplier(service, root)
+            with service:
+                results = applier.poll_once()
+                assert [r.status for r in results] == [
+                    "swapped", "swapped"
+                ]
+                assert applier.applied == 2 and not applier.failed
+                assert service.swapper.version == 3
+                assert model_table_checksums(
+                    service.batcher.runtime.model
+                ) == model_table_checksums(final.model)
+                # Nothing pending; a second poll is a no-op.
+                assert applier.poll_once() == []
+            snap = tel.snapshot()
+        assert snap["counters"]["freshness_deltas_applied_total"] == 2
+        assert (
+            snap["histograms"]["freshness_event_to_servable_seconds"][
+                "count"
+            ] == 2
+        )
+        assert snap["gauges"]["freshness_model_age_seconds"] >= 0.0
+
+    def test_failed_apply_recorded_not_retried(self, tmp_path, workload):
+        with telemetry.Telemetry(sinks=[]) as tel:
+            root, p = _publish_one(tmp_path, workload)
+            stranger = SyntheticWorkload(n_entities=32, seed=9)
+            service = ScoringService(_runtime(stranger))
+            applier = DeltaApplier(service, root)
+            with service:
+                results = applier.poll_once()
+                assert [r.status for r in results] == ["rolled_back"]
+                assert applier.failed == [p.seq]
+                assert applier.poll_once() == []  # no retry storm
+            snap = tel.snapshot()
+        assert snap["counters"]["freshness_apply_failures_total"] == 1
+
+    def test_background_thread_lifecycle(self, tmp_path, workload):
+        with telemetry.Telemetry(sinks=[]):
+            root, p = _publish_one(tmp_path, workload)
+            service = ScoringService(_runtime(workload))
+            with service:
+                applier = DeltaApplier(
+                    service, root, poll_interval_s=0.01
+                )
+                with applier:
+                    deadline = 100
+                    while applier.applied < 1 and deadline:
+                        deadline -= 1
+                        threading.Event().wait(0.05)
+                assert applier.applied == 1
+                assert service.swapper.version == 2
+
+
+class TestOnlineRefiner:
+    def _events(self, workload, n=30, seed=5):
+        rng = np.random.default_rng(seed)
+        return [
+            LabeledEvent(
+                features={
+                    workload.fixed_shard: rng.normal(
+                        size=workload.fixed_dim
+                    ).astype(np.float32),
+                    workload.re_shard: rng.normal(
+                        size=workload.re_dim
+                    ).astype(np.float32),
+                },
+                ids={workload.entity_key: f"u{rng.integers(6)}"},
+                label=float(rng.integers(2)),
+                wall_epoch=float(10 + rng.integers(5)),
+            )
+            for _ in range(n)
+        ]
+
+    def test_deterministic_and_touch_scoped(self, workload):
+        with telemetry.Telemetry(sinks=[]):
+            events = self._events(workload)
+            a = OnlineRefiner(workload.model, RefinerConfig(seed=1))
+            b = OnlineRefiner(workload.model, RefinerConfig(seed=1))
+            a.consume(events)
+            b.consume(events)
+            ra, rb = a.refined_model(), b.refined_model()
+            assert model_table_checksums(ra) == model_table_checksums(rb)
+            touched = set(a.touched["per_entity"])
+            assert touched  # events reached entities
+            base_re = workload.model.models["per_entity"]
+            out_re = ra.models["per_entity"]
+            for k, pair in base_re.coefficients.items():
+                if k not in touched:
+                    # Untouched rows share the base arrays outright.
+                    assert out_re.coefficients[k] is pair
+            assert a.latest_event_wall == max(
+                e.wall_epoch for e in events
+            )
+
+    def test_delta_roundtrips_through_publish(self, tmp_path, workload):
+        with telemetry.Telemetry(sinks=[]):
+            ref = OnlineRefiner(workload.model, RefinerConfig(seed=2))
+            ref.consume(self._events(workload))
+            with DeltaPublisher(str(tmp_path / "pubs")) as pub:
+                p = ref.publish(pub)
+            patched = apply_delta(workload.model, read_delta(p.path))
+            _assert_bitwise_equal(patched, ref.refined_model())
+            assert p.event_wall_epoch == ref.latest_event_wall
+
+    def test_sgd_moves_toward_labels(self, workload):
+        # A LEARNABLE signal (one entity, one repeated feature vector,
+        # fixed label): each step must shrink the error on that event.
+        with telemetry.Telemetry(sinks=[]):
+            cfg = RefinerConfig(algorithm="sgd", learning_rate=0.5)
+            ref = OnlineRefiner(workload.model, cfg)
+            rng = np.random.default_rng(3)
+            event = LabeledEvent(
+                features={
+                    workload.fixed_shard: rng.normal(
+                        size=workload.fixed_dim
+                    ).astype(np.float32),
+                    workload.re_shard: rng.normal(
+                        size=workload.re_dim
+                    ).astype(np.float32),
+                },
+                ids={workload.entity_key: "u0"},
+                label=1.0,
+            )
+            errs = ref.consume([event] * 30)
+            assert abs(errs[-1]) < abs(errs[0])
+            assert abs(errs[-1]) < 0.1  # converged onto the label
+
+    def test_chaos_site_fires(self, workload):
+        with telemetry.Telemetry(sinks=[]):
+            ref = OnlineRefiner(workload.model)
+            plan = chaos.FaultPlan([
+                chaos.FaultSpec(site="online.step", at=0),
+            ])
+            with plan:
+                with pytest.raises(Exception, match="chaos-injected"):
+                    ref.step(self._events(workload, n=1)[0])
+            assert [f["site"] for f in plan.fired] == ["online.step"]
+
+    def test_unknown_algorithm_refused(self, workload):
+        with pytest.raises(ValueError, match="algorithm"):
+            OnlineRefiner(
+                workload.model, RefinerConfig(algorithm="newton")
+            )
+
+
+class TestReadFingerprints:
+    def test_game_store_roundtrip(self, tmp_path, workload):
+        d = str(tmp_path / "m")
+        save_game_model(workload.model, workload.index_maps, d)
+        fps = game_store.read_fingerprints(d)
+        assert set(fps) == {"fixed", "per_entity"}
+
+    def test_game_store_legacy_pointed_error(self, tmp_path, workload):
+        d = str(tmp_path / "m")
+        save_game_model(workload.model, workload.index_maps, d)
+        meta = os.path.join(d, "metadata.json")
+        with open(meta) as f:
+            body = json.load(f)
+        body.pop("fingerprints", None)
+        with open(meta, "w") as f:
+            json.dump(body, f)
+        with pytest.raises(ValueError, match="re-save"):
+            game_store.read_fingerprints(d)
+
+    def test_model_store_roundtrip_and_legacy(self, tmp_path):
+        imap = IndexMap.build([feature_key("f0", "")])
+        glm = GeneralizedLinearModel(
+            Coefficients(means=np.array([1.0], np.float32)), "logistic"
+        )
+        p = str(tmp_path / "m.avro")
+        model_store.save_glm_model(glm, imap, p)
+        assert model_store.read_fingerprints(p)
+        os.remove(p + ".meta.json")
+        with pytest.raises(ValueError, match="re-save"):
+            model_store.read_fingerprints(p)
+
+
+class TestExecutorWarmStartDir:
+    def _published(self, tmp_path):
+        imap = IndexMap.build(
+            [feature_key(f"f{j}", "") for j in range(3)]
+        )
+        glm = GeneralizedLinearModel(
+            Coefficients(means=np.array([1.0, 2.0, 3.0], np.float32)),
+            "logistic",
+        )
+        path = str(tmp_path / "published.avro")
+        model_store.save_glm_model(glm, imap, path)
+        return path
+
+    def test_seeds_trials_before_any_completion(self, tmp_path):
+        from photon_ml_tpu.tuning.executor import (
+            TuningConfig, TuningOrchestrator,
+        )
+        from photon_ml_tpu.tuning.scheduler import (
+            RandomProposer, SearchSpace,
+        )
+        from photon_ml_tpu.tuning.state import TuningJournal
+
+        path = self._published(tmp_path)
+        sp = SearchSpace.create([(0.0, 1.0)])
+        seen = []
+        lock = threading.Lock()
+
+        def fn(p, r, w):
+            with lock:
+                seen.append(None if w is None else np.asarray(w).copy())
+            return float(p[0])
+
+        with telemetry.Telemetry(sinks=[]):
+            journal = TuningJournal(str(tmp_path / "j"))
+            res = TuningOrchestrator(
+                sp, fn, RandomProposer(sp, seed=1),
+                TuningConfig(
+                    max_trials=3, workers=1, warm_start_dir=path,
+                ),
+                journal,
+            ).run()
+            journal.close()
+        assert res.completed == 3
+        # No trial returned coefficients, so every trial fell through to
+        # the published seed.
+        assert all(
+            w is not None and w.tobytes()
+            == np.array([1.0, 2.0, 3.0], np.float32).tobytes()
+            for w in seen
+        )
+
+    def test_resume_refuses_changed_warm_start_dir(self, tmp_path):
+        from photon_ml_tpu.tuning.executor import (
+            TuningConfig, TuningOrchestrator,
+        )
+        from photon_ml_tpu.tuning.scheduler import (
+            RandomProposer, SearchSpace,
+        )
+        from photon_ml_tpu.tuning.state import (
+            ResumeMismatch, TuningJournal,
+        )
+
+        path = self._published(tmp_path)
+        sp = SearchSpace.create([(0.0, 1.0)])
+        with telemetry.Telemetry(sinks=[]):
+            journal = TuningJournal(str(tmp_path / "j"))
+            TuningOrchestrator(
+                sp, lambda p, r, w: float(p[0]),
+                RandomProposer(sp, seed=1),
+                TuningConfig(max_trials=2, workers=1), journal,
+            ).run()
+            journal.close()
+            journal2 = TuningJournal(str(tmp_path / "j"))
+            with pytest.raises(ResumeMismatch, match="warm_start_dir"):
+                TuningOrchestrator(
+                    sp, lambda p, r, w: float(p[0]),
+                    RandomProposer(sp, seed=1),
+                    TuningConfig(
+                        max_trials=2, workers=1, warm_start_dir=path,
+                    ),
+                    journal2,
+                ).run(resume=True)
+            journal2.close()

@@ -278,6 +278,62 @@ class TestProcessSwap:
         assert scores().tobytes() == ref_v1.tobytes()
 
 
+class TestProcessDelta:
+    def test_delta_apply_and_rollback_bit_identical(
+        self, proc, workload, tmp_path
+    ):
+        """ISSUE 12: a delta publication hot-applies across process
+        workers — parent patches its host copy, publishes ONE new shm
+        generation, workers clone with carried hot sets — bit-identical
+        to in-process scoring of the patched model, and the rollback
+        restores v1 bitwise."""
+        from photon_ml_tpu.freshness.delta import diff_game_models
+        from photon_ml_tpu.freshness.publisher import DeltaPublisher
+
+        target_w = SyntheticWorkload(n_entities=32, seed=7)
+        re = target_w.model.models["per_entity"]
+        for k in [f"u{i}" for i in range(5)]:
+            cols, vals = re.coefficients[k]
+            re.coefficients[k] = (
+                cols, (vals + np.float32(0.25)).astype(np.float32)
+            )
+        requests = [workload.request(i) for i in range(16)]
+        ref_v1 = _reference(workload, requests)
+        ref_target = _reference(target_w, requests)
+        assert ref_v1.tobytes() != ref_target.tobytes()
+        sup, service = proc.supervisor, proc.service
+        assert _wait_until(lambda: sup.healthy_count == 2), sup.stats()
+        version_before = service.swapper.version
+
+        with DeltaPublisher(str(tmp_path / "pubs")) as pub:
+            p = pub.publish(diff_game_models(
+                workload.model, target_w.model, event_wall_epoch=1.0
+            ))
+
+        def scores():
+            futures = [service.submit(r) for r in requests]
+            return np.asarray(
+                [
+                    np.float32(f.result(timeout=60)["score"])
+                    for f in futures
+                ],
+                np.float32,
+            )
+
+        result = service.reload(p.path, mode="delta")
+        assert result.status == "swapped", result
+        # The registry is MONOTONE: after an earlier swap+rollback the
+        # next version skips past every version ever committed.
+        assert service.swapper.version > version_before
+        assert service.swapper.version == result.version_after
+        assert scores().tobytes() == ref_target.tobytes()
+
+        rolled = service.reload(rollback=True)
+        assert rolled.status == "rolled_back", rolled
+        assert _wait_until(lambda: sup.healthy_count == 2), sup.stats()
+        assert scores().tobytes() == ref_v1.tobytes()
+
+
 # ---------------------------------------------------------------------------
 # Clean shutdown: no leaked processes, no leaked segments
 # ---------------------------------------------------------------------------
