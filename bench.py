@@ -1261,6 +1261,7 @@ def bench_serving() -> dict:
     }
     out.update(_bench_serving_scenarios(workload))
     out.update(_bench_serving_process(workload))
+    out.update(_bench_serving_tenancy(workload))
     return out
 
 
@@ -1311,6 +1312,12 @@ def _bench_serving_scenarios(workload) -> dict:
         _log("serving: saving swap-target model...")
         save_game_model(v2.model, v2.index_maps, v2_dir)
         for name, scenario in loadgen.SCENARIOS.items():
+            if name == "noisy_neighbor":
+                # Tenant-aware: needs per-tenant outcome accounting, so
+                # _bench_serving_tenancy replays it via
+                # run_noisy_neighbor — the tenant-blind run_scenario
+                # here would lump aggressor sheds in with victim counts.
+                continue
             wired = {"swap", "kill_replica"}
             if any(
                 p.action is not None and p.action not in wired
@@ -1418,6 +1425,97 @@ def _bench_serving_process(workload) -> dict:
         "serving_proc_worker_kill_errors": report.errors,
         "serving_proc_worker_kill_zero_failed": zero_failed,
     }
+
+
+def _bench_serving_tenancy(workload) -> dict:
+    """Multi-tenant isolation gate: the ``noisy_neighbor`` scenario in
+    BOTH thread and process mode.  An aggressor tenant bursts to 10x
+    its token-bucket quota while a victim tenant holds 40 rps; the
+    acceptance gate (``*_isolation_pass``) is victim ZERO failures AND
+    victim p99 inside its configured SLO AND the aggressor actually
+    shed — reported per mode so a containment regression is unmissable
+    in the bench diff."""
+    from photon_ml_tpu.serving import loadgen
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.procpool import WorkerPool
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService
+    from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+    from photon_ml_tpu.serving.tenancy import TenancyConfig, TenantSpec
+
+    victim_slo_ms = 500.0
+    n_units = 2
+    rt_cfg = RuntimeConfig(max_batch_size=32, hot_entities=1024)
+    # Quotas are enforced per batcher (per replica/worker): size the
+    # aggressor's so the 10x burst is 10x its AGGREGATE admitted rate.
+    aggressor_quota = 40.0 / n_units
+    tenancy = TenancyConfig(tenants=(
+        TenantSpec(
+            name="victim", max_queue=256, p99_slo_ms=victim_slo_ms,
+        ),
+        TenantSpec(
+            name="aggressor", quota_rps=aggressor_quota,
+            burst=max(aggressor_quota / 2.0, 1.0), max_queue=128,
+        ),
+    ))
+    batcher_cfg = BatcherConfig(
+        max_batch_size=32, max_wait_us=1000, max_queue=1024,
+        tenancy=tenancy,
+    )
+
+    def make_request(i: int, phase, tenant: str) -> dict:
+        req = dict(workload.request(i))
+        req["tenant"] = tenant
+        return req
+
+    out: dict = {}
+    for mode, prefix in (("thread", "serving_tenant"),
+                         ("process", "serving_proc_tenant")):
+        if mode == "thread":
+            supervisor = ReplicaSupervisor(
+                lambda: ScoringRuntime(
+                    workload.model, workload.index_maps, rt_cfg
+                ),
+                n_replicas=n_units, probe_interval_s=0.1,
+            )
+        else:
+            _log("serving: publishing model to shared memory "
+                 "(tenancy, process mode)...")
+            pool = WorkerPool(
+                workload.model, workload.index_maps,
+                runtime_config=rt_cfg,
+            )
+            supervisor = ReplicaSupervisor(
+                pool=pool, n_replicas=n_units, probe_interval_s=0.1
+            )
+        service = ScoringService(supervisor, batcher_cfg)
+        with service:
+            report = loadgen.run_noisy_neighbor(
+                service.submit, make_request,
+                victim_rate_rps=40.0, aggressor_rate_rps=40.0,
+            )
+        gate = report.isolation(victim_slo_ms)
+        _log(
+            f"serving tenancy noisy_neighbor ({mode}): victim "
+            f"{gate['victim_completed']} ok / {gate['victim_failed']} "
+            f"failed, p99 {gate['victim_p99_ms']} ms (SLO "
+            f"{victim_slo_ms:g} ms); aggressor "
+            f"{gate['aggressor_completed']} ok / "
+            f"{gate['aggressor_shed']} shed; isolation gate "
+            f"{'PASS' if gate['pass'] else 'FAIL'}"
+        )
+        out.update({
+            f"{prefix}_victim_completed": gate["victim_completed"],
+            f"{prefix}_victim_failed": gate["victim_failed"],
+            f"{prefix}_victim_p99_ms": gate["victim_p99_ms"],
+            f"{prefix}_victim_slo_ms": victim_slo_ms,
+            f"{prefix}_aggressor_completed": (
+                gate["aggressor_completed"]
+            ),
+            f"{prefix}_aggressor_shed": gate["aggressor_shed"],
+            f"{prefix}_isolation_pass": gate["pass"],
+        })
+    return out
 
 
 def bench_freshness() -> dict:

@@ -178,6 +178,14 @@ KNOWN_SITES = {
         "pass without corrupting the warm-start model or publishing a "
         "partial delta"
     ),
+    "serving.tenant": (
+        "dispatch thread, before a tenant-routed group scores against "
+        "its tenant-scoped runtime (serving/batcher.py _dispatch; ctx: "
+        "tenant, rows) — only fires for tenants with a committed "
+        "tenant route, so a fault degrades exactly one tenant: its "
+        "breaker opens and its traffic sheds while every other "
+        "tenant's requests keep completing"
+    ),
 }
 
 

@@ -10,7 +10,10 @@ end-to-end retrain+swap.  This package closes the loop (docs/freshness.md):
   back with bitwise parity against a full reload.
 - :mod:`photon_ml_tpu.freshness.publisher` — crash-safe publication:
   append-only journal (``tuning/state.py`` style) around an
-  atomic-rename artifact write, so a crash mid-publish resumes exactly.
+  atomic-rename artifact write, so a crash mid-publish resumes exactly;
+  bounded retention (keep-last-K pruning + journal compaction) gated on
+  the per-subscriber ack sidecar, so a root never outgrows its disk and
+  never drops a delta a registered subscriber still needs.
 - :mod:`photon_ml_tpu.freshness.applier` — subscribe side: watch a
   publication root and hot-apply new deltas into a live service.
 - :mod:`photon_ml_tpu.freshness.online` — seeded per-entity SGD/AdaGrad
@@ -38,7 +41,9 @@ from photon_ml_tpu.freshness.delta import (  # noqa: F401
 from photon_ml_tpu.freshness.publisher import (  # noqa: F401
     DeltaPublisher,
     Publication,
+    read_acks,
     read_publications,
+    write_ack,
 )
 from photon_ml_tpu.freshness.applier import DeltaApplier  # noqa: F401
 from photon_ml_tpu.freshness.online import (  # noqa: F401

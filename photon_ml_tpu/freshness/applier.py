@@ -27,6 +27,7 @@ from photon_ml_tpu import telemetry as telemetry_mod
 from photon_ml_tpu.freshness.publisher import (
     Publication,
     read_publications,
+    write_ack,
 )
 
 
@@ -40,6 +41,15 @@ class DeltaApplier:
     is recorded as failed and the loop moves on, because re-applying
     the same artifact to the same base deterministically fails the same
     way; the operator escalates to a full reload (the runbook).
+
+    Pass a ``subscriber_id`` to register with the root's ack sidecar
+    (``acks/<subscriber_id>``): the applier acks its high-water
+    ``applied_seq`` after every advance, and the publisher's retention
+    then refuses to prune any publication this subscriber has not
+    consumed yet.  Registration happens at construction (acked seq 0),
+    so a freshly-attached subscriber immediately pins the whole root.
+    Failed sequences are acked too — they are never retried, so
+    holding their artifacts would pin the root forever.
     """
 
     def __init__(
@@ -47,11 +57,15 @@ class DeltaApplier:
         service,
         root: str,
         poll_interval_s: float = 0.25,
+        subscriber_id: Optional[str] = None,
     ):
         self._service = service
         self.root = root
         self.poll_interval_s = float(poll_interval_s)
+        self.subscriber_id = subscriber_id
         self.applied_seq = 0
+        if subscriber_id is not None:
+            write_ack(root, subscriber_id, self.applied_seq)
         self.applied = 0
         self.failed: List[int] = []
         #: wall epoch of the newest event now servable (staleness anchor).
@@ -73,6 +87,7 @@ class DeltaApplier:
         staleness gauges either way."""
         tel = telemetry_mod.current()
         results = []
+        seq_before = self.applied_seq
         for pub in self.pending():
             result = self._service.reload(pub.path, mode="delta")
             results.append(result)
@@ -91,6 +106,8 @@ class DeltaApplier:
                     stage=result.stage,
                     reason=result.reason,
                 )
+        if self.subscriber_id is not None and self.applied_seq > seq_before:
+            write_ack(self.root, self.subscriber_id, self.applied_seq)
         self._refresh_staleness()
         return results
 
@@ -141,6 +158,7 @@ class DeltaApplier:
     def stats(self) -> dict:
         return {
             "root": self.root,
+            "subscriber_id": self.subscriber_id,
             "applied_seq": self.applied_seq,
             "applied": self.applied,
             "failed": list(self.failed),

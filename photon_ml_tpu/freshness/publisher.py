@@ -25,6 +25,35 @@ missing ``commit`` — the SAME publication an uninterrupted run would
 have made, never a half-published artifact.  Subscribers only ever see
 ``commit``-journaled sequence numbers (:meth:`publications`), so a torn
 publish is invisible to the apply side.
+
+Retention (bounded roots)
+-------------------------
+
+A continuously-refining loop publishes forever; without pruning the
+root grows without bound.  :meth:`DeltaPublisher.retain` (or the
+``retain_last`` constructor knob, which prunes after every publish)
+keeps the newest K committed publications and removes the rest —
+journal compaction first (write-temp + fsync + atomic rename, so the
+journal is never torn), artifact directories second (a kill in between
+leaves orphan ``delta-*`` dirs that the next retention sweeps).  Two
+things are NEVER pruned: an unsettled ``begin`` (an in-flight publish
+is not ours to judge) and the newest committed publication (an empty
+root would strand every subscriber).  Sequence numbering survives
+compaction — the kept records still carry the max seq, so
+``_next_seq`` never moves backward and a resumed publisher continues
+the same sequence.
+
+Ack sidecar (``acks/<subscriber_id>``)
+--------------------------------------
+
+Deltas are incremental: pruning a publication a subscriber has not yet
+applied forces that subscriber into a full reload.  Subscribers
+therefore register an ack file under ``acks/`` (atomic write via
+:func:`write_ack`; :class:`~photon_ml_tpu.freshness.applier.DeltaApplier`
+does this when given a ``subscriber_id``), and retention refuses to
+prune any publication newer than the slowest registered ack — those
+sequences are reported as ``blocked`` instead of removed.  A root with
+no registered subscribers prunes on age alone.
 """
 
 from __future__ import annotations
@@ -32,10 +61,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
 import shutil
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from photon_ml_tpu import telemetry as telemetry_mod
 from photon_ml_tpu.chaos import core as chaos_mod
@@ -67,6 +97,64 @@ class PublishAborted(RuntimeError):
     deterministic record boundary (tuning/state.py idiom)."""
 
 
+ACKS_DIR = "acks"
+
+#: Subscriber ids become filenames under ``acks/`` — keep them to the
+#: same safe alphabet as tenant slugs, no path separators or dots-only.
+_SUBSCRIBER_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+_DELTA_DIR_RE = re.compile(r"^delta-(\d+)$")
+
+
+def write_ack(
+    root: str, subscriber_id: str, seq: int, fsync: bool = True
+) -> str:
+    """Record that ``subscriber_id`` has applied (or deliberately
+    skipped) every publication up to and including ``seq``.  Atomic
+    (write-temp + rename), so retention never reads a torn ack.
+    Returns the ack file path."""
+    if not _SUBSCRIBER_ID_RE.match(subscriber_id):
+        raise ValueError(
+            f"subscriber id {subscriber_id!r} is not a safe filename "
+            "([A-Za-z0-9][A-Za-z0-9._-]*, max 64 chars)"
+        )
+    acks = os.path.join(root, ACKS_DIR)
+    os.makedirs(acks, exist_ok=True)
+    path = os.path.join(acks, subscriber_id + ".json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({
+            "subscriber_id": subscriber_id,
+            "acked_seq": int(seq),
+            "wall_epoch": time.time(),
+        }, f)
+        if fsync:
+            fsync_file(f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_acks(root: str) -> Dict[str, int]:
+    """Acked sequence number per registered subscriber.  A missing
+    ``acks/`` dir means no subscribers are registered (retention prunes
+    on age alone); an unparseable ack file is skipped — ack writes are
+    atomic, so garbage there is not ours."""
+    acks = os.path.join(root, ACKS_DIR)
+    if not os.path.isdir(acks):
+        return {}
+    out: Dict[str, int] = {}
+    for name in sorted(os.listdir(acks)):
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(acks, name)) as f:
+                record = json.load(f)
+            out[str(record["subscriber_id"])] = int(record["acked_seq"])
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            continue
+    return out
+
+
 class DeltaPublisher:
     """Publish :class:`~photon_ml_tpu.freshness.delta.ModelDelta`
     artifacts into a root directory, crash-safely.
@@ -84,10 +172,17 @@ class DeltaPublisher:
         root: str,
         fsync: bool = True,
         abort_after: Optional[int] = None,
+        retain_last: Optional[int] = None,
     ):
+        if retain_last is not None and retain_last < 1:
+            raise ValueError(
+                f"retain_last must be >= 1 (the newest committed "
+                f"publication is never pruned), got {retain_last}"
+            )
         self.root = root
         self.fsync = fsync
         self.abort_after = abort_after
+        self.retain_last = retain_last
         self.path = os.path.join(root, self.JOURNAL)
         self._lock = sanitizers.tracked(
             threading.Lock(), "freshness.publisher"
@@ -227,11 +322,128 @@ class DeltaPublisher:
                 "publish_wall_epoch": publish_wall,
             }
             self._append(record)
+            retention = (
+                self._retain_locked(self.retain_last)
+                if self.retain_last is not None
+                else None
+            )
         hub = telemetry_mod.current()
         hub.counter("freshness_deltas_published_total").inc()
         hub.counter("freshness_delta_rows").inc(delta.n_changed_rows)
         hub.counter("freshness_delta_bytes").inc(_artifact_bytes(manifest))
+        if retention is not None and retention["pruned"]:
+            hub.counter("freshness_retention_pruned_total").inc(
+                len(retention["pruned"])
+            )
         return _publication(record)
+
+    # -- retention ----------------------------------------------------------
+    def retain(self, keep_last: int) -> dict:
+        """Prune committed publications older than the newest
+        ``keep_last``, compacting the journal and removing their
+        artifact directories.  Returns a summary dict::
+
+            {"pruned": [seq...],   # removed this call
+             "blocked": [seq...],  # prunable by age, held by an ack
+             "kept": [seq...]}     # committed seqs still in the root
+
+        Never removes an unsettled ``begin`` or the newest committed
+        publication, and refuses any sequence a registered subscriber
+        (``acks/``) has not acked yet.  Crash-safe: the journal is
+        compacted by atomic rename BEFORE any artifact dir is removed,
+        and orphan dirs from a kill in between are swept by the next
+        retention."""
+        with self._lock:
+            retention = self._retain_locked(keep_last)
+        if retention["pruned"]:
+            telemetry_mod.current().counter(
+                "freshness_retention_pruned_total"
+            ).inc(len(retention["pruned"]))
+        return retention
+
+    def _retain_locked(self, keep_last: int) -> dict:
+        # Caller holds self._lock.
+        if keep_last < 1:
+            raise ValueError(
+                f"keep_last must be >= 1 (the newest committed "
+                f"publication is never pruned), got {keep_last}"
+            )
+        records = self._read()
+        committed = sorted(
+            {r["seq"] for r in records if r["kind"] == "commit"}
+        )
+        candidates = committed[:-keep_last]
+        acks = read_acks(self.root)
+        min_acked = min(acks.values()) if acks else None
+        pruned = sorted(
+            s for s in candidates if min_acked is None or s <= min_acked
+        )
+        blocked = sorted(set(candidates) - set(pruned))
+        kept = sorted(set(committed) - set(pruned))
+        summary = {"pruned": pruned, "blocked": blocked, "kept": kept}
+        if not pruned:
+            # Still sweep orphan dirs a prior kill may have left.
+            self._sweep_orphans(records)
+            return summary
+        # floor: the oldest surviving commit.  Everything pruned sits
+        # below it, so journal records for settled aborts down there are
+        # noise too — drop them with the pruned commits.  Unsettled
+        # begins and anything >= floor (including a trailing abort with
+        # the max seq, which anchors _next_seq) survive compaction.
+        floor = kept[0]
+        settled = {
+            r["seq"] for r in records if r["kind"] in ("commit", "abort")
+        }
+        drop = set(pruned) | {
+            s for s in settled if s < floor and s not in set(committed)
+        }
+        compacted = [r for r in records if r["seq"] not in drop]
+        compacted.append({
+            "kind": "retention",
+            "seq": max(drop),
+            "pruned": sorted(drop),
+            "floor_seq": floor,
+            "wall_epoch": time.time(),
+        })
+        # Compact via write-temp + fsync + atomic rename.  The open
+        # append handle points at the OLD inode — close it first so the
+        # next _append reopens the compacted file.
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            for r in compacted:
+                f.write(json.dumps(r) + "\n")
+            if self.fsync:
+                fsync_file(f)
+        os.replace(tmp, self.path)
+        # Only now remove artifacts: a kill before this point leaves
+        # orphan dirs (swept below / next time), never a journal that
+        # references a missing artifact.
+        for seq in sorted(drop):
+            for path in (self._final_dir(seq), self._staging_dir(seq)):
+                if os.path.isdir(path):
+                    shutil.rmtree(path)
+        self._sweep_orphans(compacted)
+        return summary
+
+    def _sweep_orphans(self, records: List[dict]) -> None:
+        # Caller holds self._lock.  A delta-* dir whose seq no journal
+        # record references is a leftover from a kill between journal
+        # compaction and artifact removal — safe to delete (subscribers
+        # only ever follow commit records).  Retention records describe
+        # PRUNED seqs, so they don't count as references.
+        referenced = {
+            r["seq"] for r in records if r["kind"] != "retention"
+        }
+        for name in os.listdir(self.root):
+            m = _DELTA_DIR_RE.match(name)
+            if m is None or int(m.group(1)) in referenced:
+                continue
+            path = os.path.join(self.root, name)
+            if os.path.isdir(path):
+                shutil.rmtree(path)
 
     def publications(self) -> List[Publication]:
         """Committed publications in sequence order — the only view

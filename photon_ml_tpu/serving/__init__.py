@@ -27,8 +27,14 @@ TPU-native analogue of that request path over the batch stack:
   model hot-swap with verified one-step rollback.
 - :mod:`~photon_ml_tpu.serving.loadgen` — closed/open-loop load
   generators plus scripted scenarios (diurnal ramp, skew shift,
-  swap-under-load, replica-kill, worker-kill; ``bench.py
-  bench_serving``).
+  swap-under-load, replica-kill, worker-kill, noisy-neighbor;
+  ``bench.py bench_serving``).
+- :mod:`~photon_ml_tpu.serving.tenancy` — multi-tenant isolation:
+  ``TenantSpec`` / ``TenancyConfig`` (per-tenant bulkhead partitions,
+  token-bucket quotas, tiered-admission watermarks, p99 SLOs, circuit
+  breakers, enforced in the batcher) and ``TenantRouter`` (tenant ->
+  model version on the HotSwapper registry, per-tenant hot swap and
+  rollback; docs/serving.md "Tenancy").
 - :mod:`~photon_ml_tpu.serving.procpool` /
   :mod:`~photon_ml_tpu.serving.worker` /
   :mod:`~photon_ml_tpu.serving.shm_model` — crash-isolated worker
@@ -67,6 +73,9 @@ _LAZY = {
     "WorkerPool": ("photon_ml_tpu.serving.procpool", "WorkerPool"),
     "ProcessReplica": ("photon_ml_tpu.serving.procpool", "ProcessReplica"),
     "ModelMapError": ("photon_ml_tpu.serving.shm_model", "ModelMapError"),
+    "TenancyConfig": ("photon_ml_tpu.serving.tenancy", "TenancyConfig"),
+    "TenantSpec": ("photon_ml_tpu.serving.tenancy", "TenantSpec"),
+    "TenantRouter": ("photon_ml_tpu.serving.tenancy", "TenantRouter"),
     "HotSwapper": ("photon_ml_tpu.serving.swap", "HotSwapper"),
     "SwapResult": ("photon_ml_tpu.serving.swap", "SwapResult"),
     "SwapInProgressError": (

@@ -20,6 +20,12 @@ directory is rejected with an automatic rollback — asserting ZERO failed
 requests throughout and a monotone ``serving_model_version`` in
 metrics.json.
 
+A third, tenancy pass replays the ``noisy_neighbor`` scenario against
+a two-tenant policy: an aggressor tenant bursting to ~10x its
+token-bucket quota is shed alone while the victim tenant's p99 stays
+inside its SLO with zero failures, and the per-tenant
+``serving_tenant_<t>_*`` metric family records both sides.
+
 Process mode (``--selfcheck --workers 2``) runs the same contracts
 against CRASH-ISOLATED worker processes attached to one shared-memory
 model publication: score parity with in-process scoring, a real SIGKILL
@@ -27,7 +33,8 @@ mid-load with zero failed requests, a cross-process hot swap + rollback
 (bit-identical on both sides), a ``serving_shared_segment_bytes`` gauge
 at one publication (not N copies), and a leak-free shutdown under a
 strict :class:`ProcessLeakSentinel` with no shared segments left
-mapped.
+mapped — then the same noisy-neighbor tenancy pass with the tenant id
+riding the worker wire protocol.
 
 Serve a saved model::
 
@@ -745,6 +752,136 @@ def run_selfcheck_process(out_dir: str, n_workers: int = 2) -> list[str]:
     return failures
 
 
+def run_selfcheck_tenancy(out_dir: str, n_workers: int = 0) -> list[str]:
+    """Two-tenant noisy-neighbor pass: an aggressor tenant bursts to
+    ~10x its quota while a victim tenant holds steady; the tenancy
+    layer must shed the aggressor alone — victim p99 inside its SLO
+    with ZERO failed requests — and the per-tenant metric family must
+    record both sides.  ``n_workers=0`` runs in-process; >0 runs the
+    same policy in crash-isolated worker processes (the TenancyConfig
+    rides BatcherConfig into each spawned worker).  Returns failure
+    strings (empty = pass)."""
+    import time
+
+    from photon_ml_tpu import telemetry as telemetry_mod
+    from photon_ml_tpu.serving import loadgen
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService
+    from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+    from photon_ml_tpu.serving.tenancy import TenancyConfig, TenantSpec
+
+    failures: list[str] = []
+    victim_slo_ms = 500.0
+    # Quotas are enforced per batcher (per worker): size the aggressor's
+    # so its 10x burst is 10x the AGGREGATE admitted rate.
+    aggressor_quota = 40.0 / max(n_workers, 1)
+    workload = SyntheticWorkload(n_entities=64, seed=3)
+    rt_cfg = RuntimeConfig(max_batch_size=8, hot_entities=16)
+    tenancy = TenancyConfig(tenants=(
+        TenantSpec(
+            name="victim", max_queue=128, p99_slo_ms=victim_slo_ms,
+        ),
+        TenantSpec(
+            name="aggressor", quota_rps=aggressor_quota,
+            burst=max(aggressor_quota / 2.0, 1.0), max_queue=64,
+        ),
+    ))
+    batcher_cfg = BatcherConfig(
+        max_batch_size=8, max_wait_us=2_000, max_queue=256,
+        tenancy=tenancy,
+    )
+
+    def make_request(i: int, phase, tenant: str) -> dict:
+        obj = dict(workload.request(i))
+        obj["tenant"] = tenant
+        return obj
+
+    mode = f"process x{n_workers}" if n_workers else "thread"
+    with telemetry_mod.Telemetry(
+        output_dir=out_dir, run_name=f"serving-selfcheck-tenancy"
+    ) as tel:
+        if n_workers:
+            from photon_ml_tpu.analysis.sanitizers import (
+                ProcessLeakSentinel,
+            )
+            from photon_ml_tpu.serving import shm_model
+            from photon_ml_tpu.serving.procpool import WorkerPool
+            from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+
+            with ProcessLeakSentinel(grace_s=15.0, strict=True):
+                pool = WorkerPool(
+                    workload.model, workload.index_maps,
+                    runtime_config=rt_cfg, version=1,
+                )
+                supervisor = ReplicaSupervisor(
+                    pool=pool, n_replicas=n_workers, probe_interval_s=0.1,
+                )
+                service = ScoringService(supervisor, batcher_cfg)
+                with service:
+                    report = loadgen.run_noisy_neighbor(
+                        service.submit, make_request,
+                        victim_rate_rps=40.0, aggressor_rate_rps=40.0,
+                    )
+                    # Per-tenant counters travel in worker heartbeats;
+                    # let one more interval land before snapshotting.
+                    time.sleep(3 * pool.heartbeat_interval_s)
+                leftover = shm_model.live_segments()
+                if leftover:
+                    failures.append(
+                        "shared segments still mapped after tenancy "
+                        f"pass: {leftover}"
+                    )
+        else:
+            runtime = ScoringRuntime(
+                workload.model, workload.index_maps, rt_cfg
+            )
+            service = ScoringService(runtime, batcher_cfg)
+            with service:
+                report = loadgen.run_noisy_neighbor(
+                    service.submit, make_request,
+                    victim_rate_rps=40.0, aggressor_rate_rps=40.0,
+                )
+        snap = tel.snapshot()
+
+    gate = report.isolation(victim_slo_ms)
+    if not gate["pass"]:
+        failures.append(
+            f"noisy-neighbor isolation gate FAILED ({mode}): {gate}"
+        )
+    counters = snap["counters"]
+    if counters.get("serving_tenant_victim_requests_total", 0) < \
+            report.victim.completed:
+        failures.append(
+            "serving_tenant_victim_requests_total = "
+            f"{counters.get('serving_tenant_victim_requests_total', 0)}, "
+            f"expected >= {report.victim.completed}"
+        )
+    if counters.get("serving_tenant_aggressor_shed_total", 0) < 1:
+        failures.append(
+            "serving_tenant_aggressor_shed_total = "
+            f"{counters.get('serving_tenant_aggressor_shed_total', 0)}, "
+            "expected >= 1 (the burst never pressured the quota)"
+        )
+    victim_hist = snap["histograms"].get(
+        "serving_tenant_victim_request_latency_seconds", {}
+    )
+    if not victim_hist.get("count"):
+        failures.append(
+            "no serving_tenant_victim_request_latency_seconds "
+            "observations — the per-tenant latency family is dark"
+        )
+    if not failures:
+        print(
+            f"serving tenancy selfcheck ({mode}): aggressor burst 10x "
+            f"quota shed {report.aggressor.shed} of its requests while "
+            f"victim completed {report.victim.completed} with 0 "
+            f"failures, p99 {gate['victim_p99_ms']} ms <= SLO "
+            f"{victim_slo_ms:g} ms"
+        )
+    return failures
+
+
 # ---------------------------------------------------------------------------
 # Entry point
 # ---------------------------------------------------------------------------
@@ -756,17 +893,28 @@ def main(argv=None) -> int:
         def both(root: str) -> list[str]:
             # Separate output dirs: each pass owns its Telemetry hub and
             # its metrics.json (the HA assertions read ha/metrics.json).
-            single, ha = (
-                os.path.join(root, "single"), os.path.join(root, "ha")
+            single, ha, tenancy = (
+                os.path.join(root, "single"), os.path.join(root, "ha"),
+                os.path.join(root, "tenancy"),
             )
             os.makedirs(single, exist_ok=True)
             os.makedirs(ha, exist_ok=True)
-            return run_selfcheck(single) + run_selfcheck_ha(ha)
+            os.makedirs(tenancy, exist_ok=True)
+            return (
+                run_selfcheck(single)
+                + run_selfcheck_ha(ha)
+                + run_selfcheck_tenancy(tenancy)
+            )
 
         def process(root: str) -> list[str]:
             proc = os.path.join(root, "proc")
+            tenancy = os.path.join(root, "tenancy")
             os.makedirs(proc, exist_ok=True)
-            return run_selfcheck_process(proc, n_workers=args.workers)
+            os.makedirs(tenancy, exist_ok=True)
+            return (
+                run_selfcheck_process(proc, n_workers=args.workers)
+                + run_selfcheck_tenancy(tenancy, n_workers=args.workers)
+            )
 
         runner = process if args.workers else both
         if args.output_dir:

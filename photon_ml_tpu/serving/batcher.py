@@ -42,6 +42,29 @@ Counting has ONE source of truth: with a telemetry hub enabled the
 registry carries every count (``stats()`` derives the /stats view from
 the same snapshot /metrics exposes); only with telemetry disabled does
 the batcher maintain its own minimal mirror so /stats still answers.
+
+**Tenancy** — when ``BatcherConfig.tenancy`` carries a
+:class:`~photon_ml_tpu.serving.tenancy.TenancyConfig`, every tenant gets
+its own isolation boundary IN FRONT of the shared admission controller
+(docs/serving.md):
+
+- a **bulkhead queue partition**: the physical queue is sized to the sum
+  of all partitions, and a tenant whose partition is full is rejected
+  without touching a neighbor's slots;
+- a **token-bucket quota** (sustained rps + burst) — over-quota traffic
+  sheds with the tenant named in the error;
+- its own **admission tiers** (partition-depth watermarks + a per-tenant
+  observed p99 from ``serving_tenant_<t>_request_latency_seconds``
+  against the tenant's own SLO);
+- a **circuit breaker** (chaos/breaker.py) fed by per-tenant dispatch
+  outcomes, so a tenant whose model path is failing degrades alone;
+- a **tenant route**: dispatch groups rows by tenant and scores each
+  group against that tenant's committed runtime (tenant-scoped hot
+  swap, serving/swap.py), with the ``serving.tenant`` chaos site
+  instrumenting exactly the tenant-routed scoring path.
+
+With ``tenancy=None`` every code path below collapses to the
+single-tenant behavior above, byte for byte.
 """
 
 from __future__ import annotations
@@ -55,7 +78,9 @@ from typing import Optional
 
 from photon_ml_tpu import telemetry as telemetry_mod
 from photon_ml_tpu.analysis import sanitizers
+from photon_ml_tpu.chaos import breaker as breaker_mod
 from photon_ml_tpu.chaos import core as chaos_mod
+from photon_ml_tpu.serving import tenancy as tenancy_mod
 from photon_ml_tpu.utils.watchdog import RetryPolicy
 
 
@@ -106,6 +131,12 @@ class BatcherConfig:
     #: how often (seconds) the p99 estimate is refreshed; between
     #: refreshes a submit pays one queue-depth read and comparisons.
     admission_interval_s: float = 0.1
+    #: multi-tenant isolation policy (serving/tenancy.py): per-tenant
+    #: bulkhead partitions, quotas, tiers, SLOs, and breakers.  None =
+    #: single-tenant behavior, bit-identical to before the field
+    #: existed.  Frozen + picklable, so it rides the spawn args into
+    #: process-mode workers unchanged (serving/worker.py).
+    tenancy: Optional["tenancy_mod.TenancyConfig"] = None
 
 
 @dataclasses.dataclass
@@ -118,9 +149,45 @@ class _Pending:
     #: span parents to it, so a request's wait + batch execution nest
     #: under the span that submitted it (cross-thread tracing).
     ctx: Optional[tuple] = None
+    #: the tenant partition this row occupies (``_TenantState``); None
+    #: when tenancy is off.  Dispatch decrements the partition depth
+    #: through this reference once the row leaves the queue.
+    tenant_state: Optional[object] = None
 
 
 _STOP = object()
+
+
+class _TenantState:
+    """One tenant's live enforcement state: partition depth, token
+    bucket, tier cache, and circuit breaker.  Named tenants each get
+    one; every unknown/absent tenant shares the default spec's state.
+
+    The bucket, depth, tier, and breaker mutate ONLY under the
+    batcher's tenancy lock ("serving.tenancy") — the breaker is
+    single-writer by design (chaos/breaker.py) and submit runs on many
+    threads.  The p99 cache fields are racy-but-benign (worst case a
+    duplicate refresh), matching the batcher's global p99 cache."""
+
+    __slots__ = (
+        "spec", "slug", "depth", "tier", "bucket", "breaker",
+        "p99_ms", "p99_refresh_t",
+    )
+
+    def __init__(self, spec: "tenancy_mod.TenantSpec"):
+        self.spec = spec
+        self.slug = spec.slug
+        self.depth = 0
+        self.tier = TIER_ACCEPT
+        self.bucket = tenancy_mod.TokenBucket(
+            spec.quota_rps, spec.effective_burst
+        )
+        self.breaker = breaker_mod.CircuitBreaker(
+            cooldown_seconds=spec.breaker_cooldown_s,
+            failure_threshold=spec.breaker_failure_threshold,
+        )
+        self.p99_ms: Optional[float] = None
+        self.p99_refresh_t = 0.0
 
 
 class MicroBatcher:
@@ -148,7 +215,37 @@ class MicroBatcher:
         self.runtime = runtime
         self.config = cfg
         self.policy = policy or RetryPolicy()
-        self._queue: "queue.Queue" = queue.Queue(maxsize=cfg.max_queue)
+        self._tenancy = cfg.tenancy
+        if self._tenancy is not None:
+            # Bulkhead partitions: the physical queue holds the SUM of
+            # every tenant partition (plus slack so bypass probes keep
+            # flowing at saturation) — a tenant filling its own
+            # partition can never consume a neighbor's slots, and
+            # _capacity is the denominator every global-tier fraction
+            # uses.
+            self._tenant_states = {
+                t.name: _TenantState(t) for t in self._tenancy.tenants
+            }
+            self._default_state: Optional[_TenantState] = _TenantState(
+                self._tenancy.default
+            )
+            self._capacity = self._tenancy.partition_total
+            self._tenant_lock = sanitizers.tracked(
+                threading.Lock(), "serving.tenancy"
+            )
+        else:
+            self._tenant_states = {}
+            self._default_state = None
+            self._capacity = cfg.max_queue
+            self._tenant_lock = None
+        self._queue: "queue.Queue" = queue.Queue(
+            maxsize=self._capacity + (32 if self._tenancy else 0)
+        )
+        # tenant -> runtime overriding self.runtime for that tenant's
+        # rows (tenant-scoped hot swap, serving/swap.py).  Copy-on-write
+        # dict: dispatch reads ONE reference per batch, commit replaces
+        # the whole dict — GIL-atomic like the self.runtime commit.
+        self._tenant_routes: dict = {}
         self._thread: Optional[threading.Thread] = None
         self._lock = sanitizers.tracked(
             threading.Lock(), "serving.batcher"
@@ -169,6 +266,8 @@ class MicroBatcher:
             "shed": 0,
             "shed_low_priority": 0,
             "shed_deadline": 0,
+            "shed_quota": 0,
+            "shed_breaker": 0,
             "tier_transitions": 0,
             "expired": 0,
             "failed": 0,
@@ -204,6 +303,9 @@ class MicroBatcher:
                 break
             if item is _STOP:
                 continue
+            if item.tenant_state is not None:
+                with self._tenant_lock:
+                    item.tenant_state.depth -= 1
             if item.future.set_running_or_notify_cancel():
                 item.future.set_exception(RuntimeError(
                     "UNAVAILABLE: batcher stopped before dispatch; "
@@ -232,7 +334,7 @@ class MicroBatcher:
         depth-watermark tier and the p99-SLO tier."""
         if now is None:
             now = time.perf_counter()
-        frac = self._queue.qsize() / self.config.max_queue
+        frac = self._queue.qsize() / self._capacity
         if frac >= self.config.reject_watermark:
             tier = TIER_REJECT
         elif frac >= self.config.shed_watermark:
@@ -265,7 +367,7 @@ class MicroBatcher:
             tier=TIER_NAMES[tier],
             previous=TIER_NAMES[prev],
             queue_depth=self._queue.qsize(),
-            max_queue=self.config.max_queue,
+            max_queue=self._capacity,
             p99_ms=self._p99_ms,
         )
 
@@ -273,7 +375,7 @@ class MicroBatcher:
         self._count("shed")
         tel = telemetry_mod.current()
         tel.counter("serving_shed_total").inc()
-        if reason == "reject_tier":
+        if reason in ("reject_tier", "tenant_reject"):
             # The reject tier refuses ALL non-probe traffic — that is
             # the same verdict the pre-tier queue-full backstop gave, so
             # it keeps feeding the legacy rejection counters.
@@ -285,11 +387,127 @@ class MicroBatcher:
         elif reason == "deadline":
             self._count("shed_deadline")
             tel.counter("serving_shed_deadline_total").inc()
+        elif reason == "tenant_quota":
+            self._count("shed_quota")
+            tel.counter("serving_shed_quota_total").inc()
+        elif reason == "tenant_breaker":
+            self._count("shed_breaker")
+            tel.counter("serving_shed_breaker_total").inc()
         exc = RejectedError(
             f"UNAVAILABLE: load shed ({detail}); retry with backoff"
         )
         self._classify(exc)
         return exc
+
+    # -- tenancy (any thread) ----------------------------------------------
+    def _tenant_state_for(self, row) -> Optional[_TenantState]:
+        """The partition governing this row: the named tenant's state
+        when registered, else the shared default-spec state."""
+        if self._tenancy is None:
+            return None
+        tenant = getattr(row, "tenant", None)
+        state = self._tenant_states.get(tenant) if tenant is not None else None
+        return state or self._default_state
+
+    def _tenant_counter(self, state: _TenantState, name: str):
+        # Dynamic per-tenant metric family; slugs keep every name
+        # convention-shaped (<subsystem>_<name>_<unit>).
+        return telemetry_mod.current().counter(
+            f"serving_tenant_{state.slug}_{name}"
+        )
+
+    def _tenant_p99_ms(self, state: _TenantState, now: float):
+        """Cached per-tenant p99 read, in ms — the tenant's own latency
+        family against the tenant's own SLO.  Racy-but-benign cache
+        (see _TenantState); call OUTSIDE the tenancy lock."""
+        if state.spec.p99_slo_ms is None:
+            return None
+        if now >= state.p99_refresh_t:
+            state.p99_refresh_t = now + self.config.admission_interval_s
+            hist = telemetry_mod.current().histogram(
+                f"serving_tenant_{state.slug}_request_latency_seconds"
+            )
+            quantile = getattr(hist, "quantile", None)
+            p99_s = None if quantile is None else quantile(0.99)
+            state.p99_ms = None if p99_s is None else p99_s * 1e3
+        return state.p99_ms
+
+    def _tenant_admit(
+        self,
+        state: _TenantState,
+        row,
+        timeout: Optional[float],
+        now: float,
+    ) -> None:
+        """Per-tenant admission: breaker, quota bucket, then the
+        tenant's own tier ladder.  Raises RejectedError on denial —
+        always naming the tenant, so a shed client knows it was ITS
+        budget (not a neighbor's) that ran out."""
+        p99 = self._tenant_p99_ms(state, now)
+        with self._tenant_lock:
+            if not state.breaker.allow_request():
+                verdict = "breaker"
+            elif not state.bucket.try_acquire():
+                verdict = "quota"
+            else:
+                frac = state.depth / state.spec.max_queue
+                if frac >= state.spec.reject_watermark:
+                    tier = TIER_REJECT
+                elif frac >= state.spec.shed_watermark:
+                    tier = TIER_SHED
+                else:
+                    tier = TIER_ACCEPT
+                if (
+                    tier < TIER_SHED
+                    and p99 is not None
+                    and p99 > state.spec.p99_slo_ms
+                ):
+                    tier = TIER_SHED
+                state.tier = tier
+                verdict = tier
+        name = state.spec.name
+        if verdict == "breaker":
+            self._tenant_counter(state, "shed_total").inc()
+            raise self._shed(
+                "tenant_breaker",
+                f"tenant {name!r} circuit open after repeated scoring "
+                "failures; cooling down",
+            )
+        if verdict == "quota":
+            self._tenant_counter(state, "shed_total").inc()
+            raise self._shed(
+                "tenant_quota",
+                f"tenant {name!r} over quota "
+                f"({state.spec.quota_rps:g} rps sustained)",
+            )
+        if verdict >= TIER_REJECT:
+            self._tenant_counter(state, "shed_total").inc()
+            self._tenant_counter(state, "rejected_total").inc()
+            raise self._shed(
+                "tenant_reject",
+                f"tenant {name!r} partition at reject tier "
+                f"({state.depth}/{state.spec.max_queue} queued)",
+            )
+        if verdict == TIER_SHED:
+            if getattr(row, "priority", "normal") == "low":
+                self._tenant_counter(state, "shed_total").inc()
+                raise self._shed(
+                    "low_priority",
+                    f"tenant {name!r} low-priority request at its shed "
+                    "tier",
+                )
+            if (
+                timeout is not None
+                and state.p99_ms is not None
+                and timeout < state.p99_ms
+            ):
+                self._tenant_counter(state, "shed_total").inc()
+                raise self._shed(
+                    "deadline",
+                    f"tenant {name!r} deadline budget {timeout:.0f} ms "
+                    f"is under its observed p99 {state.p99_ms:.0f} ms; "
+                    "it would expire in the queue",
+                )
 
     # -- submission (any thread) -------------------------------------------
     def submit(
@@ -317,6 +535,15 @@ class MicroBatcher:
         if timeout is None:
             timeout = self.config.default_timeout_ms
         now = time.perf_counter()
+        state = self._tenant_state_for(row)
+        if state is not None:
+            self._tenant_counter(state, "requests_total").inc()
+            if not bypass_admission:
+                # Tenant-scoped admission FIRST: a tenant is judged
+                # against its own breaker/quota/partition before the
+                # shared controller sees the row, so its denial can
+                # never be caused by — or blamed on — a neighbor.
+                self._tenant_admit(state, row, timeout, now)
         tier = self.admission_tier(now)
         self._note_tier(tier)
         if tier > TIER_ACCEPT and not bypass_admission:
@@ -349,15 +576,47 @@ class MicroBatcher:
             t_submit=now,
             deadline=None if timeout is None else now + timeout / 1e3,
             ctx=tel.current_context(),
+            tenant_state=state,
         )
+        if state is not None:
+            # Reserve a slot in the tenant's bulkhead partition.  Probes
+            # (bypass) still occupy depth so accounting stays exact, but
+            # they are never turned away by a full partition.
+            with self._tenant_lock:
+                full = (
+                    not bypass_admission
+                    and state.depth >= state.spec.max_queue
+                )
+                if not full:
+                    state.depth += 1
+                    depth = state.depth
+            if full:
+                self._count("rejected")
+                tel.counter("serving_rejected_total").inc()
+                self._tenant_counter(state, "rejected_total").inc()
+                exc = RejectedError(
+                    f"UNAVAILABLE: tenant {state.spec.name!r} partition "
+                    f"full ({state.spec.max_queue} pending); retry with "
+                    "backoff"
+                )
+                self._classify(exc)
+                raise exc
+            tel.gauge(
+                f"serving_tenant_{state.slug}_queue_depth"
+            ).set(depth)
         try:
             self._queue.put_nowait(pending)
         except queue.Full:
+            if state is not None:
+                with self._tenant_lock:
+                    state.depth -= 1
             self._count("rejected")
             tel.counter("serving_rejected_total").inc()
+            if state is not None:
+                self._tenant_counter(state, "rejected_total").inc()
             exc = RejectedError(
                 f"UNAVAILABLE: serving queue full "
-                f"({self.config.max_queue} pending); retry with backoff"
+                f"({self._capacity} pending); retry with backoff"
             )
             self._classify(exc)
             raise exc
@@ -395,9 +654,24 @@ class MicroBatcher:
     def _dispatch(self, batch: list) -> None:
         tel = telemetry_mod.current()
         # One read per dispatch: the whole batch scores against a single
-        # runtime even if a hot-swap commits mid-dispatch (swap.py).
+        # runtime — and ONE copy-on-write tenant route table — even if a
+        # hot-swap commits mid-dispatch (swap.py).
         runtime = self.runtime
+        routes = self._tenant_routes
         tel.gauge("serving_queue_depth").set(self._queue.qsize())
+        if self._tenancy is not None:
+            # Every batch row has left the queue: release its bulkhead
+            # partition slot now (expired rows included); publish the
+            # new depths outside the lock.
+            depths = {}
+            with self._tenant_lock:
+                for p in batch:
+                    st = p.tenant_state
+                    if st is not None:
+                        st.depth -= 1
+                        depths[st.slug] = st.depth
+            for slug, depth in depths.items():
+                tel.gauge(f"serving_tenant_{slug}_queue_depth").set(depth)
         now = time.perf_counter()
         live = []
         for p in batch:
@@ -413,45 +687,119 @@ class MicroBatcher:
                 live.append(p)
         if not live:
             return
+        # Group rows by tenant route: a tenant with a committed
+        # tenant-scoped runtime scores against it; everyone else shares
+        # the default runtime in one group.  With no routes this is
+        # exactly the old single-group dispatch.
+        if routes:
+            keyed: dict = {}
+            order = []
+            for p in live:
+                tenant = getattr(p.row, "tenant", None)
+                rt = routes.get(tenant) if tenant is not None else None
+                key = tenant if rt is not None else None
+                if key not in keyed:
+                    keyed[key] = (rt or runtime, [])
+                    order.append(key)
+                keyed[key][1].append(p)
+            groups = [(k, keyed[k][0], keyed[k][1]) for k in order]
+        else:
+            groups = [(None, runtime, live)]
         # Cross-thread trace propagation: the batch executes on the
         # dispatch thread, but its span parents to the FIRST live
         # request's submitting span (batch-mates ride along as the rows
         # count) — a request's end-to-end latency reads as one nested
         # tree in Perfetto instead of orphaned root spans.
         ctx = next((p.ctx for p in live if p.ctx is not None), None)
+        outcomes = []
         try:
             with tel.attach(ctx), tel.span(
                 "serving.batch", rows=len(live)
             ):
                 chaos_mod.maybe_fail("serving.batch", rows=len(live))
-                margins, means = runtime.score_rows(
-                    [p.row for p in live]
-                )
+                for tenant, rt, rows in groups:
+                    try:
+                        if tenant is not None:
+                            # The tenant-routed scoring path is its own
+                            # chaos seam: a fault here degrades exactly
+                            # one tenant (docs/robustness.md).
+                            chaos_mod.maybe_fail(
+                                "serving.tenant",
+                                tenant=tenant,
+                                rows=len(rows),
+                            )
+                        margins, means = rt.score_rows(
+                            [p.row for p in rows]
+                        )
+                    except Exception as exc:  # noqa: BLE001 — per-group
+                        outcomes.append(
+                            (tenant, rt, rows, None, None, exc)
+                        )
+                    else:
+                        outcomes.append(
+                            (tenant, rt, rows, margins, means, None)
+                        )
         except Exception as exc:  # noqa: BLE001 — classified + surfaced
-            for p in live:
-                self._fail(p, exc)
-            return
+            # A batch-level fault (serving.batch chaos, trace plumbing)
+            # fails every live row, exactly like the pre-tenancy single
+            # group did.
+            outcomes = [
+                (tenant, rt, rows, None, None, exc)
+                for tenant, rt, rows in groups
+            ]
         done = time.perf_counter()
-        bucket = runtime.bucket_for(len(live))
-        if not tel.enabled:
-            with self._lock:
-                self._counts["batches"] += 1
-                self._counts["completed"] += len(live)
-                self._counts["max_batch_rows"] = max(
-                    self._counts["max_batch_rows"], len(live)
-                )
-        tel.histogram("serving_batch_rows").observe(len(live))
-        tel.gauge("serving_batch_occupancy").set(len(live) / bucket)
-        for i, p in enumerate(live):
-            latency = done - p.t_submit
-            tel.histogram("serving_request_latency_seconds").observe(latency)
-            if not p.future.set_running_or_notify_cancel():
-                continue  # client cancelled while queued
-            p.future.set_result({
-                "score": float(margins[i]),
-                "mean": float(means[i]),
-                "latency_ms": latency * 1e3,
-            })
+        failed_states: dict = {}
+        ok_states: dict = {}
+        for tenant, rt, rows, margins, means, exc in outcomes:
+            if exc is not None:
+                for p in rows:
+                    self._fail(p, exc)
+                    st = p.tenant_state
+                    if st is not None:
+                        failed_states[id(st)] = st
+                        self._tenant_counter(
+                            st, "failed_requests_total"
+                        ).inc()
+                continue
+            bucket = rt.bucket_for(len(rows))
+            if not tel.enabled:
+                with self._lock:
+                    self._counts["batches"] += 1
+                    self._counts["completed"] += len(rows)
+                    self._counts["max_batch_rows"] = max(
+                        self._counts["max_batch_rows"], len(rows)
+                    )
+            tel.histogram("serving_batch_rows").observe(len(rows))
+            tel.gauge("serving_batch_occupancy").set(len(rows) / bucket)
+            for i, p in enumerate(rows):
+                latency = done - p.t_submit
+                tel.histogram(
+                    "serving_request_latency_seconds"
+                ).observe(latency)
+                st = p.tenant_state
+                if st is not None:
+                    ok_states.setdefault(id(st), st)
+                    tel.histogram(
+                        f"serving_tenant_{st.slug}"
+                        "_request_latency_seconds"
+                    ).observe(latency)
+                if not p.future.set_running_or_notify_cancel():
+                    continue  # client cancelled while queued
+                p.future.set_result({
+                    "score": float(margins[i]),
+                    "mean": float(means[i]),
+                    "latency_ms": latency * 1e3,
+                })
+        if self._tenancy is not None and (failed_states or ok_states):
+            # Feed each tenant's breaker with this dispatch's outcomes.
+            # A state that both failed and succeeded in one dispatch
+            # counts the failure (the breaker errs toward opening).
+            with self._tenant_lock:
+                for key, st in ok_states.items():
+                    if key not in failed_states:
+                        st.breaker.record_success()
+                for st in failed_states.values():
+                    st.breaker.record_failure()
 
     # -- failure plumbing --------------------------------------------------
     def _classify(self, exc: BaseException):
@@ -484,6 +832,26 @@ class MicroBatcher:
         with self._lock:
             self._counts[key] += n
 
+    # -- tenant routes (swap commit path, serving/swap.py) ------------------
+    def set_tenant_route(self, tenant: str, runtime) -> None:
+        """Commit a tenant-scoped runtime: rows carrying ``tenant``
+        score against it instead of ``self.runtime``.  Copy-on-write so
+        the dispatch thread's single route-table read stays lock-free —
+        the same GIL-atomic commit discipline as ``self.runtime``."""
+        routes = dict(self._tenant_routes)
+        routes[tenant] = runtime
+        self._tenant_routes = routes
+
+    def clear_tenant_route(self, tenant: str) -> None:
+        """Drop a tenant back onto the default route."""
+        routes = dict(self._tenant_routes)
+        routes.pop(tenant, None)
+        self._tenant_routes = routes
+
+    def tenant_route(self, tenant: str):
+        """The tenant's committed runtime, or None (default route)."""
+        return self._tenant_routes.get(tenant)
+
     # -- observability -----------------------------------------------------
     @property
     def queue_depth(self) -> int:
@@ -499,6 +867,8 @@ class MicroBatcher:
         "shed": "serving_shed_total",
         "shed_low_priority": "serving_shed_low_priority_total",
         "shed_deadline": "serving_shed_deadline_total",
+        "shed_quota": "serving_shed_quota_total",
+        "shed_breaker": "serving_shed_breaker_total",
         "tier_transitions": "serving_tier_transitions_total",
         "expired": "serving_deadline_expired_total",
         "failed": "serving_failed_requests_total",
@@ -528,10 +898,33 @@ class MicroBatcher:
                 counts = dict(self._counts)
             counts["source"] = "internal"
         counts["queue_depth"] = self._queue.qsize()
-        counts["max_queue"] = self.config.max_queue
+        counts["max_queue"] = self._capacity
         counts["max_batch_size"] = self.config.max_batch_size
         counts["max_wait_us"] = self.config.max_wait_us
         with self._lock:
             counts["tier"] = TIER_NAMES[self._tier]
         counts["model_version"] = getattr(self.runtime, "model_version", 1)
+        if self._tenancy is not None:
+            routes = self._tenant_routes
+            tenants = {}
+            with self._tenant_lock:
+                states = [self._default_state]
+                states.extend(self._tenant_states.values())
+                for st in states:
+                    tenants[st.spec.name] = {
+                        "slug": st.slug,
+                        "depth": st.depth,
+                        "max_queue": st.spec.max_queue,
+                        "tier": TIER_NAMES[st.tier],
+                        "quota": st.bucket.snapshot(),
+                        "breaker": st.breaker.snapshot(),
+                        "p99_slo_ms": st.spec.p99_slo_ms,
+                    }
+            for tenant, entry in tenants.items():
+                rt = routes.get(tenant)
+                entry["routed_version"] = (
+                    None if rt is None
+                    else getattr(rt, "model_version", None)
+                )
+            counts["tenants"] = tenants
         return counts

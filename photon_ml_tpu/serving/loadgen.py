@@ -32,6 +32,11 @@ HA selfcheck replay:
 - ``worker_kill``  — process-mode only: a worker PROCESS takes a real
   SIGKILL mid-phase; same zero-failed-requests contract through the
   pipe-EOF resubmission path.
+- ``noisy_neighbor`` — TWO tenants: an aggressor bursts to ~10x its
+  quota while a victim holds steady; the tenancy layer must shed the
+  aggressor alone — victim p99 inside its SLO, zero victim failures.
+  Tenant-aware: replay with :func:`run_noisy_neighbor` (per-tenant
+  outcome accounting), not the tenant-blind :func:`run_scenario`.
 
 Per-phase and whole-run p50/p99 come from the same shared
 ``telemetry.Histogram.quantile`` the live exposition uses.
@@ -381,6 +386,20 @@ SCENARIOS = {
             ScenarioPhase("after", 1.0),
         ],
     ),
+    "noisy_neighbor": Scenario(
+        "noisy_neighbor",
+        "an aggressor tenant bursts to rate_multiplier x its baseline "
+        "(sized ~10x its quota) while a victim tenant holds steady; the "
+        "aggressor sheds alone, the victim's p99 stays inside its SLO "
+        "with zero failures.  Tenant-aware: the multiplier scales the "
+        "AGGRESSOR only — replay via run_noisy_neighbor, never the "
+        "tenant-blind run_scenario",
+        [
+            ScenarioPhase("baseline", 1.0),
+            ScenarioPhase("burst", 2.0, rate_multiplier=10.0),
+            ScenarioPhase("recovery", 1.0),
+        ],
+    ),
 }
 
 
@@ -446,4 +465,215 @@ def run_scenario(
         scenario=scenario.name,
         phases=phase_reports,
         actions=action_results,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Tenant-aware replay (the noisy_neighbor isolation proof)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TenantLoadReport:
+    """One tenant's outcomes across a tenant-aware replay.
+
+    ``shed`` counts admission-control verdicts (RejectedError — quota,
+    bulkhead, tier, or breaker — whether raised at submit or delivered
+    through the future, which is how process-mode rejections arrive);
+    ``failed`` is everything else that isn't a completion.  The victim
+    gate reads ``failed`` — a shed aggressor is the design working,
+    a failed victim is the isolation story broken."""
+
+    tenant: str
+    completed: int
+    shed: int
+    failed: int
+    latencies_ms: np.ndarray
+
+    def percentile_ms(self, q: float) -> Optional[float]:
+        if len(self.latencies_ms) == 0:
+            return None
+        hist = Histogram(threading.Lock())
+        for v in self.latencies_ms:
+            hist.observe(v)
+        return float(hist.quantile(q / 100.0))
+
+    def snapshot(self) -> dict:
+        return {
+            "tenant": self.tenant,
+            "completed": self.completed,
+            "shed": self.shed,
+            "failed": self.failed,
+            "latency_p50_ms": _round(self.percentile_ms(50)),
+            "latency_p99_ms": _round(self.percentile_ms(99)),
+        }
+
+
+@dataclasses.dataclass
+class NoisyNeighborReport:
+    """Victim/aggressor outcomes of one noisy-neighbor replay."""
+
+    scenario: str
+    victim: TenantLoadReport
+    aggressor: TenantLoadReport
+
+    def isolation(self, victim_slo_ms: float) -> dict:
+        """The containment gate: victim completed traffic with ZERO
+        failures and a p99 inside its SLO, while the aggressor actually
+        got shed (no sheds = the burst never pressured the quota and
+        the run proved nothing)."""
+        p99 = self.victim.percentile_ms(99)
+        ok = (
+            self.victim.failed == 0
+            and self.victim.completed > 0
+            and p99 is not None
+            and p99 <= victim_slo_ms
+            and self.aggressor.shed > 0
+        )
+        return {
+            "pass": bool(ok),
+            "victim_completed": self.victim.completed,
+            "victim_failed": self.victim.failed,
+            "victim_p99_ms": _round(p99),
+            "victim_slo_ms": victim_slo_ms,
+            "aggressor_completed": self.aggressor.completed,
+            "aggressor_shed": self.aggressor.shed,
+            "aggressor_failed": self.aggressor.failed,
+        }
+
+    def snapshot(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "victim": self.victim.snapshot(),
+            "aggressor": self.aggressor.snapshot(),
+        }
+
+
+class _TenantAcct:
+    """Thread-safe per-tenant outcome accumulator."""
+
+    def __init__(self, tenant: str):
+        self.tenant = tenant
+        self.lock = threading.Lock()
+        self.completed = 0
+        self.shed = 0
+        self.failed = 0
+        self.latencies: list = []
+
+    def report(self) -> TenantLoadReport:
+        with self.lock:
+            return TenantLoadReport(
+                tenant=self.tenant,
+                completed=self.completed,
+                shed=self.shed,
+                failed=self.failed,
+                latencies_ms=np.asarray(self.latencies),
+            )
+
+
+def _tenant_open_loop(
+    submit: Callable,
+    make_request: Callable,
+    phase: ScenarioPhase,
+    tenant: str,
+    rate_rps: float,
+    acct: _TenantAcct,
+    timeout_s: float,
+    seed: int,
+) -> None:
+    """One tenant's Poisson arrival stream for one phase, classifying
+    every outcome into ``acct`` (sync or via the future — process-mode
+    rejections arrive as future exceptions)."""
+    rng = np.random.default_rng(seed)
+    pending: list = []
+
+    def waiter(fut, t_sched: float) -> None:
+        try:
+            fut.result(timeout=timeout_s)
+        except RejectedError:
+            with acct.lock:
+                acct.shed += 1
+            return
+        except Exception:  # noqa: BLE001 — loadgen counts, not raises
+            with acct.lock:
+                acct.failed += 1
+            return
+        lat = (time.perf_counter() - t_sched) * 1e3
+        with acct.lock:
+            acct.latencies.append(lat)
+            acct.completed += 1
+
+    t_start = time.perf_counter()
+    t_next = t_start
+    i = 0
+    while t_next < t_start + phase.duration_s:
+        now = time.perf_counter()
+        if now < t_next:
+            time.sleep(t_next - now)
+        try:
+            fut = submit(make_request(i, phase, tenant))
+        except RejectedError:
+            with acct.lock:
+                acct.shed += 1
+        except Exception:  # noqa: BLE001
+            with acct.lock:
+                acct.failed += 1
+        else:
+            t = threading.Thread(
+                target=waiter, args=(fut, t_next), daemon=True
+            )
+            t.start()
+            pending.append(t)
+        i += 1
+        t_next += float(rng.exponential(1.0 / rate_rps))
+    for t in pending:
+        t.join(timeout=timeout_s)
+
+
+def run_noisy_neighbor(
+    submit: Callable,
+    make_request: Callable,
+    victim: str = "victim",
+    aggressor: str = "aggressor",
+    victim_rate_rps: float = 40.0,
+    aggressor_rate_rps: float = 40.0,
+    scenario: Optional[Scenario] = None,
+    timeout_s: float = 30.0,
+    seed: int = 0,
+) -> NoisyNeighborReport:
+    """Replay the noisy-neighbor script: per phase, the victim offers
+    ``victim_rate_rps`` and the aggressor offers ``aggressor_rate_rps *
+    phase.rate_multiplier`` — the multiplier scales the AGGRESSOR only,
+    so the burst phase is the aggressor alone going over quota while the
+    victim's offered load never changes.  ``make_request(i, phase,
+    tenant)`` must build a request carrying the tenant id.  Outcomes are
+    classified per tenant (RejectedError = shed, any other
+    non-completion = failed); gate the result with
+    :meth:`NoisyNeighborReport.isolation`."""
+    scenario = scenario or SCENARIOS["noisy_neighbor"]
+    accts = {victim: _TenantAcct(victim), aggressor: _TenantAcct(aggressor)}
+    for pi, phase in enumerate(scenario.phases):
+        streams = [
+            (victim, victim_rate_rps),
+            (aggressor, aggressor_rate_rps * phase.rate_multiplier),
+        ]
+        threads = [
+            threading.Thread(
+                target=_tenant_open_loop,
+                args=(
+                    submit, make_request, phase, tenant, rate,
+                    accts[tenant], timeout_s, seed + 7 * pi + ti,
+                ),
+                name=f"noisy-{phase.name}-{tenant}",
+                daemon=True,
+            )
+            for ti, (tenant, rate) in enumerate(streams)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    return NoisyNeighborReport(
+        scenario=scenario.name,
+        victim=accts[victim].report(),
+        aggressor=accts[aggressor].report(),
     )

@@ -73,6 +73,9 @@ class _Generation:
     path: Optional[str]
     model: object = None
     index_maps: Optional[dict] = None
+    #: the runtime_config a tenant swap carried, so a respawned worker
+    #: replays the route with the same knobs (None = pool default).
+    runtime_config: Optional[RuntimeConfig] = None
 
 
 class _WorkerRuntimeView:
@@ -195,6 +198,23 @@ class ProcessReplica:
             self.stop(timeout=1.0)
             raise RuntimeError(f"worker {rid} failed to start: {error}")
         pool._register(self)
+        # Replay committed tenant routes: a worker respawned after a
+        # tenant swap must serve the same tenant → version map as its
+        # peers, or a restart would silently undo a tenant's isolation.
+        # A replay failure fails the spawn — the supervisor's restart
+        # path reschedules with backoff rather than admitting a worker
+        # with a stale route table.
+        try:
+            for tenant, generation in pool.tenant_generations().items():
+                self.swap_prepare(
+                    generation.manifest, generation.runtime_config
+                )
+                self.swap_commit(generation.version, tenant=tenant)
+        except Exception as exc:
+            self.stop(timeout=1.0)
+            raise RuntimeError(
+                f"worker {rid} failed to replay tenant routes: {exc}"
+            ) from exc
 
     # -- reader thread -----------------------------------------------------
     def _read_loop(self) -> None:
@@ -309,6 +329,10 @@ class ProcessReplica:
                 "kind": "score",
                 "id": request_id,
                 "row": row,
+                # The tenant id rides the frame explicitly (not only
+                # inside the pickled row) so the worker can stamp rows
+                # built by older parsers and the wire stays greppable.
+                "tenant": getattr(row, "tenant", None),
                 "timeout_ms": timeout_ms,
                 "bypass": bypass_admission,
             })
@@ -439,19 +463,34 @@ class ProcessReplica:
                 f"v{manifest.get('version')}: {message.get('error')}"
             )
 
-    def swap_commit(self, version: int, timeout: float = 30.0) -> None:
-        self._conn.send({"kind": "swap_commit", "version": version})
+    def swap_commit(
+        self, version: int, timeout: float = 30.0,
+        tenant: Optional[str] = None,
+    ) -> None:
+        """Commit a prepared version — as the default serving runtime,
+        or (with ``tenant``) as that one tenant's route, leaving the
+        worker's default runtime untouched."""
+        frame = {"kind": "swap_commit", "version": version}
+        if tenant is not None:
+            frame["tenant"] = tenant
+        self._conn.send(frame)
         self._await_control(
             ("swap_done",), timeout, f"swap_commit(v{version})"
         )
 
-    def swap_rollback(self, timeout: float = 30.0) -> bool:
-        """Restore the worker's retained previous runtime.  Returns
+    def swap_rollback(
+        self, timeout: float = 30.0, tenant: Optional[str] = None
+    ) -> bool:
+        """Restore the worker's retained previous runtime (or, with
+        ``tenant``, that tenant's retained previous route).  Returns
         False when the worker had nothing retained (it was restarted
         after the commit and attached the new generation directly) —
         the caller converges it by killing it onto the restored
         generation."""
-        self._conn.send({"kind": "swap_rollback"})
+        frame: dict = {"kind": "swap_rollback"}
+        if tenant is not None:
+            frame["tenant"] = tenant
+        self._conn.send(frame)
         message = self._await_control(
             ("swap_done",), timeout, "swap_rollback"
         )
@@ -498,6 +537,13 @@ class WorkerPool:
             self.publish(model, index_maps, version=version,
                          path=model_path)
         ]
+        # Tenant route registry: tenant → its committed generation, plus
+        # one retained previous generation per tenant (the rollback
+        # window).  Tenant generations live ONLY here — never in
+        # ``_generations`` — so a tenant swap can never evict the
+        # default route's rollback window and vice versa.
+        self._tenant_generations: Dict[str, _Generation] = {}
+        self._tenant_previous: Dict[str, Optional[_Generation]] = {}
         self._replicas: Dict[int, ProcessReplica] = {}
         self._hb_prev: Dict[int, dict] = {}
         self._view = _PoolRuntimeView(self)
@@ -576,6 +622,64 @@ class WorkerPool:
         shm_model.unpublish_model(dropped.manifest)
         return self._current
 
+    # -- tenant generations (serving/swap.py tenant-scoped swaps) ----------
+    def _referenced_locked(self, generation: _Generation) -> bool:
+        """Whether any registry slot still points at ``generation``
+        (identity, not equality — generations wrap live model arrays).
+        Call under ``self._lock``."""
+        for g in self._generations:
+            if g is generation:
+                return True
+        for g in self._tenant_generations.values():
+            if g is generation:
+                return True
+        for g in self._tenant_previous.values():
+            if g is generation:
+                return True
+        return False
+
+    def tenant_generations(self) -> Dict[str, _Generation]:
+        """Snapshot of committed tenant routes — what a respawned
+        worker replays before taking traffic."""
+        with self._lock:
+            return dict(self._tenant_generations)
+
+    def commit_tenant_generation(
+        self, tenant: str, generation: _Generation
+    ) -> None:
+        """Make a staged generation the tenant's committed route.  The
+        displaced route (if any) moves into the tenant's one-slot
+        rollback window; whatever that evicts is unlinked unless some
+        other slot still references it."""
+        with self._lock:
+            evicted = self._tenant_previous.get(tenant)
+            self._tenant_previous[tenant] = (
+                self._tenant_generations.get(tenant)
+            )
+            self._tenant_generations[tenant] = generation
+            unlink = (
+                evicted is not None
+                and not self._referenced_locked(evicted)
+            )
+        if unlink:
+            shm_model.unpublish_model(evicted.manifest)
+
+    def rollback_tenant_generation(self, tenant: str) -> None:
+        """Drop the tenant's committed generation and restore the one
+        its last swap displaced (or no route at all — back to the
+        default generation)."""
+        with self._lock:
+            dropped = self._tenant_generations.pop(tenant, None)
+            previous = self._tenant_previous.pop(tenant, None)
+            if previous is not None:
+                self._tenant_generations[tenant] = previous
+            unlink = (
+                dropped is not None
+                and not self._referenced_locked(dropped)
+            )
+        if unlink:
+            shm_model.unpublish_model(dropped.manifest)
+
     # -- replicas ----------------------------------------------------------
     def new_replica(
         self,
@@ -635,12 +739,17 @@ class WorkerPool:
     def stats(self) -> dict:
         with self._lock:
             replicas = sorted(self._replicas)
+            tenant_versions = {
+                tenant: generation.version
+                for tenant, generation in self._tenant_generations.items()
+            }
         return {
             "source": "pool",
             "workers": replicas,
             "model_version": self.version,
             "model_path": self.model_path,
             "generations": len(self._generations),
+            "tenant_versions": tenant_versions,
             "live_segments": shm_model.live_segments(),
         }
 
@@ -660,6 +769,17 @@ class WorkerPool:
             replica.stop(timeout=timeout)
         with self._lock:
             generations = list(self._generations)
+            for g in self._tenant_generations.values():
+                generations.append(g)
+            for g in self._tenant_previous.values():
+                if g is not None:
+                    generations.append(g)
             self._generations = self._generations[-1:]
+            self._tenant_generations = {}
+            self._tenant_previous = {}
+        seen: set = set()
         for generation in generations:
+            if id(generation) in seen:
+                continue
+            seen.add(id(generation))
             shm_model.unpublish_model(generation.manifest)

@@ -110,6 +110,9 @@ class Row:
     offset: float = 0.0
     timeout_ms: Optional[float] = None
     priority: str = "normal"  # one of PRIORITIES
+    #: tenant id for multi-tenant admission/routing (serving/tenancy.py);
+    #: None rides the default tenant's partition and route.
+    tenant: Optional[str] = None
 
 
 class RequestParser:
@@ -202,12 +205,18 @@ class RequestParser:
             raise ValueError(
                 f"priority must be one of {PRIORITIES}, got {priority!r}"
             )
+        tenant = obj.get("tenant")
+        if tenant is not None and not isinstance(tenant, str):
+            raise ValueError(
+                f"tenant must be a string, got {type(tenant).__name__}"
+            )
         return Row(
             features=features,
             ids=ids,
             offset=float(obj.get("offset") or 0.0),
             timeout_ms=None if timeout is None else float(timeout),
             priority=priority,
+            tenant=tenant,
         )
 
     def probe_row(self) -> "Row":

@@ -60,6 +60,7 @@ from photon_ml_tpu.serving.batcher import (
 )
 from photon_ml_tpu.serving.runtime import Row, ScoringRuntime
 from photon_ml_tpu.serving.swap import HotSwapper, SwapInProgressError
+from photon_ml_tpu.serving.tenancy import TenantRouter
 
 
 class ScoringService:
@@ -89,7 +90,11 @@ class ScoringService:
             self._swap_targets,
             on_commit=self._on_swap_commit,
             on_kill=self._on_swap_kill,
+            on_tenant_commit=self._on_tenant_swap_commit,
         )
+        #: tenant → model-version resolution view (serving/tenancy.py);
+        #: the swapper owns the route state, this is the read API.
+        self.router = TenantRouter(self.swapper)
         self._started = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -140,6 +145,19 @@ class ScoringService:
         else:
             self.runtime = self.batcher.runtime
 
+    def _on_tenant_swap_commit(
+        self, tenant, model, index_maps, config, version, path
+    ) -> None:
+        # Tenant-route durability across replica restarts: the
+        # supervisor retains enough to rebuild the route on a fresh
+        # replica (thread mode; the pool's tenant-generation registry
+        # replays routes in process mode).  Standalone batcher mode
+        # needs nothing — the route already lives on the one batcher.
+        if self.supervisor is not None:
+            self.supervisor.on_tenant_swap_commit(
+                tenant, model, index_maps, config, version, path
+            )
+
     def _on_swap_kill(self, batcher, reason: str) -> None:
         # Through the supervisor where there is one: kill_replica marks
         # the replica down in the same call, so the rollback returns
@@ -156,28 +174,38 @@ class ScoringService:
         model_dir: Optional[str] = None,
         rollback: bool = False,
         mode: str = "full",
+        tenant: Optional[str] = None,
     ):
         """Hot-swap to the model at ``model_dir`` (or roll back one
         step).  ``mode="delta"`` treats ``model_dir`` as a delta
         artifact (``freshness/delta.py``) and patches only the changed
         rows of the serving model — ``POST /reload?mode=delta``.
-        Returns a :class:`~photon_ml_tpu.serving.swap.SwapResult`;
-        raises SwapInProgressError on concurrent reloads and ValueError
-        on a missing path or unknown mode."""
+        ``tenant`` scopes the swap (or rollback) to ONE tenant's route
+        (``POST /reload?tenant=acme``) — every other tenant and the
+        default route are untouched; tenant reloads support
+        ``mode="full"`` only.  Returns a
+        :class:`~photon_ml_tpu.serving.swap.SwapResult`; raises
+        SwapInProgressError on concurrent reloads and ValueError on a
+        missing path or unknown mode."""
         if rollback:
-            return self.swapper.rollback()
+            return self.swapper.rollback(tenant=tenant)
         if not model_dir:
             raise ValueError(
                 "reload needs 'model_dir' (or 'rollback': true)"
             )
         if mode == "delta":
+            if tenant is not None:
+                raise ValueError(
+                    "tenant-scoped reload supports mode='full' only "
+                    "(deltas patch the default route's serving model)"
+                )
             return self.swapper.swap_delta(model_dir)
         if mode != "full":
             raise ValueError(
                 f"unknown reload mode {mode!r}; expected 'full' or "
                 "'delta'"
             )
-        return self.swapper.swap(model_dir)
+        return self.swapper.swap(model_dir, tenant=tenant)
 
     # -- scoring -----------------------------------------------------------
     def submit(self, request, timeout_ms: Optional[float] = None) -> Future:
@@ -263,6 +291,10 @@ class ScoringService:
             "model_version": self.swapper.version,
             "model_path": self.swapper.model_path,
             "swap_in_progress": self.swapper.in_progress,
+            "tenant_versions": {
+                t: v for t, (v, _) in
+                self.swapper.tenant_versions().items()
+            },
         }
         if self.supervisor is not None:
             sup = self.supervisor.stats()
@@ -278,7 +310,10 @@ class ScoringService:
         return out
 
     def stats(self) -> dict:
-        out = {"swap": self.swapper.stats()}
+        out = {
+            "swap": self.swapper.stats(),
+            "tenancy": self.router.stats(),
+        }
         if self.supervisor is not None:
             out["supervisor"] = self.supervisor.stats()
             targets = self.supervisor.swap_targets()
@@ -398,18 +433,24 @@ class _Handler(BaseHTTPRequestHandler):
             obj = self._read_body()
             if not isinstance(obj, dict):
                 raise ValueError("reload body must be a JSON object")
-            # Mode comes from the query string (?mode=delta) or the
-            # body; the body wins when both are present.
+            # Mode and tenant come from the query string
+            # (?mode=delta&tenant=acme) or the body; the body wins when
+            # both are present.
             mode = "full"
+            tenant = None
             for part in query.split("&"):
                 key, _, value = part.partition("=")
                 if key == "mode" and value:
                     mode = value
+                elif key == "tenant" and value:
+                    tenant = value
             mode = obj.get("mode", mode)
+            tenant = obj.get("tenant", tenant)
             result = self.server.service.reload(
                 model_dir=obj.get("model_dir"),
                 rollback=bool(obj.get("rollback")),
                 mode=mode,
+                tenant=tenant,
             )
         except SwapInProgressError as exc:
             self._send_json(409, {"error": str(exc)})

@@ -156,6 +156,13 @@ class ReplicaSupervisor:
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
         self._started = False
+        #: tenant → (model, index_maps, config, version, path) retained
+        #: from tenant-swap commits (thread mode), so a restarted
+        #: replica's fresh batcher gets every committed tenant route
+        #: re-applied.  Process mode keeps this empty — the pool's
+        #: tenant-generation registry replays routes into respawned
+        #: workers instead.  Written only under _lock.
+        self._tenant_factories: dict = {}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ReplicaSupervisor":
@@ -213,6 +220,17 @@ class ReplicaSupervisor:
             batcher = MicroBatcher(
                 runtime, self.batcher_config, policy=self.policy
             ).start()
+            # Re-apply every committed tenant route so the fresh
+            # replica serves tenants on their swapped versions, not the
+            # default (serving/tenancy.py).
+            with self._lock:
+                factories = dict(self._tenant_factories)
+            for tenant, (model, index_maps, config, version,
+                         path) in factories.items():
+                rt = ScoringRuntime(model, index_maps, config)
+                rt.model_version = version
+                rt.model_path = path
+                batcher.set_tenant_route(tenant, rt)
         return _Replica(rid=rid, batcher=batcher)
 
     # -- routing (any thread) ------------------------------------------------
@@ -537,6 +555,27 @@ class ReplicaSupervisor:
             return rt
 
         self.runtime_factory = factory
+
+    def on_tenant_swap_commit(
+        self, tenant: str, model, index_maps,
+        config: Optional[RuntimeConfig], version: Optional[int],
+        path: Optional[str],
+    ) -> None:
+        """HotSwapper tenant-commit hook: retain what a restart needs to
+        re-apply this tenant's route on a fresh replica.  An all-None
+        payload means the tenant rolled back onto the default route —
+        drop the retained entry."""
+        if self.pool is not None:
+            # Process mode: respawned workers replay routes from the
+            # pool's tenant-generation registry (procpool.py).
+            return
+        with self._lock:
+            if model is None:
+                self._tenant_factories.pop(tenant, None)
+            else:
+                self._tenant_factories[tenant] = (
+                    model, index_maps, config, version, path
+                )
 
     # -- observability -------------------------------------------------------
     @property

@@ -75,6 +75,7 @@ from photon_ml_tpu import telemetry as telemetry_mod
 from photon_ml_tpu.analysis import sanitizers
 from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+from photon_ml_tpu.serving.tenancy import tenant_slug
 
 
 class SwapInProgressError(RuntimeError):
@@ -95,6 +96,9 @@ class SwapResult:
     stage: str = "commit"
     reason: Optional[str] = None
     targets: int = 0
+    #: set on tenant-scoped swaps/rollbacks: only this tenant's route
+    #: moved; the default route and every other tenant are untouched.
+    tenant: Optional[str] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -118,10 +122,19 @@ class HotSwapper:
         targets_fn: Callable[[], Sequence],
         on_commit: Optional[Callable] = None,
         on_kill: Optional[Callable] = None,
+        on_tenant_commit: Optional[Callable] = None,
         probe_timeout_s: float = 30.0,
     ):
         self._targets_fn = targets_fn
         self._on_commit = on_commit
+        #: tenant-route durability hook: called after every successful
+        #: TENANT swap or rollback with ``(tenant, model, index_maps,
+        #: config, version, path)`` — all-None payload means the tenant
+        #: fell back onto the default route.  The supervisor uses it to
+        #: re-apply tenant routes on replicas it restarts (thread mode;
+        #: in pool mode the pool's tenant-generation registry replays
+        #: routes into respawned workers instead).
+        self._on_tenant_commit = on_tenant_commit
         #: convergence-kill hook: called with (target, reason) when the
         #: rollback must kill a worker that holds no retained previous.
         #: A supervisor-backed service routes this through kill_replica
@@ -148,6 +161,16 @@ class HotSwapper:
         #: successful remote swap (the runtimes to restore live in the
         #: workers and the pool's generation list, not here).
         self._remote_previous: Optional[tuple] = None
+        #: tenant → (version, model_path) for every committed
+        #: tenant-scoped route.  Tenants absent here follow the default
+        #: route (``self.version``).  Versions come from the SAME
+        #: monotone ``_max_version`` sequence as full swaps.
+        self._tenant_versions: dict = {}
+        #: one-step tenant rollback token, set by the last successful
+        #: tenant swap: ("thread", tenant, [(target, old_route)], prev)
+        #: or ("process", tenant, pool, prev) where prev is the
+        #: registry entry the swap displaced (None = default route).
+        self._tenant_previous: Optional[tuple] = None
         self.swaps = 0
         self.rollbacks = 0
         self.deferred = 0
@@ -163,6 +186,11 @@ class HotSwapper:
             self.version
         )
 
+    def tenant_versions(self) -> dict:
+        """tenant → (version, model_path) for every committed
+        tenant-scoped route (snapshot copy)."""
+        return dict(self._tenant_versions)
+
     def stats(self) -> dict:
         return {
             "model_version": self.version,
@@ -173,6 +201,10 @@ class HotSwapper:
             "deferred": self.deferred,
             "can_rollback": bool(self._previous)
             or self._remote_previous is not None,
+            "tenant_versions": {
+                t: {"version": v, "model_path": p}
+                for t, (v, p) in self._tenant_versions.items()
+            },
         }
 
     # -- the swap state machine ----------------------------------------------
@@ -180,8 +212,14 @@ class HotSwapper:
         self,
         model_path: str,
         runtime_config: Optional[RuntimeConfig] = None,
+        tenant: Optional[str] = None,
     ) -> SwapResult:
         """Roll every live target onto the model at ``model_path``.
+
+        With ``tenant`` set, only that tenant's ROUTE moves: every
+        target keeps its default runtime, and rows carrying the tenant
+        id score against the new version (serving/tenancy.py).  The
+        default route and every other tenant are bitwise untouched.
 
         Never raises for a failed swap — the failure IS the result
         (status ``"rolled_back"`` with the stage and reason), because
@@ -196,6 +234,10 @@ class HotSwapper:
             )
         try:
             self.in_progress = True
+            if tenant is not None:
+                return self._swap_tenant_locked(
+                    tenant, model_path, runtime_config
+                )
             return self._swap_locked(model_path, runtime_config)
         finally:
             self.in_progress = False
@@ -450,6 +492,265 @@ class HotSwapper:
             targets=len(targets),
         )
 
+    # -- tenant-scoped swaps (serving/tenancy.py) ----------------------------
+    def _tenant_version_before(self, tenant: str) -> int:
+        entry = self._tenant_versions.get(tenant)
+        return entry[0] if entry is not None else self.version
+
+    def _swap_tenant_locked(
+        self,
+        tenant: str,
+        model_path: str,
+        runtime_config: Optional[RuntimeConfig],
+    ) -> SwapResult:
+        """Roll ONE tenant's route onto a new model version.
+
+        Same four stages and chaos occurrences as a full swap
+        (``serving.swap`` load=0 / prepare=1 / verify=2), but commit is
+        ``set_tenant_route`` per target instead of the ``runtime``
+        assignment — the default route keeps serving everyone else
+        untouched, and ``self.version`` does not move (only
+        ``_max_version``, keeping the version sequence monotone)."""
+        tel = telemetry_mod.current()
+        version_before = self._tenant_version_before(tenant)
+        new_version = self._max_version + 1
+        targets = list(self._targets_fn())
+        if not targets:
+            return self._rolled_back(
+                version_before, model_path, "load",
+                "no live targets to swap", 0, tenant=tenant,
+            )
+        if any(getattr(t.runtime, "degraded", False) for t in targets):
+            self.deferred += 1
+            tel.counter("serving_swaps_deferred_total").inc()
+            tel.event(
+                "serving.swap_deferred",
+                model_path=model_path,
+                version=version_before,
+                tenant=tenant,
+            )
+            return SwapResult(
+                status="deferred",
+                version_before=version_before,
+                version_after=version_before,
+                model_path=model_path,
+                stage="load",
+                reason="a target runtime is degraded; recover or "
+                "restart it before swapping",
+                targets=len(targets),
+                tenant=tenant,
+            )
+
+        if hasattr(targets[0], "swap_prepare"):
+            return self._swap_tenant_remote(
+                tenant, targets, model_path, runtime_config,
+                version_before, new_version,
+            )
+
+        stage = "load"
+        try:
+            chaos_mod.maybe_fail(
+                "serving.swap", stage="load", path=model_path
+            )
+            model, index_maps = ScoringRuntime.load_model(model_path)
+            stage = "prepare"
+            fresh = []
+            for t in targets:
+                cfg = runtime_config or t.runtime.config
+                rt = ScoringRuntime(model, index_maps, cfg)
+                rt.model_version = new_version
+                rt.model_path = model_path
+                margins, means = rt.score_rows([rt.probe_row()])
+                if not (
+                    np.isfinite(margins).all() and np.isfinite(means).all()
+                ):
+                    raise ValueError(
+                        "pre-commit verification probe returned "
+                        "non-finite scores"
+                    )
+                fresh.append(rt)
+            chaos_mod.maybe_fail("serving.swap", stage="prepare")
+        except Exception as exc:  # noqa: BLE001 — abort, old route serves
+            return self._rolled_back(
+                version_before, model_path, stage,
+                f"{type(exc).__name__}: {exc}"[:300], len(targets),
+                tenant=tenant,
+            )
+
+        # Commit: route the tenant, keep the default runtime in place.
+        previous_routes = [(t, t.tenant_route(tenant)) for t in targets]
+        for t, rt in zip(targets, fresh):
+            t.set_tenant_route(tenant, rt)
+
+        # Verify THROUGH the dispatch path: a probe row carrying the
+        # tenant id must come back finite from the new route.
+        try:
+            chaos_mod.maybe_fail("serving.swap", stage="verify")
+            for t, rt in zip(targets, fresh):
+                probe = rt.probe_row()
+                probe.tenant = tenant
+                fut = t.submit(probe, bypass_admission=True)
+                result = fut.result(timeout=self.probe_timeout_s)
+                if not np.isfinite(result["score"]):
+                    raise ValueError(
+                        "post-swap probe returned a non-finite score"
+                    )
+        except Exception as exc:  # noqa: BLE001 — roll back, then report
+            for t, old in previous_routes:
+                if old is None:
+                    t.clear_tenant_route(tenant)
+                else:
+                    t.set_tenant_route(tenant, old)
+            return self._rolled_back(
+                version_before, model_path, "verify",
+                f"{type(exc).__name__}: {exc}"[:300], len(targets),
+                tenant=tenant,
+            )
+
+        prev_entry = self._tenant_versions.get(tenant)
+        self._tenant_versions[tenant] = (new_version, model_path)
+        self._max_version = new_version
+        self._tenant_previous = (
+            "thread", tenant, previous_routes, prev_entry
+        )
+        self.swaps += 1
+        tel.counter("serving_swaps_total").inc()
+        tel.gauge(
+            f"serving_tenant_{tenant_slug(tenant)}_model_version"
+        ).set(new_version)
+        tel.event(
+            "serving.swap",
+            version_before=version_before,
+            version_after=new_version,
+            model_path=model_path,
+            targets=len(targets),
+            tenant=tenant,
+        )
+        if self._on_tenant_commit is not None:
+            sample = fresh[0]
+            self._on_tenant_commit(
+                tenant, model, index_maps, sample.config,
+                new_version, model_path,
+            )
+        return SwapResult(
+            status="swapped",
+            version_before=version_before,
+            version_after=new_version,
+            model_path=model_path,
+            targets=len(targets),
+            tenant=tenant,
+        )
+
+    def _swap_tenant_remote(
+        self,
+        tenant: str,
+        targets: list,
+        model_path: str,
+        runtime_config: Optional[RuntimeConfig],
+        version_before: int,
+        new_version: int,
+    ) -> SwapResult:
+        """Tenant swap over the worker protocol: one shared-memory
+        publication, per-worker prepare, then a tenant-tagged
+        ``swap_commit`` — each worker routes the tenant onto the
+        attached runtime without touching its default.  Success records
+        the generation in the pool's TENANT registry (never the default
+        generation window), so respawned workers replay the route."""
+        tel = telemetry_mod.current()
+        pool = targets[0].pool
+        generation = None
+        prepared: list = []
+        stage = "load"
+        try:
+            chaos_mod.maybe_fail(
+                "serving.swap", stage="load", path=model_path
+            )
+            model, index_maps = ScoringRuntime.load_model(model_path)
+            generation = pool.publish(
+                model, index_maps, version=new_version, path=model_path
+            )
+            generation.runtime_config = runtime_config
+            stage = "prepare"
+            for t in targets:
+                t.swap_prepare(generation.manifest, runtime_config)
+                prepared.append(t)
+            chaos_mod.maybe_fail("serving.swap", stage="prepare")
+        except Exception as exc:  # noqa: BLE001 — abort, old route serves
+            for t in prepared:
+                t.swap_abort(new_version)
+            if generation is not None:
+                pool.retire_generation(generation)
+            return self._rolled_back(
+                version_before, model_path, stage,
+                f"{type(exc).__name__}: {exc}"[:300], len(targets),
+                tenant=tenant,
+            )
+
+        committed: list = []
+        try:
+            for t in targets:
+                t.swap_commit(new_version, tenant=tenant)
+                committed.append(t)
+            chaos_mod.maybe_fail("serving.swap", stage="verify")
+            for t in targets:
+                probe = generation.parser.probe_row()
+                probe.tenant = tenant
+                fut = t.submit(probe, bypass_admission=True)
+                result = fut.result(timeout=self.probe_timeout_s)
+                if not np.isfinite(result["score"]):
+                    raise ValueError(
+                        "post-swap probe returned a non-finite score"
+                    )
+        except Exception as exc:  # noqa: BLE001 — roll back, then report
+            for t in committed:
+                try:
+                    t.swap_rollback(tenant=tenant)
+                except Exception:  # noqa: BLE001 — dead worker respawns
+                    pass           # without the uncommitted route
+            for t in targets:
+                if t not in committed:
+                    t.swap_abort(new_version)
+            pool.retire_generation(generation)
+            return self._rolled_back(
+                version_before, model_path, "verify",
+                f"{type(exc).__name__}: {exc}"[:300], len(targets),
+                tenant=tenant,
+            )
+
+        pool.commit_tenant_generation(tenant, generation)
+        prev_entry = self._tenant_versions.get(tenant)
+        self._tenant_versions[tenant] = (new_version, model_path)
+        self._max_version = new_version
+        self._tenant_previous = ("process", tenant, pool, prev_entry)
+        self.swaps += 1
+        tel.counter("serving_swaps_total").inc()
+        tel.gauge(
+            f"serving_tenant_{tenant_slug(tenant)}_model_version"
+        ).set(new_version)
+        tel.event(
+            "serving.swap",
+            version_before=version_before,
+            version_after=new_version,
+            model_path=model_path,
+            targets=len(targets),
+            tenant=tenant,
+            mode="process",
+        )
+        if self._on_tenant_commit is not None:
+            self._on_tenant_commit(
+                tenant, model, index_maps,
+                runtime_config or pool.runtime_config,
+                new_version, model_path,
+            )
+        return SwapResult(
+            status="swapped",
+            version_before=version_before,
+            version_after=new_version,
+            model_path=model_path,
+            targets=len(targets),
+            tenant=tenant,
+        )
+
     # -- the delta path ------------------------------------------------------
     def swap_delta(
         self,
@@ -687,6 +988,7 @@ class HotSwapper:
         stage: str,
         reason: str,
         targets: int,
+        tenant: Optional[str] = None,
     ) -> SwapResult:
         """Record an aborted (pre-commit) or rolled-back (post-commit)
         swap; either way the previous version is the one serving."""
@@ -699,6 +1001,7 @@ class HotSwapper:
             reason=reason,
             model_path=model_path,
             version=version_before,
+            tenant=tenant,
         )
         return SwapResult(
             status="rolled_back",
@@ -708,18 +1011,23 @@ class HotSwapper:
             stage=stage,
             reason=reason,
             targets=targets,
+            tenant=tenant,
         )
 
-    def rollback(self) -> SwapResult:
+    def rollback(self, tenant: Optional[str] = None) -> SwapResult:
         """One-step manual rollback to the version the last successful
         swap replaced.  The retained runtimes (warm hot sets and all)
-        are restored on their original targets."""
+        are restored on their original targets.  With ``tenant`` set,
+        only that tenant's route rolls back — to its previous version,
+        or onto the default route if the undone swap was its first."""
         if not self._swap_lock.acquire(blocking=False):
             raise SwapInProgressError(
                 "a model swap is in progress; retry after it completes"
             )
         try:
             self.in_progress = True
+            if tenant is not None:
+                return self._rollback_tenant(tenant)
             if self._remote_previous is not None:
                 return self._rollback_remote()
             if not self._previous:
@@ -767,6 +1075,102 @@ class HotSwapper:
         finally:
             self.in_progress = False
             self._swap_lock.release()
+
+    def _rollback_tenant(self, tenant: str) -> SwapResult:
+        """One-step rollback of a tenant route (thread or process
+        mode).  Restores the route the last tenant swap displaced —
+        or clears it, putting the tenant back on the default route —
+        and re-syncs the version registry.  A worker that holds no
+        retained previous route (restarted after the tenant commit) is
+        converge-killed, exactly like the default-route remote
+        rollback."""
+        tel = telemetry_mod.current()
+        token = self._tenant_previous
+        version_before = self._tenant_version_before(tenant)
+        if token is None or token[1] != tenant:
+            return SwapResult(
+                status="rolled_back",
+                version_before=version_before,
+                version_after=version_before,
+                model_path=self.model_path,
+                stage="load",
+                reason=f"nothing to roll back for tenant {tenant!r} "
+                "(no prior tenant swap retained)",
+                tenant=tenant,
+            )
+        mode, _, carrier, prev_entry = token
+        restored_runtime = None
+        if mode == "thread":
+            for t, old in carrier:
+                if old is None:
+                    t.clear_tenant_route(tenant)
+                else:
+                    t.set_tenant_route(tenant, old)
+                    restored_runtime = old
+        else:
+            pool = carrier
+            targets = list(self._targets_fn())
+            stale: list = []
+            for t in targets:
+                try:
+                    if not t.swap_rollback(tenant=tenant):
+                        stale.append(t)
+                except Exception:  # noqa: BLE001 — a dead worker
+                    pass           # respawns on the restored registry
+            pool.rollback_tenant_generation(tenant)
+            for t in stale:
+                reason = (
+                    f"no retained previous route for tenant {tenant!r}; "
+                    "respawn replays the restored tenant registry"
+                )
+                if self._on_kill is not None:
+                    self._on_kill(t, reason)
+                else:
+                    t.kill(reason)
+        if prev_entry is None:
+            self._tenant_versions.pop(tenant, None)
+            version_after = self.version
+            restored_path = self.model_path
+        else:
+            self._tenant_versions[tenant] = prev_entry
+            version_after, restored_path = prev_entry
+        self._tenant_previous = None
+        self.rollbacks += 1
+        tel.counter("serving_rollbacks_total").inc()
+        tel.gauge(
+            f"serving_tenant_{tenant_slug(tenant)}_model_version"
+        ).set(version_after)
+        tel.event(
+            "serving.rollback",
+            stage="manual",
+            reason="operator-requested tenant rollback",
+            model_path=restored_path,
+            version=version_after,
+            tenant=tenant,
+        )
+        if self._on_tenant_commit is not None:
+            if restored_runtime is not None:
+                self._on_tenant_commit(
+                    tenant,
+                    restored_runtime.model,
+                    restored_runtime.index_maps,
+                    restored_runtime.config,
+                    restored_runtime.model_version,
+                    restored_runtime.model_path,
+                )
+            elif mode == "thread":
+                # Back on the default route: clear any retained factory.
+                self._on_tenant_commit(tenant, None, None, None, None, None)
+        return SwapResult(
+            status="rolled_back",
+            version_before=version_before,
+            version_after=version_after,
+            model_path=restored_path,
+            stage="manual",
+            reason="operator-requested tenant rollback",
+            targets=len(self._targets_fn()),
+            tenant=tenant,
+        )
 
     def _rollback_remote(self) -> SwapResult:
         """Process-mode manual rollback: each worker restores its

@@ -12,14 +12,20 @@ vocabulary and the supervisor resubmits them to a peer.
 
 Frames the worker understands (parent → worker)::
 
-    score         {id, row, timeout_ms, bypass}  → result {id, ok, ...}
+    score         {id, row, tenant?, timeout_ms, bypass}
+                                                 → result {id, ok, ...}
     stats         {id}                           → result {id, ok, value}
     swap_prepare  {manifest, runtime_config?, carry_hot?}
                                                  → swap_ready | swap_failed
-    swap_commit   {version}                      → swap_done
-    swap_rollback {}                             → swap_done
+    swap_commit   {version, tenant?}             → swap_done
+    swap_rollback {tenant?}                      → swap_done
     swap_abort    {version}                      (no reply)
     shutdown      {}                             → bye (after drain)
+
+A ``tenant`` on swap_commit routes ONE tenant onto the prepared
+runtime (``batcher.set_tenant_route``) without touching the worker's
+default serving runtime; each tenant retains exactly one displaced
+route for one-step rollback, mirroring the default-route discipline.
 
 and emits unprompted ``heartbeat`` frames every
 ``heartbeat_interval_s``: liveness + queue depth + model version + a
@@ -109,6 +115,12 @@ class _WorkerMain:
         # one-step rollback after a commit.
         self._prepared: dict = {}
         self._previous: Optional[Tuple] = None
+        # Tenant routes: tenant -> (runtime, attachment, version) the
+        # batcher dispatches that tenant against, plus the one displaced
+        # tuple (or None = "was on the default route") each tenant
+        # retains for one-step rollback.
+        self._tenant_routes: dict = {}
+        self._tenant_prev: dict = {}
         model, attachment = shm_model.attach_model(manifest)
         self._runtime = ScoringRuntime(model, {}, self._runtime_config)
         self._runtime.model_version = int(manifest["version"])
@@ -212,7 +224,21 @@ class _WorkerMain:
 
     def _handle_swap_commit(self, msg: dict) -> None:
         version = int(msg["version"])
+        tenant = msg.get("tenant")
         runtime, attachment = self._prepared.pop(version)
+        if tenant is not None:
+            # Tenant-scoped commit: route ONE tenant onto the prepared
+            # runtime; the default serving runtime never moves.  The
+            # displaced route fills the tenant's one-slot rollback
+            # window; whatever that evicts is done serving and closes.
+            evicted = self._tenant_prev.pop(tenant, None)
+            self._tenant_prev[tenant] = self._tenant_routes.get(tenant)
+            self._tenant_routes[tenant] = (runtime, attachment, version)
+            self._batcher.set_tenant_route(tenant, runtime)
+            if evicted is not None:
+                evicted[1].close()
+            self._send({"kind": "swap_done", "version": version})
+            return
         if self._previous is not None:
             self._previous[1].close()
         self._previous = (
@@ -225,7 +251,11 @@ class _WorkerMain:
         self._attachment = attachment
         self._send({"kind": "swap_done", "version": version})
 
-    def _handle_swap_rollback(self) -> None:
+    def _handle_swap_rollback(self, msg: dict) -> None:
+        tenant = msg.get("tenant")
+        if tenant is not None:
+            self._rollback_tenant_route(tenant)
+            return
         if self._previous is None:
             self._send({
                 "kind": "swap_done",
@@ -239,6 +269,35 @@ class _WorkerMain:
         self._batcher.runtime = runtime
         self._attachment = attachment
         retired_attachment.close()
+        self._send({
+            "kind": "swap_done", "version": version, "rolled_back": True,
+        })
+
+    def _rollback_tenant_route(self, tenant: str) -> None:
+        """Restore the route the tenant's last swap displaced — or clear
+        it (back to the default route) when that swap was the tenant's
+        first.  No retained window (this worker respawned after the
+        commit and replayed the route directly) answers
+        ``rolled_back: False`` so the parent converge-kills us onto the
+        restored registry."""
+        if tenant not in self._tenant_prev:
+            self._send({
+                "kind": "swap_done",
+                "version": getattr(self._batcher.runtime, "model_version", 1),
+                "rolled_back": False,
+            })
+            return
+        previous = self._tenant_prev.pop(tenant)
+        dropped = self._tenant_routes.pop(tenant, None)
+        if previous is None:
+            self._batcher.clear_tenant_route(tenant)
+            version = getattr(self._batcher.runtime, "model_version", 1)
+        else:
+            self._tenant_routes[tenant] = previous
+            self._batcher.set_tenant_route(tenant, previous[0])
+            version = previous[2]
+        if dropped is not None:
+            dropped[1].close()
         self._send({
             "kind": "swap_done", "version": version, "rolled_back": True,
         })
@@ -281,7 +340,7 @@ class _WorkerMain:
                 elif kind == "swap_commit":
                     self._handle_swap_commit(message)
                 elif kind == "swap_rollback":
-                    self._handle_swap_rollback()
+                    self._handle_swap_rollback(message)
                 elif kind == "swap_abort":
                     self._handle_swap_abort(message)
                 elif kind == "shutdown":
@@ -309,13 +368,24 @@ class _WorkerMain:
                 staged[1].close()
             if self._previous is not None:
                 self._previous[1].close()
+            for route in self._tenant_routes.values():
+                route[1].close()
+            for prev in self._tenant_prev.values():
+                if prev is not None:
+                    prev[1].close()
             self._attachment.close()
 
     def _handle_score(self, message: dict) -> None:
         request_id = message.get("id")
+        row = message["row"]
+        # The frame's tenant id wins over a missing row field so rows
+        # pickled by an older parser still land in the right partition.
+        tenant = message.get("tenant")
+        if tenant is not None and getattr(row, "tenant", None) is None:
+            row.tenant = tenant
         try:
             future = self._batcher.submit(
-                message["row"],
+                row,
                 timeout_ms=message.get("timeout_ms"),
                 bypass_admission=bool(message.get("bypass")),
             )
@@ -331,6 +401,10 @@ class _WorkerMain:
         stats = self._batcher.stats()
         stats["worker"] = self._worker_id
         stats["pid"] = os.getpid()
+        stats["tenant_versions"] = {
+            tenant: route[2]
+            for tenant, route in self._tenant_routes.items()
+        }
         runtime = self._batcher.runtime
         if isinstance(runtime, ScoringRuntime):
             stats["runtime"] = runtime.stats()

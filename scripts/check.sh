@@ -28,12 +28,15 @@ python -m photon_ml_tpu.telemetry --lint-metrics
 echo "== analysis invariant check =="
 python -m photon_ml_tpu.analysis --check
 
-# The serving selfcheck runs two passes: the single-runtime pass builds
-# a synthetic GAME model, serves concurrent HTTP requests, and verifies
-# batched results are bit-identical to single-request scoring (plus the
-# telemetry snapshot contents); the HA pass kills one of two replicas
-# and hot-swaps v1->v2 under live load (plus a tampered-model rollback),
-# gating on ZERO failed requests and a monotone serving_model_version.
+# The serving selfcheck runs three passes: the single-runtime pass
+# builds a synthetic GAME model, serves concurrent HTTP requests, and
+# verifies batched results are bit-identical to single-request scoring
+# (plus the telemetry snapshot contents); the HA pass kills one of two
+# replicas and hot-swaps v1->v2 under live load (plus a tampered-model
+# rollback), gating on ZERO failed requests and a monotone
+# serving_model_version; the tenancy pass replays the noisy_neighbor
+# scenario — an aggressor tenant bursting 10x its quota sheds alone
+# while the victim tenant's p99 holds inside its SLO with zero failures.
 echo "== serving selfcheck (JAX_PLATFORMS=cpu) =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.serving --selfcheck
 
@@ -42,7 +45,9 @@ env JAX_PLATFORMS=cpu python -m photon_ml_tpu.serving --selfcheck
 # parity with in-process scoring, a real SIGKILL under open-loop load
 # with zero failed requests, a cross-process hot swap + rollback
 # (bit-identical), single-publication segment accounting, and a
-# leak-free shutdown under a strict ProcessLeakSentinel.
+# leak-free shutdown under a strict ProcessLeakSentinel — then the
+# noisy-neighbor tenancy pass with the tenant id riding the worker
+# wire protocol (victim zero-failures gate in process mode too).
 echo "== serving process-mode selfcheck (JAX_PLATFORMS=cpu) =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.serving --selfcheck --workers 2
 

@@ -11,6 +11,10 @@ The load-bearing contracts:
 - a publish killed at EVERY record/rename boundary resumes exactly:
   either the publication is completed (artifact already durable) or
   cleanly aborted — subscribers never see a half-publish;
+- retention (keep-last-K, ISSUE 13) prunes artifacts + compacts the
+  journal crash-safely: never the newest commit, never an unsettled
+  begin, never past a registered subscriber's ack, and sequence
+  numbering survives compaction and reopen;
 - the serving delta path (``swap_delta``) patches live replicas with
   shared compiled kernels, rides the version registry (one-step
   rollback), and rolls back on a bad artifact with the old version
@@ -53,7 +57,9 @@ from photon_ml_tpu.freshness.online import (
 from photon_ml_tpu.freshness.publisher import (
     DeltaPublisher,
     PublishAborted,
+    read_acks,
     read_publications,
+    write_ack,
 )
 from photon_ml_tpu.game.model import (
     FixedEffectModel,
@@ -324,6 +330,195 @@ class TestPublisher:
             f.write("\n".join(lines) + "\n")
         with pytest.raises(DeltaError, match="corrupt journal"):
             read_publications(root)
+
+
+class TestRetention:
+    def _publish_n(self, root, workload, n):
+        delta = diff_game_models(
+            workload.model, _perturbed().model, event_wall_epoch=42.0
+        )
+        pub = DeltaPublisher(root)
+        for _ in range(n):
+            pub.publish(delta)
+        return pub
+
+    def test_keep_last_boundaries(self, tmp_path, workload):
+        root = str(tmp_path / "pubs")
+        with telemetry.Telemetry(sinks=[]) as tel:
+            pub = self._publish_n(root, workload, 5)
+            # keep_last == count: nothing to prune.
+            assert pub.retain(5) == {
+                "pruned": [], "blocked": [], "kept": [1, 2, 3, 4, 5],
+            }
+            # keep the newest 3.
+            s = pub.retain(3)
+            assert s["pruned"] == [1, 2] and s["kept"] == [3, 4, 5]
+            assert [p.seq for p in pub.publications()] == [3, 4, 5]
+            assert not os.path.isdir(os.path.join(root, "delta-000001"))
+            assert os.path.isdir(os.path.join(root, "delta-000003"))
+            # keep_last=1: everything but the newest goes; the newest
+            # commit itself is NEVER prunable (keep_last >= 1 enforced).
+            s = pub.retain(1)
+            assert s["pruned"] == [3, 4] and s["kept"] == [5]
+            with pytest.raises(ValueError, match="newest"):
+                pub.retain(0)
+            with pytest.raises(ValueError, match="newest"):
+                DeltaPublisher(str(tmp_path / "x"), retain_last=0)
+            # Sequence numbering survives compaction…
+            p6 = pub.publish(diff_game_models(
+                workload.model, _perturbed().model
+            ))
+            assert p6.seq == 6
+            pub.close()
+            # …and a reopened publisher continues the same sequence.
+            pub2 = DeltaPublisher(root)
+            assert pub2._next_seq == 7
+            assert [p.seq for p in read_publications(root)] == [5, 6]
+            pub2.close()
+            snap = tel.snapshot()
+        assert snap["counters"]["freshness_retention_pruned_total"] == 4
+
+    def test_unsettled_begin_survives_retention(self, tmp_path, workload):
+        root = str(tmp_path / "pubs")
+        with telemetry.Telemetry(sinks=[]):
+            pub = self._publish_n(root, workload, 2)
+            # Simulate an in-flight publish: begin journaled, no settle.
+            with pub._lock:
+                pub._append({
+                    "kind": "begin", "seq": 3,
+                    "publish_wall_epoch": 1.0,
+                })
+            s = pub.retain(1)
+            assert s["pruned"] == [1] and s["kept"] == [2]
+            kinds = {(r["kind"], r["seq"]) for r in pub._read()}
+            assert ("begin", 3) in kinds  # in-flight claim preserved
+            assert ("commit", 1) not in kinds
+            pub.close()
+            # The next constructor settles seq 3 as an abort, and the
+            # claimed sequence stays burned.
+            resumed = DeltaPublisher(root)
+            assert resumed._next_seq == 4
+            assert [p.seq for p in resumed.publications()] == [2]
+            resumed.close()
+
+    def test_crash_between_compaction_and_artifact_removal(
+        self, tmp_path, workload, monkeypatch
+    ):
+        root = str(tmp_path / "pubs")
+        with telemetry.Telemetry(sinks=[]):
+            pub = self._publish_n(root, workload, 3)
+            import photon_ml_tpu.freshness.publisher as publisher_mod
+
+            real_rmtree = publisher_mod.shutil.rmtree
+            calls = []
+
+            def dying_rmtree(path, **kwargs):
+                calls.append(path)
+                raise OSError("chaos: killed before artifact removal")
+
+            monkeypatch.setattr(
+                publisher_mod.shutil, "rmtree", dying_rmtree
+            )
+            with pytest.raises(OSError, match="chaos"):
+                pub.retain(1)
+            monkeypatch.setattr(
+                publisher_mod.shutil, "rmtree", real_rmtree
+            )
+            # The journal compacted BEFORE the crash: subscribers
+            # already see only the kept publication; the pruned
+            # artifact dirs are orphans on disk.
+            assert [p.seq for p in read_publications(root)] == [3]
+            assert os.path.isdir(os.path.join(root, "delta-000001"))
+            # The next retention sweeps the orphans even with nothing
+            # newly prunable.
+            s = pub.retain(1)
+            assert s["pruned"] == [] and s["kept"] == [3]
+            assert not os.path.isdir(os.path.join(root, "delta-000001"))
+            assert not os.path.isdir(os.path.join(root, "delta-000002"))
+            assert os.path.isdir(os.path.join(root, "delta-000003"))
+            pub.close()
+
+    def test_acks_block_and_release_pruning(self, tmp_path, workload):
+        root = str(tmp_path / "pubs")
+        with telemetry.Telemetry(sinks=[]):
+            pub = self._publish_n(root, workload, 4)
+            write_ack(root, "replica-a", 2)
+            write_ack(root, "replica-b", 4)
+            assert read_acks(root) == {"replica-a": 2, "replica-b": 4}
+            # The SLOWEST ack gates: 3 is prunable by age but unacked.
+            s = pub.retain(1)
+            assert s["pruned"] == [1, 2] and s["blocked"] == [3]
+            assert [p.seq for p in pub.publications()] == [3, 4]
+            write_ack(root, "replica-a", 4)
+            s = pub.retain(1)
+            assert s["pruned"] == [3] and s["blocked"] == []
+            assert [p.seq for p in pub.publications()] == [4]
+            pub.close()
+
+    def test_retain_last_prunes_on_publish(self, tmp_path, workload):
+        root = str(tmp_path / "pubs")
+        delta = diff_game_models(workload.model, _perturbed().model)
+        with telemetry.Telemetry(sinks=[]):
+            with DeltaPublisher(root, retain_last=2) as pub:
+                for _ in range(5):
+                    pub.publish(delta)
+                assert [p.seq for p in pub.publications()] == [4, 5]
+
+    def test_ack_hygiene(self, tmp_path):
+        root = str(tmp_path / "pubs")
+        os.makedirs(root)
+        with pytest.raises(ValueError, match="safe filename"):
+            write_ack(root, "../escape", 1)
+        write_ack(root, "ok", 7)
+        # Garbage in acks/ is skipped, never fatal (ack writes are
+        # atomic, so torn files are not ours).
+        with open(os.path.join(root, "acks", "junk.json"), "w") as f:
+            f.write("{not json")
+        assert read_acks(root) == {"ok": 7}
+
+
+class TestApplierAcks:
+    def test_applier_registers_and_advances_ack(self, tmp_path, workload):
+        with telemetry.Telemetry(sinks=[]):
+            root = str(tmp_path / "pubs")
+            middle = _perturbed()
+            keeper = DeltaPublisher(root)
+            keeper.publish(diff_game_models(
+                workload.model, middle.model, event_wall_epoch=1.0
+            ))
+            keeper.publish(diff_game_models(
+                middle.model, workload.model, event_wall_epoch=2.0
+            ))
+            service = ScoringService(_runtime(workload))
+            applier = DeltaApplier(
+                service, root, subscriber_id="replica_0"
+            )
+            # Registration at construction pins the whole root…
+            assert read_acks(root) == {"replica_0": 0}
+            s = keeper.retain(1)
+            assert s["pruned"] == [] and s["blocked"] == [1]
+            with service:
+                applier.poll_once()
+            assert applier.stats()["subscriber_id"] == "replica_0"
+            # …and the ack follows the applied high-water mark, which
+            # releases the consumed publication for pruning.
+            assert read_acks(root) == {"replica_0": 2}
+            s = keeper.retain(1)
+            assert s["pruned"] == [1] and s["blocked"] == []
+            keeper.close()
+
+    def test_failed_applies_are_acked(self, tmp_path, workload):
+        # Failed sequences are never retried, so the applier acks past
+        # them — otherwise one poisoned delta would pin the root forever.
+        with telemetry.Telemetry(sinks=[]):
+            root, p = _publish_one(tmp_path, workload)
+            stranger = SyntheticWorkload(n_entities=32, seed=9)
+            service = ScoringService(_runtime(stranger))
+            applier = DeltaApplier(service, root, subscriber_id="sub")
+            with service:
+                applier.poll_once()
+            assert applier.failed == [p.seq]
+            assert read_acks(root) == {"sub": p.seq}
 
 
 def _runtime(workload, **kwargs):

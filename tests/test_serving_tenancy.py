@@ -1,0 +1,492 @@
+"""Multi-tenant serving isolation tests (ISSUE 13).
+
+The load-bearing contracts:
+
+- a zero-quota (suspended) tenant admits NOTHING — not even the token
+  bucket's initial fill — while a neighbor on the same batcher scores
+  normally;
+- an unknown tenant id rides the default spec and the default model
+  route: it can never starve a registered tenant, and it scores
+  bit-identically to an untagged request;
+- a tenant-scoped hot swap moves ONE tenant's route; every other
+  tenant's scores — and the default route's — stay bitwise untouched,
+  and a one-step rollback restores exactly the previous route;
+- the ``serving.tenant`` chaos site fires only for tenant-routed
+  dispatch groups and degrades exactly that tenant;
+- the ``noisy_neighbor`` scenario is registered and its replay harness
+  (``run_noisy_neighbor``) proves containment: the aggressor bursting
+  10x its quota sheds alone, the victim completes with ZERO failures —
+  in thread mode and in process mode with a mid-burst worker SIGKILL.
+"""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import chaos
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.io.game_store import save_game_model
+from photon_ml_tpu.serving import loadgen, shm_model
+from photon_ml_tpu.serving.batcher import BatcherConfig, RejectedError
+from photon_ml_tpu.serving.procpool import WorkerPool
+from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+from photon_ml_tpu.serving.service import ScoringService
+from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+from photon_ml_tpu.serving.tenancy import (
+    TenancyConfig,
+    TenantRouter,
+    TenantSpec,
+    TokenBucket,
+    tenant_slug,
+)
+
+
+@pytest.fixture(scope="module")
+def workload():
+    return SyntheticWorkload(n_entities=32, seed=7)
+
+
+@pytest.fixture(scope="module")
+def workload_v2():
+    return SyntheticWorkload(n_entities=32, seed=8)
+
+
+@pytest.fixture(scope="module")
+def workload_v3():
+    return SyntheticWorkload(n_entities=32, seed=9)
+
+
+RT_CFG = dict(max_batch_size=8, hot_entities=8)
+
+
+def _runtime(workload):
+    return ScoringRuntime(
+        workload.model, workload.index_maps, RuntimeConfig(**RT_CFG)
+    )
+
+
+def _reference(workload, requests):
+    runtime = _runtime(workload)
+    return np.asarray(
+        [
+            runtime.score_rows([runtime.parse_request(r)])[0][0]
+            for r in requests
+        ],
+        np.float32,
+    )
+
+
+def _tagged(workload, i, tenant):
+    obj = dict(workload.request(i))
+    if tenant is not None:
+        obj["tenant"] = tenant
+    return obj
+
+
+def _wait_until(predicate, timeout_s=60.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# Policy primitives
+# ---------------------------------------------------------------------------
+
+class TestTenancyPrimitives:
+    def test_slug_folds_to_metric_alphabet(self):
+        assert tenant_slug("Acme Corp.") == "acme_corp"
+        assert tenant_slug("__x__") == "x"
+        assert tenant_slug("!!!") == "tenant"
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="non-empty"):
+            TenantSpec(name="")
+        with pytest.raises(ValueError, match="quota_rps"):
+            TenantSpec(name="t", quota_rps=-1.0)
+        with pytest.raises(ValueError, match="burst"):
+            TenantSpec(name="t", burst=0.0)
+        with pytest.raises(ValueError, match="watermark"):
+            TenantSpec(name="t", shed_watermark=0.9, reject_watermark=0.5)
+        with pytest.raises(ValueError, match="duplicate"):
+            TenancyConfig(tenants=(
+                TenantSpec(name="a"), TenantSpec(name="a"),
+            ))
+        with pytest.raises(ValueError, match="slug"):
+            TenancyConfig(tenants=(
+                TenantSpec(name="a b"), TenantSpec(name="a.b"),
+            ))
+
+    def test_spec_for_unknown_is_default(self):
+        cfg = TenancyConfig(tenants=(TenantSpec(name="a", max_queue=8),))
+        assert cfg.spec_for("a").max_queue == 8
+        assert cfg.spec_for("stranger") is cfg.default
+        assert cfg.spec_for(None) is cfg.default
+        assert cfg.is_known("a") and not cfg.is_known("stranger")
+        assert cfg.partition_total == 8 + cfg.default.max_queue
+
+    def test_zero_quota_bucket_denies_first_request(self):
+        bucket = TokenBucket(rate_rps=0.0, burst=5.0, clock=lambda: 0.0)
+        # The initial fill must NOT grant a suspended tenant a burst.
+        assert not bucket.try_acquire()
+        assert bucket.denied == 1 and bucket.admitted == 0
+
+    def test_unlimited_bucket_always_admits(self):
+        bucket = TokenBucket(rate_rps=None)
+        assert all(bucket.try_acquire() for _ in range(100))
+        assert bucket.denied == 0
+
+    def test_bucket_refills_at_rate_up_to_burst(self):
+        t = [0.0]
+        bucket = TokenBucket(rate_rps=2.0, burst=4.0, clock=lambda: t[0])
+        assert [bucket.try_acquire() for _ in range(5)] == [
+            True, True, True, True, False,
+        ]
+        t[0] = 1.0  # 2 tokens refilled
+        assert bucket.try_acquire() and bucket.try_acquire()
+        assert not bucket.try_acquire()
+        t[0] = 100.0  # refill clamps at burst, not elapsed * rate
+        assert bucket.tokens <= bucket.burst
+        assert [bucket.try_acquire() for _ in range(5)] == [
+            True, True, True, True, False,
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Admission: quotas and bulkheads in a live batcher
+# ---------------------------------------------------------------------------
+
+class TestTenantAdmission:
+    def _service(self, workload, tenancy):
+        return ScoringService(_runtime(workload), BatcherConfig(
+            max_batch_size=8, max_wait_us=1_000, max_queue=256,
+            tenancy=tenancy,
+        ))
+
+    def test_zero_quota_tenant_shed_neighbor_unaffected(self, workload):
+        tenancy = TenancyConfig(tenants=(
+            TenantSpec(name="suspended", quota_rps=0.0),
+            TenantSpec(name="paying"),
+        ))
+        with telemetry.Telemetry(sinks=[]) as tel:
+            with self._service(workload, tenancy) as service:
+                for i in range(8):
+                    with pytest.raises(RejectedError, match="over quota"):
+                        service.submit(_tagged(workload, i, "suspended"))
+                results = [
+                    service.score(_tagged(workload, i, "paying"))
+                    for i in range(8)
+                ]
+                assert all(np.isfinite(r["score"]) for r in results)
+            snap = tel.snapshot()
+        counters = snap["counters"]
+        assert counters["serving_tenant_suspended_shed_total"] == 8
+        assert counters["serving_shed_quota_total"] == 8
+        assert counters["serving_tenant_paying_requests_total"] == 8
+        assert "serving_tenant_paying_shed_total" not in counters
+
+    def test_unknown_tenant_scores_on_default_route(self, workload):
+        tenancy = TenancyConfig(tenants=(TenantSpec(name="paying"),))
+        requests = [workload.request(i) for i in range(8)]
+        expected = _reference(workload, requests)
+        with telemetry.Telemetry(sinks=[]):
+            with self._service(workload, tenancy) as service:
+                got = np.asarray(
+                    [
+                        np.float32(
+                            service.score(
+                                _tagged(workload, i, "stranger")
+                            )["score"]
+                        )
+                        for i in range(8)
+                    ],
+                    np.float32,
+                )
+                stats = service.batcher.stats()
+        assert got.tobytes() == expected.tobytes()
+        # The stranger rode the default partition, not a tenant's.
+        assert "stranger" not in stats["tenants"]
+        assert stats["tenants"]["default"]["quota"]["admitted"] >= 8
+
+    def test_stats_expose_per_tenant_state(self, workload):
+        tenancy = TenancyConfig(tenants=(
+            TenantSpec(name="a", quota_rps=100.0, max_queue=16),
+        ))
+        with telemetry.Telemetry(sinks=[]):
+            with self._service(workload, tenancy) as service:
+                service.score(_tagged(workload, 0, "a"))
+                stats = service.batcher.stats()
+        entry = stats["tenants"]["a"]
+        assert entry["max_queue"] == 16
+        assert entry["quota"]["admitted"] >= 1
+        assert entry["breaker"]["state"] in ("closed", "half_open", "open")
+        assert entry["routed_version"] is None  # no tenant route yet
+
+
+# ---------------------------------------------------------------------------
+# Tenant-scoped routing: swap + rollback, bitwise neighbors
+# ---------------------------------------------------------------------------
+
+class TestTenantRouting:
+    @pytest.fixture()
+    def dirs(self, tmp_path, workload, workload_v2, workload_v3):
+        out = {}
+        for name, w in (
+            ("v1", workload), ("v2", workload_v2), ("v3", workload_v3),
+        ):
+            d = str(tmp_path / name)
+            save_game_model(w.model, w.index_maps, d)
+            out[name] = d
+        return out
+
+    def test_swap_isolates_and_rollback_restores_bitwise(
+        self, dirs, workload, workload_v2, workload_v3
+    ):
+        requests = [workload.request(i) for i in range(8)]
+        want = {
+            "v1": _reference(workload, requests),
+            "v2": _reference(workload_v2, requests),
+            "v3": _reference(workload_v3, requests),
+        }
+
+        def scores(service, tenant):
+            return np.asarray(
+                [
+                    np.float32(
+                        service.score(_tagged(workload, i, tenant))["score"]
+                    )
+                    for i in range(8)
+                ],
+                np.float32,
+            )
+
+        tenancy = TenancyConfig(tenants=(
+            TenantSpec(name="acme"), TenantSpec(name="beta"),
+        ))
+        with telemetry.Telemetry(sinks=[]):
+            service = ScoringService(_runtime(workload), BatcherConfig(
+                max_batch_size=8, max_wait_us=1_000, max_queue=256,
+                tenancy=tenancy,
+            ))
+            with service:
+                result = service.reload(dirs["v2"], tenant="acme")
+                assert result.status == "swapped", result
+                assert result.tenant == "acme"
+                # acme scores v2; beta, untagged, and unknown all v1.
+                assert scores(service, "acme").tobytes() \
+                    == want["v2"].tobytes()
+                assert scores(service, "beta").tobytes() \
+                    == want["v1"].tobytes()
+                assert scores(service, None).tobytes() \
+                    == want["v1"].tobytes()
+                assert scores(service, "stranger").tobytes() \
+                    == want["v1"].tobytes()
+
+                # First-swap rollback: acme returns to the DEFAULT
+                # route, bitwise.  (A successful MANUAL rollback
+                # reports status "rolled_back" with live targets;
+                # a refusal has targets == 0.)
+                rb = service.reload(rollback=True, tenant="acme")
+                assert rb.status == "rolled_back" and rb.targets > 0, rb
+                assert scores(service, "acme").tobytes() \
+                    == want["v1"].tobytes()
+                assert service.swapper.tenant_versions() == {}
+
+                # Two tenants routed: the second swap leaves the first
+                # bitwise untouched.
+                assert service.reload(
+                    dirs["v2"], tenant="acme"
+                ).status == "swapped"
+                assert service.reload(
+                    dirs["v3"], tenant="beta"
+                ).status == "swapped"
+                assert scores(service, "beta").tobytes() \
+                    == want["v3"].tobytes()
+                assert scores(service, "acme").tobytes() \
+                    == want["v2"].tobytes()
+                assert scores(service, None).tobytes() \
+                    == want["v1"].tobytes()
+                assert set(service.swapper.tenant_versions()) \
+                    == {"acme", "beta"}
+
+                # The rollback token is one-step and belongs to the
+                # LAST tenant swap: rolling back acme now refuses…
+                stale = service.reload(rollback=True, tenant="acme")
+                assert stale.targets == 0, stale
+                assert "no prior tenant swap" in stale.reason
+                # …while rolling back beta restores it to the default
+                # route with acme's route bitwise untouched.
+                rb2 = service.reload(rollback=True, tenant="beta")
+                assert rb2.status == "rolled_back" and rb2.targets > 0, rb2
+                assert scores(service, "beta").tobytes() \
+                    == want["v1"].tobytes()
+                assert scores(service, "acme").tobytes() \
+                    == want["v2"].tobytes()
+                assert set(service.swapper.tenant_versions()) == {"acme"}
+
+    def test_router_view_tracks_routes(self, dirs, workload):
+        tenancy = TenancyConfig(tenants=(TenantSpec(name="acme"),))
+        with telemetry.Telemetry(sinks=[]):
+            service = ScoringService(_runtime(workload), BatcherConfig(
+                max_batch_size=8, max_wait_us=1_000, max_queue=256,
+                tenancy=tenancy,
+            ))
+            with service:
+                router = TenantRouter(service.swapper)
+                before = router.route("acme")
+                assert before["default_route"] is True
+                assert before["version"] == service.swapper.version
+                assert service.reload(
+                    dirs["v2"], tenant="acme"
+                ).status == "swapped"
+                after = router.route("acme")
+                assert after["default_route"] is False
+                assert after["version"] > before["version"]
+                # Unknown tenants still resolve to the default route.
+                assert router.route("stranger")["default_route"] is True
+                routes = router.routes()
+                assert routes["acme"]["version"] == after["version"]
+                assert routes["*default*"]["default_route"] is True
+
+    def test_chaos_site_degrades_one_tenant(self, dirs, workload):
+        tenancy = TenancyConfig(tenants=(TenantSpec(name="acme"),))
+        with telemetry.Telemetry(sinks=[]) as tel:
+            service = ScoringService(_runtime(workload), BatcherConfig(
+                max_batch_size=8, max_wait_us=1_000, max_queue=256,
+                tenancy=tenancy,
+            ))
+            with service:
+                assert service.reload(
+                    dirs["v2"], tenant="acme"
+                ).status == "swapped"
+                plan = chaos.FaultPlan([
+                    chaos.FaultSpec(site="serving.tenant", at=0),
+                ])
+                with plan:
+                    future = service.submit(_tagged(workload, 0, "acme"))
+                    with pytest.raises(Exception, match="chaos-injected"):
+                        future.result(timeout=30)
+                assert [f["site"] for f in plan.fired] \
+                    == ["serving.tenant"]
+                assert plan.fired[0]["tenant"] == "acme"
+                # The fault degraded acme alone: the default route
+                # scores untouched afterwards.
+                result = service.score(workload.request(1))
+                assert np.isfinite(result["score"])
+            snap = tel.snapshot()
+        assert snap["counters"][
+            "serving_tenant_acme_failed_requests_total"
+        ] == 1
+
+
+# ---------------------------------------------------------------------------
+# Noisy neighbor: the containment proof
+# ---------------------------------------------------------------------------
+
+def _short_scenario():
+    return loadgen.Scenario(
+        name="noisy_neighbor",
+        description="test-sized replay",
+        phases=[
+            loadgen.ScenarioPhase("baseline", 0.3),
+            loadgen.ScenarioPhase("burst", 0.8, rate_multiplier=10.0),
+            loadgen.ScenarioPhase("recovery", 0.3),
+        ],
+    )
+
+
+class TestNoisyNeighbor:
+    def test_scenario_registered(self):
+        scenario = loadgen.SCENARIOS["noisy_neighbor"]
+        assert [p.name for p in scenario.phases] \
+            == ["baseline", "burst", "recovery"]
+        burst = scenario.phases[1]
+        assert burst.rate_multiplier == 10.0
+        assert "aggressor" in scenario.description
+
+    def test_thread_mode_isolation(self, workload):
+        tenancy = TenancyConfig(tenants=(
+            TenantSpec(name="victim", max_queue=128, p99_slo_ms=500.0),
+            TenantSpec(name="aggressor", quota_rps=5.0, burst=2.0),
+        ))
+        with telemetry.Telemetry(sinks=[]) as tel:
+            service = ScoringService(_runtime(workload), BatcherConfig(
+                max_batch_size=8, max_wait_us=2_000, max_queue=256,
+                tenancy=tenancy,
+            ))
+            with service:
+                report = loadgen.run_noisy_neighbor(
+                    service.submit,
+                    lambda i, phase, tenant: _tagged(workload, i, tenant),
+                    victim_rate_rps=30.0, aggressor_rate_rps=30.0,
+                    scenario=_short_scenario(),
+                )
+            snap = tel.snapshot()
+        gate = report.isolation(500.0)
+        assert gate["pass"], gate
+        assert report.victim.failed == 0
+        assert report.victim.completed > 0
+        assert report.aggressor.shed > 0
+        counters = snap["counters"]
+        assert counters["serving_tenant_aggressor_shed_total"] \
+            == report.aggressor.shed
+        assert counters["serving_tenant_victim_requests_total"] \
+            >= report.victim.completed
+
+    def test_process_mode_burst_with_midstream_sigkill(self, workload):
+        # The aggressor bursts 10x its quota while a worker is
+        # SIGKILLed mid-burst: the supervisor resubmits the dead
+        # worker's in-flight rows, so the victim STILL finishes with
+        # zero failures and only the aggressor sheds.
+        tenancy = TenancyConfig(tenants=(
+            TenantSpec(name="victim", max_queue=256),
+            TenantSpec(name="aggressor", quota_rps=3.0, burst=2.0),
+        ))
+        with telemetry.Telemetry(sinks=[]):
+            pool = WorkerPool(
+                workload.model, workload.index_maps,
+                runtime_config=RuntimeConfig(**RT_CFG), version=1,
+            )
+            supervisor = ReplicaSupervisor(
+                pool=pool, n_replicas=2, probe_interval_s=0.05,
+                probe_timeout_s=60.0, probe_failure_threshold=5,
+            )
+            service = ScoringService(supervisor, BatcherConfig(
+                max_batch_size=8, max_wait_us=2_000, max_queue=256,
+                tenancy=tenancy,
+            ))
+            with service:
+                assert _wait_until(lambda: supervisor.healthy_count == 2)
+                restarts_before = sum(
+                    r["restarts"]
+                    for r in supervisor.stats()["replicas"]
+                )
+                killer = threading.Timer(
+                    0.5, lambda: supervisor.kill_replica(0)
+                )
+                killer.start()
+                report = loadgen.run_noisy_neighbor(
+                    service.submit,
+                    lambda i, phase, tenant: _tagged(workload, i, tenant),
+                    victim_rate_rps=30.0, aggressor_rate_rps=30.0,
+                    scenario=_short_scenario(), timeout_s=60.0,
+                )
+                killer.join()
+                assert report.victim.failed == 0, report.snapshot()
+                assert report.victim.completed > 0
+                assert report.aggressor.shed > 0, report.snapshot()
+                assert _wait_until(
+                    lambda: supervisor.healthy_count == 2
+                ), supervisor.stats()
+                assert sum(
+                    r["restarts"]
+                    for r in supervisor.stats()["replicas"]
+                ) == restarts_before + 1
+        assert shm_model.live_segments() == []
