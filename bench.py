@@ -73,6 +73,8 @@ GAME_BUCKET_GROWTH = 4.0  # consolidate the zipf tail: ~5 compiled shapes
 GAME_ROW_CAP = 128
 
 STREAM_CHUNKS = 4  # streaming A/B: resident vs 4-chunk double-buffered
+STREAM_OS_CHUNKS = 16  # oversubscription leg: store sized past HBM budget
+STREAM_OS_HOT_FRAC = 0.7  # hot working-set budget as fraction of wire store
 
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
@@ -668,6 +670,72 @@ def bench_streaming() -> dict:
     # in any one stage now names itself instead of hiding in the total.
     overlap = st.stage_seconds / wall_1pass if wall_1pass > 0 else 0.0
 
+    # ---- Oversubscription leg (ISSUE 14): a chunk store split well past
+    # the per-pass HBM budget, streamed with lossless wire compression +
+    # the importance-aware hot working-set cache (hot budget = 70% of the
+    # WIRE store, so ~11 of 16 chunks go resident and skip pack+transfer
+    # entirely).  The headline stream_vs_resident is THIS configuration;
+    # the uncompressed, uncached 4-chunk ratio stays reported as
+    # stream_vs_resident_raw.  Two guards make the number honest: the
+    # codec must have actually compressed (ratio > 1.02 — COO int64
+    # indices always delta/downcast on this workload, so ~raw means the
+    # planner silently fell back), and the compressed+cached gradient
+    # must be BITWISE the raw streamed gradient on the same store.
+    from photon_ml_tpu.data.staging import plan_compression
+
+    _log(f"stream: oversubscription leg ({STREAM_OS_CHUNKS} chunks, "
+         f"lossless wire + hot cache)...")
+    stream_os = make_streaming_glm_data(
+        X, y, chunk_rows=-(-n // STREAM_OS_CHUNKS), use_pallas=use_pallas
+    )
+    plan = plan_compression(stream_os.staging, stream_os.staged, "lossless")
+    wire_store = plan.wire_nbytes * stream_os.n_chunks
+    sobj_os_raw = StreamingObjective("logistic", stream_os)
+    sobj_os = StreamingObjective(
+        "logistic", stream_os, compress="lossless",
+        hot_budget_bytes=int(STREAM_OS_HOT_FRAC * wire_store),
+    )
+    codec = sobj_os._codec
+    if codec.ratio <= 1.02:
+        raise RuntimeError(
+            f"bench_streaming: lossless compression ratio {codec.ratio:.3f}"
+            " — the wire chunks are effectively RAW, so the oversubscribed"
+            " leg would time the uncompressed path while reporting it as"
+            " compressed; the codec planner fell back (measurement bug,"
+            " not a workload property)"
+        )
+    _vr, g_raw = sobj_os_raw.value_and_grad(w, 1.0)
+    _read_sync(g_raw)
+    # Warm passes: pass 1 compiles + scores chunk importance, pass 2
+    # admits the hot set; the timed passes then run at steady-state hit
+    # rate.  Bitwise gate on the LAST timed pass below.
+    for _ in range(2):
+        _vc, g_comp = sobj_os.value_and_grad(w, 1.0)
+        _read_sync(g_comp)
+    cache = sobj_os._hot_cache
+    hits0, misses0 = cache.hits, cache.misses
+    t_comp = timed(lambda: sobj_os.value_and_grad(w, 1.0), reps=2)
+    _vc, g_comp = sobj_os.value_and_grad(w, 1.0)
+    _read_sync(g_comp)
+    if np.asarray(g_comp).tobytes() != np.asarray(g_raw).tobytes():
+        raise RuntimeError(
+            "bench_streaming: compressed+cached streamed gradient is NOT"
+            " bitwise identical to the raw streamed gradient on the same"
+            " oversubscribed store — the transfer-avoidance path changed"
+            " the numbers it was supposed to only move faster"
+        )
+    d_hits = cache.hits - hits0
+    d_misses = cache.misses - misses0
+    hot_hit_rate = d_hits / max(1, d_hits + d_misses)
+    logical_pass = stream_os.staging.nbytes * stream_os.n_chunks
+    effective_gbps = logical_pass / t_comp / 1e9
+    _log(f"stream: oversubscribed compressed+cached "
+         f"{n / t_comp / 1e6:.1f} M rows/s (ratio {t_res / t_comp:.3f} vs "
+         f"resident), codec {codec.ratio:.2f}x, hot hit rate "
+         f"{hot_hit_rate:.2f} ({len(cache)} chunks / "
+         f"{cache.resident_bytes / 1e6:.1f} MB resident), effective "
+         f"{effective_gbps:.3f} GB/s logical")
+
     _log(f"stream: resident {n / t_res / 1e6:.1f} M rows/s, "
          f"streamed {n / t_str / 1e6:.1f} M rows/s "
          f"(ratio {t_res / t_str:.3f}, h2d {h2d_gbps:.3f} GB/s)")
@@ -688,7 +756,19 @@ def bench_streaming() -> dict:
         "stream_rows_per_sec": round(n / t_str, 1),
         "stream_rows": n,
         "resident_rows_per_sec": round(n / t_res, 1),
-        "stream_vs_resident": round(t_res / t_str, 4),
+        # Headline: the oversubscribed store streamed with lossless wire
+        # compression + the hot working-set cache (the ISSUE 14
+        # configuration); _raw is the uncompressed, uncached 4-chunk A/B
+        # the r2/r05 bars were set against.
+        "stream_vs_resident": round(t_res / t_comp, 4),
+        "stream_vs_resident_raw": round(t_res / t_str, 4),
+        "stream_os_rows_per_sec": round(n / t_comp, 1),
+        "stream_os_chunks": stream_os.n_chunks,
+        "stream_compression_ratio": round(codec.ratio, 3),
+        "stream_hot_hit_rate": round(hot_hit_rate, 4),
+        "stream_hot_resident_chunks": len(cache),
+        "stream_hot_resident_mb": round(cache.resident_bytes / 1e6, 2),
+        "stream_effective_gbps": round(effective_gbps, 3),
         "h2d_gbps": round(h2d_gbps, 3),
         # Per-chunk ingest pipeline metrics (ops/README.md "Reading the
         # streamed-ingest h2d metrics"): achieved staging-buffer rate,

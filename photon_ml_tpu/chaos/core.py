@@ -106,6 +106,17 @@ KNOWN_SITES = {
         "consumer thread, before dispatching chunk k's program into the "
         "carry window (optim/streaming.py _stream_accumulate)"
     ),
+    "staging.decode": (
+        "consumer thread, before dispatching the in-program dequant "
+        "step for a COMPRESSED item — only fires when a chunk codec is "
+        "active (optim/streaming.py _stream_accumulate)"
+    ),
+    "streaming.cache_evict": (
+        "the working-set cache's admission/eviction replan at pass end "
+        "(optim/streaming.py HotChunkCache.replan) — the cache clears "
+        "itself before the fault propagates, so the next pass streams "
+        "everything and stays bitwise clean"
+    ),
     "checkpoint.save": (
         "after the checkpoint tmp file is written+fsynced, BEFORE the "
         "atomic rename publishes it (io/checkpoint.py) — a kill here "

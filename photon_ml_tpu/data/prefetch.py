@@ -79,7 +79,8 @@ class TransferStats:
     """
 
     chunks: int = 0  # transfers completed
-    bytes: int = 0  # host bytes moved
+    bytes: int = 0  # WIRE bytes moved (what actually crossed the link)
+    logical_bytes: int = 0  # decoded bytes those transfers stand for
     pack_seconds: float = 0.0  # summed get_item wall (pack stage)
     dispatch_seconds: float = 0.0  # summed put() call wall (⊂ h2d_seconds)
     h2d_seconds: float = 0.0  # summed per-transfer wall time (to completion)
@@ -94,10 +95,17 @@ class TransferStats:
 
     @property
     def gbps(self) -> float:
-        """Achieved h2d rate over everything recorded, GB/s."""
+        """Achieved h2d rate over everything recorded, GB/s — WIRE
+        bytes, so this stays an honest link measurement even when the
+        stream is compressed."""
         return (
             self.bytes / self.h2d_seconds / 1e9 if self.h2d_seconds else 0.0
         )
+
+    @property
+    def compression_ratio(self) -> float:
+        """logical/wire bytes over everything recorded (1.0 = raw)."""
+        return self.logical_bytes / self.bytes if self.bytes else 1.0
 
     @property
     def chunk_seconds(self) -> float:
@@ -118,6 +126,7 @@ class TransferStats:
         d["gbps"] = self.gbps
         d["chunk_seconds"] = self.chunk_seconds
         d["stage_seconds"] = self.stage_seconds
+        d["compression_ratio"] = self.compression_ratio
         return d
 
     def reset(self) -> None:
@@ -146,14 +155,20 @@ def _publish_pass(
     if not tel.enabled:
         return
     (bytes0, h2d0, chunks0, cs0, css0, ps0, pss0,
-     pack0, disp0, cons0) = before
+     pack0, disp0, cons0, logical0) = before
     d_bytes = stats.bytes - bytes0
+    d_logical = stats.logical_bytes - logical0
     d_h2d = stats.h2d_seconds - h2d0
     d_chunks = stats.chunks - chunks0
     d_pack = stats.pack_seconds - pack0
     d_disp = stats.dispatch_seconds - disp0
     d_cons = stats.consume_seconds - cons0
     tel.counter("h2d_bytes_total").inc(d_bytes)
+    # Wire vs logical split (compressed chunk formats): h2d_bytes_total
+    # and h2d_gbps stay WIRE-denominated — the honest link measurement —
+    # while the stream_* pair lets dashboards derive the encoding's win.
+    tel.counter("stream_wire_bytes_total").inc(d_bytes)
+    tel.counter("stream_logical_bytes_total").inc(d_logical)
     tel.counter("h2d_chunks_total").inc(d_chunks)
     tel.counter("h2d_seconds").inc(d_h2d)
     tel.counter("prefetch_pack_seconds").inc(d_pack)
@@ -170,6 +185,8 @@ def _publish_pass(
     tel.counter("prefetch_passes").inc()
     if d_h2d > 0.0:
         tel.gauge("h2d_gbps").set(d_bytes / d_h2d / 1e9)
+    if d_bytes > 0:
+        tel.gauge("stream_compression_ratio").set(d_logical / d_bytes)
     if d_chunks > 0:
         tel.gauge("h2d_chunk_seconds").set(d_h2d / d_chunks)
         tel.gauge("prefetch_pack_chunk_seconds").set(d_pack / d_chunks)
@@ -188,6 +205,7 @@ def _publish_pass(
         pack_seconds=round(d_pack, 6),
         dispatch_seconds=round(d_disp, 6),
         consume_seconds=round(d_cons, 6),
+        logical_bytes=d_logical,
         consumer_stalls=stats.consumer_stalls - cs0,
         producer_stalls=stats.producer_stalls - ps0,
         max_live=run_max,
@@ -202,6 +220,7 @@ def run_prefetched(
     consume: Callable[[int, object], None],
     depth: int = 2,
     stats: TransferStats | None = None,
+    logical_nbytes: Callable[[int], int] | None = None,
 ) -> int:
     """Stream ``n_items`` through a bounded-depth three-stage pipeline.
 
@@ -219,6 +238,12 @@ def run_prefetched(
     the caller thread at the failed item's position; a consumer
     exception aborts both background threads promptly (their blocking
     waits poll an abort flag).
+
+    ``logical_nbytes(k)`` — when the host items are COMPRESSED wire
+    buffers — reports the decoded bytes item ``k`` stands for, so
+    ``stats`` can split wire (``bytes``) from logical
+    (``logical_bytes``) transfer accounting.  Defaults to the measured
+    wire bytes (ratio 1.0) for uncompressed streams.
     """
     if depth < 1:
         raise ValueError(f"prefetch depth must be >= 1, got {depth}")
@@ -232,6 +257,7 @@ def run_prefetched(
         stats.consumer_stalls, stats.consumer_stall_seconds,
         stats.producer_stalls, stats.producer_stall_seconds,
         stats.pack_seconds, stats.dispatch_seconds, stats.consume_seconds,
+        stats.logical_bytes,
     )
 
     handoff: queue.Queue = queue.Queue(maxsize=depth)
@@ -294,7 +320,8 @@ def run_prefetched(
                         for leaf in jax.tree_util.tree_leaves(host)
                         if hasattr(leaf, "nbytes")
                     )
-                    if not _handoff_put((k, host, nbytes)):
+                    lb = logical_nbytes(k) if logical_nbytes else nbytes
+                    if not _handoff_put((k, host, nbytes, lb)):
                         return
                     del host
         except BaseException as exc:  # surfaced on the caller thread
@@ -324,7 +351,7 @@ def run_prefetched(
                     if isinstance(item, _ProducerFailure):
                         q.put(item)
                         return
-                    k, host, nbytes = item
+                    k, host, nbytes, lb = item
                     if not permits.acquire(blocking=False):
                         t0 = time.perf_counter()
                         while not permits.acquire(timeout=0.05):
@@ -345,6 +372,7 @@ def run_prefetched(
                             leaf.block_until_ready()
                     stats.h2d_seconds += time.perf_counter() - t0
                     stats.bytes += nbytes
+                    stats.logical_bytes += lb
                     stats.chunks += 1
                     _bump(+1, nbytes)
                     q.put((k, dev, nbytes))

@@ -118,6 +118,15 @@ def parse_coordinate_config(spec: dict):
             chunk_fuse=int(spec.get("chunk_fuse", 1)),
             # batch line-search trials into one streamed pass per bracket.
             batch_linesearch=bool(spec.get("batch_linesearch", True)),
+            # compressed chunk wire format when streaming
+            # (off|lossless|fp16|int8) — on-device dequant, lossless is
+            # bitwise neutral.
+            stream_compress=str(spec.get("stream_compress", "off")),
+            # MB of wire chunk buffers kept HBM-resident across passes
+            # (importance-aware working-set cache; single-device only).
+            stream_hot_budget_mb=float(
+                spec.get("stream_hot_budget_mb", 0.0)
+            ),
         )
     if spec["type"] == "random":
         return name, RandomEffectCoordinateConfig(

@@ -213,6 +213,29 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "pass",
     )
     p.add_argument(
+        "--stream-compress",
+        choices=["off", "lossless", "fp16", "int8"],
+        default="off",
+        help="with --stream-chunk-rows: compressed chunk wire formats — "
+        "chunks cross the host->device link encoded (delta/downcast "
+        "index blocks, {0,1} bitmaps, fp16/int8 feature quantization) "
+        "and are dequantized ON DEVICE inside the per-chunk program. "
+        "'lossless' keeps every solve bitwise identical to the raw "
+        "stream; fp16/int8 add bounded quantization error for a bigger "
+        "wire win. Single-host only",
+    )
+    p.add_argument(
+        "--stream-hot-budget-mb",
+        type=float,
+        default=0.0,
+        help="with --stream-chunk-rows: keep up to this many MB of "
+        "(wire) chunk buffers RESIDENT in HBM across passes — the "
+        "importance-aware working-set cache: admission/eviction is "
+        "re-scored each pass from per-chunk gradient contributions, hot "
+        "chunks skip pack+transfer entirely. Bitwise neutral; "
+        "single-device only. 0 disables",
+    )
+    p.add_argument(
         "--telemetry",
         choices=["on", "off"],
         default="on",
@@ -402,6 +425,11 @@ def _run_impl(args, logger, tel) -> dict:
         raise ValueError(
             "--stream-chunk-fuse > 1 is single-device only (the scan-"
             "fused program does not compose with the mesh reduction)"
+        )
+    if args.stream_hot_budget_mb > 0 and data_parallel:
+        raise ValueError(
+            "--stream-hot-budget-mb > 0 is single-device only (a cached "
+            "chunk would pin sharded buffers across the mesh)"
         )
     streaming = args.stream_chunk_rows > 0
     with tel.span("summarize", rows=int(X_train.shape[0]), features=int(d)):
@@ -624,6 +652,8 @@ def _run_impl(args, logger, tel) -> dict:
                 prefetch_depth=args.stream_prefetch_depth,
                 chunk_fuse=args.stream_chunk_fuse,
                 batch_linesearch=args.stream_batch_linesearch == "on",
+                compress=args.stream_compress,
+                hot_budget_bytes=int(args.stream_hot_budget_mb * 1e6),
             )
         if data_parallel:
             from photon_ml_tpu.parallel.distributed import (

@@ -68,6 +68,16 @@ class FixedEffectCoordinateConfig:
     #: evaluate a bracket of line-search candidates per streamed pass
     #: (identical trial sequence, roughly half the passes per solve).
     batch_linesearch: bool = True
+    #: compressed chunk wire format when streaming: off|lossless|fp16|
+    #: int8 (data/staging.py).  Chunks cross the link encoded and are
+    #: dequantized on device inside the per-chunk program; "lossless"
+    #: keeps every solve bitwise identical to the raw stream.
+    stream_compress: str = "off"
+    #: >0 keeps up to this many MB of (wire) chunk buffers RESIDENT in
+    #: HBM across streamed passes, admission/eviction re-scored each
+    #: pass from per-chunk gradient contributions — hot chunks skip
+    #: pack + transfer entirely (single-device only, bitwise neutral).
+    stream_hot_budget_mb: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -263,6 +273,10 @@ class GameEstimator:
                         prefetch_depth=cfg.prefetch_depth,
                         chunk_fuse=cfg.chunk_fuse,
                         batch_linesearch=cfg.batch_linesearch,
+                        compress=cfg.stream_compress,
+                        hot_budget_bytes=int(
+                            cfg.stream_hot_budget_mb * 1e6
+                        ),
                     ))
                     continue
                 if self.mesh is not None:

@@ -66,12 +66,21 @@ class StreamingFixedEffectCoordinate(Coordinate):
         prefetch_depth: int = 2,
         chunk_fuse: int = 1,
         batch_linesearch: bool = True,
+        compress: str = "off",
+        hot_budget_bytes: int = 0,
     ):
         """``chunk_fuse``: chunks folded per device dispatch via
         ``lax.scan`` (single-device only) — amortizes per-dispatch
         overhead when chunks are small.  ``batch_linesearch``: evaluate
         a bracket of line-search candidates per streamed pass (identical
         trial sequence, ~half the passes per solve).
+
+        ``compress`` / ``hot_budget_bytes``: the transfer-avoidance
+        knobs — compressed chunk wire formats with on-device dequant,
+        and the importance-aware HBM working-set cache (hot chunks skip
+        pack + transfer across CD iterations; single-device only).
+        Lossless compression and the cache leave every coordinate solve
+        bitwise unchanged (see optim/streaming.py).
 
         ``mesh``: streams each chunk SHARDED over the mesh's first axis
         (chunks must be built with ``n_shards == mesh size``) — streamed
@@ -111,6 +120,7 @@ class StreamingFixedEffectCoordinate(Coordinate):
         self._sobj = StreamingObjective(
             self.task, stream, accumulate=accumulate, mesh=mesh,
             prefetch_depth=prefetch_depth, chunk_fuse=chunk_fuse,
+            compress=compress, hot_budget_bytes=hot_budget_bytes,
         )
         opt = config.optimizer
         self._lbfgs = LBFGSConfig(

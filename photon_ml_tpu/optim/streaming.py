@@ -50,6 +50,8 @@ from __future__ import annotations
 import collections
 import dataclasses
 import math
+import threading
+import time
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -58,8 +60,10 @@ import numpy as np
 from jax import lax
 
 from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.analysis import sanitizers
 from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.data.prefetch import TransferStats, run_prefetched
+from photon_ml_tpu.data.staging import COMPRESSION_MODES, plan_compression
 from photon_ml_tpu.data.streaming import StreamingGlmData
 from photon_ml_tpu.parallel.compat import shard_map
 from photon_ml_tpu.optim.lbfgs import (
@@ -80,6 +84,128 @@ _WOLFE_TRIAL_BATCH = 3
 #: candidate steps per batched OWL-QN Armijo pass (the geometric
 #: backtracking ladder is fully deterministic, so any prefix batches).
 _OWLQN_TRIAL_BATCH = 4
+
+
+# ---------------------------------------------------------------------------
+# Importance-aware HBM working set: hot chunks skip pack + transfer
+# ---------------------------------------------------------------------------
+
+
+class HotChunkCache:
+    """Byte-budgeted resident working set of streamed chunk items.
+
+    The DuHL idea (arXiv:1708.05357, PAPERS.md) applied to the chunk
+    stream: keep the most-influential chunks RESIDENT in HBM and stream
+    only the cold tail.  Importance is re-derived every accumulation
+    pass, for free, from the per-chunk deltas of the value accumulator
+    the streamed carry already computes — no extra device work.  A hot
+    hit returns the (wire) device buffers directly, skipping pack,
+    ``device_put`` and the transfer wait entirely; the SAME compiled
+    per-chunk program serves hot and cold items, so results stay
+    bitwise identical to the uncached path (accumulation order remains
+    strictly chunk-sequential — the consumer interleaves hot hits into
+    their global positions).
+
+    Admission is one pass deferred by construction: pass N's scores
+    pick the wanted set (:meth:`replan`), pass N+1 admits those items'
+    device buffers as they stream by, pass N+2 onward hits.  Ties in
+    the importance score break by item index, so admission is
+    deterministic under equal scores (pinned by tests).
+
+    The lock guards pure bookkeeping only (dict/set/counter updates);
+    evicted device references are collected under the lock but DROPPED
+    outside it, so buffer deallocation never runs in a critical section
+    (the lock-blocking-call rule in analysis/ checks this discipline).
+    Entries are never donated to XLA — chunk arguments are not in any
+    program's ``donate_argnums`` — so a resident buffer stays valid
+    across passes.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = int(budget_bytes)
+        self._lock = sanitizers.tracked(
+            threading.Lock(), "streaming.hot_cache"
+        )
+        self._entries: dict = {}  # item index -> (device bufs, nbytes)
+        self._want: set = set()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.admissions = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def get(self, i: int):
+        """Resident device buffers for item ``i``, or None (counted)."""
+        with self._lock:
+            e = self._entries.get(i)
+            if e is None:
+                self.misses += 1
+                return None
+            self.hits += 1
+            return e[0]
+
+    def maybe_admit(self, i: int, dev, nbytes: int) -> bool:
+        """Admit item ``i``'s just-transferred device buffers iff the
+        last replan wants it and it fits the remaining budget."""
+        with self._lock:
+            if i in self._entries or i not in self._want:
+                return False
+            if self._bytes + nbytes > self.budget_bytes:
+                return False
+            self._entries[i] = (dev, int(nbytes))
+            self._bytes += int(nbytes)
+            self.admissions += 1
+            return True
+
+    def replan(self, scores: dict, item_nbytes: Callable[[int], int]):
+        """Recompute the wanted set from this pass's importance scores
+        and evict residents that fell out of it.
+
+        Greedy by descending score (ties broken by ascending item index
+        — deterministic), packing until the byte budget is exhausted.
+        On an injected eviction fault the cache is CLEARED before the
+        fault propagates: a half-applied plan may never survive into
+        the next pass (which then simply streams everything — results
+        are unaffected either way, only transfer counts).
+        """
+        try:
+            chaos_mod.maybe_fail("streaming.cache_evict")
+        except BaseException:
+            self.clear()
+            raise
+        dropped = []
+        with self._lock:
+            want: set = set()
+            budget = self.budget_bytes
+            for i in sorted(scores, key=lambda j: (-scores[j], j)):
+                nb = item_nbytes(i)
+                if nb <= budget:
+                    want.add(i)
+                    budget -= nb
+            self._want = want
+            for i in [j for j in self._entries if j not in want]:
+                dev, nb = self._entries.pop(i)
+                self._bytes -= nb
+                self.evictions += 1
+                dropped.append(dev)
+        del dropped  # device refs released outside the lock
+
+    def clear(self) -> None:
+        with self._lock:
+            dropped = list(self._entries.values())
+            self._entries.clear()
+            self._want = set()
+            self._bytes = 0
+        del dropped
 
 
 # ---------------------------------------------------------------------------
@@ -124,6 +250,27 @@ class StreamingObjective:
     per-stage wall attribution (pack/dispatch/h2d/consume) and
     queue-stall counters across passes — reset it around a measurement
     window (bench_streaming does).
+
+    ``compress`` (off|lossless|fp16|int8) turns on the compressed chunk
+    wire formats (data/staging.py): chunks cross the link as encoded
+    wire buffers 2–4× smaller and are decoded ON DEVICE by the dequant
+    step traced into each per-chunk program.  "lossless" keeps every
+    streamed result BITWISE identical to the raw path; fp16/int8
+    additionally quantize float feature values (bounded error, pinned
+    by tests).  Requires the staged representation and a single-host
+    run (per-process compression plans would compile divergent SPMD
+    executables on a pod).  ``transfer_stats.bytes`` stays WIRE bytes;
+    ``logical_bytes`` carries the decoded total.
+
+    ``hot_budget_bytes`` > 0 enables the importance-aware HBM working
+    set (:class:`HotChunkCache`): up to that many bytes of (wire)
+    chunk buffers stay RESIDENT across passes, re-chosen each
+    accumulation pass from per-chunk gradient-contribution importance,
+    and hot chunks skip pack + transfer entirely.  Single-device only.
+    Results are bitwise identical to the uncached path — the cache
+    only changes which chunks cross the link, never the accumulation
+    order.  (``scores()`` always streams: its readback pipeline does
+    not consult the cache.)
     """
 
     def __init__(
@@ -135,6 +282,8 @@ class StreamingObjective:
         accumulate: str = "f32",
         prefetch_depth: int = 2,
         chunk_fuse: int = 1,
+        compress: str = "off",
+        hot_budget_bytes: int = 0,
     ):
         from photon_ml_tpu.ops import losses as losses_lib
 
@@ -157,6 +306,21 @@ class StreamingObjective:
                 "chunk_fuse > 1 is single-device only: the scan-fused "
                 "program is not composed with the shard_map reduction — "
                 "pass chunk_fuse=1 with a mesh"
+            )
+        if compress not in COMPRESSION_MODES:
+            raise ValueError(
+                f"compress must be one of {COMPRESSION_MODES}, got "
+                f"{compress!r}"
+            )
+        if hot_budget_bytes < 0:
+            raise ValueError(
+                f"hot_budget_bytes must be >= 0, got {hot_budget_bytes}"
+            )
+        if hot_budget_bytes and mesh is not None:
+            raise ValueError(
+                "the hot working-set cache is single-device only: a "
+                "cached chunk would pin sharded buffers across the mesh "
+                "— pass hot_budget_bytes=0 with a mesh"
             )
         self.stream = stream
         self.mesh = mesh
@@ -229,8 +393,51 @@ class StreamingObjective:
         elif stream.n_shards != 1:
             raise ValueError("sharded chunks need a mesh")
 
+        # Compressed chunk formats: plan one codec over the whole store
+        # (AFTER any multihost equalization so padding chunks are
+        # scanned too), encode every chunk's wire buffers eagerly (host
+        # RAM cost ≈ staged bytes / ratio — the raw staged store stays
+        # the source of truth for host-side views), and route the
+        # per-chunk unpack through the codec's on-device decode.
+        self.compress = compress
+        self._codec = None
+        self._wire = None
+        if compress != "off":
+            if stream.staged is None:
+                raise ValueError(
+                    "compress != 'off' needs the staged (coalesced-"
+                    "buffer) representation — this store could not be "
+                    "staged (hand-built disk-backed per-leaf store?)"
+                )
+            if self._multihost:
+                raise ValueError(
+                    "compress != 'off' is single-host only: each "
+                    "process would plan its own encodings from its own "
+                    "rows and compile divergent SPMD executables — "
+                    "pass compress='off' on a pod"
+                )
+            self._codec = plan_compression(
+                self._staging, stream.staged, compress
+            )
+            self._wire = [
+                self._codec.encode(bufs) for bufs in stream.staged
+            ]
+        # Importance-aware HBM working set (single-device; see class
+        # docstring for the admit-next-pass lifecycle).
+        self.hot_budget_bytes = int(hot_budget_bytes)
+        if hot_budget_bytes and stream.staged is None:
+            raise ValueError(
+                "hot_budget_bytes > 0 needs the staged representation "
+                "(byte-budgeted admission requires the fixed per-chunk "
+                "staged size)"
+            )
+        self._hot_cache = (
+            HotChunkCache(hot_budget_bytes) if hot_budget_bytes else None
+        )
+
         obj = self.objective
         staging = self._staging
+        codec = self._codec
 
         def unpack(chunk_in):
             # The compiled on-device unpack (slice + reshape) restoring
@@ -239,6 +446,11 @@ class StreamingObjective:
             # extra dispatch.  Identity for unstaged (fallback) streams.
             # Under shard_map the buffers arrive as per-device blocks;
             # unpack_device reads the local leading dim off the trace.
+            # With a codec the arriving buffers are the COMPRESSED wire
+            # buffers and this is the in-program dequant step (slice +
+            # cast + cumsum/shift), same relative-slicing contract.
+            if codec is not None:
+                return codec.unpack_device(chunk_in)
             if staging is None:
                 return chunk_in
             return staging.unpack_device(chunk_in)
@@ -605,8 +817,11 @@ class StreamingObjective:
         return [off[k * cr:(k + 1) * cr] for k in range(n_chunks)]
 
     def _host_item(self, k: int):
-        """What crosses the wire for chunk ``k``: the coalesced staging
-        buffers when the store is staged, the leaf pytree otherwise."""
+        """What crosses the wire for chunk ``k``: the encoded wire
+        buffers when compressing, else the coalesced staging buffers
+        when the store is staged, else the leaf pytree."""
+        if self._wire is not None:
+            return self._wire[k]
         if self.stream.staged is not None:
             return self.stream.staged[k]
         return self.stream.chunks[k]
@@ -620,7 +835,9 @@ class StreamingObjective:
         group (the ragged tail) stays a plain un-stacked chunk item and
         runs the ordinary per-chunk program."""
         ks = self._groups[g]
-        staged = self.stream.staged
+        staged = (
+            self._wire if self._wire is not None else self.stream.staged
+        )
         if len(ks) == 1:
             return staged[ks[0]]
         n_buf = len(staged[ks[0]])
@@ -662,6 +879,16 @@ class StreamingObjective:
         ARE donated, updating in place.  Accumulation order is strictly
         chunk-sequential regardless of depth/window/fusion — results are
         bit-identical across all of them on f32.
+
+        With the hot working-set cache enabled, resident items bypass
+        the pipeline entirely: only the cold tail rides
+        ``run_prefetched``, and the consumer interleaves each hot
+        item's dispatch at its exact global position before the next
+        cold item — the accumulation order (and therefore every f32
+        bit) is unchanged.  On "acc" passes the synced carry handles
+        double as the importance source: |Δvalue| per item scores the
+        pass for free, and the cache replans (admit set + evictions)
+        ONCE at pass end.
         """
         if self.chunk_fuse == 1:
             n_items = self.stream.n_chunks
@@ -690,31 +917,115 @@ class StreamingObjective:
         ring_peak = 0
         stats = self.transfer_stats
         bytes0, chunks0 = stats.bytes, stats.chunks
+        codec = self._codec
+        cache = self._hot_cache
+        hot0 = (
+            (cache.hits, cache.misses, cache.admissions, cache.evictions)
+            if cache is not None else None
+        )
+        st_nbytes = self._staging.nbytes if self._staging else 0
 
-        def consume(i, dev):
+        def item_logical(i: int) -> int:
+            # Decoded (staged) bytes item i stands for; × group length
+            # under fusion.
+            return st_nbytes * (lens[i] if lens else 1)
+
+        def item_wire(i: int) -> int:
+            wb = codec.wire_nbytes if codec is not None else st_nbytes
+            return wb * (lens[i] if lens else 1)
+
+        t_pass0 = time.perf_counter()
+        # Importance scoring: only accumulation passes carry a scalar
+        # value whose per-item delta is the chunk's contribution (hvp/
+        # diag carries are vectors) — other kinds still SERVE hits, they
+        # just don't replan.
+        scoring = {} if (cache is not None and kind == "acc") else None
+        vprev = [0.0]
+
+        def sync_handle(entry):
+            i, h = entry
+            jax.block_until_ready(h)
+            if scoring is not None:
+                # |Δvalue| this item added to the running accumulator —
+                # free importance (the handle is already synced; the
+                # readback is one scalar, K for batched trials where
+                # candidate 0 — the current iterate — scores).
+                v = float(np.asarray(h).reshape(-1)[0])
+                scoring[i] = abs(v - vprev[0])
+                vprev[0] = v
+
+        def dispatch(i, dev):
+            # One item's program dispatch + windowed sync, identical
+            # for hot (cache-resident) and cold (just-transferred)
+            # items — the shared path is what keeps hot/cold bitwise
+            # interchangeable.
             nonlocal ring_peak
+            if codec is not None:
+                chaos_mod.maybe_fail("staging.decode", item=i)
             chaos_mod.maybe_fail("streaming.carry_sync", item=i)
             carry_box[0] = progs[i](
                 *carry_box[0], *args, items_off[i], dev
             )
-            ring.append(carry_box[0][0])
+            ring.append((i, carry_box[0][0]))
             if len(ring) > window:
-                jax.block_until_ready(ring.popleft())
+                sync_handle(ring.popleft())
             # Post-sync occupancy: dispatched-but-unexecuted programs
             # still pinning their chunk buffers (the popped handle just
             # proved its chunk executed).
             ring_peak = max(ring_peak, len(ring))
 
+        # Hot/cold split for this pass: resident items skip pack +
+        # transfer; the cold tail streams.  The gather is one locked
+        # dict probe per item, before any thread starts.
+        hot: dict = {}
+        if cache is not None:
+            for i in range(n_items):
+                d = cache.get(i)
+                if d is not None:
+                    hot[i] = d
+        cold = [i for i in range(n_items) if i not in hot]
+        next_i = [0]  # next global item index still to dispatch
+
+        def advance_hot(upto: int) -> None:
+            # Dispatch every not-yet-dispatched HOT item below ``upto``
+            # — called before each cold item (and once at the end) so
+            # the global dispatch order is exactly 0..n_items-1.
+            while next_i[0] < upto:
+                j = next_i[0]
+                if j in hot:
+                    dispatch(j, hot[j])
+                next_i[0] = j + 1
+
+        def consume(ci, dev):
+            i = cold[ci]
+            advance_hot(i)
+            dispatch(i, dev)
+            next_i[0] = i + 1
+            if cache is not None:
+                cache.maybe_admit(i, dev, item_wire(i))
+
         run_max = run_prefetched(
-            n_items, get_host, self._put, consume,
+            len(cold), lambda ci: get_host(cold[ci]), self._put, consume,
             depth=self.prefetch_depth, stats=stats,
+            logical_nbytes=(
+                (lambda ci: item_logical(cold[ci]))
+                if codec is not None else None
+            ),
         )
-        if ring:
+        advance_hot(n_items)  # trailing hot items past the last cold one
+        while ring:
             # Drain: the carry chain is sequential, so the LAST handle's
             # readiness implies every chunk executed (and every chunk
-            # buffer is collectable) before the pass returns.
-            jax.block_until_ready(ring[-1])
-            ring.clear()
+            # buffer is collectable) before the pass returns.  When
+            # scoring, each handle is read back in order instead.
+            entry = ring.popleft()
+            if scoring is not None or not ring:
+                sync_handle(entry)
+        if scoring:
+            # Admission is one pass deferred: this replan's wanted set
+            # admits during the NEXT pass's stream.  A chaos eviction
+            # fault propagates from here (cache already cleared).
+            cache.replan(scoring, item_wire)
         # HBM accounting for the carry window (docs/telemetry.md "HBM
         # accounting"): a dispatched-but-unexecuted program pins its
         # chunk's buffers beyond the prefetch permit, so the pass's true
@@ -732,6 +1043,36 @@ class StreamingObjective:
                 tel.gauge("hbm_stream_window_peak_bytes").set(
                     int((run_max + ring_peak) * chunk_bytes)
                 )
+            if codec is not None or cache is not None:
+                # Effective ingest rate: LOGICAL bytes of every item the
+                # pass processed (hot hits move zero wire bytes but
+                # stand for their full decoded size) over the pass wall
+                # — the number compression + caching actually move,
+                # where h2d_gbps honestly reports only the link.
+                wall = time.perf_counter() - t_pass0
+                if wall > 0.0:
+                    tel.gauge("stream_effective_gbps").set(
+                        sum(item_logical(i) for i in range(n_items))
+                        / wall / 1e9
+                    )
+            if cache is not None:
+                d_hit = cache.hits - hot0[0]
+                d_miss = cache.misses - hot0[1]
+                tel.counter("stream_hot_hits_total").inc(d_hit)
+                tel.counter("stream_hot_misses_total").inc(d_miss)
+                tel.counter("stream_hot_admissions_total").inc(
+                    cache.admissions - hot0[2]
+                )
+                tel.counter("stream_hot_evictions_total").inc(
+                    cache.evictions - hot0[3]
+                )
+                if d_hit + d_miss:
+                    tel.gauge("stream_hot_hit_ratio").set(
+                        d_hit / (d_hit + d_miss)
+                    )
+                tel.gauge("hbm_hot_bytes").set(cache.resident_bytes)
+                tel.gauge("hbm_hot_budget_bytes").set(cache.budget_bytes)
+                tel.gauge("hbm_hot_chunk_count").set(len(cache))
         return carry_box[0]
 
     def _acc_init(self, batch: int | None):
@@ -871,9 +1212,15 @@ class StreamingObjective:
             if len(pend) > window:
                 materialize(*pend.popleft())
 
+        st_nbytes = self._staging.nbytes if self._staging else 0
+        glens = [len(g) for g in self._groups] if fused else None
         run_prefetched(
             n_items, get_host, self._put, consume,
             depth=self.prefetch_depth, stats=self.transfer_stats,
+            logical_nbytes=(
+                (lambda k: st_nbytes * (glens[k] if glens else 1))
+                if self._codec is not None else None
+            ),
         )
         while pend:
             materialize(*pend.popleft())
@@ -1489,6 +1836,8 @@ def streaming_run_grid(
     prefetch_depth: int = 2,
     chunk_fuse: int = 1,
     batch_linesearch: bool = True,
+    compress: str = "off",
+    hot_budget_bytes: int = 0,
 ):
     """The λ-grid warm-start chain (optim.problem.grid_loop) over a
     streamed dataset.  L1/elastic-net routes to the streamed OWL-QN and
@@ -1498,7 +1847,11 @@ def streaming_run_grid(
     ``chunk_fuse``: chunks folded per device dispatch (``lax.scan``) —
     amortizes per-dispatch overhead for small chunks; ``batch_linesearch``
     evaluates a bracket of line-search candidates per streamed pass
-    (identical trial sequence, ~half the passes).
+    (identical trial sequence, ~half the passes).  ``compress`` and
+    ``hot_budget_bytes`` are the transfer-avoidance knobs (compressed
+    wire formats + importance-aware HBM working set — see
+    :class:`StreamingObjective`); lossless compression and the cache
+    leave every solve bitwise unchanged.
     """
     from photon_ml_tpu.optim.problem import OptimizerType
     from photon_ml_tpu.optim.tron import TRONConfig
@@ -1508,6 +1861,7 @@ def streaming_run_grid(
     sobj = StreamingObjective(
         problem.objective, stream, mesh=mesh, accumulate=accumulate,
         prefetch_depth=prefetch_depth, chunk_fuse=chunk_fuse,
+        compress=compress, hot_budget_bytes=hot_budget_bytes,
     )
     opt = cfg.optimizer
     lbfgs_cfg = LBFGSConfig(

@@ -83,7 +83,9 @@ if [[ "${1:-}" == "--fast" ]]; then
   # Streaming-parity smoke rides the fast lane: a tiny 4-chunk store,
   # asserting the windowed-async pipeline is BIT-IDENTICAL to the
   # depth=1 serial baseline (value/grad, hvp, scores) — the invariant
-  # every other streamed number rests on.  test_chaos's kill/resume
+  # every other streamed number rests on.  The transfer-avoidance smoke
+  # repeats the same 4-chunk parity with compressed wire chunks + the
+  # hot working-set cache enabled.  test_chaos's kill/resume
   # boundary matrices are the fast recovery smoke.
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_ops_plane.py \
@@ -92,6 +94,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     tests/test_serving_proc.py tests/test_freshness.py \
     tests/test_tuning.py tests/test_chaos.py \
     "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
+    "tests/test_streaming.py::TestTransferAvoidance::test_fast_lane_compressed_cached_parity" \
     -m 'not slow' -q -p no:cacheprovider
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -433,6 +433,53 @@ class TestStreamingFaults:
         assert _bitwise_equal(v0, np.asarray(v1))
         assert _bitwise_equal(g0, np.asarray(g1))
 
+    @pytest.mark.parametrize(
+        # staging.decode fires per item (fault the 2nd); cache_evict
+        # fires once per accumulation pass (fault its only occurrence).
+        "site,at", [("staging.decode", 1), ("streaming.cache_evict", 0)],
+    )
+    def test_transfer_avoidance_fault_next_pass_clean(self, site, at):
+        """Faults on the transfer-avoidance seams — the in-program
+        dequant dispatch of a compressed item, and the working-set
+        cache's replan — surface on the caller, leak no pipeline
+        threads, leave the cache internally consistent (the evict fault
+        clears it before propagating), and the next pass is bitwise
+        identical to a never-faulted uncompressed, uncached pass."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.optim.streaming import StreamingObjective
+
+        stream = _small_stream()
+        ref = StreamingObjective("logistic", _small_stream())
+        w = jnp.zeros((stream.n_features,), jnp.float32)
+        v0, g0 = ref.value_and_grad(w, 1.0)
+        v0, g0 = np.asarray(v0), np.asarray(g0)
+
+        sobj = StreamingObjective(
+            "logistic", stream, compress="lossless",
+            hot_budget_bytes=1 << 30,
+        )
+        with telemetry_mod.Telemetry(enabled=True, sinks=[]) as tel:
+            # Two clean passes first: pass 1 replans, pass 2 admits —
+            # so the faulted pass exercises hot hits + the cache paths.
+            for _ in range(2):
+                sobj.value_and_grad(w, 1.0)
+            plan = chaos.FaultPlan([chaos.FaultSpec(site=site, at=at)])
+            with plan:
+                with pytest.raises(chaos.InjectedFault):
+                    sobj.value_and_grad(w, 1.0)
+            assert len(plan.fired_at(site)) == 1
+            assert tel.counter("prefetch_thread_leak").value == 0
+        if site == "streaming.cache_evict":
+            # The fault fired inside replan: the cache must have been
+            # cleared (no half-applied plan survives into later passes).
+            assert len(sobj._hot_cache) == 0
+            assert sobj._hot_cache.resident_bytes == 0
+
+        v1, g1 = sobj.value_and_grad(w, 1.0)
+        assert _bitwise_equal(v0, np.asarray(v1))
+        assert _bitwise_equal(g0, np.asarray(g1))
+
     def test_streamed_grid_kill_resume_bitwise(self, tmp_path):
         """The streamed flavor of the grid boundary matrix (one boundary
         — the full matrix runs on the resident path above; the selfcheck

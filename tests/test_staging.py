@@ -435,3 +435,147 @@ class TestStageAttribution:
                 lambda k, d: consumed.append(k), depth=2,
             )
         assert consumed == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# Compressed chunk formats: wire encodings + on-device decode
+# ---------------------------------------------------------------------------
+
+from photon_ml_tpu.data.staging import (  # noqa: E402
+    COMPRESSION_MODES,
+    plan_compression,
+)
+
+
+def _codec_roundtrip(stream, mode):
+    """Encode every chunk and decode on device; returns (codec, list of
+    (decoded leaves, reference leaves)) where the reference is the RAW
+    staged path's device decode — the exact arrays the uncompressed
+    stream would compute on."""
+    staging = stream.staging
+    codec = plan_compression(staging, stream.staged, mode)
+    dec = jax.jit(codec.unpack_device)
+    raw = jax.jit(staging.unpack_device)
+    pairs = []
+    for bufs in stream.staged:
+        wire = codec.encode(bufs)
+        got = jax.tree_util.tree_leaves(dec(jax.device_put(wire)))
+        ref = jax.tree_util.tree_leaves(raw(jax.device_put(bufs)))
+        pairs.append((got, ref))
+    return codec, pairs
+
+
+class TestChunkCodec:
+    @pytest.mark.parametrize("layout", LAYOUTS)
+    @pytest.mark.parametrize("n_shards", [1, 4])
+    def test_lossless_bitwise_and_smaller(self, rng, layout, n_shards):
+        """'lossless' mode: every decoded device leaf is BITWISE the
+        raw staged path's leaf, for every layout and sharding — the
+        contract that lets compressed solves promise bit-identity —
+        and the wire is actually smaller on these stores."""
+        _, _, stream = _stream(rng, layout, n_shards=n_shards)
+        codec, pairs = _codec_roundtrip(stream, "lossless")
+        assert codec.is_lossless
+        assert codec.ratio > 1.0
+        assert codec.wire_nbytes < codec.logical_nbytes
+        for got, ref in pairs:
+            for g, r in zip(got, ref):
+                assert g.dtype == r.dtype and g.shape == r.shape
+                assert np.asarray(g).tobytes() == np.asarray(r).tobytes()
+
+    @pytest.mark.parametrize("layout", ["dense", "coo"])
+    def test_fp16_error_bounds(self, rng, layout):
+        """fp16 mode: float32 value slots round-trip within half-
+        precision error; integer and {0,1} slots stay bitwise exact
+        (they keep their lossless encodings)."""
+        _, _, stream = _stream(rng, layout)
+        codec, pairs = _codec_roundtrip(stream, "fp16")
+        assert not codec.is_lossless and "fp16" in codec.kinds
+        for got, ref in pairs:
+            for g, r in zip(got, ref):
+                r_np = np.asarray(r)
+                if r_np.dtype.kind != "f" or set(
+                    np.unique(r_np)
+                ) <= {0.0, 1.0}:
+                    assert np.asarray(g).tobytes() == r_np.tobytes()
+                else:
+                    np.testing.assert_allclose(
+                        np.asarray(g), r_np, rtol=1e-3, atol=1e-4
+                    )
+
+    @pytest.mark.parametrize("layout", ["dense", "coo"])
+    def test_int8_error_bounds(self, rng, layout):
+        """int8 mode: per-(shard-row, slot) symmetric quantization —
+        absolute error ≤ maxabs/127 per slot (half a quantization step
+        rounds to the nearest level, so one full step is a safe
+        bound)."""
+        _, _, stream = _stream(rng, layout)
+        codec, pairs = _codec_roundtrip(stream, "int8")
+        assert "int8" in codec.kinds
+        for got, ref in pairs:
+            for g, r in zip(got, ref):
+                r_np = np.asarray(r)
+                if r_np.dtype.kind != "f" or set(
+                    np.unique(r_np)
+                ) <= {0.0, 1.0}:
+                    assert np.asarray(g).tobytes() == r_np.tobytes()
+                else:
+                    bound = np.abs(r_np).max() / 127 + 1e-7
+                    assert np.abs(np.asarray(g) - r_np).max() <= bound
+
+    def test_delta_beats_downcast_on_sorted_large_values(self):
+        """A sorted int64 slot whose VALUES need 32 bits but whose
+        per-row deltas (and first element — it rides the delta wire
+        raw) fit 8 forces the delta encoding (cumsum decode), and the
+        decode is bitwise exact."""
+        base = np.arange(256, dtype=np.int64) * 100  # max 25500 > int8,
+        # deltas all 100 -> delta wires int8, downcast needs int16
+        chunk = {"idx": base.copy(), "v": np.ones(4, np.float32)}
+        staging = plan_staging(chunk, 1)
+        staged = [pack_chunk(staging, chunk)]
+        codec = plan_compression(staging, staged, "lossless")
+        kinds = {
+            s.size: e.kind
+            for s, e in zip(staging.slots, codec.encodings)
+        }
+        assert kinds[256] == "delta"
+        got = jax.tree_util.tree_leaves(
+            jax.jit(codec.unpack_device)(
+                jax.device_put(codec.encode(staged[0]))
+            )
+        )
+        ref = jax.tree_util.tree_leaves(
+            jax.jit(staging.unpack_device)(jax.device_put(staged[0]))
+        )
+        for g, r in zip(got, ref):
+            assert np.asarray(g).tobytes() == np.asarray(r).tobytes()
+
+    def test_bitmap_rejects_negative_zero(self):
+        """-0.0 is NOT bitwise +0.0: a slot containing it must refuse
+        the bitmap encoding (whose decode emits +0.0) to keep the
+        lossless guarantee strict."""
+        ok = {"b": np.array([0.0, 1.0, 1.0, 0.0], np.float32)}
+        st = plan_staging(ok, 1)
+        codec = plan_compression(st, [pack_chunk(st, ok)], "lossless")
+        assert codec.encodings[0].kind == "bitmap"
+        bad = {"b": np.array([-0.0, 1.0, 1.0, 0.0], np.float32)}
+        st2 = plan_staging(bad, 1)
+        codec2 = plan_compression(st2, [pack_chunk(st2, bad)], "lossless")
+        assert codec2.encodings[0].kind == "raw"
+
+    def test_fp16_overflow_falls_back_to_raw(self):
+        """A float slot exceeding fp16 range must stay raw rather than
+        quantize to inf."""
+        chunk = {"v": np.array([1e5, -2.0, 3.0, 4.0], np.float32)}
+        st = plan_staging(chunk, 1)
+        codec = plan_compression(st, [pack_chunk(st, chunk)], "fp16")
+        assert codec.encodings[0].kind == "raw"
+
+    def test_mode_off_and_unknown(self, rng):
+        _, _, stream = _stream(rng, "coo")
+        assert plan_compression(
+            stream.staging, stream.staged, "off"
+        ) is None
+        with pytest.raises(ValueError, match="compress must be one of"):
+            plan_compression(stream.staging, stream.staged, "zstd")
+        assert set(COMPRESSION_MODES) == {"off", "lossless", "fp16", "int8"}

@@ -1505,3 +1505,140 @@ class TestPipelineParity:
             prev = cur
         assert st.passes == 3
         assert st.chunks == 3 * stream.n_chunks
+
+
+class TestTransferAvoidance:
+    """ISSUE 14 pins: compressed wire formats + the importance-aware hot
+    working-set cache must be BITWISE NEUTRAL on the f32 path — across
+    prefetch depth, chunk fusion and hot-budget settings, over multiple
+    passes (the cache admits on pass 2 and hits from pass 3) — while
+    actually moving fewer wire bytes; cache admission must be
+    deterministic under tied importance scores."""
+
+    @staticmethod
+    def _problem(rng, n=640, d=24):
+        return _logistic_problem(rng, n, d - 1, density=0.15)
+
+    @staticmethod
+    def _stream4(X, y, chunk_rows=160):
+        return make_streaming_glm_data(
+            X, y, chunk_rows=chunk_rows, use_pallas=False
+        )
+
+    def test_fast_lane_compressed_cached_parity(self, rng):
+        """The check.sh --fast transfer-avoidance smoke: a 4-chunk
+        store streamed compressed (lossless) + cached is bitwise the
+        raw uncached stream — value/grad, batched trials, HVP, diag and
+        scores — and the wire actually shrank."""
+        X, y = self._problem(rng)
+        stream = self._stream4(X, y)
+        assert stream.n_chunks == 4
+        w = jnp.asarray(rng.normal(size=stream.n_features), jnp.float32)
+        v = jnp.asarray(rng.normal(size=stream.n_features), jnp.float32)
+        ws = jnp.stack([w, 0.5 * w, 2.0 * w])
+        raw = StreamingObjective("logistic", stream)
+        ta = StreamingObjective(
+            "logistic", self._stream4(X, y), compress="lossless",
+            hot_budget_bytes=1 << 30,
+        )
+        assert ta._codec is not None and ta._codec.ratio > 1.0
+        v0, g0 = raw.value_and_grad(w, 0.5)
+        vb0, gb0 = raw.value_and_grad_batch(ws, 0.5)
+        for _ in range(3):  # pass 2 admits, pass 3 hits
+            v1, g1 = ta.value_and_grad(w, 0.5)
+        assert ta._hot_cache.hits > 0
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        np.testing.assert_array_equal(np.asarray(g0), np.asarray(g1))
+        vb1, gb1 = ta.value_and_grad_batch(ws, 0.5)
+        np.testing.assert_array_equal(np.asarray(vb0), np.asarray(vb1))
+        np.testing.assert_array_equal(np.asarray(gb0), np.asarray(gb1))
+        np.testing.assert_array_equal(
+            np.asarray(raw.hvp(w, v, 0.5)), np.asarray(ta.hvp(w, v, 0.5))
+        )
+        np.testing.assert_array_equal(
+            np.asarray(raw.hessian_diagonal(w)),
+            np.asarray(ta.hessian_diagonal(w)),
+        )
+        np.testing.assert_array_equal(raw.scores(w), ta.scores(w))
+        # Wire vs logical accounting: the compressed stream recorded
+        # fewer wire bytes than the decoded bytes it stood for.
+        s = ta.transfer_stats
+        assert s.logical_bytes > s.bytes > 0
+        assert s.compression_ratio > 1.0
+
+    @pytest.mark.parametrize("depth", [1, 2])
+    @pytest.mark.parametrize("fuse", [1, 2])
+    @pytest.mark.parametrize("budget", ["zero", "half", "huge"])
+    def test_cached_vs_uncached_bitwise_grid(self, rng, depth, fuse,
+                                             budget):
+        """The full knob grid: hot-budget {0, ~half the store, huge} ×
+        prefetch_depth × chunk_fuse, three passes each — every cell
+        bitwise the uncached raw baseline."""
+        X, y = self._problem(rng)
+        stream = self._stream4(X, y)
+        w = jnp.asarray(rng.normal(size=stream.n_features), jnp.float32)
+        raw = StreamingObjective("logistic", stream)
+        v0, g0 = raw.value_and_grad(w, 0.5)
+        codec_bytes = StreamingObjective(
+            "logistic", self._stream4(X, y), compress="lossless"
+        )._codec.wire_nbytes
+        budget_bytes = {
+            "zero": 0,
+            # room for 2 of the 4 chunks (×fuse items per group)
+            "half": 2 * codec_bytes * fuse + 1,
+            "huge": 1 << 30,
+        }[budget]
+        ta = StreamingObjective(
+            "logistic", self._stream4(X, y), compress="lossless",
+            hot_budget_bytes=budget_bytes, prefetch_depth=depth,
+            chunk_fuse=fuse,
+        )
+        for _ in range(3):
+            v1, g1 = ta.value_and_grad(w, 0.5)
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        np.testing.assert_array_equal(np.asarray(g0), np.asarray(g1))
+        if budget == "half":
+            cache = ta._hot_cache
+            assert 0 < cache.resident_bytes <= budget_bytes
+            assert cache.hits > 0
+        if budget == "huge":
+            # Everything fits: from pass 3 on, zero wire transfers.
+            chunks_before = ta.transfer_stats.chunks
+            v1, g1 = ta.value_and_grad(w, 0.5)
+            assert ta.transfer_stats.chunks == chunks_before
+            np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+            np.testing.assert_array_equal(np.asarray(g0), np.asarray(g1))
+
+    def test_admission_determinism_under_tie(self):
+        """Tied importance scores break by ascending item index, so the
+        wanted set — and therefore admission — is deterministic."""
+        from photon_ml_tpu.optim.streaming import HotChunkCache
+
+        nbytes = 100
+        cache = HotChunkCache(budget_bytes=250)  # fits exactly 2 items
+        scores = {i: 1.0 for i in range(6)}  # fully tied
+        cache.replan(scores, lambda i: nbytes)
+        admitted = [
+            i for i in range(6)
+            if cache.maybe_admit(i, object(), nbytes)
+        ]
+        assert admitted == [0, 1]
+        # A strictly-higher score displaces the highest tied index on
+        # the next replan (and evicts its resident entry).
+        scores[5] = 2.0
+        cache.replan(scores, lambda i: nbytes)
+        assert cache.maybe_admit(5, object(), nbytes)
+        assert not cache.maybe_admit(2, object(), nbytes)
+        assert cache.evictions == 1
+        assert len(cache) == 2 and cache.resident_bytes == 200
+
+    def test_compress_requires_staged_and_single_host(self, rng):
+        """Pointed construction errors: unknown mode, negative budget."""
+        X, y = self._problem(rng)
+        stream = self._stream4(X, y)
+        with pytest.raises(ValueError, match="compress must be one of"):
+            StreamingObjective("logistic", stream, compress="zstd")
+        with pytest.raises(ValueError, match="hot_budget_bytes"):
+            StreamingObjective(
+                "logistic", stream, hot_budget_bytes=-1
+            )
