@@ -1342,6 +1342,7 @@ def bench_serving() -> dict:
     out.update(_bench_serving_scenarios(workload))
     out.update(_bench_serving_process(workload))
     out.update(_bench_serving_tenancy(workload))
+    out.update(_bench_serving_fleet(workload))
     return out
 
 
@@ -1403,8 +1404,10 @@ def _bench_serving_scenarios(workload) -> dict:
                 p.action is not None and p.action not in wired
                 for p in scenario.phases
             ):
-                # Process-only scenarios (worker_kill) run in
-                # _bench_serving_process against a worker pool;
+                # Scenarios needing other substrates run elsewhere:
+                # worker_kill in _bench_serving_process (worker pool),
+                # host_kill / quota_partition in _bench_serving_fleet
+                # (multi-host router + lease coordinator).
                 # run_scenario refuses unwired actions by design.
                 continue
             supervisor = ReplicaSupervisor(
@@ -1595,6 +1598,183 @@ def _bench_serving_tenancy(workload) -> dict:
             f"{prefix}_aggressor_shed": gate["aggressor_shed"],
             f"{prefix}_isolation_pass": gate["pass"],
         })
+    return out
+
+
+def _bench_serving_fleet(workload) -> dict:
+    """Fleet tier gates (serving/fleet.py): whole HOSTS behind one
+    ``FleetRouter`` with a ``QuotaCoordinator`` leasing each tenant's
+    fleet budget across hosts.
+
+    - ``serving_fleet_host_kill_pass``: the ``host_kill`` scenario at
+      >= 120 rps — a host's listener dies mid-phase and returns — must
+      cost ZERO failed requests and ZERO rejections for the in-quota
+      tenant (the ReplicaSupervisor's gate, one tier up).
+    - ``serving_fleet_quota_partition_pass``: the ``quota_partition``
+      scenario — every host's LeaseClient loses the coordinator — must
+      hold fleet-wide admission within ONE LEASE WINDOW of the budget
+      (degrade-to-last-lease: never unlimited, never zero) and recover
+      to exact enforcement after heal, with zero non-shed failures.
+      ``serving_fleet_quota_error_rps`` is the measured partition-phase
+      over-admission rate; its allowance is one lease window spread
+      over the phase.
+    """
+    from photon_ml_tpu.serving import loadgen
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.fleet import (
+        FleetBudget, FleetRouter, LocalHost, QuotaCoordinator,
+    )
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService
+    from photon_ml_tpu.serving.tenancy import TenancyConfig, TenantSpec
+
+    n_hosts = 2 if SMALL else 3
+    kill_rate = 120.0 if SMALL else 240.0
+    acme_budget = 600.0 if SMALL else 1200.0
+    budget_rps = 60.0
+    burst_s = 0.25
+    lease_ttl_s = 1.0
+    rt_cfg = RuntimeConfig(max_batch_size=32, hot_entities=1024)
+    tenancy = TenancyConfig(tenants=(
+        TenantSpec(
+            name="acme", quota_rps=acme_budget / n_hosts,
+            burst=max(acme_budget * burst_s / n_hosts, 1.0),
+            max_queue=512,
+        ),
+        TenantSpec(
+            name="metered", quota_rps=budget_rps / n_hosts,
+            burst=max(budget_rps * burst_s / n_hosts, 1.0),
+            max_queue=512,
+        ),
+    ))
+    batcher_cfg = BatcherConfig(
+        max_batch_size=32, max_wait_us=1000, max_queue=1024,
+        tenancy=tenancy,
+    )
+
+    def make_request(i: int, phase, tenant: str) -> dict:
+        req = dict(workload.request(i))
+        req["tenant"] = tenant
+        return req
+
+    _log(f"serving fleet: starting {n_hosts} HTTP hosts + router...")
+    hosts = [
+        LocalHost(
+            f"host{i}",
+            ScoringService(
+                ScoringRuntime(workload.model, workload.index_maps, rt_cfg),
+                batcher_cfg,
+            ),
+        ).start()
+        for i in range(n_hosts)
+    ]
+    coordinator = QuotaCoordinator(
+        [
+            FleetBudget("acme", acme_budget, burst_s=burst_s),
+            FleetBudget("metered", budget_rps, burst_s=burst_s),
+        ],
+        lease_ttl_s=lease_ttl_s,
+    )
+    clients = [h.attach_lease_client(coordinator).start() for h in hosts]
+    router = FleetRouter(
+        [h.base_url for h in hosts], probe_interval_s=0.1
+    ).start()
+    out: dict = {}
+    try:
+        for i in range(n_hosts * 4):  # warm ladders + settle leases
+            router.score(make_request(i, None, "acme"))
+        time.sleep(1.5 * lease_ttl_s)
+
+        report = loadgen.run_fleet_scenario(
+            router.submit, make_request,
+            loadgen.SCENARIOS["host_kill"], tenant="acme",
+            base_rate_rps=kill_rate,
+            actions={
+                "kill_host": hosts[0].kill,
+                "restart_host": hosts[0].restart,
+            },
+        )
+        kill_pass = (
+            report.failed == 0 and report.shed == 0
+            and report.completed >= kill_rate
+        )
+        snap = report.snapshot()
+        _log(
+            f"serving fleet host_kill: {report.completed} ok / "
+            f"{report.shed} shed / {report.failed} failed at "
+            f"{kill_rate:g} rps, p99 "
+            f"{snap['phases']['kill']['latency_p99_ms']} ms in the kill "
+            f"phase; gate {'PASS' if kill_pass else 'FAIL'}"
+        )
+        out.update({
+            "serving_fleet_hosts": n_hosts,
+            "serving_fleet_host_kill_rate_rps": kill_rate,
+            "serving_fleet_host_kill_completed": report.completed,
+            "serving_fleet_host_kill_rejected": report.shed,
+            "serving_fleet_host_kill_failed": report.failed,
+            "serving_fleet_host_kill_kill_p99_ms": (
+                snap["phases"]["kill"]["latency_p99_ms"]
+            ),
+            "serving_fleet_host_kill_pass": kill_pass,
+        })
+
+        def partition() -> bool:
+            for lc in clients:
+                lc.partitioned = True
+            return True
+
+        def heal() -> bool:
+            for lc in clients:
+                lc.partitioned = False
+            return True
+
+        q_report = loadgen.run_fleet_scenario(
+            router.submit, make_request,
+            loadgen.SCENARIOS["quota_partition"], tenant="metered",
+            base_rate_rps=2.5 * budget_rps,
+            actions={"partition": partition, "heal": heal},
+            seed=1,
+        )
+        burst_total = budget_rps * burst_s
+        q_pass = q_report.failed == 0
+        quota_error_rps = None
+        for name, duration, _, pr in q_report.phases:
+            window = lease_ttl_s if name == "partition" else 0.0
+            bound = (
+                budget_rps * (duration + window) * 1.15
+                + burst_total + 10
+            )
+            if pr.completed > bound or (
+                pr.completed < 0.4 * budget_rps * duration
+            ):
+                q_pass = False
+            if name == "partition":
+                quota_error_rps = round(
+                    max(0.0, pr.completed / duration - budget_rps), 2
+                )
+        if any(lc.stale for lc in clients):
+            q_pass = False  # renewal never recovered after heal
+        _log(
+            f"serving fleet quota_partition: {q_report.completed} "
+            f"admitted / {q_report.shed} shed / {q_report.failed} "
+            f"failed against budget {budget_rps:g} rps; partition "
+            f"over-admission {quota_error_rps} rps (allowance: one "
+            f"{lease_ttl_s:g}s lease window); gate "
+            f"{'PASS' if q_pass else 'FAIL'}"
+        )
+        out.update({
+            "serving_fleet_quota_budget_rps": budget_rps,
+            "serving_fleet_quota_admitted": q_report.completed,
+            "serving_fleet_quota_shed": q_report.shed,
+            "serving_fleet_quota_failed": q_report.failed,
+            "serving_fleet_quota_error_rps": quota_error_rps,
+            "serving_fleet_lease_window_s": lease_ttl_s,
+            "serving_fleet_quota_partition_pass": q_pass,
+        })
+    finally:
+        router.stop()
+        for h in hosts:
+            h.stop()
     return out
 
 

@@ -18,6 +18,7 @@ Two registries in this repo are load-bearing conventions:
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Iterable, Optional
 
@@ -45,7 +46,7 @@ SUBSYSTEMS = frozenset({
 UNITS = frozenset({
     "total", "seconds", "bytes", "ratio", "gbps", "rows", "ms",
     "count", "entries", "iterations", "retries", "depth", "version",
-    "tier",
+    "tier", "rps",
 })
 
 #: Pre-convention names (PRs 1-6), grandfathered verbatim.  Do NOT add
@@ -241,6 +242,55 @@ def _check_chaos_site_sync(tree: SourceTree) -> Iterable[Finding]:
             )
 
 
+# ---------------------------------------------------------------------------
+# chaos-site-tested
+# ---------------------------------------------------------------------------
+
+
+def _test_texts(tree: SourceTree) -> list[tuple[str, str]]:
+    """``(relpath, text)`` for every ``tests/**.py`` under the repo
+    root.  Tests are deliberately NOT in ``tree.files`` (they violate
+    invariants on purpose in fixtures), so this rule reads them
+    directly — as text, not AST: a site name counts as referenced
+    however the test spells it (FaultSpec argument, plan literal,
+    parametrize id)."""
+    out: list[tuple[str, str]] = []
+    tests_root = os.path.join(tree.repo_root, "tests")
+    if not os.path.isdir(tests_root):
+        return out
+    for dirpath, dirnames, filenames in os.walk(tests_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as f:
+                out.append((os.path.relpath(path, tree.repo_root),
+                            f.read()))
+    return out
+
+
+def _check_chaos_site_tested(tree: SourceTree) -> Iterable[Finding]:
+    registry = _registry_sites(tree)
+    if not registry:
+        return  # tree without chaos/core.py (rule fixtures): nothing on
+    tests = _test_texts(tree)
+    if not tests:
+        return  # no tests/ dir alongside this tree: nothing to check
+    for site, (path, lineno) in sorted(registry.items()):
+        quoted = (f'"{site}"', f"'{site}'")
+        if any(q in text for _, text in tests for q in quoted):
+            continue
+        yield Finding(
+            "chaos-site-tested", path, lineno,
+            f"chaos site {site!r} is registered in KNOWN_SITES but no "
+            "test file references it: the recovery path behind the "
+            "seam is never exercised under injected faults — add a "
+            "test that scripts a FaultPlan (or flips the scripted "
+            "flag) at this site, or retire the registry entry",
+        )
+
+
 RULES = [
     Rule(
         id="chaos-site-sync",
@@ -263,6 +313,25 @@ RULES = [
             "wire/remove the call-site."
         ),
         fn=_check_chaos_site_sync,
+    ),
+    Rule(
+        id="chaos-site-tested",
+        family="registry",
+        summary="every chaos KNOWN_SITES entry is referenced by at "
+                "least one test file",
+        explain=(
+            "chaos-site-sync guarantees a registered site has a "
+            "maybe_fail call-site, but a seam nobody scripts a fault "
+            "at is still an untested recovery path — the hook fires in "
+            "production shapes while every test runs the happy path.  "
+            "This rule reads tests/**.py directly (tests are excluded "
+            "from the scanned tree on purpose) and flags any "
+            "KNOWN_SITES key that appears as a quoted string literal "
+            "in NO test file.  Fix: add a test that targets the site "
+            "with a FaultPlan/FaultSpec (or asserts the degrade "
+            "behavior behind it), or retire the registry entry."
+        ),
+        fn=_check_chaos_site_tested,
     ),
     Rule(
         id="metric-naming",

@@ -197,6 +197,22 @@ KNOWN_SITES = {
         "breaker opens and its traffic sheds while every other "
         "tenant's requests keep completing"
     ),
+    "serving.host": (
+        "fleet-router routing seam, after a host is picked but before "
+        "the request goes over the wire (serving/fleet.py _route; ctx: "
+        "host) — a fault is a HOST dying as it picks up the request: "
+        "the router must mark the host DOWN, resubmit to a peer, and "
+        "the client future must still resolve (zero failed requests, "
+        "the host_kill scenario's gate)"
+    ),
+    "quota.lease": (
+        "fleet lease renewal, before the LeaseClient reaches the "
+        "QuotaCoordinator (serving/fleet.py poll_once; ctx: host) — a "
+        "fault is a network partition from the coordinator: the host "
+        "must degrade to its LAST granted lease (never unlimited, "
+        "never zero), bounding fleet over-admission to one lease "
+        "window (the quota_partition scenario's gate)"
+    ),
 }
 
 

@@ -34,8 +34,9 @@ ones.
 ride the same discipline one level down: :func:`plan_compression` scans
 a staged store once and assigns every staging SLOT (one pytree leaf's
 segment) an opt-in wire encoding — delta/downcast narrowing for index
-blocks, bitmaps for {0,1}-valued float segments, fp16/int8 quantization
-with per-shard scale sidecars for feature values — then re-segregates
+blocks, bitmaps for {0,1}-valued float segments (f32, f64 and bf16),
+an f32 wire for f64 blocks whose every value round-trips bitwise,
+fp16/int8 quantization with per-shard scale sidecars — then re-segregates
 the encoded slots into wire buffers by WIRE dtype, so a compressed chunk
 still crosses as a few large contiguous transfers.  The decode
 (:meth:`ChunkCodec.unpack_device`) is pure slice/cast/cumsum/shift
@@ -238,7 +239,9 @@ def unpack_device(staging: ChunkStaging, buffers):
 
 #: the ``compress`` knob's values.  "lossless" applies only encodings
 #: whose device decode reconstructs the uncompressed arrays BITWISE
-#: (delta / integer downcast / {0,1} bitmaps); "fp16" and "int8"
+#: (delta / integer downcast / {0,1} bitmaps for f32, f64 and bf16 /
+#: the f64-over-f32-wire downcast when every value round-trips); "fp16"
+#: and "int8"
 #: additionally quantize float32 segments (lossy, bounded error — see
 #: tests/test_staging.py), keeping the lossless integer encodings.
 COMPRESSION_MODES = ("off", "lossless", "fp16", "int8")
@@ -478,6 +481,57 @@ def _is_binary_f32(segments: list) -> bool:
     return True
 
 
+def _is_binary_f64(segments: list) -> bool:
+    """The f64 analogue of :func:`_is_binary_f32`: bitwise +0.0 or 1.0
+    only (same -0.0 rejection — its bitmap decode would flip the sign
+    bit)."""
+    for s in segments:
+        bits = np.ascontiguousarray(s).view(np.uint64)
+        if not np.isin(
+            bits, (0x0000000000000000, 0x3FF0000000000000)
+        ).all():
+            return False
+    return True
+
+
+def _f32_roundtrips_f64(segments: list) -> bool:
+    """Every f64 value survives an f32 wire BITWISE (f64 -> f32 -> f64
+    is the identity on the bit pattern), so a half-width wire is still
+    lossless.  Indicator-heavy and low-precision feature blocks staged
+    as f64 pass; anything needing the extra mantissa (or carrying NaN
+    payloads f32 can't hold) falls back to raw."""
+    for s in segments:
+        rt = s.astype(np.float32).astype(np.float64)
+        same = (
+            np.ascontiguousarray(rt).view(np.uint64)
+            == np.ascontiguousarray(s).view(np.uint64)
+        )
+        if not same.all():
+            return False
+    return True
+
+
+def _bfloat16_dtype():
+    """The registered bfloat16 numpy dtype, or None when ml_dtypes is
+    absent (it ships with jax, so None is the exotic case)."""
+    try:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    except Exception:  # pragma: no cover — ml_dtypes rides with jax
+        return None
+
+
+def _is_binary_bf16(segments: list) -> bool:
+    """Bitwise +0.0 or 1.0 in bfloat16 (0x0000 / 0x3F80): the bitmap
+    precondition for bf16-staged mask/indicator blocks."""
+    for s in segments:
+        bits = np.ascontiguousarray(s).view(np.uint16)
+        if not np.isin(bits, (0x0000, 0x3F80)).all():
+            return False
+    return True
+
+
 def plan_compression(
     staging: ChunkStaging, staged: Sequence, mode: str
 ) -> ChunkCodec | None:
@@ -517,6 +571,27 @@ def plan_compression(
             continue
         if dt.kind in "iu" and dt.itemsize >= 2:
             plans.append(_plan_int_slot(dt, segments(slot)))
+            continue
+        if dt == np.float64:
+            # f64 staging is rare (x64-enabled hosts, double-precision
+            # offsets) but pays double wire width for it — recover the
+            # width wherever the VALUES don't need it, bitwise only.
+            segs = segments(slot)
+            if _is_binary_f64(segs):
+                plans.append(("bitmap", np.dtype(np.uint8)))
+                continue
+            if _f32_roundtrips_f64(segs):
+                plans.append(("downcast", np.dtype(np.float32)))
+                continue
+            plans.append(("raw", dt))
+            continue
+        bf16 = _bfloat16_dtype()
+        if bf16 is not None and dt == bf16:
+            segs = segments(slot)
+            if _is_binary_bf16(segs):
+                plans.append(("bitmap", np.dtype(np.uint8)))
+                continue
+            plans.append(("raw", dt))
             continue
         if dt == np.float32:
             segs = segments(slot)

@@ -35,6 +35,13 @@ TPU-native analogue of that request path over the batch stack:
   breakers, enforced in the batcher) and ``TenantRouter`` (tenant ->
   model version on the HotSwapper registry, per-tenant hot swap and
   rollback; docs/serving.md "Tenancy").
+- :mod:`~photon_ml_tpu.serving.fleet` — the node tier: ``FleetRouter``
+  routes requests across N host endpoints (health probes, DOWN-marking,
+  peer resubmission, jittered reconnects, connection draining) and
+  ``QuotaCoordinator`` / ``LeaseClient`` carve each tenant's FLEET
+  budget into short-lived per-host rate leases (demand-aware
+  rebalancing, reclaim on host death, degrade-to-last-lease under
+  partition; docs/serving.md "Fleet").
 - :mod:`~photon_ml_tpu.serving.procpool` /
   :mod:`~photon_ml_tpu.serving.worker` /
   :mod:`~photon_ml_tpu.serving.shm_model` — crash-isolated worker
@@ -76,6 +83,13 @@ _LAZY = {
     "TenancyConfig": ("photon_ml_tpu.serving.tenancy", "TenancyConfig"),
     "TenantSpec": ("photon_ml_tpu.serving.tenancy", "TenantSpec"),
     "TenantRouter": ("photon_ml_tpu.serving.tenancy", "TenantRouter"),
+    "FleetRouter": ("photon_ml_tpu.serving.fleet", "FleetRouter"),
+    "FleetBudget": ("photon_ml_tpu.serving.fleet", "FleetBudget"),
+    "QuotaCoordinator": (
+        "photon_ml_tpu.serving.fleet", "QuotaCoordinator",
+    ),
+    "LeaseClient": ("photon_ml_tpu.serving.fleet", "LeaseClient"),
+    "LocalHost": ("photon_ml_tpu.serving.fleet", "LocalHost"),
     "HotSwapper": ("photon_ml_tpu.serving.swap", "HotSwapper"),
     "SwapResult": ("photon_ml_tpu.serving.swap", "SwapResult"),
     "SwapInProgressError": (

@@ -26,6 +26,18 @@ token-bucket quota is shed alone while the victim tenant's p99 stays
 inside its SLO with zero failures, and the per-tenant
 ``serving_tenant_<t>_*`` metric family records both sides.
 
+A fourth, fleet pass runs whole HOSTS behind a ``FleetRouter`` with a
+``QuotaCoordinator`` leasing each tenant's fleet budget across hosts
+(serving/fleet.py): a host kill under >= 120 rps costs zero failed
+requests and zero rejections for the in-quota tenant, and a scripted
+coordinator partition holds fleet-wide admission within one lease
+window of the budget (degrade-to-last-lease), recovering to exact
+enforcement after heal.
+
+``--tenant-report metrics_ts.jsonl`` prints per-tenant accounting
+(rps, shed, latency percentiles) from the ``serving_tenant_*`` family
+of a recorded time series and exits.
+
 Process mode (``--selfcheck --workers 2``) runs the same contracts
 against CRASH-ISOLATED worker processes attached to one shared-memory
 model publication: score parity with in-process scoring, a real SIGKILL
@@ -105,6 +117,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--timeout-ms", type=float, default=None,
         help="default per-request deadline (None = no deadline)",
+    )
+    p.add_argument(
+        "--tenant-report", metavar="METRICS_TS_JSONL",
+        help="summarize per-tenant rps/shed/p99 from a metrics_ts.jsonl "
+        "(the serving_tenant_* family) as JSON and exit",
     )
     p.add_argument(
         "--loadgen", choices=["closed", "open"],
@@ -882,6 +899,336 @@ def run_selfcheck_tenancy(out_dir: str, n_workers: int = 0) -> list[str]:
     return failures
 
 
+def run_selfcheck_fleet(out_dir: str, n_workers: int = 0) -> list[str]:
+    """Fleet pass: N whole HOSTS behind one FleetRouter, leases from a
+    QuotaCoordinator — both ISSUE gates (serving/fleet.py):
+
+    - ``host_kill`` at >= 120 rps: a host's listener dies mid-phase and
+      comes back; ZERO failed requests and ZERO rejections for the
+      in-quota tenant (a dying host may delay a request, never lose it).
+    - ``quota_partition``: every host's LeaseClient loses the
+      coordinator mid-phase; fleet-wide admitted rate stays within one
+      lease window of the budget (never unlimited, never zero), and
+      exact enforcement resumes after heal.  Zero non-shed failures.
+
+    ``n_workers=0`` runs 3 thread-mode hosts; >0 runs 2 hosts each
+    backed by ``n_workers`` crash-isolated worker processes (the lease
+    crosses the worker wire protocol to bite).  Returns failure strings
+    (empty = pass)."""
+    import time
+
+    from photon_ml_tpu import telemetry as telemetry_mod
+    from photon_ml_tpu.serving import loadgen
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.fleet import (
+        FleetBudget,
+        FleetRouter,
+        LocalHost,
+        QuotaCoordinator,
+    )
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService
+    from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+    from photon_ml_tpu.serving.tenancy import TenancyConfig, TenantSpec
+
+    failures: list[str] = []
+    n_hosts = 2 if n_workers else 3
+    mode = f"process x{n_workers}/host" if n_workers else "thread"
+    kill_rate = 120.0       # the ISSUE floor: >= 120 rps offered
+    # Two budgeted tenants: "acme" is IN-quota at kill_rate (the
+    # host_kill gate must see zero rejections), "metered" is the
+    # over-subscribed tenant whose enforcement the partition gate
+    # measures.
+    acme_budget_rps = 600.0
+    budget_rps = 60.0       # quota_partition fleet budget ("metered")
+    burst_s = 0.25          # lease burst = rate * burst_s
+    lease_ttl_s = 1.0       # "one lease window"
+    workload = SyntheticWorkload(n_entities=64, seed=11)
+    rt_cfg = RuntimeConfig(max_batch_size=8, hot_entities=16)
+    # Static specs = the pre-lease defaults: each tenant's per-host
+    # slice of its fleet budget, so enforcement is budget-shaped even
+    # before the first lease lands (and after a batcher rebuild, until
+    # re-apply).
+    tenancy = TenancyConfig(tenants=(
+        TenantSpec(
+            name="acme",
+            quota_rps=acme_budget_rps / n_hosts,
+            burst=max(acme_budget_rps * burst_s / n_hosts, 1.0),
+            max_queue=256,
+        ),
+        TenantSpec(
+            name="metered",
+            quota_rps=budget_rps / n_hosts,
+            burst=max(budget_rps * burst_s / n_hosts, 1.0),
+            max_queue=256,
+        ),
+    ))
+    batcher_cfg = BatcherConfig(
+        max_batch_size=8, max_wait_us=2_000, max_queue=512,
+        tenancy=tenancy,
+    )
+
+    def build_host(i: int) -> LocalHost:
+        if n_workers:
+            from photon_ml_tpu.serving.procpool import WorkerPool
+            from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+
+            pool = WorkerPool(
+                workload.model, workload.index_maps,
+                runtime_config=rt_cfg, version=1,
+            )
+            unit = ReplicaSupervisor(
+                pool=pool, n_replicas=n_workers, probe_interval_s=0.1,
+            )
+        else:
+            unit = ScoringRuntime(
+                workload.model, workload.index_maps, rt_cfg
+            )
+        return LocalHost(f"host{i}", ScoringService(unit, batcher_cfg))
+
+    def make_request(i: int, phase, tenant: str) -> dict:
+        obj = dict(workload.request(i))
+        obj["tenant"] = tenant
+        return obj
+
+    with telemetry_mod.Telemetry(
+        output_dir=out_dir, run_name="serving-selfcheck-fleet"
+    ) as tel:
+        hosts = [build_host(i).start() for i in range(n_hosts)]
+        coordinator = QuotaCoordinator(
+            [
+                FleetBudget("acme", acme_budget_rps, burst_s=burst_s),
+                FleetBudget("metered", budget_rps, burst_s=burst_s),
+            ],
+            lease_ttl_s=lease_ttl_s,
+        )
+        clients = [
+            h.attach_lease_client(coordinator).start() for h in hosts
+        ]
+        router = FleetRouter(
+            [h.base_url for h in hosts], probe_interval_s=0.1,
+        ).start()
+        try:
+            # Warm every host (compile the bucket ladder) and let the
+            # lease shares settle before any gate measures.
+            for h_i in range(n_hosts * 4):
+                router.score(make_request(h_i, None, "acme"))
+            time.sleep(3 * lease_ttl_s / 2)
+
+            # -- gate 1: host_kill at >= 120 rps --------------------------
+            report = loadgen.run_fleet_scenario(
+                router.submit, make_request,
+                loadgen.SCENARIOS["host_kill"], tenant="acme",
+                base_rate_rps=kill_rate,
+                actions={
+                    "kill_host": hosts[0].kill,
+                    "restart_host": hosts[0].restart,
+                },
+            )
+            if report.failed:
+                failures.append(
+                    f"host_kill ({mode}): {report.failed} FAILED "
+                    f"requests (must be 0): {report.snapshot()}"
+                )
+            if report.shed:
+                failures.append(
+                    f"host_kill ({mode}): {report.shed} rejections for "
+                    f"the in-quota tenant (must be 0): "
+                    f"{report.snapshot()}"
+                )
+            if report.completed < kill_rate:  # ~1s of traffic, floor
+                failures.append(
+                    f"host_kill ({mode}): only {report.completed} "
+                    "requests completed — the scenario never loaded "
+                    "the fleet"
+                )
+            snap = tel.snapshot()
+            counters = snap["counters"]
+            if counters.get("serving_fleet_host_down_total", 0) < 1:
+                failures.append(
+                    "host_kill: serving_fleet_host_down_total = 0 — "
+                    "the router never noticed the kill"
+                )
+            if counters.get("serving_fleet_resubmitted_total", 0) < 1:
+                failures.append(
+                    "host_kill: serving_fleet_resubmitted_total = 0 — "
+                    "no request was ever resubmitted to a peer"
+                )
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if router.healthy_count == n_hosts:
+                    break
+                time.sleep(0.05)
+            if router.healthy_count != n_hosts:
+                failures.append(
+                    f"host_kill ({mode}): killed host never rejoined "
+                    f"({router.healthy_count}/{n_hosts} healthy): "
+                    f"{router.healthz()}"
+                )
+
+            # -- gate 2: quota_partition ----------------------------------
+            def partition() -> bool:
+                for lc in clients:
+                    lc.partitioned = True
+                return True
+
+            def heal() -> bool:
+                for lc in clients:
+                    lc.partitioned = False
+                return True
+
+            q_report = loadgen.run_fleet_scenario(
+                router.submit, make_request,
+                loadgen.SCENARIOS["quota_partition"], tenant="metered",
+                base_rate_rps=2.5 * budget_rps,
+                actions={"partition": partition, "heal": heal},
+                seed=1,
+            )
+            if q_report.failed:
+                failures.append(
+                    f"quota_partition ({mode}): {q_report.failed} "
+                    "non-shed FAILURES (sheds are the design working; "
+                    f"failures are not): {q_report.snapshot()}"
+                )
+            burst_total = budget_rps * burst_s
+            for pname in ("baseline", "partition", "heal"):
+                pr = q_report.phase(pname)
+                _, duration, offered, _ = next(
+                    row for row in q_report.phases if row[0] == pname
+                )
+                # Admission bound: the budget over the phase, plus the
+                # fleet burst capacity, plus one lease window of
+                # over-admission while partitioned (the contract:
+                # degrade to the LAST lease, never unlimited).
+                window = lease_ttl_s if pname == "partition" else 0.0
+                bound = (
+                    budget_rps * (duration + window) * 1.15
+                    + burst_total + 10
+                )
+                if pr.completed > bound:
+                    failures.append(
+                        f"quota_partition ({mode}) phase {pname}: "
+                        f"admitted {pr.completed} > bound {bound:.0f} "
+                        f"(budget {budget_rps:g} rps over "
+                        f"{duration:g}s + one lease window) — "
+                        "enforcement leaked past the lease contract"
+                    )
+                if pr.completed < 0.4 * budget_rps * duration:
+                    failures.append(
+                        f"quota_partition ({mode}) phase {pname}: "
+                        f"admitted only {pr.completed} — degraded "
+                        "toward zero (the contract is never-zero)"
+                    )
+            if str(q_report.actions.get("partition")).startswith("ERROR"):
+                failures.append(
+                    f"partition action failed: {q_report.actions}"
+                )
+            stale_now = [lc.stale for lc in clients]
+            if any(stale_now):
+                failures.append(
+                    f"after heal: lease clients still stale "
+                    f"({stale_now}) — renewal never recovered"
+                )
+            if not all(lc.renew_failures > 0 for lc in clients):
+                failures.append(
+                    "partition never bit: some lease client saw zero "
+                    f"renew failures "
+                    f"({[lc.renew_failures for lc in clients]})"
+                )
+            snap = tel.snapshot()
+        finally:
+            router.stop()
+            for h in hosts:
+                h.stop()
+        counters = snap["counters"]
+        if counters.get(
+            "serving_fleet_lease_renew_failures_total", 0
+        ) < 1:
+            failures.append(
+                "serving_fleet_lease_renew_failures_total = 0 — the "
+                "partition left no metric trace"
+            )
+        if counters.get("serving_fleet_lease_grants_total", 0) < n_hosts:
+            failures.append(
+                "serving_fleet_lease_grants_total = "
+                f"{counters.get('serving_fleet_lease_grants_total', 0)}"
+                f", expected >= {n_hosts}"
+            )
+    if not failures:
+        print(
+            f"serving fleet selfcheck ({mode}): host kill under "
+            f"{kill_rate:g} rps cost 0 failures / 0 rejections across "
+            f"{report.completed} requests; coordinator partition held "
+            f"admission within one {lease_ttl_s:g}s lease window of "
+            f"{budget_rps:g} rps and recovered "
+            f"({q_report.completed} admitted, {q_report.shed} shed, "
+            f"{q_report.failed} failed)"
+        )
+    return failures
+
+
+def tenant_report(ts_path: str) -> dict:
+    """Summarize the ``serving_tenant_*`` family from a metrics_ts.jsonl
+    into per-tenant accounting: request rate, shed/rejected totals, and
+    latency percentiles (ROADMAP item 3's accounting-dashboard tail).
+
+    Rates are counter deltas over the sampled ``t_mono`` span; p50/p99
+    come from the LAST record's latency-histogram summary (cumulative
+    over the run).  Returns the JSON-able report dict."""
+    from photon_ml_tpu.telemetry.timeseries import read_series
+
+    records = read_series(ts_path)
+    if not records:
+        raise ValueError(f"no time-series records in {ts_path}")
+    first, last = records[0], records[-1]
+    span_s = max(float(last["t_mono"]) - float(first["t_mono"]), 1e-9)
+    slug_re = __import__("re").compile(
+        r"^serving_tenant_([a-z0-9_]+?)_requests_total$"
+    )
+    tenants = sorted(
+        m.group(1)
+        for name in last.get("counters", {})
+        for m in [slug_re.match(name)]
+        if m is not None
+    )
+
+    def delta(name: str) -> float:
+        return float(last["counters"].get(name, 0)) - float(
+            first["counters"].get(name, 0)
+        )
+
+    report = {
+        "path": ts_path,
+        "span_seconds": round(span_s, 3),
+        "records": len(records),
+        "tenants": {},
+    }
+    for slug in tenants:
+        prefix = f"serving_tenant_{slug}_"
+        hist = last.get("histograms", {}).get(
+            prefix + "request_latency_seconds"
+        ) or {}
+        requests = delta(prefix + "requests_total")
+        shed = delta(prefix + "shed_total")
+        report["tenants"][slug] = {
+            "requests": int(requests),
+            "rps": round(requests / span_s, 2),
+            "shed": int(shed),
+            "shed_rps": round(shed / span_s, 2),
+            "rejected": int(delta(prefix + "rejected_total")),
+            "completed": int(hist.get("count") or 0),
+            "latency_p50_ms": (
+                None if hist.get("p50") is None
+                else round(hist["p50"] * 1e3, 3)
+            ),
+            "latency_p99_ms": (
+                None if hist.get("p99") is None
+                else round(hist["p99"] * 1e3, 3)
+            ),
+        }
+    return report
+
+
 # ---------------------------------------------------------------------------
 # Entry point
 # ---------------------------------------------------------------------------
@@ -889,31 +1236,46 @@ def run_selfcheck_tenancy(out_dir: str, n_workers: int = 0) -> list[str]:
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
 
+    if args.tenant_report:
+        try:
+            report = tenant_report(args.tenant_report)
+        except (OSError, ValueError) as exc:
+            print(f"tenant report failed: {exc}", file=sys.stderr)
+            return 1
+        print(json.dumps(report, indent=2))
+        return 0
+
     if args.selfcheck:
         def both(root: str) -> list[str]:
             # Separate output dirs: each pass owns its Telemetry hub and
             # its metrics.json (the HA assertions read ha/metrics.json).
-            single, ha, tenancy = (
+            single, ha, tenancy, fleet = (
                 os.path.join(root, "single"), os.path.join(root, "ha"),
                 os.path.join(root, "tenancy"),
+                os.path.join(root, "fleet"),
             )
             os.makedirs(single, exist_ok=True)
             os.makedirs(ha, exist_ok=True)
             os.makedirs(tenancy, exist_ok=True)
+            os.makedirs(fleet, exist_ok=True)
             return (
                 run_selfcheck(single)
                 + run_selfcheck_ha(ha)
                 + run_selfcheck_tenancy(tenancy)
+                + run_selfcheck_fleet(fleet)
             )
 
         def process(root: str) -> list[str]:
             proc = os.path.join(root, "proc")
             tenancy = os.path.join(root, "tenancy")
+            fleet = os.path.join(root, "fleet")
             os.makedirs(proc, exist_ok=True)
             os.makedirs(tenancy, exist_ok=True)
+            os.makedirs(fleet, exist_ok=True)
             return (
                 run_selfcheck_process(proc, n_workers=args.workers)
                 + run_selfcheck_tenancy(tenancy, n_workers=args.workers)
+                + run_selfcheck_fleet(fleet, n_workers=args.workers)
             )
 
         runner = process if args.workers else both

@@ -852,6 +852,32 @@ class MicroBatcher:
         """The tenant's committed runtime, or None (default route)."""
         return self._tenant_routes.get(tenant)
 
+    # -- tenant quotas (fleet lease apply path, serving/fleet.py) -----------
+    def set_tenant_quota(
+        self,
+        tenant: str,
+        rate_rps: Optional[float],
+        burst: Optional[float] = None,
+    ) -> None:
+        """Re-rate one tenant's token bucket in place (a fleet quota
+        lease landing on this batcher).  The spec stays immutable — the
+        lease overrides only the live bucket, so a rebuilt batcher
+        starts back at the static spec until the next lease applies."""
+        if self._tenancy is None:
+            raise ValueError(
+                "tenancy is not enabled on this batcher; no quota to set"
+            )
+        state = self._tenant_states.get(tenant)
+        if state is None and tenant == self._tenancy.default.name:
+            state = self._default_state
+        if state is None:
+            raise ValueError(
+                f"unknown tenant {tenant!r}; declare it in TenancyConfig "
+                "before leasing it quota"
+            )
+        with self._tenant_lock:
+            state.bucket.reset_rate(rate_rps, burst)
+
     # -- observability -----------------------------------------------------
     @property
     def queue_depth(self) -> int:

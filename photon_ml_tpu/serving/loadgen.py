@@ -400,6 +400,37 @@ SCENARIOS = {
             ScenarioPhase("recovery", 1.0),
         ],
     ),
+    "host_kill": Scenario(
+        "host_kill",
+        "a whole serving HOST dies mid-phase behind the FleetRouter "
+        "(listener torn down abruptly; serving/fleet.py) and comes "
+        "back later; the router marks it down, resubmits in-flight "
+        "requests to peers, and re-admits it via reconnect probes — "
+        "zero failed requests expected, the ReplicaSupervisor's gate "
+        "one tier up",
+        [
+            ScenarioPhase("warm", 1.0),
+            ScenarioPhase("kill", 2.0, action="kill_host"),
+            ScenarioPhase(
+                "recover", 1.0,
+                action="restart_host", action_at_frac=0.1,
+            ),
+        ],
+    ),
+    "quota_partition": Scenario(
+        "quota_partition",
+        "every host's LeaseClient loses its path to the "
+        "QuotaCoordinator mid-phase (serving/fleet.py): hosts degrade "
+        "to their LAST lease — never unlimited, never zero — so "
+        "fleet-wide admission stays within one lease window of the "
+        "budget; after heal, exact enforcement resumes.  Zero "
+        "non-shed errors expected throughout",
+        [
+            ScenarioPhase("baseline", 1.5),
+            ScenarioPhase("partition", 2.0, action="partition"),
+            ScenarioPhase("heal", 1.5, action="heal"),
+        ],
+    ),
 }
 
 
@@ -676,4 +707,129 @@ def run_noisy_neighbor(
         scenario=scenario.name,
         victim=accts[victim].report(),
         aggressor=accts[aggressor].report(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fleet-aware replay (host_kill / quota_partition, serving/fleet.py)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FleetScenarioReport:
+    """Per-phase shed/failed-classified outcomes of a fleet replay.
+
+    The host_kill gate reads the whole-run ``failed`` and ``shed``
+    (both must be zero for an in-quota tenant: a dying host may delay
+    a request, never fail or reject it); the quota_partition gate reads
+    PER-PHASE ``completed`` against budget × phase duration (admitted
+    rate within one lease window of the budget while partitioned,
+    exact enforcement after heal) with ``failed == 0`` throughout —
+    sheds there are the design working."""
+
+    scenario: str
+    tenant: str
+    phases: list  # (phase_name, duration_s, offered_rps, TenantLoadReport)
+    actions: dict  # action name -> result (or error string)
+
+    @property
+    def completed(self) -> int:
+        return sum(r.completed for _, _, _, r in self.phases)
+
+    @property
+    def shed(self) -> int:
+        return sum(r.shed for _, _, _, r in self.phases)
+
+    @property
+    def failed(self) -> int:
+        return sum(r.failed for _, _, _, r in self.phases)
+
+    def phase(self, name: str) -> TenantLoadReport:
+        for pname, _, _, report in self.phases:
+            if pname == name:
+                return report
+        raise KeyError(f"no phase {name!r} in {self.scenario}")
+
+    def snapshot(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "tenant": self.tenant,
+            "completed": self.completed,
+            "shed": self.shed,
+            "failed": self.failed,
+            "actions": self.actions,
+            "phases": {
+                name: dict(
+                    report.snapshot(),
+                    duration_s=_round(duration),
+                    offered_rps=_round(offered),
+                )
+                for name, duration, offered, report in self.phases
+            },
+        }
+
+
+def run_fleet_scenario(
+    submit: Callable,
+    make_request: Callable,
+    scenario: Scenario,
+    tenant: str = "acme",
+    base_rate_rps: float = 120.0,
+    actions: Optional[dict] = None,
+    timeout_s: float = 30.0,
+    seed: int = 0,
+) -> FleetScenarioReport:
+    """Replay a fleet scenario (host_kill / quota_partition) as ONE
+    tenant's open-loop stream with shed/failed-classified outcomes.
+
+    Same action contract as :func:`run_scenario` (unwired actions raise
+    up front; actions fire on a helper thread mid-phase), but outcomes
+    are accounted per phase through :class:`TenantLoadReport` so the
+    gates can tell admission-control sheds (RejectedError, through the
+    future or at submit) from real failures.  ``make_request(i, phase,
+    tenant)`` must build a wire request carrying the tenant id."""
+    actions = actions or {}
+    for phase in scenario.phases:
+        if phase.action is not None and phase.action not in actions:
+            raise ValueError(
+                f"scenario {scenario.name!r} phase {phase.name!r} needs "
+                f"action {phase.action!r}; wire it via "
+                "run_fleet_scenario(actions={...})"
+            )
+    phase_rows: list = []
+    action_results: dict = {}
+    for pi, phase in enumerate(scenario.phases):
+        action_thread = None
+        if phase.action is not None:
+            fn = actions[phase.action]
+            delay = phase.duration_s * phase.action_at_frac
+
+            def fire(fn=fn, delay=delay, key=phase.action):
+                time.sleep(delay)
+                try:
+                    action_results[key] = fn()
+                except Exception as exc:  # noqa: BLE001 — report
+                    action_results[key] = (
+                        f"ERROR {type(exc).__name__}: {exc}"
+                    )
+
+            action_thread = threading.Thread(
+                target=fire, name=f"fleet-{phase.action}", daemon=True
+            )
+            action_thread.start()
+        acct = _TenantAcct(tenant)
+        rate = base_rate_rps * phase.rate_multiplier
+        _tenant_open_loop(
+            submit, make_request, phase, tenant, rate, acct,
+            timeout_s, seed + pi,
+        )
+        if action_thread is not None:
+            action_thread.join(timeout=timeout_s)
+        phase_rows.append(
+            (phase.name, phase.duration_s, rate, acct.report())
+        )
+    return FleetScenarioReport(
+        scenario=scenario.name,
+        tenant=tenant,
+        phases=phase_rows,
+        actions=action_results,
     )

@@ -372,6 +372,36 @@ class ProcessReplica:
         stats["alive"] = self._proc.is_alive()
         return stats
 
+    def set_tenant_quota(
+        self,
+        tenant: str,
+        rate_rps,
+        burst=None,
+        timeout: float = 5.0,
+    ) -> None:
+        """Apply a fleet quota lease to the worker's batcher (the
+        ``set_quota`` frame; admission runs worker-side in process
+        mode).  Raises on an unknown tenant or a dead worker — the
+        lease client treats either as one host's failed apply."""
+        with self._lock:
+            request_id = self._next_id
+            self._next_id += 1
+            future = Future()
+            self._inflight[request_id] = future
+        try:
+            self._conn.send({
+                "kind": "set_quota", "id": request_id,
+                "tenant": tenant, "rate_rps": rate_rps, "burst": burst,
+            })
+        except Exception as exc:  # noqa: BLE001 — worker is gone
+            with self._lock:
+                self._inflight.pop(request_id, None)
+            raise RuntimeError(
+                f"UNAVAILABLE: lost connection to worker {self.rid}: "
+                f"{exc}"
+            ) from exc
+        future.result(timeout=timeout)
+
     def kill(self, reason: str = "scripted kill") -> None:
         """SIGKILL the worker — no drain, no goodbye: the real crash.
         The reader thread's EOF handling fails in-flight rows

@@ -95,6 +95,12 @@ class ScoringService:
         #: tenant → model-version resolution view (serving/tenancy.py);
         #: the swapper owns the route state, this is the read API.
         self.router = TenantRouter(self.swapper)
+        #: tenant → offered-request count, PRE-admission (counted even
+        #: when the quota then sheds the request): the demand signal the
+        #: fleet lease client feeds the QuotaCoordinator
+        #: (serving/fleet.py).  Absent tenant ids count under None.
+        self._demand: dict = {}
+        self._demand_lock = threading.Lock()
         self._started = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -218,6 +224,12 @@ class ScoringService:
             row = self.supervisor.parse_request(request)
         else:
             row = self.current_runtime.parse_request(request)
+        # Offered demand, counted BEFORE admission: a shed request is
+        # still demand — exactly the signal lease rebalancing needs
+        # (a host shedding for lack of lease must report the pressure).
+        tenant = getattr(row, "tenant", None)
+        with self._demand_lock:
+            self._demand[tenant] = self._demand.get(tenant, 0) + 1
         if self.supervisor is not None:
             return self.supervisor.submit(row, timeout_ms=timeout_ms)
         return self.batcher.submit(row, timeout_ms=timeout_ms)
@@ -246,6 +258,26 @@ class ScoringService:
             except Exception as exc:  # noqa: BLE001 — per-row reporting
                 slots[i] = _error_result(exc)
         return slots
+
+    # -- fleet quota seams (serving/fleet.py) -------------------------------
+    def demand_snapshot(self) -> dict:
+        """Cumulative per-tenant offered-request counts (pre-admission).
+        The fleet LeaseClient differences successive snapshots into
+        demand rates for the QuotaCoordinator."""
+        with self._demand_lock:
+            return {t: n for t, n in self._demand.items() if t is not None}
+
+    def set_tenant_quota(
+        self, tenant: str, rate_rps, burst=None
+    ) -> None:
+        """Apply a quota lease to this host's admission buckets —
+        through the supervisor (which splits the host rate across
+        replicas and replays it on restart) or straight onto the one
+        batcher."""
+        if self.supervisor is not None:
+            self.supervisor.set_tenant_quota(tenant, rate_rps, burst)
+        else:
+            self.batcher.set_tenant_quota(tenant, rate_rps, burst)
 
     # -- observability -----------------------------------------------------
     def readiness(self) -> tuple[bool, str]:

@@ -163,6 +163,13 @@ class ReplicaSupervisor:
         #: tenant-generation registry replays routes into respawned
         #: workers instead.  Written only under _lock.
         self._tenant_factories: dict = {}
+        #: tenant → (rate_rps, burst) live quota overrides (fleet lease
+        #: apply path, serving/fleet.py) — HOST-level rates, split
+        #: evenly across replicas because each replica admits with its
+        #: own bucket.  Replayed into every rebuilt replica so a
+        #: restart comes back under the live lease, not the static
+        #: spec.  Written only under _lock.
+        self._quota_overrides: dict = {}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ReplicaSupervisor":
@@ -231,7 +238,47 @@ class ReplicaSupervisor:
                 rt.model_version = version
                 rt.model_path = path
                 batcher.set_tenant_route(tenant, rt)
+        with self._lock:
+            overrides = dict(self._quota_overrides)
+        for tenant, (rate, burst) in overrides.items():
+            try:
+                batcher.set_tenant_quota(
+                    tenant, *self._per_replica_quota(rate, burst)
+                )
+            except Exception:  # noqa: BLE001 — next lease re-applies
+                pass
         return _Replica(rid=rid, batcher=batcher)
+
+    def _per_replica_quota(self, rate, burst) -> tuple:
+        """A host-level lease split evenly across this host's replicas
+        (admission is per-bucket, so N buckets at R/N enforce R — the
+        same sizing precedent as per-worker quota specs)."""
+        n = max(1, self.n_replicas)
+        per_rate = None if rate is None else float(rate) / n
+        per_burst = None if burst is None else max(1.0, float(burst) / n)
+        return per_rate, per_burst
+
+    def set_tenant_quota(
+        self, tenant: str, rate_rps, burst=None
+    ) -> None:
+        """Apply a HOST-level tenant quota across every replica (fleet
+        lease apply path).  Raises if no replica accepted it — e.g. an
+        undeclared tenant; partial application heals at the next lease
+        renewal, which re-applies the full rate set."""
+        with self._lock:
+            self._quota_overrides[tenant] = (rate_rps, burst)
+            replicas = list(self.replicas)
+        per_rate, per_burst = self._per_replica_quota(rate_rps, burst)
+        applied = 0
+        last_exc: Optional[Exception] = None
+        for rep in replicas:
+            try:
+                rep.batcher.set_tenant_quota(tenant, per_rate, per_burst)
+                applied += 1
+            except Exception as exc:  # noqa: BLE001 — count failures
+                last_exc = exc
+        if applied == 0 and last_exc is not None:
+            raise last_exc
 
     # -- routing (any thread) ------------------------------------------------
     def _healthy(self) -> list[_Replica]:
@@ -511,6 +558,18 @@ class ReplicaSupervisor:
                 retry_in_s=round(delay, 4),
             )
             return
+        # Restarted replicas come back under the LIVE quota lease, not
+        # the static spec (serving/fleet.py); a failed apply heals at
+        # the next lease renewal.
+        with self._lock:
+            overrides = dict(self._quota_overrides)
+        for tenant, (rate, burst) in overrides.items():
+            try:
+                batcher.set_tenant_quota(
+                    tenant, *self._per_replica_quota(rate, burst)
+                )
+            except Exception:  # noqa: BLE001 — next lease re-applies
+                pass
         with self._lock:
             rep.batcher = batcher
             rep.state = "healthy"

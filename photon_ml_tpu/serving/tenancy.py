@@ -208,6 +208,23 @@ class TokenBucket:
     def tokens(self) -> float:
         return self._tokens
 
+    def reset_rate(
+        self, rate_rps: Optional[float], burst: Optional[float] = None
+    ) -> None:
+        """Re-rate the bucket in place (quota lease updates,
+        serving/fleet.py).  Tokens only ever CLAMP down to the new
+        burst, never refill up — a lease shrink takes effect on the
+        very next acquire, and a grow never mints admission credit the
+        old rate did not earn."""
+        if rate_rps is not None and rate_rps < 0:
+            raise ValueError(f"rate_rps must be >= 0 or None, got {rate_rps}")
+        if burst is not None:
+            if burst <= 0:
+                raise ValueError(f"burst must be > 0, got {burst}")
+            self.burst = float(burst)
+        self.rate_rps = rate_rps
+        self._tokens = min(self._tokens, self.burst)
+
     def snapshot(self) -> dict:
         return {
             "rate_rps": self.rate_rps,

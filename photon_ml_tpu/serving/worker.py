@@ -335,6 +335,26 @@ class _WorkerMain:
                         "kind": "result", "id": message.get("id"),
                         "ok": True, "value": self._stats(),
                     })
+                elif kind == "set_quota":
+                    # Fleet quota lease landing on this worker's batcher
+                    # (serving/fleet.py): process-mode admission runs
+                    # HERE, so the lease must cross the wire to bite.
+                    try:
+                        self._batcher.set_tenant_quota(
+                            message["tenant"],
+                            message.get("rate_rps"),
+                            message.get("burst"),
+                        )
+                        self._send({
+                            "kind": "result", "id": message.get("id"),
+                            "ok": True, "value": True,
+                        })
+                    except Exception as exc:  # noqa: BLE001 — report
+                        self._send({
+                            "kind": "result", "id": message.get("id"),
+                            "ok": False, "error": str(exc),
+                            "error_kind": "bad_request",
+                        })
                 elif kind == "swap_prepare":
                     self._handle_swap_prepare(message)
                 elif kind == "swap_commit":

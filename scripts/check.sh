@@ -86,7 +86,9 @@ if [[ "${1:-}" == "--fast" ]]; then
   # every other streamed number rests on.  The transfer-avoidance smoke
   # repeats the same 4-chunk parity with compressed wire chunks + the
   # hot working-set cache enabled.  test_chaos's kill/resume
-  # boundary matrices are the fast recovery smoke.
+  # boundary matrices are the fast recovery smoke.  The fleet smoke is
+  # a 2-host router with a scripted host kill under in-flight load:
+  # zero failed requests, the killed host rejoins.
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_ops_plane.py \
     tests/test_watchdog.py \
@@ -95,6 +97,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     tests/test_tuning.py tests/test_chaos.py \
     "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
     "tests/test_streaming.py::TestTransferAvoidance::test_fast_lane_compressed_cached_parity" \
+    "tests/test_serving_fleet.py::TestFleetRouter::test_host_kill_under_load_costs_zero_failures" \
     -m 'not slow' -q -p no:cacheprovider
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

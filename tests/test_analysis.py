@@ -368,6 +368,67 @@ class TestChaosSiteSync:
         assert run_rules(tree, [RULES_BY_ID["chaos-site-sync"]]) == []
 
 
+class TestChaosSiteTested:
+    CORE = (
+        "KNOWN_SITES = {\n"
+        "    'a.one': 'first seam',\n"
+        "    'a.two': 'second seam',\n"
+        "}\n"
+    )
+
+    def _tree(self, tmp_path, test_src=None) -> SourceTree:
+        core = tmp_path / "photon_ml_tpu" / "chaos" / "core.py"
+        core.parent.mkdir(parents=True)
+        core.write_text(self.CORE)
+        if test_src is not None:
+            tests = tmp_path / "tests"
+            tests.mkdir()
+            (tests / "test_mod.py").write_text(test_src)
+        return SourceTree(
+            roots=[str(tmp_path / "photon_ml_tpu")],
+            repo_root=str(tmp_path),
+        )
+
+    def test_flags_site_no_test_references(self, tmp_path):
+        tree = self._tree(tmp_path, (
+            "from photon_ml_tpu import chaos\n"
+            "def test_one():\n"
+            "    chaos.FaultSpec(site='a.one', at=0)\n"
+        ))
+        found = run_rules(tree, [RULES_BY_ID["chaos-site-tested"]])
+        assert len(found) == 1
+        assert "'a.two'" in found[0].message
+        assert "no test file references it" in found[0].message
+
+    def test_silent_when_every_site_referenced(self, tmp_path):
+        # Either quote style counts — the reference is textual on
+        # purpose (FaultSpec args, plan JSON, parametrize ids all
+        # count as exercising the site).
+        tree = self._tree(tmp_path, (
+            "def test_both():\n"
+            "    plan(['a.one'])\n"
+            '    assert fired("a.two")\n'
+        ))
+        assert run_rules(
+            tree, [RULES_BY_ID["chaos-site-tested"]]
+        ) == []
+
+    def test_silent_without_tests_dir(self, tmp_path):
+        # Rule fixtures (and vendored subsets) have no tests/ tree:
+        # nothing to cross-reference, nothing to flag.
+        tree = self._tree(tmp_path, test_src=None)
+        assert run_rules(
+            tree, [RULES_BY_ID["chaos-site-tested"]]
+        ) == []
+
+    def test_live_registry_fully_tested(self):
+        # The real repo must hold the invariant the rule enforces:
+        # every KNOWN_SITES entry is exercised by some test.
+        assert run_rules(
+            SourceTree(), [RULES_BY_ID["chaos-site-tested"]]
+        ) == []
+
+
 class TestMetricNaming:
     def test_flags_bad_names_and_kind_conflict(self, tmp_path):
         found = _findings(tmp_path, "metric-naming", (

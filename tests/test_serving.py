@@ -433,3 +433,67 @@ class TestSelfcheckAndLoadGen:
                 rate_rps=100.0, duration_s=1.0,
             )
         assert report.completed > 0 and report.errors == 0
+
+
+class TestTenantReport:
+    """--tenant-report: the per-tenant accounting summary built from a
+    metrics_ts.jsonl time series (docs/serving.md "Tenancy")."""
+
+    def _write(self, path, records):
+        with open(path, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+
+    def test_summarizes_rates_and_percentiles(self, tmp_path):
+        from photon_ml_tpu.serving.__main__ import tenant_report
+
+        path = tmp_path / "metrics_ts.jsonl"
+        first = {
+            "seq": 0, "t_wall": 0.0, "t_mono": 10.0,
+            "counters": {
+                "serving_tenant_acme_requests_total": 100,
+                "serving_tenant_acme_shed_total": 5,
+                "serving_tenant_acme_rejected_total": 1,
+            },
+            "gauges": {}, "histograms": {},
+        }
+        last = {
+            "seq": 1, "t_wall": 4.0, "t_mono": 14.0,
+            "counters": {
+                "serving_tenant_acme_requests_total": 300,
+                "serving_tenant_acme_shed_total": 25,
+                "serving_tenant_acme_rejected_total": 3,
+                # Appears mid-series: deltas fall back to 0 baseline.
+                "serving_tenant_free_tier_requests_total": 40,
+            },
+            "gauges": {},
+            "histograms": {
+                "serving_tenant_acme_request_latency_seconds": {
+                    "count": 295, "p50": 0.004, "p99": 0.020,
+                },
+            },
+        }
+        self._write(path, [first, last])
+
+        report = tenant_report(str(path))
+        assert report["records"] == 2
+        assert report["span_seconds"] == 4.0
+        assert sorted(report["tenants"]) == ["acme", "free_tier"]
+        acme = report["tenants"]["acme"]
+        assert acme["requests"] == 200 and acme["rps"] == 50.0
+        assert acme["shed"] == 20 and acme["shed_rps"] == 5.0
+        assert acme["rejected"] == 2
+        assert acme["completed"] == 295
+        assert acme["latency_p50_ms"] == 4.0
+        assert acme["latency_p99_ms"] == 20.0
+        free = report["tenants"]["free_tier"]
+        assert free["requests"] == 40 and free["rps"] == 10.0
+        assert free["latency_p99_ms"] is None
+
+    def test_empty_series_raises(self, tmp_path):
+        from photon_ml_tpu.serving.__main__ import tenant_report
+
+        path = tmp_path / "metrics_ts.jsonl"
+        path.write_text("")
+        with pytest.raises(ValueError, match="no time-series records"):
+            tenant_report(str(path))

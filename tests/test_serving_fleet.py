@@ -1,0 +1,413 @@
+"""Fleet serving tests (ISSUE 15): partition-tolerant multi-host
+router + lease-based fleet-wide quota coordination.
+
+The load-bearing contracts:
+
+- a host killed as it picks up a request is marked DOWN and the request
+  resubmits to a peer: a host kill under load costs ZERO failed
+  requests (the ``serving.host`` chaos seam);
+- reconnect backoff is a decorrelated walk that RESETS after sustained
+  health — a host that flaps through repeated kill bursts re-escalates
+  from base each time, it does not inherit the previous burst's delay;
+- the coordinator never grants more than the budget across all live
+  leases, rebalances to observed demand within one renewal round per
+  host, and reclaims a dead host's share the moment its lease expires;
+- a host that cannot reach the coordinator (the ``quota.lease`` seam or
+  the scripted ``partitioned`` flag) degrades to its LAST lease — never
+  unlimited, never zero — so a partition bounds fleet over-admission to
+  one lease window.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import chaos
+from photon_ml_tpu.serving.batcher import BatcherConfig, RejectedError
+from photon_ml_tpu.serving.fleet import (
+    FleetBudget,
+    FleetRouter,
+    LeaseClient,
+    LocalHost,
+    QuotaCoordinator,
+)
+from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+from photon_ml_tpu.serving.service import ScoringService
+from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+from photon_ml_tpu.serving.tenancy import TokenBucket
+
+
+@pytest.fixture(scope="module")
+def workload():
+    return SyntheticWorkload(n_entities=32, seed=7)
+
+
+def _service(workload):
+    cfg = RuntimeConfig(max_batch_size=8, hot_entities=8)
+    runtime = ScoringRuntime(workload.model, workload.index_maps, cfg)
+    return ScoringService(runtime, BatcherConfig(
+        max_batch_size=8, max_wait_us=1000, max_queue=256,
+    ))
+
+
+def _wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class _Fleet:
+    """n hosts + one router, torn down in reverse order."""
+
+    def __init__(self, workload, n_hosts=2, **router_kwargs):
+        self.hosts = [
+            LocalHost(f"h{i}", _service(workload)).start()
+            for i in range(n_hosts)
+        ]
+        kwargs = {"probe_interval_s": 0.05, **router_kwargs}
+        self.router = FleetRouter(
+            [h.base_url for h in self.hosts], **kwargs
+        ).start()
+
+    def __enter__(self) -> "_Fleet":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.router.stop()
+        for h in self.hosts:
+            h.stop()
+        return False
+
+
+class TestFleetRouter:
+    def test_scores_and_balances_across_hosts(self, workload):
+        with _Fleet(workload) as fleet:
+            results = [
+                fleet.router.score(workload.request(i))
+                for i in range(8)
+            ]
+            assert all(np.isfinite(r["score"]) for r in results)
+            hz = fleet.router.healthz()
+            assert hz["status"] == "ok"
+            assert all(h["requests"] > 0 for h in hz["hosts"])
+
+    def test_host_kill_under_load_costs_zero_failures(self, workload):
+        with _Fleet(workload) as fleet:
+            futures = [
+                fleet.router.submit(workload.request(i))
+                for i in range(16)
+            ]
+            fleet.hosts[0].kill()
+            futures += [
+                fleet.router.submit(workload.request(i))
+                for i in range(16, 48)
+            ]
+            results = [f.result(timeout=30) for f in futures]
+            assert all(np.isfinite(r["score"]) for r in results)
+            # The killed host's listener rebinds and rejoins.
+            fleet.hosts[0].restart()
+            assert _wait_until(
+                lambda: fleet.router.healthy_count == 2
+            ), fleet.router.healthz()
+
+    def test_chaos_host_site_marks_down_and_resubmits(self, workload):
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="serving.host", at=0),
+        ])
+        with _Fleet(workload, probe_interval_s=10.0) as fleet:
+            with plan:
+                result = fleet.router.score(workload.request(0))
+            assert np.isfinite(result["score"])
+            assert plan.fired and \
+                plan.fired[0]["site"] == "serving.host"
+            # The victim is out of rotation awaiting reconnect probes.
+            assert fleet.router.healthy_count == 1
+
+    def test_no_healthy_host_is_a_transient_rejection(self, workload):
+        """With the whole fleet down, a request waits out the no-host
+        retry window (reconnect probes might restore someone), then
+        fails with the transient vocabulary."""
+        with _Fleet(
+            workload, n_hosts=1, no_host_retry_s=0.2
+        ) as fleet:
+            fleet.hosts[0].kill()
+            with pytest.raises((RejectedError, RuntimeError)) as exc:
+                fleet.router.score(workload.request(0), timeout=10)
+            assert "UNAVAILABLE" in str(exc.value)
+            assert fleet.router.healthy_count == 0
+
+    def test_whole_fleet_blip_delays_instead_of_failing(self, workload):
+        """EVERY host momentarily unreachable: requests in the window
+        wait for reconnect probes and still complete (the host-kill
+        zero-failures contract extends to total blips shorter than
+        ``no_host_retry_s``)."""
+        with _Fleet(workload, n_hosts=1) as fleet:
+            fleet.hosts[0].kill()
+            # Trip the transport failure so the host is marked down.
+            fut = fleet.router.submit(workload.request(0))
+            restorer = threading.Timer(0.3, fleet.hosts[0].restart)
+            restorer.start()
+            try:
+                assert np.isfinite(fut.result(timeout=30)["score"])
+            finally:
+                restorer.join()
+
+    def test_drain_removes_host_without_dropping_requests(
+        self, workload
+    ):
+        with _Fleet(workload) as fleet:
+            assert fleet.router.drain(0, timeout_s=10.0)
+            before = fleet.router.healthz()
+            drained = next(
+                h for h in before["hosts"] if h["hid"] == 0
+            )
+            assert drained["state"] == "removed"
+            for i in range(6):
+                r = fleet.router.score(workload.request(i))
+                assert np.isfinite(r["score"])
+            after = fleet.router.healthz()
+            assert (
+                next(h for h in after["hosts"] if h["hid"] == 0)[
+                    "requests"
+                ] == drained["requests"]
+            )
+            with pytest.raises(ValueError, match="unknown host id"):
+                fleet.router.drain(99)
+
+    def test_reconnect_backoff_resets_after_sustained_health(
+        self, workload
+    ):
+        """Satellite: repeated HOST-level failure bursts.  The backoff
+        walk escalates while a host stays dead, resets to base once
+        probes see sustained health, and the NEXT burst escalates from
+        base again instead of inheriting the previous burst's delay."""
+        with _Fleet(workload) as fleet:
+            host = fleet.router.hosts[0]
+
+            def burst():
+                fleet.hosts[0].kill()
+                # A request trips the transport failure -> mark down.
+                assert np.isfinite(
+                    fleet.router.score(workload.request(0))["score"]
+                )
+                assert _wait_until(lambda: host.state == "down")
+                # Reconnect probes keep failing: the walk escalates.
+                assert _wait_until(
+                    lambda: host.reconnect_attempt >= 3, timeout=20.0
+                ), fleet.router.healthz()
+                first_delay = host.last_delay
+                assert first_delay is not None and first_delay > 0
+                fleet.hosts[0].restart()
+                assert _wait_until(lambda: host.state == "healthy")
+                # Sustained health resets the walk (healthy probes run
+                # every probe_interval_s).
+                assert _wait_until(
+                    lambda: host.reconnect_attempt == 0
+                    and host.last_delay is None
+                ), fleet.router.healthz()
+
+            burst()  # burst 1: escalate, recover, reset
+            burst()  # burst 2: must re-escalate from a reset walk
+
+
+class TestQuotaCoordinator:
+    def _clock(self, start=100.0):
+        state = {"t": start}
+
+        def clock():
+            return state["t"]
+
+        return state, clock
+
+    def test_outstanding_never_exceeds_budget(self):
+        state, clock = self._clock()
+        coord = QuotaCoordinator(
+            [FleetBudget("t", 100.0)], lease_ttl_s=1.0, clock=clock
+        )
+        for rnd in range(6):
+            for host, demand in (("a", 10.0), ("b", 90.0), ("c", 40.0)):
+                coord.renew(host, {"t": demand})
+                outstanding = coord.stats()["tenants"]["t"][
+                    "outstanding_rps"
+                ]
+                assert outstanding <= 100.0 + 1e-6, coord.stats()
+            state["t"] += 0.4  # inside the TTL: nothing expires
+
+    def test_rebalance_converges_to_demand_in_one_round(self):
+        state, clock = self._clock()
+        coord = QuotaCoordinator(
+            [FleetBudget("t", 100.0, min_share=0.1)],
+            lease_ttl_s=5.0, clock=clock,
+        )
+        # First renewer is the only live host: it holds the whole
+        # budget until a peer shows up.
+        assert coord.renew("a", {"t": 10.0})["t"].rate_rps == \
+            pytest.approx(100.0)
+        # b's target is demand-proportional but the budget is spoken
+        # for — it gets the leftovers (zero), never over-commits.
+        assert coord.renew("b", {"t": 30.0})["t"].rate_rps == \
+            pytest.approx(0.0)
+        # One more renewal each converges to floor + proportional:
+        # floor 5 each, variable 90 split 10:30 -> 27.5 / 72.5.
+        assert coord.renew("a", {"t": 10.0})["t"].rate_rps == \
+            pytest.approx(27.5)
+        assert coord.renew("b", {"t": 30.0})["t"].rate_rps == \
+            pytest.approx(72.5)
+        assert coord.rebalances >= 2
+
+    def test_equal_split_at_zero_demand(self):
+        _, clock = self._clock()
+        coord = QuotaCoordinator(
+            [FleetBudget("t", 60.0)], lease_ttl_s=5.0, clock=clock
+        )
+        coord.renew("a", {})
+        coord.renew("b", {})
+        assert coord.renew("a", {})["t"].rate_rps == pytest.approx(30.0)
+        assert coord.renew("b", {})["t"].rate_rps == pytest.approx(30.0)
+
+    def test_dead_host_share_reclaimed_after_ttl(self):
+        state, clock = self._clock()
+        coord = QuotaCoordinator(
+            [FleetBudget("t", 100.0)], lease_ttl_s=1.0, clock=clock
+        )
+        assert coord.renew("a", {"t": 50.0})["t"].rate_rps == \
+            pytest.approx(100.0)
+        # a dies (stops renewing); its lease expires...
+        state["t"] += 1.5
+        # ...and b's next renewal reclaims the whole budget.
+        assert coord.renew("b", {"t": 50.0})["t"].rate_rps == \
+            pytest.approx(100.0)
+        assert coord.reclaims == 1
+        assert coord.stats()["tenants"]["t"]["outstanding_rps"] == \
+            pytest.approx(100.0)
+
+
+class _FakeService:
+    """The two methods LeaseClient needs, with an applied-quota log."""
+
+    def __init__(self):
+        self.demand = {}
+        self.applied = []
+
+    def demand_snapshot(self):
+        return dict(self.demand)
+
+    def set_tenant_quota(self, tenant, rate_rps, burst=None):
+        self.applied.append((tenant, rate_rps, burst))
+
+
+class TestLeaseClient:
+    def test_poll_applies_granted_lease(self):
+        coord = QuotaCoordinator([FleetBudget("t", 50.0)])
+        svc = _FakeService()
+        lc = LeaseClient("h0", coord, svc)
+        assert lc.poll_once()
+        assert lc.leases["t"].rate_rps == pytest.approx(50.0)
+        assert svc.applied == [("t", pytest.approx(50.0),
+                                pytest.approx(50.0))]
+        assert not lc.stale
+
+    def test_partition_degrades_to_last_lease(self):
+        """The partition contract: on renewal failure the LAST lease
+        keeps enforcing — never unlimited, never zero."""
+        coord = QuotaCoordinator([FleetBudget("t", 50.0)])
+        svc = _FakeService()
+        lc = LeaseClient("h0", coord, svc)
+        assert lc.poll_once()
+        applied_before = list(svc.applied)
+        lease_before = lc.leases["t"]
+
+        lc.partitioned = True
+        assert not lc.poll_once()
+        assert lc.stale
+        assert lc.renew_failures == 1
+        # Buckets untouched: no new set_tenant_quota, no zeroing, and
+        # the remembered lease still carries a bounded nonzero rate.
+        assert svc.applied == applied_before
+        assert lc.leases["t"] is lease_before
+        assert 0 < lc.leases["t"].rate_rps <= 50.0
+
+        lc.partitioned = False
+        assert lc.poll_once()
+        assert not lc.stale
+        assert len(svc.applied) > len(applied_before)
+
+    def test_chaos_lease_site_degrades_then_recovers(self):
+        coord = QuotaCoordinator([FleetBudget("t", 50.0)])
+        svc = _FakeService()
+        lc = LeaseClient("h0", coord, svc)
+        assert lc.poll_once()
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="quota.lease", at=0, count=1),
+        ])
+        with plan:
+            assert not lc.poll_once()  # scripted partition fires
+            assert lc.stale
+            assert lc.poll_once()  # next renewal heals
+        assert plan.fired and plan.fired[0]["site"] == "quota.lease"
+        assert not lc.stale
+        assert lc.renew_failures == 1
+
+    def test_demand_rates_difference_counters(self):
+        times = iter([0.0, 2.0])
+        coord = QuotaCoordinator([FleetBudget("t", 50.0)])
+        svc = _FakeService()
+        lc = LeaseClient(
+            "h0", coord, svc, clock=lambda: next(times)
+        )
+        svc.demand = {"t": 10}
+        lc.poll_once()  # first poll: no interval yet -> zero rate
+        svc.demand = {"t": 50}
+        lc.poll_once()
+        # 40 offered requests over 2s -> 20 rps observed demand.
+        grant = coord.stats()["tenants"]["t"]["hosts"]["h0"]
+        assert grant["demand_rps"] == pytest.approx(20.0)
+
+
+class TestTokenBucketReset:
+    def test_reset_clamps_tokens_down_never_refills_up(self):
+        times = iter([0.0, 0.0, 0.0, 0.0, 0.0])
+        bucket = TokenBucket(
+            100.0, burst=100.0, clock=lambda: next(times)
+        )
+        assert bucket.try_acquire(60.0)  # 40 tokens left
+        bucket.reset_rate(10.0, burst=5.0)
+        # Shrinking the burst clamps stored tokens down with it...
+        assert bucket.tokens <= 5.0
+        bucket.reset_rate(200.0, burst=100.0)
+        # ...but raising the rate never mints tokens retroactively.
+        assert bucket.tokens <= 5.0
+
+    def test_reset_to_none_is_unlimited(self):
+        bucket = TokenBucket(1.0, burst=1.0)
+        bucket.reset_rate(None)
+        assert all(bucket.try_acquire() for _ in range(100))
+
+    def test_reset_rejects_bad_values(self):
+        bucket = TokenBucket(10.0, burst=10.0)
+        with pytest.raises(ValueError):
+            bucket.reset_rate(-1.0)
+        with pytest.raises(ValueError):
+            bucket.reset_rate(10.0, burst=0.0)
+
+
+class TestServiceQuotaSurface:
+    def test_set_tenant_quota_requires_tenancy(self, workload):
+        service = _service(workload)
+        with service:
+            with pytest.raises(ValueError):
+                service.set_tenant_quota("acme", 10.0)
+
+    def test_demand_counts_offered_not_admitted(self, workload):
+        service = _service(workload)
+        with service:
+            req = dict(workload.request(0))
+            req["tenant"] = "acme"
+            for _ in range(5):
+                service.submit(req).result(timeout=30)
+            assert service.demand_snapshot().get("acme") == 5

@@ -579,3 +579,122 @@ class TestChunkCodec:
         with pytest.raises(ValueError, match="compress must be one of"):
             plan_compression(stream.staging, stream.staged, "zstd")
         assert set(COMPRESSION_MODES) == {"off", "lossless", "fp16", "int8"}
+
+
+class TestChunkCodecWideFloats:
+    """f64 and bf16 lossless planning: bitmaps for bitwise-{0,1} blocks,
+    an f32 wire for f64 blocks whose every value round-trips bitwise,
+    raw for everything else — the lossless guarantee stays strict."""
+
+    def _roundtrip(self, chunk, mode="lossless"):
+        from photon_ml_tpu.data.staging import plan_compression
+
+        st = plan_staging(chunk, 1)
+        staged = [pack_chunk(st, chunk)]
+        codec = plan_compression(st, staged, mode)
+        got = jax.tree_util.tree_leaves(
+            jax.jit(codec.unpack_device)(
+                jax.device_put(codec.encode(staged[0]))
+            )
+        )
+        ref = jax.tree_util.tree_leaves(
+            jax.jit(st.unpack_device)(jax.device_put(staged[0]))
+        )
+        return codec, got, ref
+
+    def test_f64_binary_slot_bitmaps_bitwise(self):
+        chunk = {
+            "mask": np.array([0.0, 1.0, 1.0, 0.0, 1.0], np.float64),
+            "v": np.linspace(-1, 1, 8, dtype=np.float32),
+        }
+        codec, got, ref = self._roundtrip(chunk)
+        kinds = {
+            s.size: e.kind
+            for s, e in zip(codec.staging.slots, codec.encodings)
+        }
+        assert kinds[5] == "bitmap"
+        assert codec.is_lossless
+        assert codec.wire_nbytes < codec.logical_nbytes
+        for g, r in zip(got, ref):
+            assert g.dtype == r.dtype and g.shape == r.shape
+            assert np.asarray(g).tobytes() == np.asarray(r).tobytes()
+
+    def test_f64_bitmap_rejects_negative_zero(self):
+        from photon_ml_tpu.data.staging import plan_compression
+
+        # -0.0 must refuse the BITMAP (its decode emits +0.0, a bit
+        # flip) — but it survives an f32 wire bitwise, so the planner
+        # may still take the downcast; the sign bit rides along.
+        bad = {"mask": np.array([-0.0, 1.0, 0.0], np.float64)}
+        st = plan_staging(bad, 1)
+        codec = plan_compression(st, [pack_chunk(st, bad)], "lossless")
+        assert codec.encodings[0].kind == "downcast"
+        wire = codec.encode(pack_chunk(st, bad))[0]
+        assert np.signbit(wire.astype(np.float64)[0, 0])
+
+    def test_f64_downcasts_to_f32_wire_when_bitwise_exact(self):
+        # Every value exactly representable in f32: the codec must take
+        # the half-width wire, and the WIRE itself must reconstruct the
+        # f64 bit patterns (host check — device canonicalization may
+        # narrow f64 anyway when x64 is off).
+        vals = np.array([1.0, -0.5, 2.75, 1024.0, -3.125], np.float64)
+        chunk = {"offs": vals.copy()}
+        codec, got, ref = self._roundtrip(chunk)
+        assert codec.encodings[0].kind == "downcast"
+        assert codec.wire_dtypes[codec.encodings[0].wire_buffer] == (
+            np.dtype(np.float32)
+        )
+        assert codec.is_lossless
+        wire = codec.encode([pack_chunk(
+            codec.staging, chunk
+        )[0]])[codec.encodings[0].wire_buffer]
+        back = wire.astype(np.float64)
+        assert back.tobytes() == np.ascontiguousarray(
+            vals.reshape(1, -1)
+        ).tobytes()
+        for g, r in zip(got, ref):
+            assert np.asarray(g).tobytes() == np.asarray(r).tobytes()
+
+    def test_f64_needing_full_mantissa_stays_raw(self):
+        from photon_ml_tpu.data.staging import plan_compression
+
+        # 0.1 and 1 + 2**-40 do NOT survive an f32 round-trip bitwise.
+        chunk = {"offs": np.array([0.1, 1.0 + 2.0 ** -40], np.float64)}
+        st = plan_staging(chunk, 1)
+        codec = plan_compression(st, [pack_chunk(st, chunk)], "lossless")
+        assert codec.encodings[0].kind == "raw"
+        assert codec.is_lossless  # raw is still bitwise
+
+    def test_bf16_binary_slot_bitmaps_bitwise(self):
+        import ml_dtypes
+
+        bf16 = ml_dtypes.bfloat16
+        chunk = {
+            "mask": np.array([0.0, 1.0, 0.0, 1.0, 1.0, 0.0], bf16),
+            "v": np.ones(4, np.float32),
+        }
+        codec, got, ref = self._roundtrip(chunk)
+        kinds = {
+            s.size: e.kind
+            for s, e in zip(codec.staging.slots, codec.encodings)
+        }
+        assert kinds[6] == "bitmap"
+        assert codec.is_lossless
+        for g, r in zip(got, ref):
+            assert g.dtype == r.dtype and g.shape == r.shape
+            assert np.asarray(g).tobytes() == np.asarray(r).tobytes()
+
+    def test_bf16_general_values_stay_raw(self):
+        import ml_dtypes
+
+        from photon_ml_tpu.data.staging import plan_compression
+
+        bf16 = ml_dtypes.bfloat16
+        chunk = {"v": np.array([0.25, 3.0, -1.5], bf16)}
+        st = plan_staging(chunk, 1)
+        codec = plan_compression(st, [pack_chunk(st, chunk)], "lossless")
+        assert codec.encodings[0].kind == "raw"
+        neg = {"v": np.array([-0.0, 1.0], bf16)}
+        st2 = plan_staging(neg, 1)
+        codec2 = plan_compression(st2, [pack_chunk(st2, neg)], "lossless")
+        assert codec2.encodings[0].kind == "raw"
