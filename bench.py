@@ -1339,6 +1339,7 @@ def bench_serving() -> dict:
             None if hot["hit_rate"] is None else round(hot["hit_rate"], 4)
         ),
     }
+    out.update(_bench_serving_wire(workload))
     out.update(_bench_serving_scenarios(workload))
     out.update(_bench_serving_process(workload))
     out.update(_bench_serving_tenancy(workload))
@@ -1597,6 +1598,164 @@ def _bench_serving_tenancy(workload) -> dict:
             ),
             f"{prefix}_aggressor_shed": gate["aggressor_shed"],
             f"{prefix}_isolation_pass": gate["pass"],
+        })
+    return out
+
+
+def _bench_serving_wire(workload) -> dict:
+    """Data-plane A/B (ISSUE 16): the same service, the same request
+    stream, measured over HTTP with persistent connections under both
+    wire formats, plus adaptive-vs-static micro-batching in process.
+
+    - ``serving_wire_{json,binary}_*``: closed-loop throughput and
+      open-loop p50/p99/p999 at a FIXED offered rate for the JSON
+      compatibility path vs the binary frame path.  The speedup ratio
+      is reported, not hard-gated (accelerator-dependent).
+    - ``serving_adaptive_*`` / ``serving_static_*``: open-loop latency
+      at the same offered rate with the coalescing wait sized by the
+      arrival-rate EWMA vs the static ``max_wait_us`` knob.
+    """
+    from photon_ml_tpu.serving import loadgen
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService, start_http_server
+
+    duration = 2.0 if SMALL else 5.0
+    clients = 16
+    rate = 300.0 if SMALL else 1000.0
+    out: dict = {}
+
+    def service():
+        return ScoringService(
+            ScoringRuntime(
+                workload.model, workload.index_maps,
+                RuntimeConfig(max_batch_size=64, hot_entities=4096),
+            ),
+            BatcherConfig(
+                max_batch_size=64, max_wait_us=1000, max_queue=1024,
+            ),
+        )
+
+    # -- JSON vs binary over HTTP ------------------------------------------
+    for fmt in ("json", "binary"):
+        svc = service()
+        with svc:
+            server, _ = start_http_server(svc, port=0)
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            try:
+                with loadgen.HttpSubmitter(
+                    base, wire_format=fmt, workers=clients * 2
+                ) as sub:
+                    loadgen.closed_loop(  # warmup
+                        sub.submit, workload.request,
+                        clients=4, duration_s=0.5,
+                    )
+                    closed = loadgen.closed_loop(
+                        sub.submit, workload.request,
+                        clients=clients, duration_s=duration,
+                    )
+                    fixed = loadgen.open_loop(
+                        sub.submit, workload.request,
+                        rate_rps=rate, duration_s=duration,
+                    )
+            finally:
+                server.shutdown()
+                server.server_close()
+        snap_c, snap_o = closed.snapshot(), fixed.snapshot()
+        _log(
+            f"serving wire[{fmt}]: {snap_c['throughput_rps']} rps closed"
+            f"-loop; open-loop @{rate:g} rps p50 "
+            f"{snap_o['latency_p50_ms']} / p99 {snap_o['latency_p99_ms']}"
+            f" / p99.9 {snap_o['latency_p999_ms']} ms"
+        )
+        out.update({
+            f"serving_wire_{fmt}_throughput_rps": snap_c["throughput_rps"],
+            f"serving_wire_{fmt}_open_p50_ms": snap_o["latency_p50_ms"],
+            f"serving_wire_{fmt}_open_p99_ms": snap_o["latency_p99_ms"],
+            f"serving_wire_{fmt}_open_p999_ms": snap_o["latency_p999_ms"],
+            f"serving_wire_{fmt}_errors": closed.errors + fixed.errors,
+        })
+    j = out["serving_wire_json_throughput_rps"]
+    b = out["serving_wire_binary_throughput_rps"]
+    out["serving_wire_speedup"] = round(b / j, 3) if j else None
+    _log(f"serving wire: binary/json throughput ratio "
+         f"{out['serving_wire_speedup']}")
+
+    # -- codec microbench: framing cost without socket noise ----------------
+    # The server-side work a request batch buys before scoring: encode
+    # on the client, decode + validate into Rows on the server.  This
+    # is where the binary format's zero-copy columns pay — JSON pays
+    # json.loads + per-row parse allocations.
+    import json as json_mod
+    import time as time_mod
+
+    from photon_ml_tpu.serving import wire as wire_mod
+
+    runtime = ScoringRuntime(
+        workload.model, workload.index_maps,
+        RuntimeConfig(max_batch_size=64, hot_entities=4096),
+    )
+    parser = runtime._parser
+    batch = [workload.request(i) for i in range(512)]
+    reps = 5 if SMALL else 20
+
+    def timed(fn) -> float:
+        fn()  # warm
+        t0 = time_mod.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time_mod.perf_counter() - t0) / reps
+
+    def json_path():
+        raw = json_mod.dumps({"rows": batch}).encode()
+        rows = json_mod.loads(raw)["rows"]
+        return [parser.parse(r) for r in rows]
+
+    def binary_path():
+        raw = wire_mod.encode_request(batch)
+        return wire_mod.decode_request(raw, parser)
+
+    t_json = timed(json_path)
+    t_bin = timed(binary_path)
+    out["serving_wire_codec_json_ms"] = round(t_json * 1e3, 3)
+    out["serving_wire_codec_binary_ms"] = round(t_bin * 1e3, 3)
+    out["serving_wire_codec_speedup"] = round(t_json / t_bin, 2)
+    _log(
+        f"serving wire codec (512 rows): json {t_json * 1e3:.2f} ms, "
+        f"binary {t_bin * 1e3:.2f} ms — {t_json / t_bin:.1f}x"
+    )
+
+    # -- adaptive vs static micro-batching ---------------------------------
+    for label, adaptive in (("static", False), ("adaptive", True)):
+        svc = ScoringService(
+            ScoringRuntime(
+                workload.model, workload.index_maps,
+                RuntimeConfig(max_batch_size=64, hot_entities=4096),
+            ),
+            BatcherConfig(
+                max_batch_size=64, max_wait_us=1000, max_queue=1024,
+                adaptive_wait=adaptive,
+            ),
+        )
+        with svc:
+            loadgen.open_loop(  # warmup
+                svc.submit, workload.request,
+                rate_rps=rate / 2, duration_s=0.5,
+            )
+            report = loadgen.open_loop(
+                svc.submit, workload.request,
+                rate_rps=rate, duration_s=duration,
+            )
+        snap = report.snapshot()
+        _log(
+            f"serving batching[{label}]: open-loop @{rate:g} rps p50 "
+            f"{snap['latency_p50_ms']} / p99 {snap['latency_p99_ms']} / "
+            f"p99.9 {snap['latency_p999_ms']} ms"
+        )
+        out.update({
+            f"serving_{label}_open_p50_ms": snap["latency_p50_ms"],
+            f"serving_{label}_open_p99_ms": snap["latency_p99_ms"],
+            f"serving_{label}_open_p999_ms": snap["latency_p999_ms"],
         })
     return out
 

@@ -49,6 +49,13 @@ TPU-native analogue of that request path over the batch stack:
   into POSIX shared memory with verified (sha256) attach, framed
   request/heartbeat protocol, cross-process hot swap
   (``--workers N``; docs/serving.md "Process mode").
+- :mod:`~photon_ml_tpu.serving.wire` — the binary data plane: fixed-
+  layout, versioned frames of dtype-tagged columns carrying requests,
+  responses, and worker-IPC messages with zero-copy decode and bitwise
+  score parity against the JSON path (docs/serving.md "Data plane").
+- :mod:`~photon_ml_tpu.serving.shm_ingress` — same-machine ingress: a
+  shared-memory slot ring carrying wire frames, skipping HTTP entirely
+  for co-located clients (``--shm-ingress``).
 
 ``python -m photon_ml_tpu.serving --selfcheck`` builds a synthetic GAME
 model, serves concurrent HTTP requests, and verifies batched results are
@@ -95,6 +102,15 @@ _LAZY = {
     "SwapInProgressError": (
         "photon_ml_tpu.serving.swap", "SwapInProgressError",
     ),
+    "WireFormatError": ("photon_ml_tpu.serving.wire", "WireFormatError"),
+    "ShmIngress": ("photon_ml_tpu.serving.shm_ingress", "ShmIngress"),
+    "ShmIngressClient": (
+        "photon_ml_tpu.serving.shm_ingress", "ShmIngressClient",
+    ),
+    "ShmIngressError": (
+        "photon_ml_tpu.serving.shm_ingress", "ShmIngressError",
+    ),
+    "HttpSubmitter": ("photon_ml_tpu.serving.loadgen", "HttpSubmitter"),
 }
 
 __all__ = sorted(_LAZY)

@@ -97,6 +97,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--max-queue", type=int, default=256)
     p.add_argument(
+        "--adaptive-wait", action="store_true",
+        help="size the coalescing wait from the arrival-rate EWMA "
+        "instead of always paying --max-wait-us (which becomes the "
+        "ceiling); docs/serving.md#data-plane",
+    )
+    p.add_argument(
+        "--shm-ingress", metavar="NAME", nargs="?", const="", default=None,
+        help="also serve same-machine clients over a shared-memory "
+        "ingress ring (skips HTTP entirely); optional segment NAME, "
+        "auto-generated when omitted",
+    )
+    p.add_argument(
         "--hot-entities", type=int, default=1024,
         help="per-coordinate LRU hot-set capacity (device-resident rows)",
     )
@@ -181,6 +193,7 @@ def _make_service(args):
         max_wait_us=args.max_wait_us,
         max_queue=args.max_queue,
         default_timeout_ms=args.timeout_ms,
+        adaptive_wait=args.adaptive_wait,
     )
     if args.workers:
         from photon_ml_tpu.serving.procpool import WorkerPool
@@ -1352,6 +1365,18 @@ def _run_service(args, service, workload) -> int:
             service, host=args.host, port=args.port
         )
         host, port = server.server_address[:2]
+        ingress = None
+        if args.shm_ingress is not None:
+            from photon_ml_tpu.serving.shm_ingress import ShmIngress
+
+            ingress = ShmIngress(
+                service, name=args.shm_ingress or None
+            ).start()
+            print(
+                f"shm ingress ring {ingress.name!r} "
+                f"({ingress.n_slots} slots x {ingress.slot_bytes} bytes)",
+                flush=True,
+            )
         print(
             f"serving on http://{host}:{port} "
             f"(/score /reload /healthz /livez /readyz /stats); "
@@ -1363,6 +1388,8 @@ def _run_service(args, service, workload) -> int:
         except KeyboardInterrupt:
             print("shutting down")
         finally:
+            if ingress is not None:
+                ingress.stop()
             server.shutdown()
             server.server_close()
     return 0

@@ -109,8 +109,28 @@ class BatcherConfig:
     max_batch_size: int = 64
     #: how long the dispatcher waits for more rows after the first one.
     #: 0 disables coalescing (every request scores alone — highest
-    #: throughput cost, lowest latency under no load).
+    #: throughput cost, lowest latency under no load).  With
+    #: ``adaptive_wait`` on this becomes the CEILING the adaptive
+    #: policy never exceeds (the static knob stays the override).
     max_wait_us: int = 2000
+    #: size the batch wait from the OBSERVED arrival rate instead of the
+    #: static ``max_wait_us``: an EWMA over inter-arrival times
+    #: estimates how long filling a batch would take; the dispatcher
+    #: waits that long when it is under ``max_wait_us`` (traffic dense
+    #: enough to fill a batch quickly) and drops to ``min_wait_us`` when
+    #: it is not (sparse traffic must not idle a request at the ceiling
+    #: for batch-mates that are not coming).  Bounded by
+    #: ``slo_wait_fraction`` of the tightest p99 SLO in play.
+    adaptive_wait: bool = False
+    #: adaptive-mode floor: the wait under sparse traffic (microseconds).
+    min_wait_us: int = 100
+    #: EWMA smoothing factor over inter-arrival times, in (0, 1];
+    #: higher = faster reaction to rate changes, lower = steadier waits.
+    wait_ewma_alpha: float = 0.2
+    #: adaptive waits never exceed this fraction of the tightest
+    #: configured p99 SLO (global ``p99_slo_ms`` and every tenant's) —
+    #: queueing time must leave the SLO room for scoring time.
+    slo_wait_fraction: float = 0.25
     #: bounded queue depth; submissions beyond it are REJECTED, not
     #: buffered (explicit backpressure beats silent latency collapse).
     max_queue: int = 256
@@ -137,6 +157,59 @@ class BatcherConfig:
     #: existed.  Frozen + picklable, so it rides the spawn args into
     #: process-mode workers unchanged (serving/worker.py).
     tenancy: Optional["tenancy_mod.TenancyConfig"] = None
+
+    def __post_init__(self) -> None:
+        # Pointed refusals at construction: a bad knob must name itself
+        # here, not surface later as a hang (max_batch_size=0 would
+        # dispatch nothing), a busy-spin (negative waits), or a queue
+        # that admits nothing (inverted watermarks).
+        if self.max_batch_size < 1:
+            raise ValueError(
+                f"max_batch_size must be >= 1, got {self.max_batch_size}"
+            )
+        if self.max_wait_us < 0:
+            raise ValueError(
+                f"max_wait_us must be >= 0, got {self.max_wait_us}"
+            )
+        if self.max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1, got {self.max_queue}"
+            )
+        if not (0.0 < self.shed_watermark <= self.reject_watermark <= 1.0):
+            raise ValueError(
+                "need 0 < shed_watermark <= reject_watermark <= 1, got "
+                f"{self.shed_watermark} / {self.reject_watermark}"
+            )
+        if self.default_timeout_ms is not None \
+                and self.default_timeout_ms <= 0:
+            raise ValueError(
+                "default_timeout_ms must be positive (or None), got "
+                f"{self.default_timeout_ms}"
+            )
+        if self.p99_slo_ms is not None and self.p99_slo_ms <= 0:
+            raise ValueError(
+                f"p99_slo_ms must be positive (or None), got "
+                f"{self.p99_slo_ms}"
+            )
+        if self.admission_interval_s < 0:
+            raise ValueError(
+                "admission_interval_s must be >= 0, got "
+                f"{self.admission_interval_s}"
+            )
+        if self.min_wait_us < 0:
+            raise ValueError(
+                f"min_wait_us must be >= 0, got {self.min_wait_us}"
+            )
+        if not (0.0 < self.wait_ewma_alpha <= 1.0):
+            raise ValueError(
+                f"wait_ewma_alpha must be in (0, 1], got "
+                f"{self.wait_ewma_alpha}"
+            )
+        if not (0.0 < self.slo_wait_fraction <= 1.0):
+            raise ValueError(
+                f"slo_wait_fraction must be in (0, 1], got "
+                f"{self.slo_wait_fraction}"
+            )
 
 
 @dataclasses.dataclass
@@ -204,11 +277,6 @@ class MicroBatcher:
             cfg = dataclasses.replace(
                 cfg, max_batch_size=runtime.buckets[-1]
             )
-        if not (0.0 < cfg.shed_watermark <= cfg.reject_watermark <= 1.0):
-            raise ValueError(
-                "need 0 < shed_watermark <= reject_watermark <= 1, got "
-                f"{cfg.shed_watermark} / {cfg.reject_watermark}"
-            )
         # NOTE ``self.runtime`` is re-read at every dispatch: plain
         # attribute assignment is the hot-swap commit point
         # (serving/swap.py) — atomic under the GIL, no lock needed.
@@ -255,6 +323,23 @@ class MicroBatcher:
         self._tier = TIER_ACCEPT
         self._p99_ms: Optional[float] = None
         self._p99_refresh_t = 0.0
+        # Adaptive-wait state: EWMA over submit inter-arrival times.
+        # Written racy-benign from submit threads (GIL-atomic attribute
+        # stores; worst case one lost sample) and read by the dispatch
+        # loop.  The SLO cap is static: the tightest p99 SLO configured
+        # anywhere (global + per-tenant), scaled by slo_wait_fraction.
+        self._last_arrival_t: Optional[float] = None
+        self._arrival_ewma_s: Optional[float] = None
+        slos = [
+            s for s in [cfg.p99_slo_ms]
+            + ([t.p99_slo_ms for t in cfg.tenancy.tenants]
+               + [cfg.tenancy.default.p99_slo_ms]
+               if cfg.tenancy is not None else [])
+            if s is not None
+        ]
+        self._adaptive_cap_s: Optional[float] = (
+            min(slos) * 1e-3 * cfg.slo_wait_fraction if slos else None
+        )
         # Internal counters exist ONLY for the telemetry-disabled path:
         # with a hub enabled, the registry is the single source of truth
         # and stats() derives every count from it (mirror drift is
@@ -535,6 +620,16 @@ class MicroBatcher:
         if timeout is None:
             timeout = self.config.default_timeout_ms
         now = time.perf_counter()
+        if self.config.adaptive_wait:
+            last = self._last_arrival_t
+            self._last_arrival_t = now
+            if last is not None and now > last:
+                dt = now - last
+                ewma = self._arrival_ewma_s
+                alpha = self.config.wait_ewma_alpha
+                self._arrival_ewma_s = (
+                    dt if ewma is None else alpha * dt + (1 - alpha) * ewma
+                )
         state = self._tenant_state_for(row)
         if state is not None:
             self._tenant_counter(state, "requests_total").inc()
@@ -626,6 +721,37 @@ class MicroBatcher:
         return pending.future
 
     # -- dispatch loop (one thread) ----------------------------------------
+    def _wait_budget_s(self) -> float:
+        """How long this dispatch waits for batch-mates.
+
+        Static mode: ``max_wait_us``, unconditionally.  Adaptive mode
+        sizes the wait from the arrival-rate EWMA: the expected time to
+        fill the rest of a batch (``ewma × (max_batch_size − 1)``) when
+        that is under the ``max_wait_us`` ceiling, else ``min_wait_us``
+        — dense traffic waits exactly as long as filling takes, sparse
+        traffic stops paying the ceiling for batch-mates that are not
+        coming.  Clamped into [min_wait_us, slo_fraction × tightest p99
+        SLO] so queueing can never eat a tenant's latency budget.
+        """
+        cfg = self.config
+        if not cfg.adaptive_wait:
+            return cfg.max_wait_us / 1e6
+        ceiling = cfg.max_wait_us / 1e6
+        floor = cfg.min_wait_us / 1e6
+        ewma = self._arrival_ewma_s
+        if ewma is None:
+            wait = ceiling
+        else:
+            fill = ewma * max(1, cfg.max_batch_size - 1)
+            wait = fill if fill <= ceiling else floor
+        if self._adaptive_cap_s is not None:
+            wait = min(wait, self._adaptive_cap_s)
+        wait = max(wait, floor)
+        telemetry_mod.current().gauge(
+            "serving_adaptive_wait_seconds"
+        ).set(wait)
+        return wait
+
     def _loop(self) -> None:
         while True:
             item = self._queue.get()
@@ -633,7 +759,7 @@ class MicroBatcher:
                 return
             batch = [item]
             stop_after = False
-            wait_s = self.config.max_wait_us / 1e6
+            wait_s = self._wait_budget_s()
             t_close = time.perf_counter() + wait_s
             while len(batch) < self.config.max_batch_size:
                 remaining = t_close - time.perf_counter()
@@ -927,6 +1053,12 @@ class MicroBatcher:
         counts["max_queue"] = self._capacity
         counts["max_batch_size"] = self.config.max_batch_size
         counts["max_wait_us"] = self.config.max_wait_us
+        counts["adaptive_wait"] = self.config.adaptive_wait
+        if self.config.adaptive_wait:
+            ewma = self._arrival_ewma_s
+            counts["arrival_ewma_ms"] = (
+                None if ewma is None else ewma * 1e3
+            )
         with self._lock:
             counts["tier"] = TIER_NAMES[self._tier]
         counts["model_version"] = getattr(self.runtime, "model_version", 1)

@@ -74,6 +74,7 @@ from photon_ml_tpu.serving.batcher import (
     DeadlineExceededError,
     RejectedError,
 )
+from photon_ml_tpu.serving import wire as wire_mod
 from photon_ml_tpu.utils.watchdog import RetryPolicy
 
 
@@ -90,20 +91,52 @@ def _http_json(
     return normally (the body carries the verdict); only transport-level
     failures (refused, reset, timeout) raise."""
     data = None if payload is None else json.dumps(payload).encode()
+    return _http_post_raw(
+        url, data, "application/json", timeout_s, method=method
+    )
+
+
+def _http_post_raw(
+    url: str, body: Optional[bytes], content_type: str,
+    timeout_s: float = 30.0, method: str = "POST",
+) -> tuple[int, dict]:
+    """One round-trip with a PRE-ENCODED body; returns ``(status,
+    body_dict)``.  A binary response frame decodes into the same
+    ``{"results": [...]}`` shape the JSON path returns (plus a
+    top-level ``"error"`` mirror of the first failed row, so the
+    status-code verdict logic reads both formats identically)."""
     req = urllib.request.Request(
-        url, data=data, method=method,
-        headers={"Content-Type": "application/json"},
+        url, data=body, method=method,
+        headers={"Content-Type": content_type},
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-            return resp.status, json.loads(resp.read() or b"{}")
+            return resp.status, _parse_response(
+                resp.headers.get("Content-Type"), resp.read()
+            )
     except urllib.error.HTTPError as exc:
-        body = exc.read()
+        return exc.code, _parse_response(
+            exc.headers.get("Content-Type") if exc.headers else None,
+            exc.read(),
+        )
+
+
+def _parse_response(content_type: Optional[str], raw: bytes) -> dict:
+    ctype = (content_type or "").split(";", 1)[0].strip().lower()
+    if ctype == wire_mod.CONTENT_TYPE:
         try:
-            obj = json.loads(body or b"{}")
-        except json.JSONDecodeError:
-            obj = {"error": body.decode(errors="replace")}
-        return exc.code, obj
+            results = wire_mod.decode_response(raw)
+        except wire_mod.WireFormatError as exc:
+            return {"error": f"bad response frame: {exc}"}
+        out = {"results": results}
+        if results and isinstance(results[0], dict) \
+                and "error" in results[0]:
+            out["error"] = results[0]["error"]
+        return out
+    try:
+        return json.loads(raw or b"{}")
+    except json.JSONDecodeError:
+        return {"error": raw.decode(errors="replace")}
 
 
 _ERROR_BUILDERS = {
@@ -162,9 +195,19 @@ class FleetRouter:
         max_pending: int = 1024,
         rng: Optional[random.Random] = None,
         clock: Callable[[], float] = time.monotonic,
+        wire_format: str = "json",
     ):
         if not endpoints:
             raise ValueError("FleetRouter needs at least one endpoint")
+        if wire_format not in ("json", "binary"):
+            raise ValueError(
+                f"wire_format must be 'json' or 'binary', got "
+                f"{wire_format!r}"
+            )
+        #: request encoding toward the hosts: "binary" sends wire
+        #: frames (serving/wire.py) and falls back to JSON per-request
+        #: when a row is not frame-encodable (named sparse features).
+        self.wire_format = wire_format
         self.policy = policy or RetryPolicy()
         self.reconnect_policy = reconnect_policy or RetryPolicy(
             backoff_seconds=0.05,
@@ -334,8 +377,28 @@ class FleetRouter:
         with self._lock:
             host.inflight -= 1
 
+    def _encode_request(self, request: dict) -> tuple[bytes, str]:
+        """Encode one wire request body, ONCE per routed request — the
+        peer-retry loop reuses these bytes on every resubmission, so a
+        retry costs a socket, never a re-serialization."""
+        if self.wire_format == "binary":
+            try:
+                return (
+                    wire_mod.encode_request([request]),
+                    wire_mod.CONTENT_TYPE,
+                )
+            except ValueError:
+                # Not frame-encodable (named sparse features) — the
+                # JSON compatibility path carries it instead.
+                pass
+        return (
+            json.dumps({"rows": [request]}).encode(),
+            "application/json",
+        )
+
     def _route(self, item) -> None:
         request, fut, t_submit = item
+        body, content_type = self._encode_request(request)
         tel = telemetry_mod.current()
         tried: set = set()
         last_reject: Optional[Exception] = None
@@ -370,9 +433,9 @@ class FleetRouter:
                 # The scripted-crash seam: a fault here is the host
                 # dying as it picks up the request (docs/robustness.md).
                 chaos_mod.maybe_fail("serving.host", host=host.hid)
-                status, obj = _http_json(
-                    "POST", host.base_url + "/score",
-                    {"rows": [request]}, self.request_timeout_s,
+                status, obj = _http_post_raw(
+                    host.base_url + "/score", body, content_type,
+                    self.request_timeout_s,
                 )
             except Exception as exc:  # noqa: BLE001 — transport failure
                 self._release(host)

@@ -132,3 +132,53 @@ def build_bucket_kernel(mean_fn: Callable):
         return total, mean_fn(total)
 
     return kernel
+
+
+def build_fused_bucket_kernel(mean_fn: Callable):
+    """Single-round-trip variant of :func:`build_bucket_kernel`.
+
+    The composed kernel takes ``1 + n_fixed + 3·n_random`` request-side
+    arrays, so every batch pays that many host→device transfers plus two
+    device→host readbacks.  This kernel takes exactly TWO request-side
+    arguments — one packed float32 buffer and one int32 slot matrix —
+    and returns margins and means STACKED into one ``(2, B)`` array, so
+    a batch costs two uploads and one readback regardless of model
+    structure.
+
+    ``packed`` is ``(B, 1 + Σ fixed_dims + Σ 2·re_dims)``, laid out as
+    the offset column, then each fixed coordinate's request features,
+    then per random coordinate its request features followed by its
+    host-gathered cold rows.  ``slots`` is ``(n_random, B)`` int32 hot
+    slots.  ``fixed_w`` / ``re_tables`` are the device-resident model
+    arrays, unchanged from the composed signature.
+
+    Bit-parity contract: the margin arithmetic is the SAME expression
+    sequence as the composed kernel — per-row multiply+reduce per
+    coordinate, accumulated in the same order, with ``table[slot] +
+    cold`` exactness — over contiguous column slices of the packed
+    buffer, so fused and composed scores are bitwise identical (pinned
+    by tests/test_serving_wire.py).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(packed, slots, fixed_w, re_tables):
+        total = packed[:, 0]
+        off = 1
+        for w in fixed_w:
+            d = w.shape[0]
+            total = total + jnp.sum(
+                packed[:, off:off + d] * w[None, :], axis=1
+            )
+            off += d
+        for j, table in enumerate(re_tables):
+            d = table.shape[1]
+            x = packed[:, off:off + d]
+            cold = packed[:, off + d:off + 2 * d]
+            off += 2 * d
+            coefs = table[slots[j]] + cold
+            total = total + jnp.sum(x * coefs, axis=1)
+        return jnp.stack([total, mean_fn(total)])
+
+    return kernel

@@ -48,14 +48,18 @@ Used by ``python -m photon_ml_tpu.serving --loadgen ...`` and by
 from __future__ import annotations
 
 import dataclasses
+import http.client
+import json
 import threading
 import time
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
 import numpy as np
 
 from photon_ml_tpu.telemetry import Histogram
-from photon_ml_tpu.serving.batcher import RejectedError
+from photon_ml_tpu.serving.batcher import DeadlineExceededError, RejectedError
 
 
 @dataclasses.dataclass
@@ -833,3 +837,130 @@ def run_fleet_scenario(
         phases=phase_rows,
         actions=action_results,
     )
+
+
+# ---------------------------------------------------------------------------
+# HTTP submitter (wire A/B benchmarking)
+# ---------------------------------------------------------------------------
+
+class HttpSubmitter:
+    """A ``submit(request) -> Future`` adapter that drives POST /score
+    over HTTP with PERSISTENT connections — one keep-alive
+    ``http.client.HTTPConnection`` per worker thread, so the measured
+    numbers are the data plane (framing + parse + score), not TCP
+    handshakes.
+
+    ``wire_format="json"`` sends the JSON compatibility body;
+    ``"binary"`` sends a serving/wire.py request frame and decodes the
+    frame response — the A/B lever ``bench.py``'s
+    ``_bench_serving_wire`` pulls.  Per-row errors come back as the
+    same exceptions the in-process ``ScoringService.submit`` path
+    raises (RejectedError / DeadlineExceededError), so the load
+    generators count rejections identically either way.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        wire_format: str = "json",
+        workers: int = 16,
+        timeout_s: float = 30.0,
+    ):
+        if wire_format not in ("json", "binary"):
+            raise ValueError(
+                f"wire_format must be 'json' or 'binary', got "
+                f"{wire_format!r}"
+            )
+        parsed = urllib.parse.urlparse(base_url)
+        if not parsed.hostname:
+            raise ValueError(f"base_url {base_url!r} has no host")
+        self._host = parsed.hostname
+        self._port = parsed.port or 80
+        self.wire_format = wire_format
+        self._timeout_s = timeout_s
+        self._local = threading.local()
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="http-loadgen"
+        )
+
+    # -- per-thread connection ---------------------------------------------
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self._timeout_s
+            )
+            self._local.conn = conn
+        return conn
+
+    def _reset_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+        self._local.conn = None
+
+    # -- one round-trip -----------------------------------------------------
+    def _encode(self, request: dict) -> tuple:
+        if self.wire_format == "binary":
+            from photon_ml_tpu.serving import wire
+
+            return wire.encode_request([request]), wire.CONTENT_TYPE
+        return (
+            json.dumps({"rows": [request]}).encode(), "application/json"
+        )
+
+    def _call(self, request: dict) -> dict:
+        body, ctype = self._encode(request)
+        for attempt in (0, 1):
+            conn = self._conn()
+            try:
+                conn.request("POST", "/score", body=body, headers={
+                    "Content-Type": ctype,
+                    "Content-Length": str(len(body)),
+                })
+                resp = conn.getresponse()
+                raw = resp.read()
+                break
+            except (http.client.HTTPException, OSError):
+                # A dropped keep-alive connection: reconnect once.
+                self._reset_conn()
+                if attempt:
+                    raise
+        resp_ctype = (resp.getheader("Content-Type") or "").split(";")[0]
+        if resp_ctype == "application/x-photon-frame":
+            from photon_ml_tpu.serving import wire
+
+            result = wire.decode_response(raw)[0]
+        else:
+            payload = json.loads(raw or b"{}")
+            results = payload.get("results")
+            if not results:
+                raise RuntimeError(
+                    payload.get("error") or f"HTTP {resp.status}"
+                )
+            result = results[0]
+        if "error" in result:
+            kind = result.get("kind")
+            if kind == "rejected":
+                raise RejectedError(result["error"])
+            if kind == "deadline":
+                raise DeadlineExceededError(result["error"])
+            raise RuntimeError(result["error"])
+        return result
+
+    # -- loadgen surface ----------------------------------------------------
+    def submit(self, request: dict):
+        """Enqueue one request; returns a Future resolving to the
+        result dict (or raising like ``ScoringService.submit``'s
+        future)."""
+        return self._pool.submit(self._call, request)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> "HttpSubmitter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -76,6 +76,12 @@ class RuntimeConfig:
     breaker_cooldown_s: float = 5.0
     #: consecutive device-path failures before the breaker trips.
     breaker_failure_threshold: int = 1
+    #: score through the fused single-round-trip kernel (two uploads +
+    #: one readback per batch, any model structure) instead of the
+    #: composed per-coordinate kernel.  Scores are bitwise identical
+    #: either way (kernels.build_fused_bucket_kernel); the composed
+    #: path remains for A/B benchmarking and as the conservative knob.
+    fused: bool = True
 
 
 def _host_mean(task: str, margins: np.ndarray) -> np.ndarray:
@@ -348,7 +354,18 @@ class ScoringRuntime:
             raise ValueError("model has no coordinates to serve")
         self._parser = RequestParser(self.shard_dims, self.index_maps)
         self.buckets = self._bucket_ladder(self.config.max_batch_size)
-        self._kernel = kernels_lib.build_bucket_kernel(self._mean_fn)
+        if self.config.fused:
+            self._kernel = kernels_lib.build_fused_bucket_kernel(
+                self._mean_fn
+            )
+        else:
+            self._kernel = kernels_lib.build_bucket_kernel(self._mean_fn)
+        #: packed-buffer width for the fused kernel: offset column +
+        #: fixed feature blocks + (features, cold) block pairs per
+        #: random coordinate (kernels.build_fused_bucket_kernel).
+        self._packed_width = 1 + sum(
+            int(np.asarray(c.host_means).shape[0]) for c in self.fixed
+        ) + sum(2 * c.hot.dim for c in self.random)
         self.batches = 0
         self.rows_scored = 0
         self.warmup_compiles = 0
@@ -453,6 +470,7 @@ class ScoringRuntime:
         kernel object — and with it the already-compiled ladder."""
         return (
             self.task,
+            bool(self.config.fused),
             tuple(self.buckets),
             tuple(int(c.means.shape[0]) for c in self.fixed),
             tuple((c.hot.dim, c.hot.capacity) for c in self.random),
@@ -505,6 +523,17 @@ class ScoringRuntime:
 
         f32 = np.float32
         sds = jax.ShapeDtypeStruct
+        if self.config.fused:
+            packed = sds((bucket, self._packed_width), f32)
+            slots = sds((len(self.random), bucket), np.int32)
+            fixed_w = tuple(
+                sds((int(c.means.shape[0]),), f32) for c in self.fixed
+            )
+            re_tables = tuple(
+                sds((c.hot.capacity + 1, c.hot.dim), f32)
+                for c in self.random
+            )
+            return (packed, slots, fixed_w, re_tables)
         offsets = sds((bucket,), f32)
         fixed_x = tuple(
             sds((bucket, int(c.means.shape[0])), f32) for c in self.fixed
@@ -663,29 +692,17 @@ class ScoringRuntime:
         bucket = self.bucket_for(n)
         tel = telemetry_mod.current()
 
-        offsets = np.zeros(bucket, np.float32)
-        for i, row in enumerate(rows):
-            offsets[i] = row.offset
-
-        def shard_matrix(shard: str, dim: int) -> np.ndarray:
-            x = np.zeros((bucket, dim), np.float32)
+        def fill_shard(dst: np.ndarray, shard: str) -> None:
             for i, row in enumerate(rows):
                 vec = row.features.get(shard)
                 if vec is not None:
-                    x[i] = vec
-            return x
+                    dst[i] = vec
 
-        fixed_x = tuple(
-            jnp.asarray(shard_matrix(c.shard, int(c.means.shape[0])))
-            for c in self.fixed
-        )
-        fixed_w = tuple(c.means for c in self.fixed)
-
-        re_x, re_tables, re_slots, re_cold = [], [], [], []
-        promotions: list[tuple[_RandomCoord, object, np.ndarray]] = []
-        for c in self.random:
-            slots = np.zeros(bucket, np.int32)
-            cold = np.zeros((bucket, c.hot.dim), np.float32)
+        def gather_random(c, slots: np.ndarray, cold: np.ndarray) -> None:
+            """Hot-slot lookup + cold-tail host gather for one random
+            coordinate, writing into the caller's (bucket,) slots and
+            (bucket, dim) cold arrays (fused mode passes views into the
+            packed buffer, so the gather lands in place)."""
             pending: dict = {}
             hits_before = c.hot.hits
             for i, row in enumerate(rows):
@@ -714,17 +731,69 @@ class ScoringRuntime:
             tel.counter("serving_hot_hits_total").inc(
                 c.hot.hits - hits_before
             )
-            re_x.append(jnp.asarray(shard_matrix(c.shard, c.hot.dim)))
-            re_tables.append(c.hot.table)
-            re_slots.append(jnp.asarray(slots))
-            re_cold.append(jnp.asarray(cold))
 
-        margins, means = self._kernel(
-            jnp.asarray(offsets), fixed_x, fixed_w,
-            tuple(re_x), tuple(re_tables), tuple(re_slots), tuple(re_cold),
-        )
-        margins = np.asarray(margins[:n], np.float32)
-        means = np.asarray(means[:n], np.float32)
+        promotions: list[tuple[_RandomCoord, object, np.ndarray]] = []
+        if self.config.fused:
+            # Single-round-trip path: every request-side value rides in
+            # ONE packed f32 buffer plus one i32 slot matrix (two
+            # uploads), and margins+means come back stacked (one
+            # readback) — see kernels.build_fused_bucket_kernel.
+            packed = np.zeros((bucket, self._packed_width), np.float32)
+            all_slots = np.zeros((len(self.random), bucket), np.int32)
+            for i, row in enumerate(rows):
+                packed[i, 0] = row.offset
+            off = 1
+            for c in self.fixed:
+                d = int(c.means.shape[0])
+                fill_shard(packed[:, off:off + d], c.shard)
+                off += d
+            for j, c in enumerate(self.random):
+                d = c.hot.dim
+                fill_shard(packed[:, off:off + d], c.shard)
+                gather_random(
+                    c, all_slots[j], packed[:, off + d:off + 2 * d]
+                )
+                off += 2 * d
+            out = np.asarray(self._kernel(
+                jnp.asarray(packed), jnp.asarray(all_slots),
+                tuple(c.means for c in self.fixed),
+                tuple(c.hot.table for c in self.random),
+            ))
+            margins = np.asarray(out[0, :n], np.float32)
+            means = np.asarray(out[1, :n], np.float32)
+        else:
+            offsets = np.zeros(bucket, np.float32)
+            for i, row in enumerate(rows):
+                offsets[i] = row.offset
+
+            def shard_matrix(shard: str, dim: int) -> np.ndarray:
+                x = np.zeros((bucket, dim), np.float32)
+                fill_shard(x, shard)
+                return x
+
+            fixed_x = tuple(
+                jnp.asarray(shard_matrix(c.shard, int(c.means.shape[0])))
+                for c in self.fixed
+            )
+            fixed_w = tuple(c.means for c in self.fixed)
+
+            re_x, re_tables, re_slots, re_cold = [], [], [], []
+            for c in self.random:
+                slots = np.zeros(bucket, np.int32)
+                cold = np.zeros((bucket, c.hot.dim), np.float32)
+                gather_random(c, slots, cold)
+                re_x.append(jnp.asarray(shard_matrix(c.shard, c.hot.dim)))
+                re_tables.append(c.hot.table)
+                re_slots.append(jnp.asarray(slots))
+                re_cold.append(jnp.asarray(cold))
+
+            margins, means = self._kernel(
+                jnp.asarray(offsets), fixed_x, fixed_w,
+                tuple(re_x), tuple(re_tables), tuple(re_slots),
+                tuple(re_cold),
+            )
+            margins = np.asarray(margins[:n], np.float32)
+            means = np.asarray(means[:n], np.float32)
 
         # Promote the cold tail AFTER this batch (the batch itself scored
         # through the cold path; the next request finds the entity hot).

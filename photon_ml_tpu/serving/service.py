@@ -61,6 +61,8 @@ from photon_ml_tpu.serving.batcher import (
 from photon_ml_tpu.serving.runtime import Row, ScoringRuntime
 from photon_ml_tpu.serving.swap import HotSwapper, SwapInProgressError
 from photon_ml_tpu.serving.tenancy import TenantRouter
+from photon_ml_tpu.serving import wire as wire_mod
+from photon_ml_tpu import telemetry as telemetry_mod
 
 
 class ScoringService:
@@ -238,6 +240,22 @@ class ScoringService:
         """Blocking single-request convenience."""
         return self.submit(request).result(timeout=timeout)
 
+    def request_parser(self):
+        """The :class:`~photon_ml_tpu.serving.runtime.RequestParser`
+        validating this service's requests — what the binary wire path
+        decodes against (shard dims; the JSON path reads the same
+        object, so both paths refuse identically)."""
+        if self.supervisor is not None and self.supervisor.pool is not None:
+            return self.supervisor.pool.parser
+        runtime = self.current_runtime
+        parser = getattr(runtime, "_parser", None)
+        if parser is None:
+            raise RejectedError(
+                "UNAVAILABLE: no runtime available to parse against; "
+                "retry with backoff"
+            )
+        return parser
+
     def score_many(
         self, requests: Sequence, timeout: Optional[float] = 30.0
     ) -> list:
@@ -392,6 +410,17 @@ _KIND_STATUS = {
 _SWAP_STATUS = {"swapped": 200, "rolled_back": 422, "deferred": 503}
 
 
+def _status_for(results: list) -> int:
+    """HTTP status for a batch of per-row results: only an ALL-failed
+    response surfaces a row error as the status (429 tells a client to
+    back off, 504 to re-budget); partial failure reports per-row."""
+    errors = [r["kind"] for r in results if r and "error" in r]
+    if errors and len(errors) == len(results):
+        kinds = set(errors)
+        return _KIND_STATUS[errors[0]] if len(kinds) == 1 else 500
+    return 200
+
+
 class _Handler(BaseHTTPRequestHandler):
     service: ScoringService  # set on the server class per instance
     protocol_version = "HTTP/1.1"
@@ -426,9 +455,16 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 
-    def _read_body(self) -> dict:
+    def _read_raw(self) -> bytes:
         length = int(self.headers.get("Content-Length", "0"))
-        return json.loads(self.rfile.read(length) or b"{}")
+        return self.rfile.read(length)
+
+    def _read_body(self) -> dict:
+        return json.loads(self._read_raw() or b"{}")
+
+    def _content_type(self) -> str:
+        ctype = self.headers.get("Content-Type") or ""
+        return ctype.split(";", 1)[0].strip().lower()
 
     def do_POST(self) -> None:  # noqa: N802 — stdlib casing
         # Split the query string off before routing: the reload mode
@@ -440,6 +476,13 @@ class _Handler(BaseHTTPRequestHandler):
         if path != "/score":
             self._send_json(404, {"error": f"no route {self.path}"})
             return
+        # Content-type negotiation (docs/serving.md "Data plane"): a
+        # binary frame body takes the wire fast path; everything else is
+        # the JSON compatibility path.  Both produce bitwise-identical
+        # scores.
+        if self._content_type() == wire_mod.CONTENT_TYPE:
+            self._do_score_binary()
+            return
         try:
             obj = self._read_body()
             rows = obj["rows"] if isinstance(obj, dict) and "rows" in obj \
@@ -450,15 +493,42 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"bad request: {exc}"})
             return
         results = self.server.service.score_many(rows)
-        errors = [r["kind"] for r in results if r and "error" in r]
-        if errors and len(errors) == len(results):
-            # Every row failed the same way → surface it as the HTTP
-            # status (429 tells a client to back off, 504 to re-budget).
-            kinds = set(errors)
-            status = _KIND_STATUS[errors[0]] if len(kinds) == 1 else 500
-        else:
-            status = 200  # partial failure reports per-row
-        self._send_json(status, {"results": results})
+        self._send_json(_status_for(results), {"results": results})
+
+    def _do_score_binary(self) -> None:
+        """POST /score with a wire-frame body: decode zero-copy into
+        Rows, score, answer with a wire response frame — unless the
+        client's Accept header explicitly asks for JSON back (the
+        fallback matrix in docs/serving.md)."""
+        tel = telemetry_mod.current()
+        body = self._read_raw()
+        tel.counter("serving_wire_rx_bytes").inc(len(body))
+        try:
+            rows = wire_mod.decode_request(
+                body, self.server.service.request_parser()
+            )
+        except wire_mod.WireFormatError as exc:
+            tel.counter("serving_wire_errors_total").inc()
+            self._send_json(400, {"error": f"bad frame: {exc}"})
+            return
+        except RejectedError as exc:
+            self._send_json(429, {"error": str(exc)})
+            return
+        tel.counter("serving_wire_requests_total").inc()
+        tel.counter("serving_wire_rows_total").inc(len(rows))
+        results = self.server.service.score_many(rows)
+        status = _status_for(results)
+        accept = (self.headers.get("Accept") or "").lower()
+        if "application/json" in accept:
+            self._send_json(status, {"results": results})
+            return
+        frame = wire_mod.encode_response(results)
+        tel.counter("serving_wire_tx_bytes").inc(len(frame))
+        self.send_response(status)
+        self.send_header("Content-Type", wire_mod.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(frame)))
+        self.end_headers()
+        self.wfile.write(frame)
 
     def _do_reload(self, query: str = "") -> None:
         try:

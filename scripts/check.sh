@@ -88,12 +88,16 @@ if [[ "${1:-}" == "--fast" ]]; then
   # hot working-set cache enabled.  test_chaos's kill/resume
   # boundary matrices are the fast recovery smoke.  The fleet smoke is
   # a 2-host router with a scripted host kill under in-flight load:
-  # zero failed requests, the killed host rejoins.
+  # zero failed requests, the killed host rejoins.  test_serving_wire
+  # is the binary-parity smoke: a 3-bucket synthetic model scored over
+  # live HTTP in both wire formats must produce BITWISE-identical
+  # scores (plus fused-kernel parity and frame refusal tests).
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_ops_plane.py \
     tests/test_watchdog.py \
     tests/test_serving.py tests/test_serving_ha.py \
     tests/test_serving_proc.py tests/test_freshness.py \
+    tests/test_serving_wire.py \
     tests/test_tuning.py tests/test_chaos.py \
     "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
     "tests/test_streaming.py::TestTransferAvoidance::test_fast_lane_compressed_cached_parity" \

@@ -1,0 +1,677 @@
+"""Million-QPS data plane tests (ISSUE 16).
+
+The load-bearing contracts:
+
+- The binary wire codec round-trips requests and responses exactly —
+  scores that cross the wire as frames are BITWISE identical to the
+  JSON path, because both carry float64 end to end.
+- Every malformed frame refuses loudly before anything trusts a length
+  field: truncated, bad magic, unknown version, forged lengths beyond
+  the 256 MB cap, unknown dtype tags — mirroring the frame-cap
+  discipline of serving/protocol.py.
+- The fused scoring kernel produces bit-identical margins/means to the
+  composed kernels across the whole bucket ladder and hot/cold states.
+- The adaptive micro-batcher sizes its wait from the arrival EWMA,
+  bounded by the SLO fraction, and BatcherConfig refuses bad knobs
+  with errors that name the field.
+- Worker IPC (protocol.py), the shm ingress ring, and the fleet
+  router's binary mode all ride the same codec and agree with JSON.
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.serving import wire
+from photon_ml_tpu.serving.batcher import (
+    BatcherConfig,
+    DeadlineExceededError,
+    MicroBatcher,
+    RejectedError,
+)
+from photon_ml_tpu.serving.protocol import (
+    FrameConn,
+    ProtocolError,
+    _encode_payload,
+)
+from photon_ml_tpu.serving.runtime import Row, RuntimeConfig, ScoringRuntime
+from photon_ml_tpu.serving.service import ScoringService, start_http_server
+from photon_ml_tpu.serving.shm_ingress import (
+    ShmIngress,
+    ShmIngressClient,
+    ShmIngressError,
+)
+from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+
+
+@pytest.fixture(scope="module")
+def workload():
+    return SyntheticWorkload(n_entities=32, seed=7, unknown_rate=0.1)
+
+
+def _runtime(workload, **kwargs):
+    cfg = RuntimeConfig(**{"max_batch_size": 4, "hot_entities": 8, **kwargs})
+    return ScoringRuntime(workload.model, workload.index_maps, cfg)
+
+
+def _requests(workload, n, start=0):
+    return [workload.request(i) for i in range(start, start + n)]
+
+
+# ---------------------------------------------------------------------------
+# Container codec
+# ---------------------------------------------------------------------------
+
+class TestColumnCodec:
+    def test_round_trips_every_wire_dtype(self):
+        from photon_ml_tpu.data.staging import WIRE_DTYPE_TAGS
+        rng = np.random.default_rng(11)
+        columns = {}
+        for dt in WIRE_DTYPE_TAGS:
+            dt = np.dtype(dt)
+            if dt == np.bool_:
+                columns[f"c_{dt.name}"] = rng.random(7) > 0.5
+            elif dt.kind == "f":
+                columns[f"c_{dt.name}"] = rng.normal(size=7).astype(dt)
+            else:
+                columns[f"c_{dt.name}"] = rng.integers(
+                    0, 100, size=7
+                ).astype(dt)
+        columns["mat"] = rng.normal(size=(7, 3)).astype(np.float32)
+        buf = wire.encode_columns(columns, wire.KIND_REQUEST, 7)
+        kind, n, out = wire.decode_columns(buf)
+        assert (kind, n) == (wire.KIND_REQUEST, 7)
+        assert list(out) == list(columns)  # insertion order preserved
+        for name, arr in columns.items():
+            assert out[name].dtype == arr.dtype, name
+            assert np.array_equal(out[name], arr), name
+
+    def test_fuzz_random_shapes_round_trip(self):
+        rng = np.random.default_rng(23)
+        for trial in range(50):
+            n = int(rng.integers(1, 40))
+            columns = {}
+            for c in range(int(rng.integers(1, 6))):
+                if rng.random() < 0.5:
+                    columns[f"v{c}"] = rng.normal(size=n).astype(
+                        rng.choice([np.float32, np.float64])
+                    )
+                else:
+                    columns[f"m{c}"] = rng.normal(
+                        size=(n, int(rng.integers(1, 9)))
+                    ).astype(np.float32)
+            buf = wire.encode_columns(columns, wire.KIND_RESPONSE, n)
+            kind, n2, out = wire.decode_columns(buf)
+            assert n2 == n
+            for name, arr in columns.items():
+                assert arr.tobytes() == np.ascontiguousarray(
+                    out[name]
+                ).tobytes(), f"trial {trial} column {name}"
+
+    def test_decode_is_zero_copy(self):
+        arr = np.arange(32, dtype=np.float32)
+        buf = wire.encode_columns({"x": arr}, wire.KIND_REQUEST, 32)
+        _, _, out = wire.decode_columns(buf)
+        assert out["x"].base is not None  # a view, not a copy
+
+    def test_refuses_truncated_header(self):
+        with pytest.raises(wire.WireFormatError, match="truncated"):
+            wire.decode_columns(b"PHW")
+
+    def test_refuses_bad_magic(self):
+        buf = bytearray(
+            wire.encode_columns(
+                {"x": np.zeros(1, np.float32)}, wire.KIND_REQUEST, 1
+            )
+        )
+        buf[:4] = b"EVIL"
+        with pytest.raises(wire.WireFormatError, match="magic"):
+            wire.decode_columns(bytes(buf))
+
+    def test_refuses_unknown_version(self):
+        buf = bytearray(
+            wire.encode_columns(
+                {"x": np.zeros(1, np.float32)}, wire.KIND_REQUEST, 1
+            )
+        )
+        struct.pack_into("<H", buf, 4, 99)
+        with pytest.raises(wire.WireFormatError, match="version 99"):
+            wire.decode_columns(bytes(buf))
+
+    def test_refuses_forged_lengths_beyond_cap(self):
+        # A 24-byte header claiming a 300 MB payload: the decoder must
+        # refuse on the cap BEFORE attempting any allocation — same
+        # discipline as protocol.py's MAX_FRAME_BYTES.
+        header = struct.pack(
+            "<4sHBBHHIII", b"PHWF", wire.WIRE_VERSION, 1, 0, 0, 0,
+            1, 0, 300 << 20,
+        )
+        with pytest.raises(wire.WireFormatError, match="forged"):
+            wire.decode_columns(header)
+
+    def test_refuses_total_length_mismatch(self):
+        buf = wire.encode_columns(
+            {"x": np.zeros(4, np.float32)}, wire.KIND_REQUEST, 4
+        )
+        with pytest.raises(wire.WireFormatError, match="length mismatch"):
+            wire.decode_columns(buf + b"extra")
+        with pytest.raises(wire.WireFormatError, match="length mismatch"):
+            wire.decode_columns(buf[:-1])
+
+    def test_refuses_unknown_dtype_tag(self):
+        buf = bytearray(
+            wire.encode_columns(
+                {"x": np.zeros(2, np.float32)}, wire.KIND_REQUEST, 2
+            )
+        )
+        # dtype tag is byte 6 of the directory entry, after the header.
+        struct.pack_into("<B", buf, 24 + 6, 250)
+        with pytest.raises(wire.WireFormatError, match="dtype tag"):
+            wire.decode_columns(bytes(buf))
+
+    def test_refuses_column_payload_overrun(self):
+        buf = bytearray(
+            wire.encode_columns(
+                {"x": np.zeros(2, np.float32)}, wire.KIND_REQUEST, 2
+            )
+        )
+        # Forge the column's row count so its payload range overruns.
+        struct.pack_into("<I", buf, 24 + 8, 1 << 20)
+        with pytest.raises(wire.WireFormatError, match="payload range"):
+            wire.decode_columns(bytes(buf))
+
+
+# ---------------------------------------------------------------------------
+# Request / response layers
+# ---------------------------------------------------------------------------
+
+class TestRequestResponseFrames:
+    def test_request_round_trip_matches_json_parser(self, workload):
+        runtime = _runtime(workload)
+        reqs = _requests(workload, 8)
+        frame = wire.encode_request(reqs)
+        rows = wire.decode_request(frame, runtime._parser)
+        for row, req in zip(rows, reqs):
+            ref = runtime.parse_request(req)
+            assert row.offset == ref.offset
+            assert row.timeout_ms == ref.timeout_ms
+            assert row.priority == ref.priority
+            assert row.ids == ref.ids
+            assert set(row.features) == set(ref.features)
+            for shard in ref.features:
+                assert np.asarray(row.features[shard]).tobytes() == \
+                    np.asarray(ref.features[shard]).tobytes()
+
+    def test_refuses_named_sparse_features(self):
+        with pytest.raises(ValueError, match="JSON path"):
+            wire.encode_request([
+                {"features": {"g": [["a", "", 1.0]]}}
+            ])
+
+    def test_refuses_unknown_shard_like_json(self, workload):
+        runtime = _runtime(workload)
+        frame = wire.encode_request([{"dense": {"nope": [1.0, 2.0]}}])
+        with pytest.raises(wire.WireFormatError, match="unknown feature"):
+            wire.decode_request(frame, runtime._parser)
+
+    def test_refuses_wrong_shard_width_like_json(self, workload):
+        runtime = _runtime(workload)
+        shard = workload.fixed_shard
+        frame = wire.encode_request([{"dense": {shard: [1.0, 2.0, 3.0]}}])
+        with pytest.raises(wire.WireFormatError, match="features"):
+            wire.decode_request(frame, runtime._parser)
+
+    def test_response_round_trip_is_exact(self):
+        results = [
+            {"score": 0.1234567890123456789, "mean": 0.5,
+             "latency_ms": 1.875},
+            {"error": "queue full; shedding", "kind": "rejected"},
+            {"error": "past deadline", "kind": "deadline"},
+            None,
+        ]
+        out = wire.decode_response(wire.encode_response(results))
+        assert out[0] == results[0]  # bitwise float64 equality
+        assert out[1] == results[1]
+        assert out[2] == results[2]
+        assert out[3]["kind"] == "internal"
+
+    def test_priority_and_tenant_round_trip(self, workload):
+        req = dict(workload.request(0))
+        req.update(priority="high", tenant="acme", timeout_ms=125.5)
+        rows = wire.decode_request(wire.encode_request([req]))
+        assert rows[0].priority == "high"
+        assert rows[0].tenant == "acme"
+        assert rows[0].timeout_ms == 125.5
+
+
+# ---------------------------------------------------------------------------
+# Fused scoring kernel
+# ---------------------------------------------------------------------------
+
+class TestFusedKernel:
+    def test_fused_bit_identical_to_composed_all_buckets(self, workload):
+        fused = _runtime(workload, fused=True)
+        composed = _runtime(workload, fused=False)
+        rows_f = [
+            fused.parse_request(workload.request(i)) for i in range(4)
+        ]
+        rows_c = [
+            composed.parse_request(workload.request(i)) for i in range(4)
+        ]
+        for n in range(1, 5):  # bucket ladder 1, 2, 4
+            mf, ef = fused.score_rows(rows_f[:n])
+            mc, ec = composed.score_rows(rows_c[:n])
+            assert mf.tobytes() == mc.tobytes(), f"margins differ at n={n}"
+            assert ef.tobytes() == ec.tobytes(), f"means differ at n={n}"
+
+    def test_fused_parity_survives_hot_promotion(self, workload):
+        # Score the same entities repeatedly so they promote into the
+        # hot table, then confirm parity again: the fused gather path
+        # must agree with the composed one in BOTH hot and cold states.
+        fused = _runtime(workload, fused=True)
+        composed = _runtime(workload, fused=False)
+        for _ in range(3):
+            rows_f = [
+                fused.parse_request(workload.request(i)) for i in range(4)
+            ]
+            rows_c = [
+                composed.parse_request(workload.request(i))
+                for i in range(4)
+            ]
+            mf, ef = fused.score_rows(rows_f)
+            mc, ec = composed.score_rows(rows_c)
+            assert mf.tobytes() == mc.tobytes()
+            assert ef.tobytes() == ec.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Adaptive micro-batching + config validation
+# ---------------------------------------------------------------------------
+
+class TestBatcherConfigValidation:
+    @pytest.mark.parametrize("kwargs,field", [
+        ({"max_batch_size": 0}, "max_batch_size"),
+        ({"max_wait_us": -1}, "max_wait_us"),
+        ({"max_queue": 0}, "max_queue"),
+        ({"shed_watermark": 0.9, "reject_watermark": 0.5},
+         "shed_watermark"),
+        ({"shed_watermark": 0.0}, "shed_watermark"),
+        ({"default_timeout_ms": 0}, "default_timeout_ms"),
+        ({"p99_slo_ms": -5}, "p99_slo_ms"),
+        ({"admission_interval_s": -0.1}, "admission_interval_s"),
+        ({"min_wait_us": -1}, "min_wait_us"),
+        ({"wait_ewma_alpha": 0.0}, "wait_ewma_alpha"),
+        ({"wait_ewma_alpha": 1.5}, "wait_ewma_alpha"),
+        ({"slo_wait_fraction": 0.0}, "slo_wait_fraction"),
+        ({"slo_wait_fraction": 2.0}, "slo_wait_fraction"),
+    ])
+    def test_bad_knob_names_the_field(self, kwargs, field):
+        with pytest.raises(ValueError, match=field):
+            BatcherConfig(**kwargs)
+
+    def test_valid_config_constructs(self):
+        BatcherConfig(
+            adaptive_wait=True, min_wait_us=50, wait_ewma_alpha=0.5,
+            slo_wait_fraction=0.1,
+        )
+
+
+class TestAdaptiveWait:
+    def _batcher(self, workload, **cfg_kwargs):
+        runtime = _runtime(workload, max_batch_size=8)
+        cfg = BatcherConfig(**{
+            "max_batch_size": 8, "max_wait_us": 2000, "max_queue": 64,
+            "adaptive_wait": True, **cfg_kwargs,
+        })
+        return MicroBatcher(runtime, cfg)
+
+    def test_static_mode_returns_ceiling(self, workload):
+        runtime = _runtime(workload)
+        b = MicroBatcher(runtime, BatcherConfig(max_wait_us=1500))
+        assert b._wait_budget_s() == pytest.approx(1.5e-3)
+
+    def test_dense_traffic_waits_to_fill(self, workload):
+        b = self._batcher(workload)
+        b._arrival_ewma_s = 50e-6  # 20k rps: fill = 350 µs < ceiling
+        assert b._wait_budget_s() == pytest.approx(50e-6 * 7)
+
+    def test_sparse_traffic_drops_to_floor(self, workload):
+        b = self._batcher(workload, min_wait_us=100)
+        b._arrival_ewma_s = 0.050  # 20 rps: fill ≫ ceiling → floor
+        assert b._wait_budget_s() == pytest.approx(100e-6)
+
+    def test_slo_fraction_caps_the_wait(self, workload):
+        b = self._batcher(
+            workload, p99_slo_ms=2.0, slo_wait_fraction=0.25,
+            max_wait_us=100_000,
+        )
+        b._arrival_ewma_s = 10e-3  # fill = 70 ms, ceiling 100 ms
+        # cap = 0.25 × 2 ms = 500 µs
+        assert b._wait_budget_s() == pytest.approx(500e-6)
+
+    def test_cold_start_uses_ceiling(self, workload):
+        b = self._batcher(workload, max_wait_us=800)
+        assert b._arrival_ewma_s is None
+        assert b._wait_budget_s() == pytest.approx(800e-6)
+
+    def test_submit_updates_ewma_and_stats(self, workload):
+        b = self._batcher(workload)
+        b.start()
+        try:
+            for i in range(6):
+                b.submit(
+                    b.runtime.parse_request(workload.request(i))
+                ).result(timeout=30)
+            stats = b.stats()
+            assert stats["adaptive_wait"] is True
+            assert "arrival_ewma_ms" in stats
+            assert b._arrival_ewma_s is not None
+        finally:
+            b.stop()
+
+    def test_adaptive_scores_match_static(self, workload):
+        runtime = _runtime(workload)
+        static = MicroBatcher(runtime, BatcherConfig(max_batch_size=8))
+        static.start()
+        try:
+            ref = [
+                static.submit(
+                    runtime.parse_request(workload.request(i))
+                ).result(timeout=30)["score"]
+                for i in range(6)
+            ]
+        finally:
+            static.stop()
+        runtime2 = _runtime(workload)
+        adaptive = MicroBatcher(runtime2, BatcherConfig(
+            max_batch_size=8, adaptive_wait=True,
+        ))
+        adaptive.start()
+        try:
+            got = [
+                adaptive.submit(
+                    runtime2.parse_request(workload.request(i))
+                ).result(timeout=30)["score"]
+                for i in range(6)
+            ]
+        finally:
+            adaptive.stop()
+        assert got == ref  # batching policy never changes the math
+
+
+# ---------------------------------------------------------------------------
+# HTTP data plane: JSON vs binary
+# ---------------------------------------------------------------------------
+
+class _Http:
+    def __init__(self, workload, **runtime_kwargs):
+        self.runtime = _runtime(workload, **runtime_kwargs)
+        self.service = ScoringService(self.runtime)
+        self.service.start()
+        self.server, _ = start_http_server(self.service, port=0)
+        self.base = f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()
+        self.service.stop()
+        return False
+
+    def post(self, path, body, headers):
+        req = urllib.request.Request(
+            self.base + path, data=body, headers=headers
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.headers.get("Content-Type"), resp.read()
+
+
+class TestHttpBinaryPath:
+    def test_binary_scores_bitwise_match_json(self, workload):
+        with _Http(workload) as http:
+            reqs = _requests(workload, 8)
+            _, raw = http.post(
+                "/score", json.dumps({"rows": reqs}).encode(),
+                {"Content-Type": "application/json"},
+            )
+            via_json = json.loads(raw)["results"]
+            ctype, raw = http.post(
+                "/score", wire.encode_request(reqs),
+                {"Content-Type": wire.CONTENT_TYPE},
+            )
+            assert ctype == wire.CONTENT_TYPE
+            via_bin = wire.decode_response(raw)
+            assert len(via_bin) == len(via_json) == 8
+            for b, j in zip(via_bin, via_json):
+                assert b["score"] == j["score"]
+                assert b["mean"] == j["mean"]
+
+    def test_accept_json_falls_back_to_json_response(self, workload):
+        with _Http(workload) as http:
+            ctype, raw = http.post(
+                "/score", wire.encode_request(_requests(workload, 2)),
+                {"Content-Type": wire.CONTENT_TYPE,
+                 "Accept": "application/json"},
+            )
+            assert "application/json" in ctype
+            assert len(json.loads(raw)["results"]) == 2
+
+    def test_garbage_frame_is_a_400(self, workload):
+        with _Http(workload) as http:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                http.post(
+                    "/score", b"not a frame at all",
+                    {"Content-Type": wire.CONTENT_TYPE},
+                )
+            assert err.value.code == 400
+
+
+# ---------------------------------------------------------------------------
+# Worker IPC frames (protocol.py)
+# ---------------------------------------------------------------------------
+
+class TestProtocolWireFrames:
+    def _pair(self):
+        a, b = socket.socketpair()
+        return FrameConn(a), FrameConn(b)
+
+    def test_score_message_rides_the_wire_codec(self):
+        row = Row(
+            features={"g": np.arange(4, dtype=np.float32)},
+            ids={"memberId": "m1"}, offset=0.25, timeout_ms=50.0,
+            priority="high", tenant="acme",
+        )
+        msg = {"kind": "score", "id": 7, "row": row, "tenant": "acme",
+               "timeout_ms": 50.0, "bypass": True}
+        assert _encode_payload(msg)[0] == 1  # wire, not pickle
+        ca, cb = self._pair()
+        try:
+            ca.send(msg)
+            got = cb.recv()
+            assert got["id"] == 7 and got["bypass"] is True
+            assert got["row"].features["g"].tobytes() == \
+                row.features["g"].tobytes()
+            assert got["row"].tenant == "acme"
+        finally:
+            ca.close()
+            cb.close()
+
+    def test_success_result_rides_the_wire_codec(self):
+        msg = {"kind": "result", "id": 3, "ok": True, "value": {
+            "score": 1.0000000000000002, "mean": 0.5, "latency_ms": 0.75,
+        }}
+        assert _encode_payload(msg)[0] == 2
+        ca, cb = self._pair()
+        try:
+            ca.send(msg)
+            assert cb.recv() == msg  # bitwise float64 equality
+        finally:
+            ca.close()
+            cb.close()
+
+    @pytest.mark.parametrize("msg", [
+        {"kind": "result", "id": 3, "ok": False, "error": "boom",
+         "error_kind": "internal"},
+        {"kind": "result", "id": 3, "ok": True, "value": {"depth": 4}},
+        {"kind": "stats", "id": 1},
+        {"kind": "swap_prepare", "id": 2, "model_dir": "/x"},
+        ["not", "a", "dict"],
+    ])
+    def test_everything_else_stays_pickle(self, msg):
+        assert _encode_payload(msg)[0] == 0
+        ca, cb = self._pair()
+        try:
+            ca.send(msg)
+            assert cb.recv() == msg
+        finally:
+            ca.close()
+            cb.close()
+
+    def test_corrupt_wire_payload_raises_protocol_error(self):
+        ca, cb = self._pair()
+        try:
+            bad = bytes([1]) + b"XXXX" + bytes(40)
+            ca._sock.sendall(struct.pack(">I", len(bad)) + bad)
+            with pytest.raises(ProtocolError, match="corrupt wire"):
+                cb.recv()
+        finally:
+            ca.close()
+            cb.close()
+
+    def test_unknown_kind_byte_raises_protocol_error(self):
+        ca, cb = self._pair()
+        try:
+            ca._sock.sendall(struct.pack(">I", 1) + bytes([9]))
+            with pytest.raises(ProtocolError, match="kind byte"):
+                cb.recv()
+        finally:
+            ca.close()
+            cb.close()
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory ingress
+# ---------------------------------------------------------------------------
+
+class _Ring:
+    def __init__(self, workload, **kwargs):
+        self.service = ScoringService(_runtime(workload))
+        self.service.start()
+        self.ingress = ShmIngress(self.service, **{
+            "n_slots": 4, "slot_bytes": 64 << 10, **kwargs,
+        }).start()
+        self.client = ShmIngressClient(self.ingress.name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.client.close()
+        self.ingress.stop()
+        self.service.stop()
+        return False
+
+
+class TestShmIngress:
+    def test_ring_scores_match_in_process(self, workload):
+        with _Ring(workload) as ring:
+            reqs = _requests(workload, 8)
+            via_ring = ring.client.score_many(reqs, timeout_s=60.0)
+            via_proc = ring.service.score_many(
+                [dict(r) for r in reqs]
+            )
+            for a, b in zip(via_ring, via_proc):
+                if "error" in b:
+                    assert a.get("kind") == b.get("kind")
+                else:
+                    assert a["score"] == b["score"]
+
+    def test_concurrent_clients_share_the_ring(self, workload):
+        with _Ring(workload) as ring:
+            reqs = _requests(workload, 3)
+            errors = []
+
+            def hammer():
+                try:
+                    for _ in range(5):
+                        out = ring.client.score_many(reqs, timeout_s=60.0)
+                        assert len(out) == 3
+                except Exception as exc:  # noqa: BLE001 — collect
+                    errors.append(exc)
+
+            threads = [
+                threading.Thread(target=hammer) for _ in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors
+
+    def test_oversized_request_refused_client_side(self, workload):
+        with _Ring(workload) as ring:
+            big = [{"dense": {"g": [0.0] * 64}}] * 4096
+            with pytest.raises(ShmIngressError, match="exceeds"):
+                ring.client.score_many(big, timeout_s=5.0)
+
+    def test_garbage_frame_answers_in_band(self, workload):
+        with _Ring(workload) as ring:
+            out = ring.client._roundtrip(b"JUNK" + bytes(28), 30.0)
+            assert out[0]["kind"] == "bad_request"
+
+    def test_missing_segment_refused(self):
+        with pytest.raises(ShmIngressError, match="gone"):
+            ShmIngressClient("no-such-ingress-ring")
+
+    def test_geometry_validation(self, workload):
+        service = ScoringService(_runtime(workload))
+        with pytest.raises(ValueError, match="n_slots"):
+            ShmIngress(service, n_slots=0)
+        with pytest.raises(ValueError, match="slot_bytes"):
+            ShmIngress(service, slot_bytes=16)
+
+
+# ---------------------------------------------------------------------------
+# Fleet binary mode
+# ---------------------------------------------------------------------------
+
+class TestFleetBinaryMode:
+    def test_binary_fleet_matches_json_fleet(self, workload):
+        from photon_ml_tpu.serving.fleet import FleetRouter, LocalHost
+        host = LocalHost("h0", ScoringService(_runtime(workload))).start()
+        try:
+            reqs = _requests(workload, 6)
+            json_router = FleetRouter(
+                [host.base_url], probe_interval_s=0.05,
+            ).start()
+            try:
+                via_json = [json_router.score(r) for r in reqs]
+            finally:
+                json_router.stop()
+            bin_router = FleetRouter(
+                [host.base_url], probe_interval_s=0.05,
+                wire_format="binary",
+            ).start()
+            try:
+                via_bin = [bin_router.score(r) for r in reqs]
+            finally:
+                bin_router.stop()
+            for a, b in zip(via_bin, via_json):
+                assert a["score"] == b["score"]
+                assert a["mean"] == b["mean"]
+        finally:
+            host.stop()
+
+    def test_wire_format_validated(self):
+        from photon_ml_tpu.serving.fleet import FleetRouter
+        with pytest.raises(ValueError, match="wire_format"):
+            FleetRouter(["http://127.0.0.1:1"], wire_format="msgpack")
