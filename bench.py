@@ -34,8 +34,9 @@ the primary metric in the required fields, the other metrics under "extra"
 with their own vs_baseline ratios.
 
 Env knobs: BENCH_SMALL=1 shrinks every workload (CI/smoke); BENCH_ONLY=
-glm|game|driver|stream|serving|freshness|tuning|chaos runs a single
-section.
+glm|game|driver|stream|serving|freshness|tuning|chaos|telemetry|tracing
+runs a single section (tracing: trace-propagation overhead A/B, gated
+<= 1% of the closed-loop serving baseline).
 """
 
 import json
@@ -1937,6 +1938,127 @@ def _bench_serving_fleet(workload) -> dict:
     return out
 
 
+def bench_tracing() -> dict:
+    """Distributed-tracing propagation overhead (PR 17): the same
+    closed-loop in-process serving workload as bench_serving, A/B'd with
+    trace-context propagation OFF (sink-less hub — every adopt/span is
+    the one-branch no-op) vs ON at the DEFAULT 1/256 head sampling
+    against an active hub.  The ON leg pays, per request, exactly what
+    the transport edges pay: mint the context, render the header string,
+    re-parse it, adopt it, and open the hop span (emitted for the ~0.4%
+    sampled traces, elided otherwise).  Gate: overhead <= 1% of
+    baseline throughput.
+
+    The GATED number is deterministic: per-request propagation cost
+    (tight-loop median over the exact wrapper, sans the submit) divided
+    by the baseline per-request service time (clients / closed-loop
+    rps) — the throughput delta the A/B converges to in expectation.
+    The raw alternating off/on closed-loop pairs are still run and
+    reported, but this box's throughput drifts 10-30% between
+    back-to-back IDENTICAL legs, so the raw delta measures machine
+    weather, not the ~0.3% tracing cost."""
+    from photon_ml_tpu import telemetry as telemetry_mod
+    from photon_ml_tpu.serving import loadgen
+    from photon_ml_tpu.serving.batcher import BatcherConfig
+    from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+    from photon_ml_tpu.serving.service import ScoringService
+    from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+    from photon_ml_tpu.telemetry.recorder import FlightRecorder
+
+    n_entities = 10_000
+    duration = 1.5 if SMALL else 4.0
+    clients = 16
+    _log(f"tracing: building synthetic GAME model ({n_entities} "
+         "entities)...")
+    workload = SyntheticWorkload(
+        n_entities=n_entities, fixed_dim=64, re_dim=8, seed=11
+    )
+    runtime = ScoringRuntime(
+        workload.model, workload.index_maps,
+        RuntimeConfig(max_batch_size=64, hot_entities=4096),
+    )
+    service = ScoringService(runtime, BatcherConfig(
+        max_batch_size=64, max_wait_us=1000, max_queue=1024,
+    ))
+
+    # ON leg: an ACTIVE hub (in-memory ring sink — no disk I/O in the
+    # timed window) at the default head-sampling rate, driven the way
+    # the HTTP edge drives it.
+    traced_hub = telemetry_mod.Telemetry(sinks=[FlightRecorder()])
+    TraceContext = telemetry_mod.TraceContext
+
+    def submit_traced(request):
+        ctx = traced_hub.new_trace()
+        wire = ctx.header_value()          # what the transport renders
+        parsed = TraceContext.parse(wire)  # ...and the far edge parses
+        with traced_hub.adopt(parsed), \
+                traced_hub.span("serving.http_score"):
+            return service.submit(request)
+
+    pairs = 3
+    off_rps: list = []
+    on_rps: list = []
+    with service:
+        loadgen.closed_loop(
+            service.submit, workload.request, clients=4, duration_s=0.5
+        )
+        for k in range(pairs):
+            for leg, submit, sink in (
+                ("off", service.submit, off_rps),
+                ("on", submit_traced, on_rps),
+            ):
+                report = loadgen.closed_loop(
+                    submit, workload.request,
+                    clients=clients, duration_s=duration,
+                )
+                sink.append(report.snapshot()["throughput_rps"])
+                _log(f"tracing: pair {k} leg {leg}: {sink[-1]} rps")
+    # Deterministic per-request propagation cost: the SAME wrapper with
+    # the submit replaced by a no-op, tight loop, median of 5 runs.
+    def noop_submit(request):
+        return request
+
+    n_iter = 50_000
+    costs = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for i in range(n_iter):
+            ctx = traced_hub.new_trace()
+            wire = ctx.header_value()
+            parsed = TraceContext.parse(wire)
+            with traced_hub.adopt(parsed), \
+                    traced_hub.span("serving.http_score"):
+                noop_submit(None)
+        costs.append((time.perf_counter() - t0) / n_iter)
+    cost_s = float(np.median(costs))
+    traced_hub.close()
+
+    base = float(np.median(off_rps))
+    # Closed loop: rps = clients / t_req, so adding cost_s per request
+    # costs cost_s / t_req = cost_s * rps / clients of throughput.
+    t_req = clients / base if base > 0 else float("inf")
+    overhead = cost_s / t_req
+    raw_deltas = [
+        round(1.0 - on / off, 4) if off > 0 else None
+        for off, on in zip(off_rps, on_rps)
+    ]
+    _log(f"tracing: {cost_s * 1e6:.2f} us/request propagation cost over "
+         f"{t_req * 1e3:.2f} ms/request baseline -> {overhead * 100:.3f}% "
+         f"throughput overhead (gate: <= 1%); raw A/B deltas "
+         f"{raw_deltas} (machine noise)")
+    return {
+        "tracing_baseline_rps": round(base, 1),
+        "tracing_on_rps": round(float(np.median(on_rps)), 1),
+        "tracing_off_rps": off_rps,
+        "tracing_on_rps_legs": on_rps,
+        "tracing_raw_ab_deltas": raw_deltas,
+        "tracing_cost_us_per_request": round(cost_s * 1e6, 3),
+        "tracing_sample_every": traced_hub.trace_sample_every,
+        "tracing_overhead_frac": round(overhead, 5),
+        "tracing_overhead_pass": overhead <= 0.01,
+    }
+
+
 def bench_freshness() -> dict:
     """Continuous train→serve loop (PR 12): the wall cost of staying
     fresh.  Two measurements:
@@ -2311,6 +2433,11 @@ def main() -> None:
             extra.update(bench_telemetry())
         except Exception as e:  # new section: never sink the headline
             extra["telemetry_ops_plane_overhead_frac"] = f"failed: {e}"
+    if ONLY in ("", "tracing"):
+        try:
+            extra.update(bench_tracing())
+        except Exception as e:  # new section: never sink the headline
+            extra["tracing_overhead_frac"] = f"failed: {e}"
     if ONLY in ("", "analysis"):
         try:
             extra.update(bench_analysis())

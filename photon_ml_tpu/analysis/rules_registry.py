@@ -39,7 +39,7 @@ SUBSYSTEMS = frozenset({
     "h2d", "hbm", "prefetch", "stream", "streaming", "staging",
     "solver", "cd", "grid", "game", "glm", "watchdog", "checkpoint",
     "chaos", "serving", "tuning", "compile", "run", "telemetry",
-    "evaluation", "model", "analysis", "freshness",
+    "evaluation", "model", "analysis", "freshness", "fleet", "slo",
 })
 
 #: Last name token: what the value measures.

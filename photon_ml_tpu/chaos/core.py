@@ -213,6 +213,14 @@ KNOWN_SITES = {
         "never zero), bounding fleet over-admission to one lease "
         "window (the quota_partition scenario's gate)"
     ),
+    "telemetry.scrape": (
+        "fleet aggregator scrape, before one host's /snapshot fetch "
+        "(telemetry/fleet.py _scrape_host; ctx: host) — a fault is the "
+        "host dropping off the network mid-scrape: the aggregator must "
+        "degrade to the host's last-seen snapshot (counted in "
+        "fleet_scrape_failures_total, aged by the staleness gauge) and "
+        "keep folding every other host — the loop never wedges"
+    ),
 }
 
 

@@ -131,9 +131,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="default per-request deadline (None = no deadline)",
     )
     p.add_argument(
-        "--tenant-report", metavar="METRICS_TS_JSONL",
-        help="summarize per-tenant rps/shed/p99 from a metrics_ts.jsonl "
-        "(the serving_tenant_* family) as JSON and exit",
+        "--tenant-report", metavar="METRICS_TS_JSONL", nargs="+",
+        help="summarize per-tenant rps/shed/p99 from one or more "
+        "metrics_ts.jsonl files (the serving_tenant_* family) as JSON "
+        "and exit; several files — one per host — merge into per-host "
+        "sections plus a fleet-wide fold",
     )
     p.add_argument(
         "--loadgen", choices=["closed", "open"],
@@ -1242,6 +1244,59 @@ def tenant_report(ts_path: str) -> dict:
     return report
 
 
+def tenant_report_multi(ts_paths) -> dict:
+    """Fleet-grain tenant accounting: one :func:`tenant_report` per
+    metrics_ts.jsonl (one file per host), keyed by the host identity the
+    sampler recorded (falling back to the file name when two hosts
+    collide or a pre-PR-17 file carries none), plus a fleet-wide fold —
+    additive columns sum, latency percentiles report the WORST host
+    (the number a fleet SLO is judged on).  A single path keeps the
+    original single-host report shape."""
+    paths = list(ts_paths)
+    if len(paths) == 1:
+        return tenant_report(paths[0])
+    from photon_ml_tpu.telemetry.timeseries import read_series
+
+    hosts: dict = {}
+    for path in paths:
+        rep = tenant_report(path)
+        records = read_series(path)
+        host_id = None
+        for rec in reversed(records):
+            identity = rec.get("host")
+            if isinstance(identity, dict) and identity.get("host_id"):
+                host_id = str(identity["host_id"])
+                break
+        key = host_id or os.path.basename(os.path.dirname(path)) or path
+        if key in hosts:
+            key = f"{key}:{path}"
+        hosts[key] = rep
+
+    fleet: dict = {}
+    for rep in hosts.values():
+        for slug, row in rep["tenants"].items():
+            agg = fleet.setdefault(slug, {
+                "requests": 0, "rps": 0.0, "shed": 0, "shed_rps": 0.0,
+                "rejected": 0, "completed": 0, "hosts": 0,
+                "latency_p50_ms": None, "latency_p99_ms": None,
+            })
+            agg["hosts"] += 1
+            for col in ("requests", "shed", "rejected", "completed"):
+                agg[col] += row[col]
+            for col in ("rps", "shed_rps"):
+                agg[col] = round(agg[col] + row[col], 2)
+            for col in ("latency_p50_ms", "latency_p99_ms"):
+                if row[col] is not None:
+                    agg[col] = (
+                        row[col] if agg[col] is None
+                        else max(agg[col], row[col])
+                    )
+    return {
+        "hosts": hosts,
+        "fleet": {"tenants": fleet},
+    }
+
+
 # ---------------------------------------------------------------------------
 # Entry point
 # ---------------------------------------------------------------------------
@@ -1251,7 +1306,7 @@ def main(argv=None) -> int:
 
     if args.tenant_report:
         try:
-            report = tenant_report(args.tenant_report)
+            report = tenant_report_multi(args.tenant_report)
         except (OSError, ValueError) as exc:
             print(f"tenant report failed: {exc}", file=sys.stderr)
             return 1

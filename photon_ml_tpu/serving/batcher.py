@@ -226,6 +226,13 @@ class _Pending:
     #: when tenancy is off.  Dispatch decrements the partition depth
     #: through this reference once the row leaves the queue.
     tenant_state: Optional[object] = None
+    #: stage-decomposition timestamps (perf_counter seconds): when
+    #: submit finished admission and enqueued the row, and when the
+    #: dispatch loop pulled it back out.  Together with the group's
+    #: scoring wall they split ``latency_ms`` into admission / queue /
+    #: batch-wait / device stages (docs/telemetry.md).
+    t_enqueue: float = 0.0
+    t_pickup: float = 0.0
 
 
 _STOP = object()
@@ -699,6 +706,7 @@ class MicroBatcher:
             tel.gauge(
                 f"serving_tenant_{state.slug}_queue_depth"
             ).set(depth)
+        pending.t_enqueue = time.perf_counter()
         try:
             self._queue.put_nowait(pending)
         except queue.Full:
@@ -718,6 +726,9 @@ class MicroBatcher:
         self._count("submitted")
         tel.counter("serving_requests_total").inc()
         tel.gauge("serving_queue_depth").set(self._queue.qsize())
+        tel.histogram("serving_stage_admission_seconds").observe(
+            max(0.0, pending.t_enqueue - now)
+        )
         return pending.future
 
     # -- dispatch loop (one thread) ----------------------------------------
@@ -757,10 +768,11 @@ class MicroBatcher:
             item = self._queue.get()
             if item is _STOP:
                 return
+            item.t_pickup = time.perf_counter()
             batch = [item]
             stop_after = False
             wait_s = self._wait_budget_s()
-            t_close = time.perf_counter() + wait_s
+            t_close = item.t_pickup + wait_s
             while len(batch) < self.config.max_batch_size:
                 remaining = t_close - time.perf_counter()
                 if remaining <= 0:
@@ -772,6 +784,7 @@ class MicroBatcher:
                 if nxt is _STOP:
                     stop_after = True
                     break
+                nxt.t_pickup = time.perf_counter()
                 batch.append(nxt)
             self._dispatch(batch)
             if stop_after:
@@ -844,6 +857,7 @@ class MicroBatcher:
             ):
                 chaos_mod.maybe_fail("serving.batch", rows=len(live))
                 for tenant, rt, rows in groups:
+                    t_score = time.perf_counter()
                     try:
                         if tenant is not None:
                             # The tenant-routed scoring path is its own
@@ -859,24 +873,27 @@ class MicroBatcher:
                         )
                     except Exception as exc:  # noqa: BLE001 — per-group
                         outcomes.append(
-                            (tenant, rt, rows, None, None, exc)
+                            (tenant, rt, rows, None, None, exc,
+                             t_score, 0.0)
                         )
                     else:
                         outcomes.append(
-                            (tenant, rt, rows, margins, means, None)
+                            (tenant, rt, rows, margins, means, None,
+                             t_score, time.perf_counter() - t_score)
                         )
         except Exception as exc:  # noqa: BLE001 — classified + surfaced
             # A batch-level fault (serving.batch chaos, trace plumbing)
             # fails every live row, exactly like the pre-tenancy single
             # group did.
             outcomes = [
-                (tenant, rt, rows, None, None, exc)
+                (tenant, rt, rows, None, None, exc, now, 0.0)
                 for tenant, rt, rows in groups
             ]
         done = time.perf_counter()
         failed_states: dict = {}
         ok_states: dict = {}
-        for tenant, rt, rows, margins, means, exc in outcomes:
+        for tenant, rt, rows, margins, means, exc, t_score, device_s \
+                in outcomes:
             if exc is not None:
                 for p in rows:
                     self._fail(p, exc)
@@ -902,6 +919,27 @@ class MicroBatcher:
                 tel.histogram(
                     "serving_request_latency_seconds"
                 ).observe(latency)
+                # Per-request latency decomposition: where inside
+                # ``latency_ms`` the time went (docs/telemetry.md
+                # "stage decomposition").  admission = submit-side
+                # admission control, queue = waiting to be picked up,
+                # batch = waiting for batch-mates + grouping, device =
+                # this row's group's scoring wall.
+                stages = {
+                    "admission_s": max(0.0, p.t_enqueue - p.t_submit),
+                    "queue_s": max(0.0, p.t_pickup - p.t_enqueue),
+                    "batch_s": max(0.0, t_score - p.t_pickup),
+                    "device_s": device_s,
+                }
+                tel.histogram(
+                    "serving_stage_queue_seconds"
+                ).observe(stages["queue_s"])
+                tel.histogram(
+                    "serving_stage_batch_seconds"
+                ).observe(stages["batch_s"])
+                tel.histogram(
+                    "serving_stage_device_seconds"
+                ).observe(stages["device_s"])
                 st = p.tenant_state
                 if st is not None:
                     ok_states.setdefault(id(st), st)
@@ -911,11 +949,17 @@ class MicroBatcher:
                     ).observe(latency)
                 if not p.future.set_running_or_notify_cancel():
                     continue  # client cancelled while queued
-                p.future.set_result({
+                result = {
                     "score": float(margins[i]),
                     "mean": float(means[i]),
                     "latency_ms": latency * 1e3,
-                })
+                }
+                if getattr(p.row, "want_stages", False):
+                    # Opt-in response annotation; an extra result key
+                    # deliberately leaves the IPC result fast path
+                    # (protocol.py keys check) and rides pickle/JSON.
+                    result["stages"] = stages
+                p.future.set_result(result)
         if self._tenancy is not None and (failed_states or ok_states):
             # Feed each tenant's breaker with this dispatch's outcomes.
             # A state that both failed and succeeded in one dispatch

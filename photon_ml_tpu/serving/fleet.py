@@ -99,15 +99,20 @@ def _http_json(
 def _http_post_raw(
     url: str, body: Optional[bytes], content_type: str,
     timeout_s: float = 30.0, method: str = "POST",
+    headers: Optional[dict] = None,
 ) -> tuple[int, dict]:
     """One round-trip with a PRE-ENCODED body; returns ``(status,
     body_dict)``.  A binary response frame decodes into the same
     ``{"results": [...]}`` shape the JSON path returns (plus a
     top-level ``"error"`` mirror of the first failed row, so the
-    status-code verdict logic reads both formats identically)."""
+    status-code verdict logic reads both formats identically).
+    ``headers`` adds extra request headers (the trace-context header
+    rides here) without touching the content-type negotiation."""
+    hdrs = {"Content-Type": content_type}
+    if headers:
+        hdrs.update(headers)
     req = urllib.request.Request(
-        url, data=body, method=method,
-        headers={"Content-Type": content_type},
+        url, data=body, method=method, headers=hdrs,
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
@@ -286,7 +291,7 @@ class FleetRouter:
                 break
             if item is _STOP:
                 continue
-            _, fut, _ = item
+            fut = item[1]
             if fut.set_running_or_notify_cancel():
                 fut.set_exception(RuntimeError(
                     "UNAVAILABLE: fleet router stopped before dispatch; "
@@ -308,10 +313,19 @@ class FleetRouter:
         pending queue is full (backpressure, not a host verdict)."""
         if not self._started:
             raise RuntimeError("fleet router is not started")
+        # The router is the request's entry into the fleet: mint the
+        # ROOT trace context here (head sampling decides once, every
+        # downstream hop re-derives the verdict from the id) — unless
+        # the caller is already inside a traced request, whose context
+        # propagates instead.
+        tel = telemetry_mod.current()
+        ctx = tel.propagation_context()
+        if ctx is None and tel.active:
+            ctx = tel.new_trace()
         fut: Future = Future()
         try:
             self._queue.put_nowait(
-                (request, fut, time.perf_counter())
+                (request, fut, time.perf_counter(), ctx)
             )
         except queue.Full:
             telemetry_mod.current().counter(
@@ -355,7 +369,7 @@ class FleetRouter:
             try:
                 self._route(item)
             except Exception as exc:  # noqa: BLE001 — never kill a worker
-                _, fut, _ = item
+                fut = item[1]
                 if fut.set_running_or_notify_cancel():
                     fut.set_exception(exc)
 
@@ -377,14 +391,18 @@ class FleetRouter:
         with self._lock:
             host.inflight -= 1
 
-    def _encode_request(self, request: dict) -> tuple[bytes, str]:
+    def _encode_request(
+        self, request: dict, trace: Optional[str] = None
+    ) -> tuple[bytes, str]:
         """Encode one wire request body, ONCE per routed request — the
         peer-retry loop reuses these bytes on every resubmission, so a
-        retry costs a socket, never a re-serialization."""
+        retry costs a socket, never a re-serialization.  ``trace`` rides
+        the frame's v2 ``trace:ctx`` column on the binary path (the
+        JSON path carries it as an HTTP header instead)."""
         if self.wire_format == "binary":
             try:
                 return (
-                    wire_mod.encode_request([request]),
+                    wire_mod.encode_request([request], trace=trace),
                     wire_mod.CONTENT_TYPE,
                 )
             except ValueError:
@@ -397,9 +415,28 @@ class FleetRouter:
         )
 
     def _route(self, item) -> None:
-        request, fut, t_submit = item
-        body, content_type = self._encode_request(request)
+        request, fut, t_submit, ctx = item
         tel = telemetry_mod.current()
+        # The routing span is the trace's root span on this node: every
+        # host-side hop parents to it via the propagated context (HTTP
+        # header on the JSON path, wire v2 trace:ctx column on the
+        # binary path), so one fleet request reads as ONE stitched tree
+        # across router, host, and worker processes.
+        with tel.adopt(ctx), tel.span("serving.fleet_route"):
+            pctx = tel.propagation_context()
+            trace_value = None if pctx is None else pctx.header_value()
+            headers = (
+                {telemetry_mod.TRACE_HEADER: trace_value}
+                if trace_value is not None else None
+            )
+            body, content_type = self._encode_request(request, trace_value)
+            self._route_one(
+                fut, t_submit, body, content_type, headers, tel
+            )
+
+    def _route_one(
+        self, fut, t_submit, body, content_type, headers, tel
+    ) -> None:
         tried: set = set()
         last_reject: Optional[Exception] = None
         no_host_deadline: Optional[float] = None
@@ -435,7 +472,7 @@ class FleetRouter:
                 chaos_mod.maybe_fail("serving.host", host=host.hid)
                 status, obj = _http_post_raw(
                     host.base_url + "/score", body, content_type,
-                    self.request_timeout_s,
+                    self.request_timeout_s, headers=headers,
                 )
             except Exception as exc:  # noqa: BLE001 — transport failure
                 self._release(host)

@@ -324,18 +324,31 @@ class ProcessReplica:
             self._next_id += 1
             future: Future = Future()
             self._inflight[request_id] = future
+        # Cross-process trace propagation: the submitting span's global
+        # context rides the score frame (wire.py meta:trace column) so
+        # the worker's serving.batch span parents to it and the request
+        # stitches into ONE trace across the process boundary.
+        pctx = telemetry_mod.current().propagation_context()
+        message = {
+            "kind": "score",
+            "id": request_id,
+            "row": row,
+            # The tenant id rides the frame explicitly (not only
+            # inside the pickled row) so the worker can stamp rows
+            # built by older parsers and the wire stays greppable.
+            "tenant": getattr(row, "tenant", None),
+            "timeout_ms": timeout_ms,
+            "bypass": bypass_admission,
+        }
+        if pctx is not None:
+            message["trace"] = pctx.header_value()
+        if getattr(row, "want_stages", False):
+            # Stage-annotation opt-in must survive the wire fast path
+            # (which re-builds the row from columns); the flag rides the
+            # frame and the worker re-stamps the row.
+            message["stages"] = True
         try:
-            self._conn.send({
-                "kind": "score",
-                "id": request_id,
-                "row": row,
-                # The tenant id rides the frame explicitly (not only
-                # inside the pickled row) so the worker can stamp rows
-                # built by older parsers and the wire stays greppable.
-                "tenant": getattr(row, "tenant", None),
-                "timeout_ms": timeout_ms,
-                "bypass": bypass_admission,
-            })
+            self._conn.send(message)
         except Exception as exc:  # noqa: BLE001 — connection is gone
             with self._lock:
                 self._inflight.pop(request_id, None)

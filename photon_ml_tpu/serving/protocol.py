@@ -63,13 +63,21 @@ def _encode_payload(message: Any) -> bytes:
     if isinstance(message, dict):
         kind = message.get("kind")
         try:
-            if kind == "score" and isinstance(message.get("id"), int):
+            if (
+                kind == "score"
+                and isinstance(message.get("id"), int)
+                # The stage-annotation opt-in flag has no wire column;
+                # it rides the pickle fallback (it is off the hot path
+                # by definition).
+                and not message.get("stages")
+            ):
                 return bytes([_PAYLOAD_SCORE]) + wire_mod.encode_score_ipc(
                     message["id"],
                     message["row"],
                     tenant=message.get("tenant"),
                     timeout_ms=message.get("timeout_ms"),
                     bypass=bool(message.get("bypass")),
+                    trace=message.get("trace"),
                 )
             if (
                 kind == "result"
@@ -79,7 +87,8 @@ def _encode_payload(message: Any) -> bytes:
                 and set(message["value"]) == _RESULT_KEYS
             ):
                 return bytes([_PAYLOAD_RESULT]) + wire_mod.encode_result_ipc(
-                    message["id"], message["value"]
+                    message["id"], message["value"],
+                    trace=message.get("trace"),
                 )
         except Exception:  # noqa: BLE001 — fall back to pickle
             pass

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from concurrent.futures import Future
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
@@ -216,16 +217,25 @@ class ScoringService:
         return self.swapper.swap(model_dir, tenant=tenant)
 
     # -- scoring -----------------------------------------------------------
-    def submit(self, request, timeout_ms: Optional[float] = None) -> Future:
+    def submit(
+        self,
+        request,
+        timeout_ms: Optional[float] = None,
+        annotate_stages: bool = False,
+    ) -> Future:
         """Parse + enqueue one request (dict or pre-parsed Row); returns
         the future.  Raises RejectedError on a full queue or load shed
-        and ValueError on malformed input."""
+        and ValueError on malformed input.  ``annotate_stages`` asks the
+        batcher to attach the per-request latency decomposition to the
+        result (the opt-in ``stages`` key — docs/telemetry.md)."""
         if isinstance(request, Row):
             row = request
         elif self.supervisor is not None:
             row = self.supervisor.parse_request(request)
         else:
             row = self.current_runtime.parse_request(request)
+        if annotate_stages:
+            row.want_stages = True
         # Offered demand, counted BEFORE admission: a shed request is
         # still demand — exactly the signal lease rebalancing needs
         # (a host shedding for lack of lease must report the pressure).
@@ -257,7 +267,10 @@ class ScoringService:
         return parser
 
     def score_many(
-        self, requests: Sequence, timeout: Optional[float] = 30.0
+        self,
+        requests: Sequence,
+        timeout: Optional[float] = 30.0,
+        annotate_stages: bool = False,
     ) -> list:
         """Submit all, then gather — concurrent submissions coalesce into
         shared batches.  Per-row failures come back as result dicts
@@ -267,7 +280,10 @@ class ScoringService:
         futures: list[tuple[int, Future]] = []
         for i, req in enumerate(requests):
             try:
-                futures.append((i, self.submit(req)))
+                futures.append((
+                    i,
+                    self.submit(req, annotate_stages=annotate_stages),
+                ))
             except (RejectedError, ValueError, DeadlineExceededError) as exc:
                 slots[i] = _error_result(exc)
         for i, fut in futures:
@@ -466,6 +482,20 @@ class _Handler(BaseHTTPRequestHandler):
         ctype = self.headers.get("Content-Type") or ""
         return ctype.split(";", 1)[0].strip().lower()
 
+    def _trace_context(self):
+        """The caller's propagated trace context, from the
+        ``X-Photon-Trace`` header (None when absent/malformed — an
+        untraceable header must never fail the request)."""
+        return telemetry_mod.TraceContext.parse(
+            self.headers.get(telemetry_mod.TRACE_HEADER) or ""
+        )
+
+    def _want_stages(self) -> bool:
+        """Per-request opt-in for the latency-decomposition annotation
+        (``X-Photon-Stages: 1``)."""
+        value = (self.headers.get("X-Photon-Stages") or "").strip().lower()
+        return value in ("1", "true", "yes")
+
     def do_POST(self) -> None:  # noqa: N802 — stdlib casing
         # Split the query string off before routing: the reload mode
         # rides it (POST /reload?mode=delta).
@@ -492,8 +522,21 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError) as exc:
             self._send_json(400, {"error": f"bad request: {exc}"})
             return
-        results = self.server.service.score_many(rows)
+        # Distributed tracing, JSON path: adopt the caller's context so
+        # this hop's span — and the batcher's serving.batch span behind
+        # it — stitch into the caller's trace (docs/telemetry.md).
+        tel = telemetry_mod.current()
+        with tel.adopt(self._trace_context()), tel.span(
+            "serving.http_score", rows=len(rows)
+        ):
+            results = self.server.service.score_many(
+                rows, annotate_stages=self._want_stages()
+            )
+        t_encode = time.perf_counter()
         self._send_json(_status_for(results), {"results": results})
+        tel.histogram("serving_stage_encode_seconds").observe(
+            time.perf_counter() - t_encode
+        )
 
     def _do_score_binary(self) -> None:
         """POST /score with a wire-frame body: decode zero-copy into
@@ -504,7 +547,7 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._read_raw()
         tel.counter("serving_wire_rx_bytes").inc(len(body))
         try:
-            rows = wire_mod.decode_request(
+            rows, trace = wire_mod.decode_request_ex(
                 body, self.server.service.request_parser()
             )
         except wire_mod.WireFormatError as exc:
@@ -516,13 +559,34 @@ class _Handler(BaseHTTPRequestHandler):
             return
         tel.counter("serving_wire_requests_total").inc()
         tel.counter("serving_wire_rows_total").inc(len(rows))
-        results = self.server.service.score_many(rows)
+        # Distributed tracing, binary path: the wire v2 trace:ctx column
+        # wins (it rode the frame itself); the HTTP header is the
+        # fallback for v1 frames POSTed by a traced client.
+        ctx = None
+        if trace is not None:
+            ctx = telemetry_mod.TraceContext.parse(trace)
+        if ctx is None:
+            ctx = self._trace_context()
+        with tel.adopt(ctx), tel.span(
+            "serving.http_score", rows=len(rows)
+        ):
+            results = self.server.service.score_many(
+                rows, annotate_stages=self._want_stages()
+            )
         status = _status_for(results)
         accept = (self.headers.get("Accept") or "").lower()
         if "application/json" in accept:
+            t_encode = time.perf_counter()
             self._send_json(status, {"results": results})
+            tel.histogram("serving_stage_encode_seconds").observe(
+                time.perf_counter() - t_encode
+            )
             return
+        t_encode = time.perf_counter()
         frame = wire_mod.encode_response(results)
+        tel.histogram("serving_stage_encode_seconds").observe(
+            time.perf_counter() - t_encode
+        )
         tel.counter("serving_wire_tx_bytes").inc(len(frame))
         self.send_response(status)
         self.send_header("Content-Type", wire_mod.CONTENT_TYPE)

@@ -14,7 +14,15 @@ Layout (all little-endian)::
     ring header  <4s magic "PHSI"> <u16 version> <u16 reserved>
                  <u32 n_slots> <u32 slot_bytes> <u32 publisher_pid>
     slot[i]      <u32 state> <u32 seq> <u32 length> <u32 reserved>
+                 <u64 trace> <u64 span> <u32 flags>
                  + slot_bytes of payload
+
+Ring version 2 grew the per-slot trace-context words (trace / span /
+flags — :meth:`~photon_ml_tpu.telemetry.core.TraceContext.to_words`):
+a client inside a traced request writes its propagated context before
+flipping the slot to REQUEST, and the server adopts it around scoring
+so the shm hop's spans stitch into the caller's distributed trace.
+All-zero words (``trace == 0``) mean "untraced" and cost nothing.
 
 Slot states walk ``FREE → REQUEST → BUSY → RESPONSE → FREE``: the
 client owns a FREE slot, writes a request frame, flips it to REQUEST;
@@ -54,9 +62,12 @@ from photon_ml_tpu import telemetry as telemetry_mod
 __all__ = ["ShmIngress", "ShmIngressClient", "ShmIngressError"]
 
 _RING_HEADER = struct.Struct("<4sHHIII")
-_SLOT_HEADER = struct.Struct("<IIII")
+_SLOT_HEADER = struct.Struct("<IIIIQQI")
+#: the trace-context words alone, at offset 16 inside the slot header
+#: (after the four aligned u32 control fields, whose offsets v2 keeps).
+_TRACE_WORDS = struct.Struct("<QQI")
 _MAGIC = b"PHSI"
-_VERSION = 1
+_VERSION = 2
 
 #: slot states
 _FREE, _REQUEST, _BUSY, _RESPONSE = 0, 1, 2, 3
@@ -128,7 +139,9 @@ class ShmIngress:
         )
         for i in range(n_slots):
             off, _ = _slot_offsets(i, slot_bytes)
-            _SLOT_HEADER.pack_into(self._shm.buf, off, _FREE, 0, 0, 0)
+            _SLOT_HEADER.pack_into(
+                self._shm.buf, off, _FREE, 0, 0, 0, 0, 0, 0
+            )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -188,18 +201,25 @@ class ShmIngress:
         tel = telemetry_mod.current()
         buf = self._shm.buf
         off, data_off = _slot_offsets(i, self.slot_bytes)
-        _state, seq, length, _res = _SLOT_HEADER.unpack_from(buf, off)
+        (_state, seq, length, _res,
+         trace_w, span_w, flags) = _SLOT_HEADER.unpack_from(buf, off)
+        # Trace adoption from the slot header's words: the handler's
+        # spans (and the batcher's serving.batch span downstream) parent
+        # to the CLIENT's span, so the shm hop rides the caller's
+        # distributed trace.  Zero words = untraced, ctx = None.
+        ctx = telemetry_mod.TraceContext.from_words(trace_w, span_w, flags)
         payload = bytes(buf[data_off:data_off + min(length, self.slot_bytes)])
         tel.counter("serving_ingress_rx_bytes").inc(len(payload))
         n_rows = 1
         try:
-            rows = wire_mod.decode_request(
-                payload, self.service.request_parser()
-            )
-            n_rows = len(rows)
-            tel.counter("serving_ingress_requests_total").inc()
-            tel.counter("serving_ingress_rows_total").inc(n_rows)
-            results = self.service.score_many(rows)
+            with tel.adopt(ctx):
+                rows = wire_mod.decode_request(
+                    payload, self.service.request_parser()
+                )
+                n_rows = len(rows)
+                tel.counter("serving_ingress_requests_total").inc()
+                tel.counter("serving_ingress_rows_total").inc(n_rows)
+                results = self.service.score_many(rows)
         except Exception as exc:  # noqa: BLE001 — answer in-band
             tel.counter("serving_ingress_errors_total").inc()
             kind = (
@@ -209,7 +229,11 @@ class ShmIngress:
                 else "internal"
             )
             results = [{"error": str(exc), "kind": kind}] * n_rows
+        t_encode = time.perf_counter()
         frame = wire_mod.encode_response(results)
+        tel.histogram("serving_stage_encode_seconds").observe(
+            time.perf_counter() - t_encode
+        )
         if len(frame) > self.slot_bytes:
             tel.counter("serving_ingress_errors_total").inc()
             overflow = {
@@ -334,9 +358,16 @@ class ShmIngressClient:
         i = self._acquire(deadline)
         buf = self._shm.buf
         off, data_off = _slot_offsets(i, self.slot_bytes)
-        _state, seq, _len, _res = _SLOT_HEADER.unpack_from(buf, off)
+        (_state, seq, _len, _res,
+         _tw, _sw, _fl) = _SLOT_HEADER.unpack_from(buf, off)
         seq = (seq + 1) & 0xFFFFFFFF
         buf[data_off:data_off + len(frame)] = frame
+        # Trace-context words ride the slot header (before the state
+        # flip, like the payload): the server parents its handling spans
+        # to this caller's span.  No active trace writes zeros.
+        pctx = telemetry_mod.current().propagation_context()
+        words = pctx.to_words() if pctx is not None else (0, 0, 0)
+        _TRACE_WORDS.pack_into(buf, off + 16, *words)
         _U32.pack_into(buf, off + 8, len(frame))
         _U32.pack_into(buf, off + 4, seq)
         _U32.pack_into(buf, off, _REQUEST)

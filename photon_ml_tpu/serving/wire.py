@@ -61,7 +61,13 @@ CONTENT_TYPE = "application/x-photon-frame"
 #: believing a forged length.
 MAX_WIRE_BYTES = 256 << 20
 
-WIRE_VERSION = 1
+#: v2 adds OPTIONAL trace-context string columns (``trace:ctx`` on
+#: request frames, ``meta:trace`` on worker-IPC frames) — distributed
+#: tracing, PR 17.  Decoders accept every version in
+#: :data:`COMPAT_VERSIONS`: a v1 frame simply has no trace column, so
+#: old senders keep working against new receivers unchanged.
+WIRE_VERSION = 2
+COMPAT_VERSIONS = frozenset({1, 2})
 
 #: frame kinds (header byte)
 KIND_REQUEST = 1
@@ -147,10 +153,10 @@ def decode_columns(buf) -> tuple:
         raise WireFormatError(
             f"bad magic {bytes(magic)!r}: not a wire frame"
         )
-    if version != WIRE_VERSION:
+    if version not in COMPAT_VERSIONS:
         raise WireFormatError(
             f"unknown wire version {version} (this build speaks "
-            f"{WIRE_VERSION})"
+            f"{sorted(COMPAT_VERSIONS)})"
         )
     if names_len > MAX_WIRE_BYTES or payload_len > MAX_WIRE_BYTES:
         raise WireFormatError(
@@ -257,10 +263,31 @@ def _decode_strings(
 
 
 # ---------------------------------------------------------------------------
+# Trace-context columns (optional, v2)
+# ---------------------------------------------------------------------------
+
+def _encode_trace(columns: dict, name: str, trace: Optional[str]) -> None:
+    """Attach the serialized trace context (``TraceContext.
+    header_value()``) as a one-entry optional string column.  None
+    attaches nothing — an untraced frame is byte-identical to v1 except
+    for the version field."""
+    if trace is not None:
+        _encode_strings(columns, name, [str(trace)])
+
+
+def _decode_trace(columns: dict, name: str, n: int = 1) -> Optional[str]:
+    if f"{name}#off" not in columns:
+        return None  # v1 frame, or an untraced v2 frame
+    return _decode_strings(columns, name, n)[0]
+
+
+# ---------------------------------------------------------------------------
 # Request layer
 # ---------------------------------------------------------------------------
 
-def encode_request(requests: Sequence[dict]) -> bytes:
+def encode_request(
+    requests: Sequence[dict], trace: Optional[str] = None
+) -> bytes:
     """Encode JSON-shaped request dicts into one request frame.
 
     Supports ``dense`` shards, ``ids``, ``offset``, ``timeout_ms``,
@@ -334,6 +361,7 @@ def encode_request(requests: Sequence[dict]) -> bytes:
     for key, values in id_cols.items():
         _encode_strings(columns, f"ids:{key}", values)
     _encode_strings(columns, "tenant", tenants)
+    _encode_trace(columns, "trace:ctx", trace)
     return encode_columns(columns, KIND_REQUEST, n)
 
 
@@ -396,12 +424,22 @@ def decode_request(buf, parser=None) -> list:
     the JSON parser.  ``parser=None`` is the trusted IPC path.  Feature
     vectors are zero-copy row views over ``buf``.
     """
+    return decode_request_ex(buf, parser)[0]
+
+
+def decode_request_ex(buf, parser=None) -> tuple:
+    """:func:`decode_request` plus the frame's trace context:
+    ``(rows, trace_str_or_None)``.  v1 frames and untraced v2 frames
+    decode with ``trace=None``."""
     kind, n, columns = decode_columns(buf)
     if kind != KIND_REQUEST:
         raise WireFormatError(
             f"expected a request frame, got kind {kind}"
         )
-    return _rows_from_columns(n, columns, parser)
+    return (
+        _rows_from_columns(n, columns, parser),
+        _decode_trace(columns, "trace:ctx"),
+    )
 
 
 def _rows_from_columns(n: int, columns: dict, parser) -> list:
@@ -563,6 +601,7 @@ def encode_score_ipc(
     tenant: Optional[str] = None,
     timeout_ms: Optional[float] = None,
     bypass: bool = False,
+    trace: Optional[str] = None,
 ) -> bytes:
     """Encode one score submission for worker IPC: the parsed row plus
     the frame-level routing metadata that rides beside it."""
@@ -573,6 +612,7 @@ def encode_score_ipc(
     )
     columns["meta:bypass"] = np.asarray([1 if bypass else 0], np.uint8)
     _encode_strings(columns, "meta:tenant", [tenant])
+    _encode_trace(columns, "meta:trace", trace)
     return encode_columns(columns, KIND_SCORE_IPC, 1)
 
 
@@ -598,7 +638,7 @@ def decode_score_ipc(buf) -> dict:
         None,
     )[0]
     t = float(mt[0])
-    return {
+    out = {
         "kind": "score",
         "id": int(rid[0]),
         "row": row,
@@ -606,9 +646,15 @@ def decode_score_ipc(buf) -> dict:
         "timeout_ms": None if np.isnan(t) else t,
         "bypass": bool(byp[0]),
     }
+    trace = _decode_trace(columns, "meta:trace")
+    if trace is not None:
+        out["trace"] = trace
+    return out
 
 
-def encode_result_ipc(request_id: int, value: dict) -> bytes:
+def encode_result_ipc(
+    request_id: int, value: dict, trace: Optional[str] = None
+) -> bytes:
     """Encode one successful score result for worker IPC.  Error
     results stay on the pickle path — they are rare and carry
     free-form strings."""
@@ -618,6 +664,7 @@ def encode_result_ipc(request_id: int, value: dict) -> bytes:
         "mean": np.asarray([value["mean"]], np.float64),
         "latency_ms": np.asarray([value["latency_ms"]], np.float64),
     }
+    _encode_trace(columns, "meta:trace", trace)
     return encode_columns(columns, KIND_RESULT_IPC, 1)
 
 
@@ -635,7 +682,7 @@ def decode_result_ipc(buf) -> dict:
             raise WireFormatError(
                 f"result IPC column {name!r} missing or misshaped"
             )
-    return {
+    out = {
         "kind": "result",
         "id": int(columns["meta:id"][0]),
         "ok": True,
@@ -645,3 +692,7 @@ def decode_result_ipc(buf) -> dict:
             "latency_ms": float(columns["latency_ms"][0]),
         },
     }
+    trace = _decode_trace(columns, "meta:trace")
+    if trace is not None:
+        out["trace"] = trace
+    return out

@@ -157,6 +157,10 @@ class _WorkerMain:
             "kind": "heartbeat",
             "worker": self._worker_id,
             "pid": os.getpid(),
+            # Host identity block (telemetry/exporter.py): which machine
+            # and process this heartbeat speaks for — the parent and the
+            # fleet aggregator label merged metrics with it.
+            "host": telemetry_mod.host_identity(),
             "queue_depth": self._batcher.queue_depth,
             "model_version": getattr(runtime, "model_version", 1),
             "degraded": getattr(runtime, "degraded", False),
@@ -403,12 +407,25 @@ class _WorkerMain:
         tenant = message.get("tenant")
         if tenant is not None and getattr(row, "tenant", None) is None:
             row.tenant = tenant
+        if message.get("stages"):
+            row.want_stages = True
+        # Cross-process trace adoption: the parent's propagated context
+        # rides the score frame; adopting it around submit makes the
+        # submitting thread's context — and through _Pending.ctx the
+        # dispatch thread's serving.batch span — parent to the PARENT
+        # process's span, so the request stitches into one trace.
+        trace = message.get("trace")
+        ctx = (
+            telemetry_mod.TraceContext.parse(trace)
+            if isinstance(trace, str) else None
+        )
         try:
-            future = self._batcher.submit(
-                row,
-                timeout_ms=message.get("timeout_ms"),
-                bypass_admission=bool(message.get("bypass")),
-            )
+            with telemetry_mod.current().adopt(ctx):
+                future = self._batcher.submit(
+                    row,
+                    timeout_ms=message.get("timeout_ms"),
+                    bypass_admission=bool(message.get("bypass")),
+                )
         except Exception as exc:  # noqa: BLE001 — sync admission verdict
             self._send({
                 "kind": "result", "id": request_id, "ok": False,
@@ -441,16 +458,35 @@ def worker_main(
 ) -> None:
     """Spawn target (module-level so the spawn pickler can import it).
 
-    Installs a private enabled telemetry hub (sink-less: metrics only —
-    the parent's heartbeat merge is this process's event stream),
-    attaches the shared model, and serves frames until shutdown/EOF.
-    Startup failures are reported as a ``fatal`` frame so the parent's
-    spawn raises a pointed error instead of timing out.
+    Installs a private enabled telemetry hub (sink-less by default:
+    metrics only — the parent's heartbeat merge is this process's event
+    stream), attaches the shared model, and serves frames until
+    shutdown/EOF.  With ``PHOTON_TRACE_DIR`` set in the environment the
+    hub grows real trace sinks — ``trace-worker-<id>-<pid>.trace.json``
+    (Chrome trace array) and ``.jsonl`` (record log) under that
+    directory — so the worker's spans can be merged with the parent's
+    into one stitched distributed trace (docs/telemetry.md).  Startup
+    failures are reported as a ``fatal`` frame so the parent's spawn
+    raises a pointed error instead of timing out.
     """
     _pin_platform()
     conn = FrameConn(sock)
+    sinks: list = []
+    trace_dir = os.environ.get("PHOTON_TRACE_DIR")
+    if trace_dir:
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            base = os.path.join(
+                trace_dir, f"trace-worker-{worker_id}-{os.getpid()}"
+            )
+            sinks = [
+                telemetry_mod.ChromeTraceSink(base + ".trace.json"),
+                telemetry_mod.JsonlSink(base + ".jsonl"),
+            ]
+        except OSError:
+            sinks = []  # tracing must never block serving startup
     hub = telemetry_mod.Telemetry(
-        enabled=True, sinks=[], run_name=f"serving-worker-{worker_id}"
+        enabled=True, sinks=sinks, run_name=f"serving-worker-{worker_id}"
     )
     telemetry_mod.set_current(hub)
     try:
@@ -468,5 +504,11 @@ def worker_main(
         except Exception:  # noqa: BLE001
             pass
         conn.close()
+        hub.close()
         raise SystemExit(1)
-    main.run()
+    try:
+        main.run()
+    finally:
+        # Flush the trace sinks (a sink-less close is a no-op): the
+        # parent merges the written trace-worker files after stop.
+        hub.close()

@@ -25,12 +25,14 @@ chaos fault (:func:`dump_flight_recorder`).
 
 from photon_ml_tpu.telemetry.core import (  # noqa: F401
     NULL,
+    TRACE_HEADER,
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
     Span,
     Telemetry,
+    TraceContext,
     current,
     dump_flight_recorder,
     json_safe,
@@ -39,6 +41,7 @@ from photon_ml_tpu.telemetry.core import (  # noqa: F401
 from photon_ml_tpu.telemetry.exporter import (  # noqa: F401
     MetricsExporter,
     OpsPlane,
+    host_identity,
     mount_ops_plane,
     parse_prometheus_text,
     prometheus_text,
@@ -53,4 +56,8 @@ from photon_ml_tpu.telemetry.sinks import (  # noqa: F401
 from photon_ml_tpu.telemetry.timeseries import (  # noqa: F401
     TimeSeriesSampler,
     read_series,
+)
+from photon_ml_tpu.telemetry.fleet import (  # noqa: F401
+    FleetAggregator,
+    SloPolicy,
 )

@@ -17,7 +17,18 @@ validates the outputs:
   cleanly on close;
 - **flight recorder**: an injected chaos fault (``serving.batch`` via a
   scripted FaultPlan) dumps ``flightrecorder.json`` whose last-N events
-  END at the fault site's ``chaos.fault`` record.
+  END at the fault site's ``chaos.fault`` record;
+- **fleet pass** (PR 17): a synthetic 2-host x 2-worker fleet — one
+  traced request crosses router -> host -> worker hubs via
+  ``TraceContext`` header propagation and the merged Chrome traces
+  stitch into ONE trace (shared trace id, ``rparent`` links resolving
+  across files); a :class:`FleetAggregator` scrapes both hosts' live
+  ``/snapshot`` endpoints and its aggregated ``/metrics`` exposition
+  PARSES with per-``host`` labels; injected slow latency trips the
+  multi-window SLO burn alert (``slo.burn`` event + flight-recorder
+  dump), and a scripted ``telemetry.scrape`` fault degrades to
+  last-seen snapshots (failures counted, recovery observed) without
+  wedging the poll loop.
 
 ``--lint-metrics`` runs the metric-name lint (telemetry/lint.py) over
 the package source instead: duplicate-kind registrations and
@@ -338,10 +349,368 @@ def validate_ops_plane(out_dir: str, info: dict) -> list[str]:
     return failures
 
 
+def _build_fleet_run(out_dir: str) -> dict:
+    """Synthetic 2-host x 2-worker fleet, all hubs in-process: a traced
+    request hops router -> host -> worker through real ``TraceContext``
+    header strings, the aggregator scrapes both hosts' live exporters
+    over HTTP, injected slow latency trips the burn alert, and a chaos
+    fault exercises scrape degradation.  Device-free and fast: no jax,
+    no subprocesses — the hop boundaries are exactly the header-encoded
+    contexts the real transports carry."""
+    import urllib.request
+
+    from photon_ml_tpu import chaos
+    from photon_ml_tpu.telemetry import (
+        ChromeTraceSink,
+        FleetAggregator,
+        JsonlSink,
+        MetricsExporter,
+        SloPolicy,
+        Telemetry,
+        TraceContext,
+    )
+
+    info: dict = {}
+    fleet_dir = os.path.join(out_dir, "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+
+    def _leaf_hub(name: str) -> tuple:
+        path = os.path.join(fleet_dir, name + ".trace.json")
+        hub = Telemetry(
+            sinks=[
+                ChromeTraceSink(path),
+                JsonlSink(os.path.join(fleet_dir, name + ".jsonl")),
+            ],
+            run_name=name,
+        )
+        return hub, path
+
+    # The router hub doubles as the aggregator-side current hub: the
+    # slo.burn event and its flight-recorder dump land in fleet_dir.
+    with Telemetry(output_dir=fleet_dir, run_name="fleet-router") as router:
+        router.configure_tracing(sample_every=1)
+        hosts: dict = {}
+        trace_files = [os.path.join(fleet_dir, "trace.json")]
+        try:
+            for hid in ("host-0", "host-1"):
+                hub, path = _leaf_hub(hid)
+                trace_files.append(path)
+                workers = []
+                for wk in range(2):
+                    whub, wpath = _leaf_hub(f"{hid}-worker-{wk}")
+                    trace_files.append(wpath)
+                    workers.append(whub)
+                exporter = MetricsExporter(hub, port=0, host_id=hid)
+                exporter.start()
+                hosts[hid] = {
+                    "hub": hub, "workers": workers, "exporter": exporter,
+                }
+
+            # -- one traced request fanning out across the fleet -------
+            ctx = router.new_trace()
+            info["trace_id"] = ctx.trace_id
+            info["trace_sampled"] = ctx.sampled
+            with router.adopt(ctx), router.span("serving.fleet_route"):
+                header = router.propagation_context().header_value()
+            for hid, entry in hosts.items():
+                hub = entry["hub"]
+                # Each hop re-parses the wire string — the same
+                # round-trip the HTTP header / wire frame / shm slot
+                # transports perform.
+                with hub.adopt(TraceContext.parse(header)), \
+                        hub.span("serving.http_score", host=hid):
+                    inner = hub.propagation_context().header_value()
+                    for wk, whub in enumerate(entry["workers"]):
+                        with whub.adopt(TraceContext.parse(inner)), \
+                                whub.span("serving.batch", worker=wk):
+                            pass
+
+            # -- metrics: a healthy baseline, then injected latency ----
+            for entry in hosts.values():
+                hub = entry["hub"]
+                lat = hub.histogram("serving_request_latency_seconds")
+                for _ in range(50):
+                    lat.observe(0.002)
+                for stage in ("admission", "queue", "batch", "device",
+                              "encode"):
+                    hub.histogram(
+                        f"serving_stage_{stage}_seconds"
+                    ).observe(0.001)
+
+            agg = FleetAggregator(
+                {
+                    hid: f"http://127.0.0.1:{entry['exporter'].port}"
+                    for hid, entry in hosts.items()
+                },
+                policies=[SloPolicy(
+                    name="latency-p99", p99_s=0.05, error_budget=0.01,
+                )],
+            )
+            try:
+                agg.poll_once(now=1000.0)  # baseline: all fast
+
+                # -- scrape chaos: both hosts drop off for one round ---
+                # (before the burn injection, so the burn's forensics
+                # dump is the LAST flightrecorder.json write)
+                with chaos.FaultPlan([chaos.FaultSpec(
+                    site="telemetry.scrape", at=0, count=2,
+                )]):
+                    info["faulted_report"] = agg.poll_once(now=1030.0)
+                info["recovered_report"] = agg.poll_once(now=1060.0)
+
+                for entry in hosts.values():
+                    lat = entry["hub"].histogram(
+                        "serving_request_latency_seconds"
+                    )
+                    for _ in range(20):
+                        lat.observe(1.0)  # way past the 50ms target
+                info["burn_report"] = agg.poll_once(now=1120.0)
+
+                port = agg.serve()
+                for route, key in (
+                    ("/metrics", "fleet_prom_text"),
+                    ("/slo", "fleet_slo_body"),
+                    ("/healthz", "fleet_healthz_body"),
+                ):
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{route}", timeout=10
+                    ) as resp:
+                        info[key] = resp.read().decode()
+            finally:
+                agg.stop()
+        finally:
+            for entry in hosts.values():
+                entry["exporter"].close()
+                for whub in entry["workers"]:
+                    whub.close()
+                entry["hub"].close()
+    info["trace_files"] = trace_files
+
+    # Merge the per-hub Chrome traces the way ops would before loading
+    # Perfetto: concatenate the event arrays.
+    merged: list = []
+    for path in trace_files:
+        if os.path.exists(path):
+            with open(path) as f:
+                try:
+                    merged.extend(json.load(f))
+                except json.JSONDecodeError:
+                    pass  # validated (and failed) per-file below
+    merged_path = os.path.join(fleet_dir, "merged.trace.json")
+    with open(merged_path, "w") as f:
+        json.dump(merged, f)
+    info["merged_path"] = merged_path
+    return info
+
+
+def validate_fleet(out_dir: str, info: dict) -> list[str]:
+    """Validate the fleet pass: one stitched trace across 7 hubs, a
+    parseable host-labeled aggregated exposition, a fired burn alert
+    with its forensics dump, and non-wedging scrape degradation."""
+    from photon_ml_tpu.telemetry.exporter import parse_prometheus_text
+
+    failures: list[str] = []
+    fleet_dir = os.path.join(out_dir, "fleet")
+    trace_id = info.get("trace_id")
+
+    # -- stitched trace ----------------------------------------------------
+    if not info.get("trace_sampled"):
+        failures.append("fleet: sample_every=1 trace not head-sampled")
+    gids: set = set()
+    links: list = []  # (file, rparent)
+    files_in_trace = 0
+    for path in info.get("trace_files") or []:
+        if not os.path.exists(path):
+            failures.append(f"fleet: missing trace file {path}")
+            continue
+        with open(path) as f:
+            try:
+                events = json.load(f)
+            except json.JSONDecodeError as e:
+                failures.append(f"fleet: {path} unparseable: {e}")
+                continue
+        in_trace = False
+        for ev in events:
+            args = ev.get("args") or {}
+            if ev.get("ph") == "X" and args.get("trace") == trace_id:
+                in_trace = True
+                if args.get("gid"):
+                    gids.add(args["gid"])
+                if args.get("rparent"):
+                    links.append((path, args["rparent"]))
+        if in_trace:
+            files_in_trace += 1
+    if files_in_trace != 7:
+        failures.append(
+            f"fleet: trace {trace_id} spans {files_in_trace} hub files, "
+            "expected 7 (router + 2 hosts + 4 workers)"
+        )
+    if len(links) != 6:
+        failures.append(
+            f"fleet: {len(links)} cross-hub parent links, expected 6"
+        )
+    for path, rparent in links:
+        if rparent not in gids:
+            failures.append(
+                f"fleet: {os.path.basename(path)} rparent {rparent} "
+                "resolves to no span gid in the merged trace"
+            )
+    merged_path = info.get("merged_path") or ""
+    if not os.path.exists(merged_path):
+        failures.append(f"fleet: missing merged trace {merged_path}")
+    else:
+        with open(merged_path) as f:
+            try:
+                merged = json.load(f)
+            except json.JSONDecodeError as e:
+                failures.append(f"fleet: merged trace unparseable: {e}")
+                merged = None
+        if merged is not None:
+            if not isinstance(merged, list) or not merged:
+                failures.append("fleet: merged trace not a non-empty array")
+            else:
+                for i, ev in enumerate(merged):
+                    missing = [
+                        k for k in ("name", "ph", "ts", "pid", "tid")
+                        if not isinstance(ev, dict) or k not in ev
+                    ]
+                    if missing:
+                        failures.append(
+                            f"fleet: merged[{i}] missing {missing} — "
+                            "not Perfetto-loadable"
+                        )
+                        break
+
+    # -- aggregated exposition ---------------------------------------------
+    prom = info.get("fleet_prom_text")
+    if not prom:
+        failures.append("fleet: /metrics returned no body")
+    else:
+        try:
+            parsed = parse_prometheus_text(prom)
+        except ValueError as e:
+            failures.append(f"fleet: /metrics exposition unparseable: {e}")
+            parsed = {}
+        if parsed.get(("fleet_hosts_count", "")) != 2.0:
+            failures.append("fleet: /metrics fleet_hosts_count != 2")
+        for hid in ("host-0", "host-1"):
+            key = ("serving_request_latency_seconds_count",
+                   f'{{host="{hid}"}}')
+            if key not in parsed:
+                failures.append(
+                    "fleet: /metrics lacks host-labeled latency count "
+                    f"for {hid}"
+                )
+        if ("serving_request_latency_seconds_count", "") not in parsed:
+            failures.append(
+                "fleet: /metrics lacks the fleet-wide latency fold"
+            )
+        if not any(
+            name.startswith("serving_stage_") and name.endswith("_count")
+            for name, _ in parsed
+        ):
+            failures.append(
+                "fleet: /metrics lacks serving_stage_* decomposition "
+                "families"
+            )
+        if parsed.get(("fleet_scrape_failures_total", ""), 0.0) < 2.0:
+            failures.append(
+                "fleet: fleet_scrape_failures_total < 2 after the "
+                "scripted 2-host scrape fault"
+            )
+        if parsed.get(("slo_burn_alerts_total", ""), 0.0) < 1.0:
+            failures.append("fleet: slo_burn_alerts_total never fired")
+
+    # -- burn alert --------------------------------------------------------
+    report = info.get("burn_report") or {}
+    policies = report.get("policies") or []
+    if not policies:
+        failures.append("fleet: burn report carries no policies")
+    else:
+        pol = policies[0]
+        if not pol.get("alerting"):
+            failures.append(
+                "fleet: burn alert did not fire under injected latency: "
+                f"{pol}"
+            )
+        if pol.get("fast", {}).get("burn", 0.0) < 1.0:
+            failures.append(
+                f"fleet: fast-window burn below threshold: {pol.get('fast')}"
+            )
+    slo_body = info.get("fleet_slo_body")
+    if not slo_body:
+        failures.append("fleet: /slo returned no body")
+    else:
+        try:
+            slo = json.loads(slo_body)
+        except json.JSONDecodeError as e:
+            failures.append(f"fleet: /slo not JSON: {e}")
+            slo = {}
+        for hid, entry in (slo.get("hosts") or {}).items():
+            identity = entry.get("identity") or {}
+            if identity.get("host_id") != hid:
+                failures.append(
+                    f"fleet: /slo host {hid} identity block says "
+                    f"{identity.get('host_id')!r}"
+                )
+    fr_path = os.path.join(fleet_dir, "flightrecorder.json")
+    if not os.path.exists(fr_path):
+        failures.append(
+            f"fleet: burn alert left no flight-recorder dump at {fr_path}"
+        )
+    else:
+        with open(fr_path) as f:
+            try:
+                dump = json.load(f)
+            except json.JSONDecodeError as e:
+                failures.append(f"fleet: flight dump unparseable: {e}")
+                dump = {}
+        if not str(dump.get("reason") or "").startswith("slo.burn"):
+            failures.append(
+                f"fleet: flight dump reason {dump.get('reason')!r} does "
+                "not name the burn"
+            )
+
+    # -- scrape degradation: fail soft, recover --------------------------
+    faulted = (info.get("faulted_report") or {}).get("hosts") or {}
+    for hid, entry in faulted.items():
+        if entry.get("failures", 0) < 1:
+            failures.append(
+                f"fleet: host {hid} shows no scrape failure under the "
+                "chaos plan"
+            )
+    recovered = (info.get("recovered_report") or {}).get("hosts") or {}
+    if not recovered:
+        failures.append("fleet: poll loop wedged after the scrape fault")
+    for hid, entry in recovered.items():
+        if entry.get("stale"):
+            failures.append(
+                f"fleet: host {hid} still stale after the fault cleared"
+            )
+    events_path = os.path.join(fleet_dir, "events.jsonl")
+    names = set()
+    if os.path.exists(events_path):
+        with open(events_path) as f:
+            for line in f:
+                try:
+                    names.add(json.loads(line).get("name"))
+                except json.JSONDecodeError:
+                    pass
+    for needed in ("slo.burn", "fleet.scrape_stale",
+                   "fleet.scrape_recovered"):
+        if needed not in names:
+            failures.append(
+                f"fleet: router events.jsonl lacks the {needed} event"
+            )
+    return failures
+
+
 def _run_and_validate(out_dir: str) -> list[str]:
     info = _build_synthetic_run(out_dir)
     failures = validate_outputs(out_dir, info["snapshot"])
     failures.extend(validate_ops_plane(out_dir, info))
+    fleet_info = _build_fleet_run(out_dir)
+    failures.extend(validate_fleet(out_dir, fleet_info))
     return failures
 
 
@@ -361,7 +730,8 @@ def selfcheck(keep_dir: str | None = None) -> int:
     print(
         "telemetry selfcheck OK: events.jsonl + trace.json + metrics.json "
         "+ metrics_ts.jsonl + /metrics exposition + flightrecorder.json "
-        f"valid ({out_dir})"
+        "+ fleet pass (stitched 2-host trace, aggregated /metrics, SLO "
+        f"burn alert, scrape degradation) valid ({out_dir})"
     )
     return 0
 

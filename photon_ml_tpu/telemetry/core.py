@@ -79,6 +79,85 @@ def json_safe(value):
 
 
 # ---------------------------------------------------------------------------
+# Distributed trace context
+# ---------------------------------------------------------------------------
+
+#: HTTP header carrying the serialized trace context on the JSON path.
+TRACE_HEADER = "X-Photon-Trace"
+
+
+class TraceContext:
+    """The compact context that rides every transport hop.
+
+    Three fields, two encodings: the string form
+    (``"<trace16hex>-<span16hex>-<0|1>"``) travels as an HTTP header and
+    as a string column in wire frames; :meth:`to_words` packs the same
+    data into three fixed integers for binary slot headers (shm ring).
+    ``span_id`` is the GLOBAL id of the remote parent span (0 = the
+    trace root: no parent yet); ``sampled`` is the head-sampling verdict
+    made once at the edge and honored by every hop downstream, so one
+    request is either traced everywhere or nowhere (tail retention
+    excepted — see :meth:`Telemetry.configure_tracing`).
+    """
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: int = 0,
+                 sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = int(span_id)
+        self.sampled = bool(sampled)
+
+    def __repr__(self) -> str:
+        return (f"TraceContext({self.trace_id!r}, "
+                f"{self.span_id:#x}, sampled={self.sampled})")
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, TraceContext)
+                and self.trace_id == other.trace_id
+                and self.span_id == other.span_id
+                and self.sampled == other.sampled)
+
+    def header_value(self) -> str:
+        """String form for headers / wire string columns."""
+        return f"{self.trace_id}-{self.span_id:016x}-{int(self.sampled)}"
+
+    @classmethod
+    def parse(cls, text) -> Optional["TraceContext"]:
+        """Parse :meth:`header_value` output; None on anything malformed
+        (propagation is best-effort — a bad header degrades to an
+        untraced request, never a failed one)."""
+        if not text or not isinstance(text, str):
+            return None
+        parts = text.strip().split("-")
+        if len(parts) != 3 or len(parts[0]) != 16:
+            return None
+        try:
+            trace_word = int(parts[0], 16)
+            span_id = int(parts[1], 16)
+            sampled = bool(int(parts[2]))
+        except ValueError:
+            return None
+        if trace_word == 0:
+            return None
+        return cls(parts[0], span_id, sampled)
+
+    def to_words(self) -> tuple:
+        """``(trace_word, span_word, flags)`` — three unsigned ints for
+        fixed binary headers.  ``trace_word`` is never 0 for a live
+        context, so 0 doubles as "no context" on the wire."""
+        return (int(self.trace_id, 16), self.span_id,
+                1 if self.sampled else 0)
+
+    @classmethod
+    def from_words(cls, trace_word: int, span_word: int,
+                   flags: int) -> Optional["TraceContext"]:
+        if not trace_word:
+            return None
+        return cls(f"{trace_word:016x}", span_word, bool(flags & 1))
+
+
+# ---------------------------------------------------------------------------
 # Metrics registry
 # ---------------------------------------------------------------------------
 
@@ -381,6 +460,31 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+class _Adopt:
+    """Context manager behind :meth:`Telemetry.adopt`: installs a remote
+    :class:`TraceContext` as this thread's distributed context for the
+    duration.  ``ctx=None`` degrades to a no-op enter/exit — cheap
+    enough that every transport handler wraps unconditionally."""
+
+    __slots__ = ("_hub", "_ctx", "_prev")
+
+    def __init__(self, hub: "Telemetry", ctx):
+        self._hub = hub
+        self._ctx = ctx
+
+    def __enter__(self) -> "Telemetry":
+        if self._ctx is not None:
+            local = self._hub._local
+            self._prev = getattr(local, "remote", None)
+            local.remote = self._ctx
+        return self._hub
+
+    def __exit__(self, *exc) -> bool:
+        if self._ctx is not None:
+            self._hub._local.remote = self._prev
+        return False
+
+
 class Span:
     """One wall-clock interval; emits a record to the hub's sinks on exit.
 
@@ -390,7 +494,7 @@ class Span:
     """
 
     __slots__ = ("_hub", "name", "attrs", "span_id", "parent_id", "t0",
-                 "_tid")
+                 "_tid", "_remote")
 
     def __init__(self, hub: "Telemetry", name: str, attrs: dict):
         self._hub = hub
@@ -400,6 +504,7 @@ class Span:
         self.parent_id = None
         self.t0 = None
         self._tid = None
+        self._remote = None
 
     def set(self, **attrs) -> "Span":
         """Attach attributes mid-span (solver iteration counts, sizes)."""
@@ -419,6 +524,7 @@ class Span:
         )
         self.span_id = next(hub._ids)
         self._tid = threading.get_ident()
+        self._remote = getattr(hub._local, "remote", None)
         stack.append(self)
         self.t0 = time.perf_counter()
         return self
@@ -431,6 +537,18 @@ class Span:
         # sibling spans' parents for the rest of the run.
         while stack and stack.pop() is not self:
             pass
+        remote = self._remote
+        tail = False
+        if remote is not None and not remote.sampled \
+                and exc_type is None:
+            # Head-unsampled request: drop the span record UNLESS the
+            # hop blew the tail-retention SLO (then keep it, tagged) —
+            # the slow 1-in-N request is exactly the one worth a trace.
+            # Errored spans always emit.  Metrics are sampling-blind.
+            slo = hub.trace_tail_slo_s
+            if slo is None or (t1 - self.t0) < slo:
+                return False
+            tail = True
         record = {
             "type": "span",
             "name": self.name,
@@ -440,6 +558,16 @@ class Span:
             "parent": self.parent_id,
             "tid": self._tid,
         }
+        if remote is not None:
+            # Cross-process stitching fields: the distributed trace id,
+            # this span's GLOBAL id, and — for the local root of the
+            # adopted subtree — the remote parent's global id.
+            record["trace"] = remote.trace_id
+            record["gid"] = f"{hub._global_span_id(self.span_id):016x}"
+            if self.parent_id is None and remote.span_id:
+                record["rparent"] = f"{remote.span_id:016x}"
+            if tail:
+                record["tail"] = True
         if exc_type is not None:
             record["error"] = f"{exc_type.__name__}: {exc}"
         if self.attrs:
@@ -488,6 +616,16 @@ class Telemetry:
         #: hub = one trace); the meta record publishes it so traces from
         #: several processes can be correlated after a Perfetto merge.
         self.trace_id = uuid.uuid4().hex[:16]
+        #: 32-bit node tag mixed into GLOBAL span ids: two hubs (even in
+        #: one process — tests run several) never collide, so a merged
+        #: multi-process trace keeps its parent links unambiguous.
+        self._node = int(uuid.uuid4().hex[:8], 16)
+        #: head sampling: a fresh trace is sampled iff its 64-bit id is
+        #: 0 mod this (deterministic — every hop agrees without talking).
+        self.trace_sample_every = 256
+        #: tail retention: an UNSAMPLED hop slower than this still emits
+        #: its span records, tagged ``"tail": true``.  None = off.
+        self.trace_tail_slo_s: Optional[float] = None
         self._ids = itertools.count(1)
         self._local = threading.local()
         self._emit_lock = threading.Lock()
@@ -525,6 +663,7 @@ class Telemetry:
                 "wall_epoch": self._epoch_wall,
                 "pid": os.getpid(),
                 "trace": self.trace_id,
+                "node": f"{self._node:08x}",
             })
 
     # -- state ---------------------------------------------------------------
@@ -540,19 +679,102 @@ class Telemetry:
         return stack
 
     # -- trace-context propagation -------------------------------------------
-    def current_context(self) -> Optional[tuple]:
-        """``(trace_id, span_id)`` of this thread's innermost span — the
-        handle a caller passes to :meth:`attach` on another thread so
-        work it farms out nests under the span that requested it.  None
-        when the hub is inactive or no span is open."""
+    def _global_span_id(self, local_id: int) -> int:
+        """Process-transcending span id: node tag (high 32) | local id
+        (low 32).  What :class:`TraceContext` carries across hops and
+        span records publish as ``gid``."""
+        return ((self._node & 0xFFFFFFFF) << 32) \
+            | (int(local_id) & 0xFFFFFFFF)
+
+    def configure_tracing(
+        self,
+        sample_every: Optional[int] = None,
+        tail_slo_s: Optional[float] = None,
+    ) -> "Telemetry":
+        """Set the distributed-tracing knobs (docs/telemetry.md):
+        ``sample_every`` — head-sample 1 in N new traces (1 = all);
+        ``tail_slo_s`` — emit UNSAMPLED hops slower than this anyway,
+        tagged ``tail``.  Returns self for chaining."""
+        if sample_every is not None:
+            sample_every = int(sample_every)
+            if sample_every < 1:
+                raise ValueError(
+                    f"sample_every must be >= 1, got {sample_every}"
+                )
+            self.trace_sample_every = sample_every
+        if tail_slo_s is not None:
+            tail_slo_s = float(tail_slo_s)
+            if tail_slo_s <= 0:
+                raise ValueError(
+                    f"tail_slo_s must be > 0, got {tail_slo_s}"
+                )
+            self.trace_tail_slo_s = tail_slo_s
+        return self
+
+    def new_trace(self, sampled: Optional[bool] = None) -> TraceContext:
+        """Mint the root context for one request entering the system
+        (the fleet router / service edge calls this).  The head-sampling
+        verdict is decided HERE, deterministically from the trace id, so
+        every downstream hop re-derives the same answer for free."""
+        # os.urandom over uuid4: same 64 random bits at ~1/6 the cost —
+        # this runs once per request on the serving edge.
+        trace_word = int.from_bytes(os.urandom(8), "big")
+        while trace_word == 0:  # 0 means "no context" on binary wires
+            trace_word = int.from_bytes(os.urandom(8), "big")
+        if sampled is None:
+            every = self.trace_sample_every
+            sampled = every <= 1 or trace_word % every == 0
+        return TraceContext(f"{trace_word:016x}", 0, sampled)
+
+    def adopt(self, ctx: Optional[TraceContext]) -> "_Adopt":
+        """Adopt a remote hop's :class:`TraceContext` for spans opened
+        on this thread: their records gain the distributed ``trace`` /
+        ``gid`` fields, the first one parents to the remote span
+        (``rparent``), and the sampling verdict applies.  None → no-op,
+        so transport handlers adopt unconditionally.  (A slotted context
+        manager, not contextlib — this sits on the per-request path.)"""
+        return _Adopt(self, ctx if self.active else None)
+
+    def propagation_context(self) -> Optional[TraceContext]:
+        """The :class:`TraceContext` to send DOWNSTREAM from here: the
+        adopted remote trace with the current span's global id as the
+        parent.  None when no remote context is active — background work
+        pays one branch and sends nothing."""
         if not self.active:
+            return None
+        remote = getattr(self._local, "remote", None)
+        if remote is None:
             return None
         stack = self._span_stack()
         if stack:
-            return (self.trace_id, stack[-1].span_id)
+            span_id = self._global_span_id(stack[-1].span_id)
+        else:
+            inherit = getattr(self._local, "inherit", None)
+            span_id = (self._global_span_id(inherit)
+                       if inherit is not None else remote.span_id)
+        return TraceContext(remote.trace_id, span_id, remote.sampled)
+
+    def current_context(self) -> Optional[tuple]:
+        """``(trace_id, span_id, remote_ctx)`` of this thread's
+        innermost span — the handle a caller passes to :meth:`attach` on
+        another thread so work it farms out nests under the span that
+        requested it (and keeps the adopted distributed context, if
+        any).  None when the hub is inactive or no span is open."""
+        if not self.active:
+            return None
+        remote = getattr(self._local, "remote", None)
+        stack = self._span_stack()
+        if stack:
+            return (self.trace_id, stack[-1].span_id, remote)
         inherit = getattr(self._local, "inherit", None)
         if inherit is not None:
-            return (self.trace_id, inherit)
+            return (self.trace_id, inherit, remote)
+        if remote is not None:
+            # Adopted remote with no local span open (a transport
+            # handler between hops — the worker's score loop): the
+            # capture still carries the distributed context, so work
+            # farmed to another thread parents to the REMOTE span.
+            return (self.trace_id, None, remote)
         return None
 
     @contextlib.contextmanager
@@ -560,21 +782,37 @@ class Telemetry:
         """Adopt ``ctx`` (a :meth:`current_context` capture) as this
         thread's parent for spans/events opened while attached.  No-op
         for None / inactive hubs, so threads attach unconditionally at
-        one-branch cost when telemetry is off."""
+        one-branch cost when telemetry is off.  Accepts the legacy
+        2-tuple form; the 3-tuple form also restores the distributed
+        remote context across the thread hop."""
         if ctx is None or not self.active:
             yield self
             return
         prev = getattr(self._local, "inherit", None)
+        prev_remote = getattr(self._local, "remote", None)
         self._local.inherit = ctx[1]
+        has_remote = len(ctx) > 2
+        if has_remote:
+            self._local.remote = ctx[2]
         try:
             yield self
         finally:
             self._local.inherit = prev
+            if has_remote:
+                self._local.remote = prev_remote
 
     # -- recording -----------------------------------------------------------
     def span(self, name: str, **attrs):
         """Context manager for a hierarchical wall-clock span."""
         if not self.active:
+            return _NULL_SPAN
+        # Head-unsampled distributed request with tail retention off:
+        # nothing under this span can ever emit (every hop shares the
+        # verdict), so skip the Span bookkeeping entirely — this is the
+        # 255-in-256 per-request path on the serving edge.
+        remote = getattr(self._local, "remote", None)
+        if remote is not None and not remote.sampled \
+                and self.trace_tail_slo_s is None:
             return _NULL_SPAN
         return Span(self, name, attrs)
 

@@ -32,12 +32,28 @@ import json
 import math
 import os
 import re
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from photon_ml_tpu.telemetry.timeseries import TimeSeriesSampler
+
+
+def host_identity(host_id: Optional[str] = None) -> dict:
+    """Stable provenance block every snapshot/heartbeat carries so the
+    fleet aggregator and trace stitching never GUESS which host a
+    number came from: explicit ``host_id`` > ``$PHOTON_HOST_ID`` >
+    hostname, plus the emitting pid."""
+    return {
+        "host_id": str(
+            host_id
+            or os.environ.get("PHOTON_HOST_ID")
+            or socket.gethostname()
+        ),
+        "pid": os.getpid(),
+    }
 
 #: summary quantiles /metrics exposes per histogram.
 QUANTILES = (0.5, 0.9, 0.99)
@@ -154,6 +170,11 @@ class _Handler(BaseHTTPRequestHandler):
             snap["wall_epoch"] = hub._epoch_wall
             snap["trace"] = hub.trace_id
             snap["pid"] = os.getpid()
+            snap["host"] = host_identity(self.server.exporter.host_id)
+            # Mergeable histogram state rides alongside the summaries:
+            # the fleet aggregator folds /snapshot via absorb_delta,
+            # which needs raw bucket vectors, not quantiles.
+            snap["transport"] = hub.metrics.transport_snapshot()
             self._send(
                 200, json.dumps(snap).encode(), "application/json"
             )
@@ -200,13 +221,16 @@ class MetricsExporter:
     """HTTP exposition of one hub's registry; start/close lifecycle."""
 
     def __init__(
-        self, hub, host: str = "127.0.0.1", port: int = 0, readiness=None
+        self, hub, host: str = "127.0.0.1", port: int = 0, readiness=None,
+        host_id: Optional[str] = None,
     ):
         self.hub = hub
         self.host = host
         #: optional ``() -> bool | (bool, reason)`` behind /readyz; None
         #: keeps the pre-split behavior (ready iff serving).
         self.readiness = readiness
+        #: stable identity /snapshot publishes (see :func:`host_identity`).
+        self.host_id = host_id
         self._requested_port = port
         self._server: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None

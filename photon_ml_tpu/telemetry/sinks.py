@@ -99,6 +99,15 @@ class ChromeTraceSink(Sink):
             args["span_id"] = record.get("id")
             if record.get("parent") is not None:
                 args["parent_span_id"] = record["parent"]
+            # Distributed-trace stitching fields (telemetry/core.py
+            # trace-context section): the shared trace id, this span's
+            # GLOBAL id, the remote parent's global id, and the
+            # tail-retention marker — concatenated per-process traces
+            # merge into one Perfetto timeline that preserves the
+            # cross-process parent links through these args.
+            for key in ("trace", "gid", "rparent", "tail"):
+                if record.get(key) is not None:
+                    args[key] = record[key]
         elif kind == "event":
             base["ph"] = "i"
             base["s"] = "t"  # thread-scoped instant

@@ -11,6 +11,7 @@ serves the same registry over HTTP).
 Records are append-only and self-describing::
 
     {"seq": 3, "t_wall": 1754..., "t_mono": 12.04,
+     "host": {"host_id": "...", "pid": 1234},
      "counters": {...}, "gauges": {...}, "histograms": {...}}
 
 ``t_mono`` is monotonic seconds since the hub's epoch (immune to
@@ -124,6 +125,9 @@ class TimeSeriesSampler:
         if not self.enabled:
             return None
         snap = self.hub.metrics.snapshot()
+        # Local import: exporter imports this module at its top level.
+        from photon_ml_tpu.telemetry.exporter import host_identity
+
         with self._lock:
             if self._file is None:
                 return None
@@ -131,6 +135,7 @@ class TimeSeriesSampler:
                 "seq": self._seq,
                 "t_wall": time.time(),
                 "t_mono": time.perf_counter() - self.hub._epoch_perf,
+                "host": host_identity(),
                 "counters": snap["counters"],
                 "gauges": snap["gauges"],
                 "histograms": snap["histograms"],

@@ -98,6 +98,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     tests/test_serving.py tests/test_serving_ha.py \
     tests/test_serving_proc.py tests/test_freshness.py \
     tests/test_serving_wire.py \
+    tests/test_distributed_tracing.py \
     tests/test_tuning.py tests/test_chaos.py \
     "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
     "tests/test_streaming.py::TestTransferAvoidance::test_fast_lane_compressed_cached_parity" \

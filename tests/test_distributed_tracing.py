@@ -1,0 +1,563 @@
+"""Distributed tracing + fleet aggregation tests (ISSUE 17).
+
+The load-bearing contracts:
+
+- ONE compact ``TraceContext`` round-trips every transport encoding the
+  serving plane uses — HTTP header, wire-frame v2 ``trace:ctx`` column,
+  worker-IPC ``meta:trace`` column, shm slot-header words, fleet router
+  hop — with the SAME trace id and the sampling verdict intact (parity:
+  a request is traced everywhere or nowhere);
+- the head-sampling verdict is a pure function of the trace id, so
+  every hop that re-derives it agrees, and tail retention under a fake
+  clock is deterministic: an unsampled hop slower than the SLO emits
+  its span tagged ``tail``, a fast one emits nothing;
+- one request through FleetRouter -> LocalHost -> process worker yields
+  ONE stitched trace: the worker's ``serving.batch`` span carries the
+  router's trace id and an ``rparent`` link resolving to the
+  ``serving.http_score`` span's global id, across a REAL process
+  boundary, and every per-process trace.json is Perfetto-loadable;
+- the fleet aggregator degrades a host that drops mid-scrape
+  (``telemetry.scrape`` chaos seam) to its last-seen snapshot — counts
+  the failure, gauges the staleness, never wedges — and the multi-window
+  burn evaluator fires exactly one edge-triggered alert per excursion.
+"""
+
+import glob
+import json
+import os
+import struct
+import types
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import chaos
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.serving import wire
+from photon_ml_tpu.serving.batcher import BatcherConfig
+from photon_ml_tpu.serving.fleet import FleetRouter, LocalHost
+from photon_ml_tpu.serving.procpool import WorkerPool
+from photon_ml_tpu.serving.runtime import RuntimeConfig, ScoringRuntime
+from photon_ml_tpu.serving.service import ScoringService
+from photon_ml_tpu.serving.shm_ingress import _TRACE_WORDS
+from photon_ml_tpu.serving.supervisor import ReplicaSupervisor
+from photon_ml_tpu.serving.synthetic import SyntheticWorkload
+from photon_ml_tpu.telemetry import (
+    TRACE_HEADER,
+    FleetAggregator,
+    SloPolicy,
+    Telemetry,
+    TraceContext,
+)
+
+
+@pytest.fixture(scope="module")
+def workload():
+    return SyntheticWorkload(n_entities=32, seed=7)
+
+
+RT_CFG = dict(max_batch_size=8, hot_entities=8)
+
+
+@pytest.fixture(scope="module")
+def runtime(workload):
+    return ScoringRuntime(
+        workload.model, workload.index_maps, RuntimeConfig(**RT_CFG)
+    )
+
+
+def _ctx() -> TraceContext:
+    """A context with every field non-trivial: parity tests must prove
+    all three survive, not just the trace id."""
+    return TraceContext(
+        f"{0xDEADBEEF12345678:016x}",
+        span_id=0x0123456789ABCDEF,
+        sampled=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# TraceContext: encodings + head sampling
+# ---------------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_header_round_trip(self):
+        for sampled in (True, False):
+            ctx = TraceContext("00ab" * 4, 0x1234, sampled)
+            parsed = TraceContext.parse(ctx.header_value())
+            assert parsed == ctx
+
+    def test_parse_rejects_malformed(self):
+        bad = [
+            None, "", 123, b"bytes",
+            "deadbeef",                         # one part
+            "deadbeef-0-1",                     # trace id not 16 hex
+            "deadbeefdeadbeef-0",               # two parts
+            "deadbeefdeadbeef-0-1-9",           # four parts
+            "zzzzzzzzzzzzzzzz-0-1",             # not hex
+            "deadbeefdeadbeef-0-x",             # flag not int
+            "0000000000000000-0-1",             # zero trace word
+        ]
+        for text in bad:
+            assert TraceContext.parse(text) is None, text
+
+    def test_words_round_trip(self):
+        ctx = _ctx()
+        assert TraceContext.from_words(*ctx.to_words()) == ctx
+        # Zero trace word means "untraced" on every binary transport.
+        assert TraceContext.from_words(0, 5, 1) is None
+
+    def test_head_sampling_is_pure_function_of_trace_id(self):
+        hub = Telemetry(enabled=True, sinks=[])
+        hub.configure_tracing(sample_every=4)
+        contexts = [hub.new_trace() for _ in range(256)]
+        for ctx in contexts:
+            expected = int(ctx.trace_id, 16) % 4 == 0
+            assert ctx.sampled is expected
+            # The verdict RIDES the wire — a downstream hop parses it
+            # back rather than re-rolling the dice.
+            assert TraceContext.parse(ctx.header_value()).sampled \
+                is expected
+        # ~1/4 of random ids sample; all-or-nothing would be a bug.
+        n = sum(c.sampled for c in contexts)
+        assert 0 < n < len(contexts)
+        hub.configure_tracing(sample_every=1)
+        assert all(hub.new_trace().sampled for _ in range(16))
+
+    def test_trace_word_never_zero(self):
+        hub = Telemetry(enabled=True, sinks=[])
+        assert all(
+            int(hub.new_trace().trace_id, 16) != 0 for _ in range(512)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Propagation parity: the five transports
+# ---------------------------------------------------------------------------
+
+class TestPropagationParity:
+    """Each transport encodes the SAME context and decodes it intact —
+    same trace id, same remote-parent span id, same sampling verdict."""
+
+    def test_http_header_transport(self):
+        ctx = _ctx()
+        headers = {TRACE_HEADER: ctx.header_value()}
+        assert TRACE_HEADER == "X-Photon-Trace"
+        assert TraceContext.parse(headers[TRACE_HEADER]) == ctx
+
+    def test_wire_frame_v2_trace_column(self, workload):
+        ctx = _ctx()
+        frame = wire.encode_request(
+            [workload.request(0)], trace=ctx.header_value()
+        )
+        _rows, trace = wire.decode_request_ex(frame)
+        assert TraceContext.parse(trace) == ctx
+        # Untraced frames carry no column at all — and still decode.
+        _rows, trace = wire.decode_request_ex(
+            wire.encode_request([workload.request(0)])
+        )
+        assert trace is None
+
+    def test_worker_ipc_score_and_result_frames(self, workload, runtime):
+        ctx = _ctx()
+        row = runtime.parse_request(workload.request(0))
+        msg = wire.decode_score_ipc(
+            wire.encode_score_ipc(7, row, trace=ctx.header_value())
+        )
+        assert TraceContext.parse(msg["trace"]) == ctx
+        assert "trace" not in wire.decode_score_ipc(
+            wire.encode_score_ipc(7, row)
+        )
+        value = {"score": 1.5, "mean": 0.25, "latency_ms": 2.0}
+        out = wire.decode_result_ipc(
+            wire.encode_result_ipc(7, value, trace=ctx.header_value())
+        )
+        assert TraceContext.parse(out["trace"]) == ctx
+        assert "trace" not in wire.decode_result_ipc(
+            wire.encode_result_ipc(7, value)
+        )
+
+    def test_shm_slot_header_words(self):
+        ctx = _ctx()
+        buf = bytearray(_TRACE_WORDS.size)
+        _TRACE_WORDS.pack_into(buf, 0, *ctx.to_words())
+        assert TraceContext.from_words(
+            *_TRACE_WORDS.unpack_from(buf, 0)
+        ) == ctx
+        # All-zero words (a fresh slot) decode to "untraced".
+        assert TraceContext.from_words(
+            *_TRACE_WORDS.unpack_from(bytes(_TRACE_WORDS.size), 0)
+        ) is None
+        # The words fit the fixed slot-header field exactly.
+        assert _TRACE_WORDS.size == struct.calcsize("<QQI")
+
+    def test_fleet_hop_shares_trace_and_links_parent(
+        self, workload, tmp_path
+    ):
+        """JSON-path fleet hop, in-process: the router's routing span
+        and the host's handler span land in one trace with a resolvable
+        parent link — the cross-HOST half of the stitched chain (the
+        cross-PROCESS half is TestStitchedFleetTrace)."""
+        cfg = RuntimeConfig(**RT_CFG)
+        service = ScoringService(
+            ScoringRuntime(workload.model, workload.index_maps, cfg),
+            BatcherConfig(max_batch_size=8, max_wait_us=1000,
+                          max_queue=256),
+        )
+        with Telemetry(output_dir=str(tmp_path), run_name="hop") as hub:
+            hub.configure_tracing(sample_every=1)
+            host = LocalHost("h0", service).start()
+            router = FleetRouter(
+                [host.base_url], probe_interval_s=0.05,
+                wire_format="json",
+            ).start()
+            try:
+                result = router.score(workload.request(0))
+                assert np.isfinite(result["score"])
+            finally:
+                router.stop()
+                host.stop()
+        spans = _spans(os.path.join(tmp_path, "trace.json"))
+        routes = [s for s in spans if s["name"] == "serving.fleet_route"]
+        scores = [s for s in spans if s["name"] == "serving.http_score"]
+        assert len(routes) == 1 and len(scores) >= 1
+        trace_id = routes[0]["args"]["trace"]
+        for s in scores:
+            assert s["args"]["trace"] == trace_id
+            assert s["args"]["rparent"] == routes[0]["args"]["gid"]
+
+
+def _spans(trace_path: str) -> list:
+    """Chrome-trace complete events ("X") from one trace.json —
+    asserting Perfetto-loadability on the way (array of events, each
+    with the keys the UI requires)."""
+    with open(trace_path) as f:
+        events = json.load(f)
+    assert isinstance(events, list) and events
+    for ev in events:
+        assert isinstance(ev, dict)
+        for key in ("name", "ph", "ts", "pid"):
+            assert key in ev, (trace_path, ev)
+    return [ev for ev in events if ev.get("ph") == "X"]
+
+
+# ---------------------------------------------------------------------------
+# Tail sampling: deterministic under a fake clock
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self, t0: float = 1000.0):
+        self.t = t0
+
+    def perf_counter(self) -> float:
+        return self.t
+
+
+class TestTailSampling:
+    @pytest.fixture()
+    def clocked(self, tmp_path, monkeypatch):
+        import time as real_time
+
+        from photon_ml_tpu.telemetry import core as core_mod
+
+        clock = _Clock()
+        # Patch only core's module reference, not the global time
+        # module — nothing outside the hub sees the fake clock.
+        monkeypatch.setattr(
+            core_mod, "time",
+            types.SimpleNamespace(
+                perf_counter=clock.perf_counter,
+                time=real_time.time,
+                sleep=real_time.sleep,
+                monotonic=real_time.monotonic,
+            ),
+        )
+        hub = Telemetry(output_dir=str(tmp_path), run_name="tail")
+        return types.SimpleNamespace(
+            hub=hub, clock=clock, path=str(tmp_path / "events.jsonl")
+        )
+
+    def _records(self, path: str) -> list:
+        with open(path) as f:
+            return [json.loads(line) for line in f]
+
+    def test_slow_unsampled_hop_emits_tail_span(self, clocked):
+        hub, clock = clocked.hub, clocked.clock
+        hub.configure_tracing(tail_slo_s=0.05)
+        ctx = TraceContext("ab" * 8, span_id=0x99, sampled=False)
+        with hub:
+            with hub.adopt(ctx):
+                with hub.span("hop.fast"):
+                    clock.t += 0.049  # just under the SLO: dropped
+                with hub.span("hop.slow"):
+                    clock.t += 0.051  # just over: retained, tagged
+        spans = [r for r in self._records(clocked.path)
+                 if r.get("type") == "span"]
+        assert [s["name"] for s in spans] == ["hop.slow"]
+        span = spans[0]
+        assert span["tail"] is True
+        assert span["trace"] == ctx.trace_id
+        assert span["rparent"] == f"{ctx.span_id:016x}"
+        assert span["dur"] == pytest.approx(0.051)
+
+    def test_verdicts_are_deterministic(self, clocked):
+        """Same durations, same verdicts, run twice — retention depends
+        only on the clock, never on wall-time jitter."""
+        hub, clock = clocked.hub, clocked.clock
+        hub.configure_tracing(tail_slo_s=0.05)
+        ctx = TraceContext("cd" * 8, sampled=False)
+        # No duration sits exactly ON the 50ms boundary: accumulated
+        # float error there would test rounding, not retention.
+        durations = [0.01, 0.2, 0.06, 0.04, 0.5, 0.002]
+        with hub:
+            for _ in range(2):
+                with hub.adopt(ctx):
+                    for i, dur in enumerate(durations):
+                        with hub.span(f"hop.{i}"):
+                            clock.t += dur
+        names = [r["name"] for r in self._records(clocked.path)
+                 if r.get("type") == "span"]
+        kept = [f"hop.{i}" for i, d in enumerate(durations) if d >= 0.05]
+        assert names == kept * 2
+
+    def test_sampled_hop_always_emits(self, clocked):
+        hub, clock = clocked.hub, clocked.clock
+        hub.configure_tracing(tail_slo_s=0.05)
+        ctx = TraceContext("ef" * 8, sampled=True)
+        with hub:
+            with hub.adopt(ctx), hub.span("hop.sampled"):
+                clock.t += 0.001  # fast, but head-sampled: kept
+        spans = [r for r in self._records(clocked.path)
+                 if r.get("type") == "span"]
+        assert [s["name"] for s in spans] == ["hop.sampled"]
+        assert "tail" not in spans[0]
+
+    def test_unsampled_without_tail_slo_elides_span_entirely(self):
+        """With tail retention off, the 255-in-256 unsampled path takes
+        the null-span fast path: no record, no bookkeeping."""
+        recorder = telemetry.FlightRecorder()
+        hub = Telemetry(enabled=True, sinks=[recorder])
+        ctx = TraceContext("12" * 8, sampled=False)
+        with hub.adopt(ctx):
+            a = hub.span("x")
+            b = hub.span("y")
+        assert a is b  # the shared null-span singleton
+        with hub.adopt(TraceContext("34" * 8, sampled=True)):
+            with hub.span("x"):
+                pass
+        names = [r.get("name") for r in recorder.snapshot()
+                 if r.get("type") == "span"]
+        assert names == ["x"]  # only the sampled hop reached a sink
+
+
+# ---------------------------------------------------------------------------
+# The acceptance contract: one request, one stitched trace, real processes
+# ---------------------------------------------------------------------------
+
+class TestStitchedFleetTrace:
+    def test_one_request_one_stitched_trace_across_processes(
+        self, workload, tmp_path, monkeypatch
+    ):
+        """A live 2-host fleet, each host backed by a real worker
+        PROCESS: one traced request's spans — router routing span, host
+        HTTP span, worker batch span — share one trace id and chain
+        through resolvable global-id parent links, merged from three
+        independently written Perfetto-loadable trace files."""
+        worker_dir = tmp_path / "workers"
+        monkeypatch.setenv("PHOTON_TRACE_DIR", str(worker_dir))
+        n_requests = 4
+        with Telemetry(
+            output_dir=str(tmp_path / "router"), run_name="router"
+        ) as hub:
+            hub.configure_tracing(sample_every=1)
+            hosts, router = [], None
+            try:
+                for i in range(2):
+                    pool = WorkerPool(
+                        workload.model, workload.index_maps,
+                        runtime_config=RuntimeConfig(**RT_CFG), version=1,
+                    )
+                    supervisor = ReplicaSupervisor(
+                        pool=pool, n_replicas=1, probe_interval_s=0.05,
+                        probe_timeout_s=60.0, probe_failure_threshold=5,
+                    )
+                    service = ScoringService(supervisor, BatcherConfig(
+                        max_batch_size=8, max_wait_us=2_000,
+                        max_queue=256,
+                    ))
+                    hosts.append(LocalHost(f"h{i}", service).start())
+                # Binary wire format: the trace context rides the v2
+                # trace:ctx frame column on this hop, not the header.
+                router = FleetRouter(
+                    [h.base_url for h in hosts], probe_interval_s=0.05,
+                    wire_format="binary",
+                ).start()
+                results = [
+                    router.score(workload.request(i))
+                    for i in range(n_requests)
+                ]
+                assert all(np.isfinite(r["score"]) for r in results)
+            finally:
+                if router is not None:
+                    router.stop()
+                for h in hosts:
+                    h.stop()  # graceful: workers flush their sinks
+
+        router_spans = _spans(
+            os.path.join(tmp_path, "router", "trace.json")
+        )
+        routes = {
+            s["args"]["trace"]: s for s in router_spans
+            if s["name"] == "serving.fleet_route"
+        }
+        scores = [s for s in router_spans
+                  if s["name"] == "serving.http_score"
+                  and "trace" in s.get("args", {})]
+        assert len(routes) == n_requests  # one distinct trace each
+        # Host hop: every HTTP span stitches to its request's routing
+        # span (LocalHost handlers run in the router's process, so both
+        # hops land in the router's trace file).
+        assert len(scores) == n_requests
+        score_gids = {}
+        for s in scores:
+            trace_id = s["args"]["trace"]
+            assert trace_id in routes
+            assert s["args"]["rparent"] == \
+                routes[trace_id]["args"]["gid"]
+            score_gids[s["args"]["gid"]] = trace_id
+
+        # Worker hop: the REAL process boundary.  Each worker wrote its
+        # own trace file; its serving.batch spans carry the router's
+        # trace ids and parent to the host's HTTP spans by global id.
+        worker_files = sorted(
+            glob.glob(str(worker_dir / "trace-worker-*.trace.json"))
+        )
+        assert len(worker_files) == 2, worker_files
+        stitched_traces = set()
+        for path in worker_files:
+            for s in _spans(path):
+                args = s.get("args", {})
+                if s["name"] != "serving.batch" or "trace" not in args:
+                    continue
+                assert args["trace"] in routes, path
+                assert args["rparent"] in score_gids, path
+                assert score_gids[args["rparent"]] == args["trace"]
+                stitched_traces.add(args["trace"])
+        # At least one request's chain crosses all three hops — ONE
+        # stitched trace spanning two processes (batching can coalesce
+        # neighbors into a shared batch span, so not necessarily all 4).
+        assert stitched_traces, (
+            "no worker batch span carried a router trace id"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Fleet aggregation: scrape chaos + burn alerting
+# ---------------------------------------------------------------------------
+
+def _host_hub() -> Telemetry:
+    return Telemetry(enabled=True, sinks=[])
+
+
+def _snapshot_fetch(hubs: dict):
+    """Injectable fetch mapping base URLs back to live hubs — the same
+    snapshot shape MetricsExporter serves, no sockets needed."""
+    def fetch(url: str, timeout_s: float) -> dict:
+        hid = url.split("//", 1)[1].split("/", 1)[0]
+        hub = hubs[hid]
+        return {
+            "transport": hub.metrics.transport_snapshot(),
+            "host": {"host_id": hid, "pid": os.getpid()},
+        }
+    return fetch
+
+
+class TestFleetAggregation:
+    def test_scrape_chaos_degrades_to_last_seen_and_recovers(self):
+        hub = _host_hub()
+        lat = hub.histogram("serving_request_latency_seconds")
+        for _ in range(10):
+            lat.observe(0.001)
+        agg = FleetAggregator(
+            {"h0": "http://h0"}, fetch=_snapshot_fetch({"h0": hub})
+        )
+        report = agg.poll_once(now=10.0)
+        assert report["hosts"]["h0"]["stale"] is False
+
+        # The host drops off the network mid-scrape (the
+        # "telemetry.scrape" chaos seam): the aggregator counts the
+        # failure, marks the host stale, and keeps serving the
+        # last-seen fold — it must never wedge or raise.
+        with chaos.FaultPlan([chaos.FaultSpec(
+            site="telemetry.scrape", at=0, count=1,
+        )]):
+            report = agg.poll_once(now=25.0)
+        assert report["hosts"]["h0"]["stale"] is True
+        counters = agg.registry.snapshot()["counters"]
+        assert counters["fleet_scrape_failures_total"] == 1
+        gauges = agg.registry.snapshot()["gauges"]
+        assert gauges["fleet_scrape_staleness_seconds"] == \
+            pytest.approx(15.0)
+        # Last-seen state survives the outage: the fold still carries
+        # the 10 observations scraped while the host was up.
+        parsed = telemetry.parse_prometheus_text(agg.prometheus_text())
+        assert parsed[(
+            "serving_request_latency_seconds_count", '{host="h0"}'
+        )] == 10.0
+
+        report = agg.poll_once(now=30.0)  # the host comes back
+        assert report["hosts"]["h0"]["stale"] is False
+        assert report["hosts"]["h0"]["staleness_s"] == 0.0
+
+    def test_burn_alert_fires_once_per_excursion(self):
+        hub = _host_hub()
+        lat = hub.histogram("serving_request_latency_seconds")
+        agg = FleetAggregator(
+            {"h0": "http://h0"},
+            fetch=_snapshot_fetch({"h0": hub}),
+            policies=[SloPolicy(
+                name="latency-p99", p99_s=0.05, error_budget=0.01,
+            )],
+        )
+        for _ in range(100):
+            lat.observe(0.002)
+        report = agg.poll_once(now=1000.0)
+        policy = report["policies"][0]
+        assert policy["alerting"] is False and policy["alerts"] == 0
+
+        for _ in range(20):
+            lat.observe(1.0)  # way past the 50ms target
+        for now in (1060.0, 1120.0):  # two rounds inside one excursion
+            report = agg.poll_once(now=now)
+        policy = report["policies"][0]
+        assert policy["alerting"] is True
+        assert policy["alerts"] == 1  # edge-triggered, not per-round
+        assert policy["fast"]["burn"] >= 1.0
+        counters = agg.registry.snapshot()["counters"]
+        assert counters["slo_burn_alerts_total"] == 1
+
+        # The excursion ends (a quiet window): the alert re-arms, and a
+        # second excursion fires a SECOND alert.
+        report = agg.poll_once(now=1120.0 + 7200.0)
+        assert report["policies"][0]["alerting"] is False
+        for _ in range(20):
+            lat.observe(1.0)
+        report = agg.poll_once(now=1120.0 + 7260.0)
+        assert report["policies"][0]["alerts"] == 2
+
+    def test_fleet_fold_is_host_labeled_and_parseable(self):
+        hubs = {"h0": _host_hub(), "h1": _host_hub()}
+        for i, hub in enumerate(hubs.values()):
+            hub.counter("serving_requests_total").inc(10 * (i + 1))
+        agg = FleetAggregator(
+            {hid: f"http://{hid}" for hid in hubs},
+            fetch=_snapshot_fetch(hubs),
+        )
+        agg.poll_once(now=1.0)
+        parsed = telemetry.parse_prometheus_text(agg.prometheus_text())
+        assert parsed[("serving_requests_total", "")] == 30.0  # fold
+        assert parsed[
+            ("serving_requests_total", '{host="h0"}')] == 10.0
+        assert parsed[
+            ("serving_requests_total", '{host="h1"}')] == 20.0
+        assert parsed[("fleet_hosts_count", "")] == 2.0

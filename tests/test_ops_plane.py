@@ -300,7 +300,9 @@ class TestTraceContext:
         with telemetry.Telemetry(output_dir=str(tmp_path)) as tel:
             with tel.span("run") as run_span:
                 ctx = tel.current_context()
-                assert ctx == (tel.trace_id, run_span.span_id)
+                # (trace_id, span_id, remote_ctx) — remote is None
+                # outside an adopted distributed context.
+                assert ctx == (tel.trace_id, run_span.span_id, None)
 
                 def worker():
                     with tel.attach(ctx):
