@@ -37,7 +37,7 @@ from photon_ml_tpu.analysis.engine import (
 #: First name token: which subsystem emits the metric.
 SUBSYSTEMS = frozenset({
     "h2d", "hbm", "prefetch", "stream", "streaming", "staging",
-    "solver", "cd", "grid", "game", "glm", "watchdog", "checkpoint",
+    "solver", "solvers", "cd", "grid", "game", "glm", "watchdog", "checkpoint",
     "chaos", "serving", "tuning", "compile", "run", "telemetry",
     "evaluation", "model", "analysis", "freshness", "fleet", "slo",
 })
@@ -46,7 +46,7 @@ SUBSYSTEMS = frozenset({
 UNITS = frozenset({
     "total", "seconds", "bytes", "ratio", "gbps", "rows", "ms",
     "count", "entries", "iterations", "retries", "depth", "version",
-    "tier", "rps",
+    "tier", "rps", "residual",
 })
 
 #: Pre-convention names (PRs 1-6), grandfathered verbatim.  Do NOT add

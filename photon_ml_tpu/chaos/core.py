@@ -221,6 +221,21 @@ KNOWN_SITES = {
         "fleet_scrape_failures_total, aged by the staleness gauge) and "
         "keep folding every other host — the loop never wedges"
     ),
+    "distributed.allreduce": (
+        "a distributed solver's outer-iteration reduce seam, before the "
+        "round's step program (and its all-reduce) dispatches "
+        "(solvers/admm.py, solvers/block_cd.py; ctx: solver, outer) — a "
+        "fault is a host dying at the collective: the watchdog re-enters "
+        "the grid, the checkpoint warm-start chain replays the in-flight "
+        "λ deterministically, and the resumed sweep is bitwise identical"
+    ),
+    "admm.consensus": (
+        "consensus-ADMM z-update boundary, after outer iteration k's "
+        "consensus variable (and adapted ρ) is computed "
+        "(solvers/admm.py; ctx: solver, outer, rho) — a kill here lands "
+        "between outer iterations; resume must replay the λ point to "
+        "the SAME consensus trajectory (bitwise, the ISSUE 18 gate)"
+    ),
 }
 
 

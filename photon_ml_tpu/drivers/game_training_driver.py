@@ -89,11 +89,21 @@ def expand_config_grid(coordinate_specs: Sequence[dict]) -> list[dict]:
 
 def parse_coordinate_config(spec: dict):
     """One JSON coordinate spec → (name, CoordinateConfig)."""
+    solver = spec.get("solver")
+    solver_options = tuple(
+        sorted((str(k), str(v)) for k, v in
+               dict(spec.get("solver_options", {})).items())
+    )
     opt = GlmOptimizationConfig(
         optimizer=OptimizerConfig(
             optimizer=OptimizerType(spec.get("optimizer", "lbfgs")),
             max_iters=int(spec.get("max_iters", 100)),
             tolerance=float(spec.get("tolerance", 1e-7)),
+            # "solver" names a registered solver (docs/solvers.md);
+            # unset keeps the historical OWL-QN/TRON/L-BFGS routing
+            # bitwise.  "solver_options" is a JSON object of knobs.
+            solver=solver if solver is None else str(solver),
+            solver_options=solver_options,
         ),
         regularization=RegularizationContext(
             RegularizationType(spec.get("reg_type", "none")),

@@ -82,6 +82,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--optimizer", default="lbfgs", choices=[t.value for t in OptimizerType]
     )
     p.add_argument(
+        "--solver",
+        help="registered solver name (photon_ml_tpu/solvers): lbfgs | "
+        "owlqn | tron | spg | admm | block_cd.  Unset keeps the historical "
+        "routing (bounds → spg, any L1 → owlqn, else --optimizer) bitwise. "
+        "Host-kind solvers (admm, block_cd) run sharded: over the "
+        "--data-parallel mesh when available, else over --solver-shards "
+        "logical shards on one device",
+    )
+    p.add_argument(
+        "--solver-shards",
+        type=int,
+        default=0,
+        help="logical shard count for host-kind solvers without a mesh "
+        "(0 = auto: 2, or the solver_options 'shards' knob)",
+    )
+    p.add_argument(
+        "--solver-option",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="solver-specific knob (repeatable), e.g. --solver-option "
+        "rho=1.0 --solver-option n_blocks=8 (see docs/solvers.md)",
+    )
+    p.add_argument(
         "--reg-type",
         default="none",
         choices=[t.value for t in RegularizationType],
@@ -276,6 +300,8 @@ def make_fit_once(
     tolerance: float = 1e-8,
     suite=None,
     val_weights=None,
+    solver: Optional[str] = None,
+    solver_options: tuple = (),
 ):
     """Reusable single-fit entry for the tuning orchestrator
     (photon_ml_tpu/tuning/): ``fit_once(params, resource, warm_start) ->
@@ -301,9 +327,20 @@ def make_fit_once(
         from photon_ml_tpu.ops import losses as losses_lib
 
         suite = EvaluationSuite.for_task(losses_lib.get(task).name)
+    from photon_ml_tpu.solvers import registry as solver_registry
+
+    host_kind = (
+        solver is not None
+        and solver_registry.get(solver).kind == "host"
+    )
+    if host_kind and hasattr(X_train, "todense"):
+        # Host-kind solvers shard dense row blocks; tuning-scale designs
+        # densify cheaply (the distributed grid path takes sparse).
+        X_train = np.asarray(X_train.todense(), np.float32)
     data = make_glm_data(X_train, y_train)
     y_val = np.asarray(y_val)
     problems: dict[int, GlmOptimizationProblem] = {}
+    sharded_solves: dict[int, object] = {}
     lock = sanitizers.tracked(threading.Lock(), "glm.problem_cache")
 
     def _problem(iters: int) -> GlmOptimizationProblem:
@@ -319,6 +356,8 @@ def make_fit_once(
                             optimizer=OptimizerType(optimizer),
                             max_iters=iters,
                             tolerance=tolerance,
+                            solver=solver,
+                            solver_options=tuple(solver_options),
                         ),
                         regularization=RegularizationContext(
                             RegularizationType(reg_type), elastic_net_alpha
@@ -327,16 +366,40 @@ def make_fit_once(
                 )
             return p
 
+    def _sharded_solve(iters: int):
+        # Host-kind counterpart of the per-iters problem cache: one
+        # bound solver (logical shards, one compiled step program) per
+        # iteration budget.
+        from photon_ml_tpu.solvers import sharded as solvers_sharded
+
+        problem = _problem(iters)
+        with lock:
+            s = sharded_solves.get(iters)
+            if s is None:
+                n_shards = solvers_sharded.resolve_shard_count(
+                    problem.config.optimizer
+                )
+                dist = solvers_sharded.stack_resident(data, n_shards)
+                defn = solver_registry.get(solver)
+                s = sharded_solves[iters] = defn.sharded(
+                    problem, dist, None, None
+                )
+            return s
+
     def fit_once(params, resource=0, warm_start=None):
-        problem = _problem(int(resource) if resource else max_iters)
+        iters = int(resource) if resource else max_iters
         w0 = (
             None
             if warm_start is None
             else jnp.asarray(np.asarray(warm_start, np.float32))
         )
-        res = problem.solve_single_device(
-            data, reg_weight=float(np.asarray(params).ravel()[0]), w0=w0
-        )
+        lam = float(np.asarray(params).ravel()[0])
+        if host_kind:
+            res = _sharded_solve(iters)(lam, w0)
+        else:
+            res = _problem(iters).solve_single_device(
+                data, reg_weight=lam, w0=w0
+            )
         w = np.asarray(res.w, np.float32)
         scores = np.asarray(X_val @ w).ravel()
         metric, all_metrics = suite.evaluate_primary(
@@ -469,6 +532,42 @@ def _run_impl(args, logger, tel) -> dict:
     )
 
     # Stage 3: train over the λ grid ----------------------------------------
+    solver_options = []
+    for kv in args.solver_option:
+        if "=" not in kv:
+            raise SystemExit(
+                f"--solver-option must be KEY=VALUE, got {kv!r}"
+            )
+        k, _, v = kv.partition("=")
+        solver_options.append((k.strip(), v.strip()))
+    if args.solver_shards:
+        solver_options.append(("shards", args.solver_shards))
+    host_solver = False
+    if args.solver is not None:
+        from photon_ml_tpu.solvers import registry as solver_registry
+
+        try:
+            host_solver = solver_registry.get(args.solver).kind == "host"
+        except KeyError as e:
+            raise SystemExit(str(e))
+        if host_solver:
+            if streaming:
+                raise SystemExit(
+                    f"--solver {args.solver} runs over sharded resident "
+                    "data; it does not compose with --streaming (the "
+                    "streamed pass loop IS the jit-kind solvers' "
+                    "distribution story)"
+                )
+            if args.compute_variances:
+                raise SystemExit(
+                    f"--solver {args.solver} does not support "
+                    "--compute-variances"
+                )
+            if args.coefficient_bounds:
+                raise SystemExit(
+                    f"--solver {args.solver} does not support "
+                    "--coefficient-bounds (only spg does)"
+                )
     problem = GlmOptimizationProblem(
         args.task,
         GlmOptimizationConfig(
@@ -476,6 +575,8 @@ def _run_impl(args, logger, tel) -> dict:
                 optimizer=OptimizerType(args.optimizer),
                 max_iters=args.max_iters,
                 tolerance=args.tolerance,
+                solver=args.solver,
+                solver_options=tuple(solver_options),
             ),
             regularization=RegularizationContext(
                 RegularizationType(args.reg_type), args.elastic_net_alpha
@@ -664,6 +765,34 @@ def _run_impl(args, logger, tel) -> dict:
             dist = shard_glm_data(X_train, y_train, mesh)
             return run_grid_distributed(
                 problem, dist, mesh, reg_weights, w0=w0, l1_mask=l1_mask,
+                solved=solved_now, on_solved=on_solved,
+            )
+        if host_solver:
+            # No mesh: a host-kind solver still runs sharded, over
+            # logical row blocks on one device (same step program as the
+            # mesh path, vmap + axis-0 sum standing in for the psum).
+            from photon_ml_tpu.parallel.distributed import shard_glm_data
+            from photon_ml_tpu.solvers import sharded as solvers_sharded
+
+            n_shards = solvers_sharded.resolve_shard_count(
+                problem.config.optimizer
+            )
+            X_sh = X_train
+            if args.solver == "block_cd" and hasattr(X_sh, "todense"):
+                # block CD reads per-shard columns; densify (LIBSVM
+                # inputs at driver scale fit — the mesh path keeps
+                # sparse for admm).
+                X_sh = np.asarray(X_sh.todense(), np.float32)
+                logger.info(
+                    "block_cd: densified %d x %d design for column "
+                    "access", X_sh.shape[0], X_sh.shape[1],
+                )
+            dist = shard_glm_data(X_sh, y_train, None, n_shards=n_shards)
+            logger.info(
+                "solver %s: %d logical shard(s)", args.solver, n_shards
+            )
+            return solvers_sharded.run_grid_sharded(
+                problem, dist, None, reg_weights, w0=w0, l1_mask=l1_mask,
                 solved=solved_now, on_solved=on_solved,
             )
         data = train_data if attempt == 0 else make_glm_data(

@@ -138,6 +138,30 @@ class FixedEffectCoordinate(Coordinate):
         self.reg_weight = reg_weight
         self.feature_shard = feature_shard
         self.axis_name = axis_name
+        self._sharded_trainer = None
+        solver_name = getattr(config.optimizer, "solver", None)
+        if solver_name is not None:
+            from photon_ml_tpu.solvers import registry as solver_registry
+
+            if solver_registry.get(solver_name).kind == "host":
+                # Host-kind solvers (ADMM, block CD) distribute this
+                # coordinate's solve over logical row shards; per-GAME-
+                # iteration offsets re-slot into one shard template so
+                # the compiled step program is reused across iterations.
+                from photon_ml_tpu.solvers import sharded as solvers_sharded
+
+                if axis_name is not None:
+                    raise ValueError(
+                        f"solver {solver_name!r} manages its own mesh "
+                        "collectives; it cannot nest inside an existing "
+                        f"axis {axis_name!r} (drop data-parallel GAME or "
+                        "the solver override)"
+                    )
+                self._sharded_trainer = solvers_sharded.make_fixed_effect_trainer(
+                    self.problem,
+                    dataset.data,
+                    solvers_sharded.resolve_shard_count(config.optimizer),
+                )
         self._train_jit, self._score_jit = _fixed_effect_jits(
             self.task, config, axis_name, _layout_sig(dataset.data)
         )
@@ -148,6 +172,8 @@ class FixedEffectCoordinate(Coordinate):
             if warm_state is None
             else warm_state
         )
+        if self._sharded_trainer is not None:
+            return self._sharded_trainer(offsets, w0, self.reg_weight)
         return self._train_jit(
             self.dataset.data, offsets, w0,
             jnp.asarray(self.reg_weight, jnp.float32),
@@ -206,7 +232,27 @@ def _make_block_solver_cached(task: str, config: GlmOptimizationConfig):
     loss = losses_lib.get(task)
     opt = config.optimizer
     has_l1 = config.regularization.l1_weight(1.0) > 0.0
-    use_owlqn = opt.optimizer is OptimizerType.OWLQN or has_l1
+    if getattr(opt, "solver", None) is not None:
+        # Registry dispatch for an explicit solver name.  Random-effect
+        # blocks are batched per-entity traced solves, so only jit-kind
+        # solvers apply here (host-kind ADMM/block-CD distribute the
+        # FIXED-effect coordinate — see FixedEffectCoordinate).
+        from photon_ml_tpu.solvers import registry as solver_registry
+
+        defn = solver_registry.resolve(
+            opt, l1_frac=config.regularization.l1_weight(1.0)
+        )
+        if defn.kind != "jit":
+            raise ValueError(
+                f"solver {defn.name!r} is host-kind and cannot run the "
+                "per-entity random-effect blocks; set it on the "
+                "fixed-effect coordinate's spec instead"
+            )
+        use_owlqn = defn.name == "owlqn" or has_l1
+        use_tron = defn.name == "tron"
+    else:
+        use_owlqn = opt.optimizer is OptimizerType.OWLQN or has_l1
+        use_tron = opt.optimizer is OptimizerType.TRON
 
     def rank1_newton(block, offsets_block, w0, l2):
         """Single-row entities (R == 1 — the LARGEST bucket class in
@@ -421,7 +467,7 @@ def _make_block_solver_cached(task: str, config: GlmOptimizationConfig):
                         history=history,
                     ),
                 ).w
-            if opt.optimizer is OptimizerType.TRON:
+            if use_tron:
                 def hvp(w, v, aux):
                     return X.T @ (aux * (X @ v)) + l2 * v
 

@@ -28,11 +28,9 @@ from photon_ml_tpu.data.dataset import GlmData
 from photon_ml_tpu.data.normalization import NormalizationContext
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_ml_tpu.ops import losses as losses_lib
-from photon_ml_tpu.optim.lbfgs import LBFGSConfig, SolveResult, lbfgs_solve
+from photon_ml_tpu.optim.lbfgs import SolveResult
 from photon_ml_tpu.optim.objective import GlmObjective
-from photon_ml_tpu.optim.owlqn import OWLQNConfig, owlqn_solve
 from photon_ml_tpu.optim.regularization import RegularizationContext
-from photon_ml_tpu.optim.tron import TRONConfig, tron_solve
 
 Array = jax.Array
 
@@ -46,12 +44,21 @@ class OptimizerType(enum.Enum):
 @dataclasses.dataclass(frozen=True)
 class OptimizerConfig:
     """Mirrors the reference's ``OptimizerConfig`` (optimizerType,
-    maximumIterations, tolerance)."""
+    maximumIterations, tolerance).
+
+    ``solver`` names a registered solver (photon_ml_tpu/solvers/registry.py)
+    explicitly; None keeps the historical routing (bounds → SPG, any L1
+    component → OWL-QN, else ``optimizer``) bitwise.  ``solver_options`` is
+    a tuple of (key, value) pairs — a TUPLE, not a dict, because this
+    config lives in lru_cache keys (GAME block solvers, fixed-effect jit
+    caches) and must stay hashable."""
 
     optimizer: OptimizerType = OptimizerType.LBFGS
     max_iters: int = 100
     tolerance: float = 1e-7
     history: int = 10  # L-BFGS/OWL-QN corrections
+    solver: Optional[str] = None
+    solver_options: tuple = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,68 +163,37 @@ class GlmOptimizationProblem:
         l2 = cfg.regularization.l2_weight(1.0) * reg_weight
         opt = cfg.optimizer
 
-        if bounds is not None:
-            # Box constraints route to SPG for any smooth config (the
-            # constraint set, not the configured optimizer, decides the
-            # machinery — same policy as the L1→OWL-QN routing below).
-            if l1_frac > 0.0:
-                raise NotImplementedError(
-                    "box constraints combined with L1 regularization are "
-                    "not supported: the orthant-wise and projection "
-                    "machineries conflict (drop the L1 component or the "
-                    "bounds)"
-                )
-            from photon_ml_tpu.optim.projected import SPGConfig, spg_solve
+        if bounds is not None and l1_frac > 0.0:
+            # Box constraints conflict with the orthant-wise machinery
+            # for any solver choice.
+            raise NotImplementedError(
+                "box constraints combined with L1 regularization are "
+                "not supported: the orthant-wise and projection "
+                "machineries conflict (drop the L1 component or the "
+                "bounds)"
+            )
+        # Dispatch through the solver registry (photon_ml_tpu/solvers/):
+        # cfg.optimizer.solver unset reproduces the pre-registry static
+        # routing bitwise — bounds → SPG for any smooth config, any L1
+        # component → OWL-QN (the only orthant-capable machinery, as in
+        # the reference), else the configured optimizer.  All checks are
+        # static: l1_frac is a float, the solver name a config string.
+        from photon_ml_tpu.solvers import registry as solver_registry
 
-            return spg_solve(
-                lambda w: obj.value_and_grad(
-                    w, data, l2_weight=l2, axis_name=axis_name
-                ),
-                w0,
-                bounds[0],
-                bounds[1],
-                SPGConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
-                w_axis=None,
-            )
-        # L1 is only representable by OWL-QN's orthant machinery; any config
-        # carrying an L1 component routes there regardless of the configured
-        # smooth optimizer (as the reference does — L-BFGS/TRON have no
-        # subgradient handling).  The check is static: l1_frac is a float.
-        if opt.optimizer is OptimizerType.OWLQN or l1_frac > 0.0:
-            return owlqn_solve(
-                lambda w: obj.value_and_grad(
-                    w, data, l2_weight=l2, axis_name=axis_name
-                ),
-                w0,
-                l1,
-                OWLQNConfig(
-                    max_iters=opt.max_iters,
-                    tolerance=opt.tolerance,
-                    history=opt.history,
-                ),
-                l1_mask=l1_mask,
-            )
-        if opt.optimizer is OptimizerType.TRON:
-            return tron_solve(
-                lambda w: obj.value_and_grad(
-                    w, data, l2_weight=l2, axis_name=axis_name
-                ),
-                lambda w, v, aux: obj.hvp(
-                    w, v, data, l2_weight=l2, axis_name=axis_name, d2w=aux
-                ),
-                w0,
-                TRONConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
-                d2_fn=lambda w: obj.d2_weights(w, data),
-            )
-        return lbfgs_solve(
-            lambda w: obj.value_and_grad(w, data, l2_weight=l2, axis_name=axis_name),
-            w0,
-            LBFGSConfig(
-                max_iters=opt.max_iters,
-                tolerance=opt.tolerance,
-                history=opt.history,
-            ),
+        defn = solver_registry.resolve(
+            opt, l1_frac=l1_frac, has_bounds=bounds is not None
         )
+        if defn.kind != "jit":
+            raise ValueError(
+                f"solver {defn.name!r} runs a host-side outer loop and "
+                "cannot execute inside a traced solve; route through "
+                "solvers.sharded.run_grid_sharded (glm_driver --solver "
+                "and run_grid_distributed do this automatically)"
+            )
+        return defn.resident(solver_registry.ResidentSolve(
+            objective=obj, data=data, w0=w0, l1=l1, l2=l2, opt=opt,
+            axis_name=axis_name, l1_mask=l1_mask, bounds=bounds,
+        ))
 
     # -- variances (reference: optional coefficient variance computation) ---
     def coefficient_variances(

@@ -1036,6 +1036,16 @@ class StreamingObjective:
         # per PASS, nothing per chunk.
         tel = telemetry_mod.current()
         if tel.enabled:
+            # Every streamed pass is one logical all-reduce round: the
+            # chunk-sequential accumulation folds a (batch × (d+1)) carry
+            # exactly like a psum across shards.  Publishing it here puts
+            # the jit-kind solvers on the same instrument the distributed
+            # solvers (solvers/admm.py, solvers/block_cd.py) report on, so
+            # BENCH_ONLY=solvers A/Bs reduces-per-solve directly.
+            tel.counter("solver_allreduce_count").inc(1)
+            tel.counter("solver_allreduce_bytes_total").inc(
+                (batch or 1) * (self.stream.n_features + 1) * 4
+            )
             d_chunks = stats.chunks - chunks0
             if d_chunks > 0:
                 chunk_bytes = (stats.bytes - bytes0) / d_chunks
@@ -1853,8 +1863,7 @@ def streaming_run_grid(
     :class:`StreamingObjective`); lossless compression and the cache
     leave every solve bitwise unchanged.
     """
-    from photon_ml_tpu.optim.problem import OptimizerType
-    from photon_ml_tpu.optim.tron import TRONConfig
+    from photon_ml_tpu.solvers import registry as solver_registry
 
     cfg = problem.config
     ensure_streamable(cfg)
@@ -1864,17 +1873,15 @@ def streaming_run_grid(
         compress=compress, hot_budget_bytes=hot_budget_bytes,
     )
     opt = cfg.optimizer
-    lbfgs_cfg = LBFGSConfig(
-        max_iters=opt.max_iters,
-        tolerance=opt.tolerance,
-        history=opt.history,
-    )
-    owlqn_cfg = OWLQNConfig(
-        max_iters=opt.max_iters,
-        tolerance=opt.tolerance,
-        history=opt.history,
-    )
     l1_frac = cfg.regularization.l1_weight(1.0)
+    defn = solver_registry.resolve(opt, l1_frac=l1_frac)
+    if defn.streamed is None:
+        raise ValueError(
+            f"solver {defn.name!r} has no streamed implementation; the "
+            "streamed grid serves jit-kind solvers with a streamed pass "
+            "loop (lbfgs, owlqn, tron) — distributed solvers run over "
+            "sharded resident data (solvers.sharded.run_grid_sharded)"
+        )
 
     def solve_fn(lam, w_prev):
         l1 = l1_frac * float(lam)
@@ -1885,24 +1892,10 @@ def streaming_run_grid(
             (lambda ws: sobj.value_and_grad_batch(ws, l2))
             if batch_linesearch else None
         )
-        # Static routing, as in problem.solve: any L1 component needs the
-        # orthant machinery.
-        if opt.optimizer is OptimizerType.OWLQN or l1_frac > 0.0:
-            return streaming_owlqn_solve(
-                lambda w: sobj.value_and_grad(w, l2), w_prev, l1,
-                owlqn_cfg, l1_mask=l1_mask, value_and_grad_batch=vgb,
-            )
-        if opt.optimizer is OptimizerType.TRON:
-            return streaming_tron_solve(
-                lambda w: sobj.value_and_grad(w, l2),
-                lambda w, v: sobj.hvp(w, v, l2),
-                w_prev,
-                TRONConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
-            )
-        return streaming_lbfgs_solve(
-            lambda w: sobj.value_and_grad(w, l2), w_prev, lbfgs_cfg,
-            value_and_grad_batch=vgb,
-        )
+        return defn.streamed(solver_registry.StreamedSolve(
+            sobj=sobj, w0=w_prev, l1=l1, l2=l2, opt=opt,
+            l1_mask=l1_mask, value_and_grad_batch=vgb,
+        ))
 
     variance_fn = None
     if cfg.compute_variances:

@@ -77,10 +77,11 @@ def _pad_rows_to(n_rows: int, n_shards: int) -> int:
 def shard_glm_data(
     data_host,
     labels,
-    mesh: Mesh,
+    mesh: Optional[Mesh],
     weights=None,
     offsets=None,
     dtype=jnp.float32,
+    n_shards: Optional[int] = None,
 ) -> DistributedGlmData:
     """Build row-block shards from host data and place them on the mesh.
 
@@ -88,13 +89,21 @@ def shard_glm_data(
     padded (weight=0) to a multiple of the mesh size, split into contiguous
     blocks, and each block becomes a shard-local matrix with LOCAL row ids.
     Sparse blocks pad nnz to the max across shards so shapes are uniform.
+
+    ``mesh=None`` builds LOGICAL shards: the same leading-shard-axis layout
+    with ``n_shards`` row blocks, left on the default device — the
+    single-device stand-in the host-kind solvers (solvers/admm.py,
+    solvers/block_cd.py) vmap over when no mesh participates.
     """
     import scipy.sparse as sp
 
     from photon_ml_tpu.data.dataset import make_glm_data
     from photon_ml_tpu.ops.sparse import from_coo
 
-    n_shards = mesh.devices.size
+    if mesh is not None:
+        n_shards = mesh.devices.size
+    elif n_shards is None or n_shards < 1:
+        raise ValueError("shard_glm_data needs a mesh or n_shards >= 1")
     n = data_host.shape[0]
     d = data_host.shape[1]
     total = _pad_rows_to(n, n_shards)
@@ -143,8 +152,9 @@ def shard_glm_data(
         weights=jnp.asarray(weights.reshape(n_shards, rows_per)),
         offsets=jnp.asarray(offsets.reshape(n_shards, rows_per)),
     )
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
-    stacked = jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, P(DATA_AXIS))
+        stacked = jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
     return DistributedGlmData(data=stacked, n_shards=n_shards)
 
 
@@ -164,8 +174,26 @@ def run_grid_distributed(
     (reg_weight and the warm start are traced), each objective evaluation
     is one fused psum — the reference's per-λ ``treeAggregate`` loop
     collapsed onto ICI.  Coefficient variances, when configured, run as a
-    second shard_map program (one psum'd squared-column reduction per λ)."""
+    second shard_map program (one psum'd squared-column reduction per λ).
+
+    Host-kind solvers (``OptimizerConfig.solver`` naming admm/block_cd)
+    cannot run inside the traced shard_map solve; they route to
+    ``solvers.sharded.run_grid_sharded``, which drives the same grid_loop
+    warm-start chain around the solver's own host outer loop."""
     import jax.numpy as jnp
+
+    from photon_ml_tpu.solvers import registry as solver_registry
+    from photon_ml_tpu.solvers import sharded as solvers_sharded
+
+    cfg = problem.config
+    defn = solver_registry.resolve(
+        cfg.optimizer, l1_frac=cfg.regularization.l1_weight(1.0)
+    )
+    if defn.kind == "host":
+        return solvers_sharded.run_grid_sharded(
+            problem, dist_data, mesh, reg_weights, w0=w0, l1_mask=l1_mask,
+            warm_start=warm_start, solved=solved, on_solved=on_solved,
+        )
 
     d = dist_data.data.features.shape[-1]
     if w0 is None:

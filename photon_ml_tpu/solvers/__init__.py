@@ -1,0 +1,29 @@
+"""Solver subsystem: config-keyed registry + communication-light solvers.
+
+The registry (solvers/registry.py) turns the hard-wired optimizer
+dispatch that lived in ``optim/problem.solve``, ``optim/streaming
+.streaming_run_grid`` and the GAME block solvers into a config-keyed
+factory: every solver — the existing L-BFGS / OWL-QN / TRON / SPG and
+the new consensus-ADMM (solvers/admm.py) and distributed block
+coordinate descent (solvers/block_cd.py) — registers a
+:class:`~photon_ml_tpu.solvers.registry.SolverDef` and is selected by
+``OptimizerConfig.solver`` (name) + ``solver_options`` (knobs).  Unset
+``solver`` reproduces the historical static routing bitwise (bounds →
+SPG, any L1 component → OWL-QN, else the configured optimizer).
+
+Importing the package registers every built-in solver.
+"""
+
+from photon_ml_tpu.solvers import admm as _admm  # noqa: F401  (registers)
+from photon_ml_tpu.solvers import block_cd as _block_cd  # noqa: F401
+from photon_ml_tpu.solvers import registry
+from photon_ml_tpu.solvers.registry import (  # noqa: F401
+    ResidentSolve,
+    SolverDef,
+    StreamedSolve,
+    get,
+    names,
+    register,
+    resolve,
+    solver_options_dict,
+)

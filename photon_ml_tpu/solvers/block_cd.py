@@ -1,0 +1,341 @@
+"""Distributed block coordinate descent — local CD sweeps, periodic sync.
+
+The reference's Spark ecosystem pairs GLMs with distributed coordinate
+descent ("Distributed Coordinate Descent for Generalized Linear Models with
+Regularization", PAPERS.md): workers run proximal-Newton coordinate updates
+against LOCAL rows and synchronize per block round instead of per step.
+The TPU translation, with one crucial correction:
+
+- the coordinate space is partitioned statically into ``n_blocks`` blocks;
+  round k works block ``k mod n_blocks`` (round-robin cycling);
+- each round opens with ONE all-reduce of the active block's GLOBAL
+  per-coordinate gradient and curvature at the round-start iterate
+  (``[g_blk, h_blk, f]`` — 2·blk+1 floats);
+- each shard then runs ``sweeps`` sequential prox-Newton CD sweeps over the
+  block against its OWN rows, using the DRIFT-CORRECTED gradient
+  ``ĝ_j = g_j^glob(m₀) + (g_j^loc(m) − g_j^loc(m₀))`` — the global
+  round-start gradient plus the shard's live local drift (maintained
+  margins make every update O(rows)).  Naive local sweeps average to a
+  BIASED fixed point (shard-local Newton steps cancel where
+  ``Σ_s g^s/h^s = 0``, not where ``Σ_s g^s = 0`` — measured ~0.6% objective
+  gap on heterogeneous logistic shards); with the correction the update is
+  zero exactly at GLOBAL prox-stationarity, so cycling the blocks converges
+  to the true optimum;
+- the block synchronization closes the round with a second all-reduce of
+  the shard-averaged block delta (``blk`` floats).
+
+Two fixed-size all-reduces per block round — independent of sweep count and
+block size versus one per line-search step for the psum-per-evaluation
+solvers.  Like consensus-ADMM (solvers/admm.py) this runs over a real
+``shard_map`` mesh (``lax.psum`` over ``DATA_AXIS``) or as logical shards
+(``vmap`` + axis-0 sums) on one device, fires the ``distributed.allreduce``
+chaos site at each round's reduce seam, and publishes the
+``solver_allreduce_*`` / ``solver_outer_iterations_total`` counters.
+
+Scope: per-shard column access needs DENSE features (``DenseMatrix``) and
+identity normalization — sparse inputs are densified upstream when small
+(glm_driver) or rejected pointedly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.chaos import core as chaos_mod
+from photon_ml_tpu.optim.lbfgs import SolveResult
+from photon_ml_tpu.optim.owlqn import _pseudo_gradient
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockCDOptions:
+    """Knobs, settable via ``OptimizerConfig.solver_options`` (docs/solvers.md).
+
+    ``max_rounds`` of 0 defers to ``OptimizerConfig.max_iters × n_blocks``
+    (one configured "iteration" ≈ one full block cycle); ``tolerance`` of 0
+    defers to ``OptimizerConfig.tolerance`` (relative objective change over
+    one full cycle)."""
+
+    n_blocks: int = 4
+    sweeps: int = 2  # local CD sweeps over the active block per round
+    max_rounds: int = 0
+    tolerance: float = 0.0
+    shards: int = 0  # logical-shard count (0 = auto; sharded.py reads it)
+
+    @classmethod
+    def from_options(cls, options: dict) -> "BlockCDOptions":
+        fields = [f.name for f in dataclasses.fields(cls)]
+        unknown = sorted(set(options) - set(fields))
+        if unknown:
+            raise ValueError(
+                f"unknown block_cd solver_options {unknown}; valid: {fields}"
+            )
+        coerced = {
+            k: (float(v) if k == "tolerance" else int(v))
+            for k, v in options.items()
+        }
+        opts = cls(**coerced)
+        if opts.n_blocks < 1 or opts.sweeps < 1:
+            raise ValueError("block_cd n_blocks and sweeps must be >= 1")
+        return opts
+
+
+def make_sharded_solver(problem, dist, mesh, l1_mask=None):
+    """Registry ``sharded`` factory (same contract as
+    solvers.admm.make_sharded_solver)."""
+    from photon_ml_tpu.ops.sparse import DenseMatrix
+    from photon_ml_tpu.parallel.compat import shard_map
+    from photon_ml_tpu.parallel.distributed import DATA_AXIS
+    from photon_ml_tpu.solvers import registry as registry_mod
+
+    if not isinstance(dist.data.features, DenseMatrix):
+        raise ValueError(
+            "block_cd needs dense per-shard columns (DenseMatrix); densify "
+            "the design matrix upstream (glm_driver does this automatically "
+            "for small feature spaces) or use the 'admm' solver, whose "
+            "matvec-based subproblems take sparse features"
+        )
+    if problem.normalization is not None:
+        raise ValueError(
+            "block_cd does not compose with feature normalization (its "
+            "column updates read raw columns); drop --normalization or use "
+            "'admm'"
+        )
+    obj = problem.objective
+    loss = obj.loss
+    cfg = problem.config
+    opt = cfg.optimizer
+    opts = BlockCDOptions.from_options(
+        registry_mod.solver_options_dict(opt)
+    )
+    l1_frac = cfg.regularization.l1_weight(1.0)
+    l2_frac = cfg.regularization.l2_weight(1.0)
+
+    n = dist.n_shards
+    d = int(dist.data.features.shape[-1])
+    n_blocks = min(opts.n_blocks, d)
+    max_rounds = opts.max_rounds or opt.max_iters * n_blocks
+    tol = opts.tolerance or opt.tolerance
+    mask = (
+        jnp.ones((d,), jnp.float32)
+        if l1_mask is None
+        else jnp.asarray(l1_mask, jnp.float32)
+    )
+    # Static block partition, padded with -1 so every round runs the SAME
+    # compiled step program (coords are a traced argument).
+    splits = np.array_split(np.arange(d, dtype=np.int32), n_blocks)
+    blk = max(len(s) for s in splits)
+    blocks = [
+        jnp.asarray(
+            np.concatenate([s, np.full(blk - len(s), -1, np.int32)])
+        )
+        for s in splits
+    ]
+
+    def block_stats(local, w, coords):
+        """Round-start margins + the shard's block gradient/curvature and
+        data term — the payload of the round's FIRST reduce."""
+        x_mat = local.features.data
+        y, wt, off = local.labels, local.weights, local.offsets
+        m0 = x_mat @ w + off
+        u0 = wt * loss.d1(m0, y)
+        d20 = wt * loss.d2(m0, y)
+        cols = jnp.take(x_mat, jnp.maximum(coords, 0), axis=1)  # (rows, blk)
+        g0 = cols.T @ u0
+        h0 = (cols * cols).T @ d20
+        f0 = jnp.sum(wt * loss.value(m0, y))
+        return m0, u0, cols, g0, h0, f0
+
+    def local_sweeps(local, w, coords, m0, cols, g0_loc, g_glob, h_glob,
+                     l1, l2):
+        """``sweeps`` drift-corrected prox-Newton CD passes over the active
+        block; returns the shard's block delta (blk,)."""
+        y, wt = local.labels, local.weights
+        w_blk0 = w[jnp.maximum(coords, 0)]
+        valid = coords >= 0
+        h = jnp.maximum(h_glob + l2, 1e-12)
+        pos = jnp.tile(jnp.arange(blk, dtype=jnp.int32), opts.sweeps)
+
+        def coord_step(carry, i):
+            w_blk, m = carry
+            col = cols[:, i]
+            wj = w_blk[i]
+            g_live = jnp.vdot(col, wt * loss.d1(m, y))
+            ghat = g_glob[i] + (g_live - g0_loc[i]) + l2 * wj
+            zhat = wj - ghat / h[i]
+            thr = l1 * mask[jnp.maximum(coords[i], 0)] / h[i]
+            wj_new = jnp.sign(zhat) * jnp.maximum(jnp.abs(zhat) - thr, 0.0)
+            wj_new = jnp.where(valid[i], wj_new, wj)
+            m = m + (wj_new - wj) * col
+            return (w_blk.at[i].set(wj_new), m), None
+
+        (w_blk, _), _ = lax.scan(coord_step, (w_blk0, m0), pos)
+        return jnp.where(valid, w_blk - w_blk0, 0.0)
+
+    def apply_sync(w, coords, delta_sum, f0, l1, l2):
+        """Block synchronization from the second reduce (replicated)."""
+        upd = jnp.zeros((d,), jnp.float32).at[
+            jnp.maximum(coords, 0)
+        ].add(jnp.where(coords >= 0, delta_sum / n, 0.0))
+        w_next = w + upd
+        f_total = (
+            f0 + l1 * jnp.sum(jnp.abs(w) * mask)
+            + 0.5 * l2 * jnp.vdot(w, w)
+        )
+        return w_next, f_total
+
+    if mesh is not None:
+        spec_data = jax.sharding.PartitionSpec(DATA_AXIS)
+        spec_repl = jax.sharding.PartitionSpec()
+
+        def spmd_step(dd, w, coords, l1, l2):
+            local = dd.local()
+            m0, _u0, cols, g0, h0, f0_loc = block_stats(local, w, coords)
+            tot1 = lax.psum(
+                jnp.concatenate([g0, h0, f0_loc[None]]), DATA_AXIS
+            )
+            g_glob, h_glob, f0 = tot1[:blk], tot1[blk:2 * blk], tot1[2 * blk]
+            delta = local_sweeps(
+                local, w, coords, m0, cols, g0, g_glob, h_glob, l1, l2
+            )
+            delta_sum = lax.psum(delta, DATA_AXIS)
+            return apply_sync(w, coords, delta_sum, f0, l1, l2)
+
+        step = jax.jit(shard_map(
+            spmd_step,
+            mesh=mesh,
+            in_specs=(spec_data, spec_repl, spec_repl, spec_repl, spec_repl),
+            out_specs=(spec_repl, spec_repl),
+            check_vma=False,
+        ))
+
+        def spmd_eval(dd, w, l1, l2):
+            val, grad = obj.raw_value_and_grad(w, dd.local())
+            val, grad = lax.psum((val, grad), DATA_AXIS)
+            val = (
+                val + l1 * jnp.sum(jnp.abs(w) * mask)
+                + 0.5 * l2 * jnp.vdot(w, w)
+            )
+            return val, _pseudo_gradient(w, grad + l2 * w, l1, mask)
+
+        eval_fn = jax.jit(shard_map(
+            spmd_eval,
+            mesh=mesh,
+            in_specs=(spec_data, spec_repl, spec_repl, spec_repl),
+            out_specs=(spec_repl, spec_repl),
+            check_vma=False,
+        ))
+    else:
+        def logical_step(dd, w, coords, l1, l2):
+            m0, _u0, cols, g0, h0, f0_loc = jax.vmap(
+                lambda local: block_stats(local, w, coords)
+            )(dd.data)
+            g_glob = jnp.sum(g0, axis=0)
+            h_glob = jnp.sum(h0, axis=0)
+            f0 = jnp.sum(f0_loc)
+            delta = jax.vmap(
+                lambda local, m0s, colss, g0s: local_sweeps(
+                    local, w, coords, m0s, colss, g0s, g_glob, h_glob,
+                    l1, l2,
+                )
+            )(dd.data, m0, cols, g0)
+            return apply_sync(w, coords, jnp.sum(delta, axis=0), f0, l1, l2)
+
+        step = jax.jit(logical_step)
+
+        def logical_eval(dd, w, l1, l2):
+            vals, grads = jax.vmap(
+                lambda local: obj.raw_value_and_grad(w, local)
+            )(dd.data)
+            val = (
+                jnp.sum(vals) + l1 * jnp.sum(jnp.abs(w) * mask)
+                + 0.5 * l2 * jnp.vdot(w, w)
+            )
+            return val, _pseudo_gradient(
+                w, jnp.sum(grads, axis=0) + l2 * w, l1, mask
+            )
+
+        eval_fn = jax.jit(logical_eval)
+
+    # first reduce: [g_blk, h_blk, f] — second: the block delta.
+    payload_bytes = (2 * blk + 1) * 4 + blk * 4
+
+    def solve_fn(lam, w_prev, dist_override=None) -> SolveResult:
+        dd = dist if dist_override is None else dist_override
+        l1 = jnp.asarray(l1_frac * float(lam), jnp.float32)
+        l2 = jnp.asarray(l2_frac * float(lam), jnp.float32)
+        w = (
+            jnp.zeros((d,), jnp.float32)
+            if w_prev is None
+            else jnp.asarray(w_prev, jnp.float32)
+        )
+        values = []
+        rounds = 0
+        converged = False
+        for k in range(max_rounds):
+            # The reduce seam: the step program about to run carries this
+            # round's two all-reduces (docs/robustness.md).
+            chaos_mod.maybe_fail(
+                "distributed.allreduce", solver="block_cd", outer=k
+            )
+            w_new, f_total = step(dd, w, blocks[k % n_blocks], l1, l2)
+            values.append(float(f_total))  # objective at round-START w
+            w = w_new
+            rounds = k + 1
+            # Objective change over one full block cycle (every coordinate
+            # visited once): the per-round change of a single small block
+            # can be ~0 while other blocks still move.
+            if k >= n_blocks:
+                prev, cur = values[-1 - n_blocks], values[-1]
+                if abs(prev - cur) <= tol * max(1.0, abs(cur)):
+                    converged = True
+                    break
+
+        value, grad = eval_fn(dd, w, l1, l2)
+        tel = telemetry_mod.current()
+        if tel.enabled:
+            tel.counter("solver_outer_iterations_total").inc(rounds)
+            # Two fused reduces per round + the final exact evaluation.
+            tel.counter("solver_allreduce_count").inc(2 * rounds + 1)
+            tel.counter("solver_allreduce_bytes_total").inc(
+                rounds * payload_bytes + (d + 1) * 4
+            )
+            tel.counter("solvers_sharded_solves_total").inc()
+        return SolveResult(
+            w=w,
+            value=value,
+            grad=grad,
+            iterations=jnp.asarray(rounds, jnp.int32),
+            converged=jnp.asarray(converged),
+            values=jnp.asarray(values, jnp.float32),
+            grad_norms=jnp.asarray(
+                [abs(v) for v in np.diff(values)] or [0.0], jnp.float32
+            ),
+        )
+
+    return solve_fn
+
+
+def _register():
+    from photon_ml_tpu.solvers import registry
+
+    registry.register(registry.SolverDef(
+        name="block_cd",
+        kind="host",
+        description=(
+            "distributed block coordinate descent: drift-corrected local "
+            "prox-Newton CD sweeps + two all-reduces per block round"
+        ),
+        supports_l1=True,
+        sharded=make_sharded_solver,
+    ))
+
+
+_register()

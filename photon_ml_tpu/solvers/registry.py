@@ -1,0 +1,336 @@
+"""Config-keyed solver registry.
+
+The reference keys its optimizer choice off ``OptimizerConfig.optimizerType``
+inside each optimization problem class; PRs 1-17 reproduced that as static
+``if``-chains in three places (``optim/problem.solve``, ``optim/streaming
+.streaming_run_grid``, the GAME block solvers).  This module centralizes the
+dispatch: each solver registers a :class:`SolverDef` under a name, and
+``OptimizerConfig.solver`` selects one explicitly — or, when unset,
+:func:`resolve` reproduces the historical routing rules bitwise (bounds →
+SPG, any L1 component → OWL-QN, else the configured optimizer).
+
+Two solver kinds exist:
+
+- ``"jit"`` — the solve is one pure traced function (L-BFGS, OWL-QN, TRON,
+  SPG).  It runs inside ``jax.jit`` / ``shard_map`` via the ``resident``
+  callable, or as a host loop of streamed passes via ``streamed``.
+- ``"host"`` — the solve runs a host-side outer loop around a compiled step
+  program (consensus-ADMM, distributed block CD): it CANNOT execute inside
+  a traced solve, so ``problem.solve`` rejects it and the grid runners
+  route through :func:`photon_ml_tpu.solvers.sharded.run_grid_sharded`
+  instead (``sharded`` is the factory: ``sharded(problem, dist, mesh,
+  l1_mask) → solve_fn(lam, w_prev, dist_override=None)``).
+
+Registration is guarded by a lock-order-sanitized lock (witness class
+``solvers.registry`` — analysis/sanitizers.py): drivers and tuning threads
+resolve concurrently while tests register scratch solvers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+
+from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.analysis import sanitizers
+
+Array = jax.Array
+
+
+class ResidentSolve(NamedTuple):
+    """One resident (device-array) solve request — what ``problem.solve``
+    hands a jit-kind solver.  ``l1``/``l2`` are the already-split traced
+    regularization weights; ``opt`` is the ``OptimizerConfig``."""
+
+    objective: Any  # GlmObjective
+    data: Any  # GlmData
+    w0: Array
+    l1: Array
+    l2: Array
+    opt: Any  # OptimizerConfig
+    axis_name: Optional[str] = None
+    l1_mask: Optional[Array] = None
+    bounds: Optional[tuple] = None
+
+
+class StreamedSolve(NamedTuple):
+    """One streamed solve request — what ``streaming_run_grid`` hands a
+    jit-kind solver's ``streamed`` callable.  ``sobj`` is the
+    StreamingObjective; ``value_and_grad_batch`` is the batched
+    line-search evaluator (or None when disabled)."""
+
+    sobj: Any  # StreamingObjective
+    w0: Array
+    l1: float
+    l2: float
+    opt: Any  # OptimizerConfig
+    l1_mask: Optional[Array] = None
+    value_and_grad_batch: Optional[Callable] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverDef:
+    """One registered solver.
+
+    ``resident`` / ``streamed`` serve jit-kind solvers on the resident and
+    streamed paths; ``sharded`` serves host-kind solvers (and is a
+    FACTORY — it binds the problem + sharded data once and returns the
+    per-λ ``solve_fn``, so Gram factorizations and compiled step programs
+    are shared across a warm-start grid)."""
+
+    name: str
+    kind: str  # "jit" | "host"
+    description: str
+    supports_l1: bool = False
+    supports_bounds: bool = False
+    resident: Optional[Callable[[ResidentSolve], Any]] = None
+    streamed: Optional[Callable[[StreamedSolve], Any]] = None
+    sharded: Optional[Callable] = None
+
+    def __post_init__(self):
+        if self.kind not in ("jit", "host"):
+            raise ValueError(f"solver kind must be jit|host, got {self.kind!r}")
+        if self.kind == "jit" and self.resident is None:
+            raise ValueError(f"jit-kind solver {self.name!r} needs a resident callable")
+        if self.kind == "host" and self.sharded is None:
+            raise ValueError(f"host-kind solver {self.name!r} needs a sharded factory")
+
+
+_REGISTRY: dict[str, SolverDef] = {}
+_LOCK = sanitizers.tracked(threading.Lock(), "solvers.registry")
+
+
+def register(defn: SolverDef, replace: bool = False) -> SolverDef:
+    """Register a solver under ``defn.name``; duplicate names are refused
+    unless ``replace=True`` (tests swapping in instrumented doubles)."""
+    with _LOCK:
+        if defn.name in _REGISTRY and not replace:
+            raise ValueError(
+                f"solver {defn.name!r} is already registered "
+                "(pass replace=True to override)"
+            )
+        _REGISTRY[defn.name] = defn
+    tel = telemetry_mod.current()
+    if tel.enabled:
+        tel.counter("solvers_registered_total").inc()
+    return defn
+
+
+def get(name: str) -> SolverDef:
+    with _LOCK:
+        defn = _REGISTRY.get(name)
+    if defn is None:
+        raise KeyError(
+            f"unknown solver {name!r}; registered: {names()}"
+        )
+    return defn
+
+
+def names() -> list[str]:
+    with _LOCK:
+        return sorted(_REGISTRY)
+
+
+def solver_options_dict(opt) -> dict:
+    """``OptimizerConfig.solver_options`` (a hashable tuple of (key, value)
+    pairs — it lives in lru_cache keys) as a plain dict."""
+    return dict(getattr(opt, "solver_options", ()) or ())
+
+
+def resolve(opt, *, l1_frac: float, has_bounds: bool = False) -> SolverDef:
+    """Pick the solver for an ``OptimizerConfig``.
+
+    ``opt.solver`` unset reproduces the historical static routing bitwise:
+    bounds → SPG for any smooth config, any L1 component → OWL-QN (the
+    orthant machinery is the only L1-capable one), else the configured
+    optimizer.  An explicit name is honored as-is, but incompatible
+    combinations (an L1 component with a solver that has no subgradient
+    handling; bounds with anything but SPG) are rejected here — statically,
+    before any compute is spent."""
+    name = getattr(opt, "solver", None)
+    if name is None:
+        if has_bounds:
+            return get("spg")
+        if l1_frac > 0.0:
+            return get("owlqn")
+        return get(opt.optimizer.value)
+    defn = get(name)
+    if has_bounds and not defn.supports_bounds:
+        raise ValueError(
+            f"solver {name!r} does not support box constraints; "
+            "only 'spg' does — drop the bounds or the solver override"
+        )
+    if l1_frac > 0.0 and not defn.supports_l1:
+        raise ValueError(
+            f"solver {name!r} has no L1 subgradient handling; use "
+            "'owlqn', 'admm', or 'block_cd' for L1/elastic-net configs"
+        )
+    if name == "spg" and not has_bounds:
+        # SPG is a projection method: without box constraints there is no
+        # feasible set to project onto (and its resident closure reads
+        # ctx.bounds).  Reject up front instead of crashing mid-trace.
+        raise ValueError(
+            "solver 'spg' needs box constraints (lower/upper bounds); "
+            "use 'lbfgs' or 'tron' for unconstrained smooth configs"
+        )
+    return defn
+
+
+# ---------------------------------------------------------------------------
+# Built-in jit-kind solvers.  Each callable builds EXACTLY the closure the
+# pre-registry problem.solve / streaming_run_grid built, so dispatching
+# through the registry is bitwise-identical to the old static routing
+# (tests/test_solvers.py pins this).
+# ---------------------------------------------------------------------------
+
+
+def _lbfgs_resident(ctx: ResidentSolve):
+    from photon_ml_tpu.optim.lbfgs import LBFGSConfig, lbfgs_solve
+
+    obj, data, opt = ctx.objective, ctx.data, ctx.opt
+    return lbfgs_solve(
+        lambda w: obj.value_and_grad(
+            w, data, l2_weight=ctx.l2, axis_name=ctx.axis_name
+        ),
+        ctx.w0,
+        LBFGSConfig(
+            max_iters=opt.max_iters,
+            tolerance=opt.tolerance,
+            history=opt.history,
+        ),
+    )
+
+
+def _owlqn_resident(ctx: ResidentSolve):
+    from photon_ml_tpu.optim.owlqn import OWLQNConfig, owlqn_solve
+
+    obj, data, opt = ctx.objective, ctx.data, ctx.opt
+    return owlqn_solve(
+        lambda w: obj.value_and_grad(
+            w, data, l2_weight=ctx.l2, axis_name=ctx.axis_name
+        ),
+        ctx.w0,
+        ctx.l1,
+        OWLQNConfig(
+            max_iters=opt.max_iters,
+            tolerance=opt.tolerance,
+            history=opt.history,
+        ),
+        l1_mask=ctx.l1_mask,
+    )
+
+
+def _tron_resident(ctx: ResidentSolve):
+    from photon_ml_tpu.optim.tron import TRONConfig, tron_solve
+
+    obj, data, opt = ctx.objective, ctx.data, ctx.opt
+    return tron_solve(
+        lambda w: obj.value_and_grad(
+            w, data, l2_weight=ctx.l2, axis_name=ctx.axis_name
+        ),
+        lambda w, v, aux: obj.hvp(
+            w, v, data, l2_weight=ctx.l2, axis_name=ctx.axis_name, d2w=aux
+        ),
+        ctx.w0,
+        TRONConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
+        d2_fn=lambda w: obj.d2_weights(w, data),
+    )
+
+
+def _spg_resident(ctx: ResidentSolve):
+    from photon_ml_tpu.optim.projected import SPGConfig, spg_solve
+
+    obj, data, opt = ctx.objective, ctx.data, ctx.opt
+    return spg_solve(
+        lambda w: obj.value_and_grad(
+            w, data, l2_weight=ctx.l2, axis_name=ctx.axis_name
+        ),
+        ctx.w0,
+        ctx.bounds[0],
+        ctx.bounds[1],
+        SPGConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
+        w_axis=None,
+    )
+
+
+def _lbfgs_streamed(ctx: StreamedSolve):
+    from photon_ml_tpu.optim.lbfgs import LBFGSConfig
+    from photon_ml_tpu.optim.streaming import streaming_lbfgs_solve
+
+    opt = ctx.opt
+    return streaming_lbfgs_solve(
+        lambda w: ctx.sobj.value_and_grad(w, ctx.l2),
+        ctx.w0,
+        LBFGSConfig(
+            max_iters=opt.max_iters,
+            tolerance=opt.tolerance,
+            history=opt.history,
+        ),
+        value_and_grad_batch=ctx.value_and_grad_batch,
+    )
+
+
+def _owlqn_streamed(ctx: StreamedSolve):
+    from photon_ml_tpu.optim.owlqn import OWLQNConfig
+    from photon_ml_tpu.optim.streaming import streaming_owlqn_solve
+
+    opt = ctx.opt
+    return streaming_owlqn_solve(
+        lambda w: ctx.sobj.value_and_grad(w, ctx.l2),
+        ctx.w0,
+        ctx.l1,
+        OWLQNConfig(
+            max_iters=opt.max_iters,
+            tolerance=opt.tolerance,
+            history=opt.history,
+        ),
+        l1_mask=ctx.l1_mask,
+        value_and_grad_batch=ctx.value_and_grad_batch,
+    )
+
+
+def _tron_streamed(ctx: StreamedSolve):
+    from photon_ml_tpu.optim.streaming import streaming_tron_solve
+    from photon_ml_tpu.optim.tron import TRONConfig
+
+    opt = ctx.opt
+    return streaming_tron_solve(
+        lambda w: ctx.sobj.value_and_grad(w, ctx.l2),
+        lambda w, v: ctx.sobj.hvp(w, v, ctx.l2),
+        ctx.w0,
+        TRONConfig(max_iters=opt.max_iters, tolerance=opt.tolerance),
+    )
+
+
+register(SolverDef(
+    name="lbfgs",
+    kind="jit",
+    description="limited-memory BFGS (smooth objectives)",
+    resident=_lbfgs_resident,
+    streamed=_lbfgs_streamed,
+))
+register(SolverDef(
+    name="owlqn",
+    kind="jit",
+    description="orthant-wise L-BFGS (L1/elastic-net)",
+    supports_l1=True,
+    resident=_owlqn_resident,
+    streamed=_owlqn_streamed,
+))
+register(SolverDef(
+    name="tron",
+    kind="jit",
+    description="trust-region Newton-CG (smooth objectives)",
+    resident=_tron_resident,
+    streamed=_tron_streamed,
+))
+register(SolverDef(
+    name="spg",
+    kind="jit",
+    description="spectral projected gradient (box constraints)",
+    supports_bounds=True,
+    resident=_spg_resident,
+))

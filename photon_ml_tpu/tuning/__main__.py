@@ -59,6 +59,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--task", default="logistic", help="glm: task type")
     p.add_argument("--reg-type", default="l2", help="glm: regularization")
     p.add_argument("--optimizer", default="lbfgs", help="glm")
+    p.add_argument("--solver", help="glm: registered solver name "
+                   "(lbfgs|owlqn|tron|admm|block_cd); unset keeps the "
+                   "historical routing bitwise — docs/solvers.md")
     p.add_argument("--max-iters", type=int, default=100, help="glm: full-"
                    "resource iteration budget (non-ASHA trials)")
     p.add_argument("--n-features", type=int, help="glm: fixed width")
@@ -431,6 +434,7 @@ def _build_search(args):
             X_train, y_train, X_val, y_val,
             task=args.task, reg_type=args.reg_type,
             optimizer=args.optimizer, max_iters=args.max_iters,
+            solver=args.solver,
         )
         from photon_ml_tpu.tuning.scheduler import SearchSpace
 

@@ -91,7 +91,10 @@ if [[ "${1:-}" == "--fast" ]]; then
   # zero failed requests, the killed host rejoins.  test_serving_wire
   # is the binary-parity smoke: a 3-bucket synthetic model scored over
   # live HTTP in both wire formats must produce BITWISE-identical
-  # scores (plus fused-kernel parity and frame refusal tests).
+  # scores (plus fused-kernel parity and frame refusal tests).  The
+  # solver smoke pins registry dispatch (explicit --solver lbfgs is
+  # bitwise the implicit routing) and consensus-ADMM landing within
+  # 1e-5 of the resident OWL-QN optimum over logical shards.
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_ops_plane.py \
     tests/test_watchdog.py \
@@ -103,6 +106,8 @@ if [[ "${1:-}" == "--fast" ]]; then
     "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
     "tests/test_streaming.py::TestTransferAvoidance::test_fast_lane_compressed_cached_parity" \
     "tests/test_serving_fleet.py::TestFleetRouter::test_host_kill_under_load_costs_zero_failures" \
+    "tests/test_solvers.py::TestDispatchParity::test_resident_bitwise" \
+    "tests/test_solvers.py::TestADMM::test_logical_shards_match_owlqn" \
     -m 'not slow' -q -p no:cacheprovider
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
