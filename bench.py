@@ -34,9 +34,11 @@ the primary metric in the required fields, the other metrics under "extra"
 with their own vs_baseline ratios.
 
 Env knobs: BENCH_SMALL=1 shrinks every workload (CI/smoke); BENCH_ONLY=
-glm|game|driver|stream|serving|freshness|tuning|chaos|telemetry|tracing
-runs a single section (tracing: trace-propagation overhead A/B, gated
-<= 1% of the closed-loop serving baseline).
+glm|game|driver|stream|serving|freshness|tuning|solvers|chaos|telemetry|
+tracing|analysis|cluster runs a single section (tracing: trace-
+propagation overhead A/B, gated <= 1% of the closed-loop serving
+baseline; cluster: the 3-host control-plane drill as a gate plus the
+checksum-verified snapshot-fetch MB/s).
 """
 
 import json
@@ -2446,6 +2448,79 @@ def bench_solvers() -> dict:
     }
 
 
+def bench_cluster() -> dict:
+    """Cluster control plane (ISSUE 19): the 3-host drill as a gate,
+    plus a distribution wire microbench.
+
+    The drill (the same one ``python -m photon_ml_tpu.cluster
+    --selfcheck`` runs) kills the leader quota-coordinator replica
+    under >= 120 rps open-loop load — failover must land within one
+    lease TTL with ZERO failed requests and journal-replay-bounded
+    over-admission — then cold-starts a third host from the newest
+    snapshot publication over HTTP (bit-identical scores) while
+    another host drains.  The microbench times a fresh snapshot fetch
+    through :class:`PublicationClient` — every byte sha256-verified
+    end to end — so the reported MB/s is the VERIFIED ingest rate a
+    joining host actually sees, not raw socket throughput."""
+    import shutil
+    import tempfile
+
+    from photon_ml_tpu.cluster import PublicationClient, PublicationServer
+    from photon_ml_tpu.cluster.__main__ import run_cluster_drill
+    from photon_ml_tpu.freshness.publisher import DeltaPublisher
+
+    out: dict = {}
+    _log("cluster: 3-host drill (coordinator kill + join/drain + "
+         "cold start)...")
+    td = tempfile.mkdtemp(prefix="bench_cluster_")
+    try:
+        t0 = time.perf_counter()
+        failures = run_cluster_drill(
+            td, drill_rate=60.0 if SMALL else 150.0, lease_ttl_s=1.0
+        )
+        out["cluster_drill_wall_seconds"] = round(
+            time.perf_counter() - t0, 2
+        )
+        out["cluster_drill_ok"] = not failures
+        if failures:
+            out["cluster_drill_failures"] = failures[:3]
+
+        # Verified-ingest microbench: one snapshot, fetched cold.
+        payload_mb = 2 if SMALL else 16
+        root = os.path.join(td, "bench_pub_root")
+        model = os.path.join(td, "bench_model")
+        os.makedirs(model)
+        rng = np.random.default_rng(5)
+        for i in range(4):
+            with open(os.path.join(model, f"block{i}.bin"), "wb") as f:
+                f.write(rng.bytes(payload_mb * 1024 * 1024 // 4))
+        pub = DeltaPublisher(root, fsync=False).publish_snapshot(model)
+        server = PublicationServer(root).serve()
+        try:
+            client = PublicationClient(
+                server.base_url, os.path.join(td, "bench_cache")
+            )
+            remote = [
+                p for p in client.publications() if p.seq == pub.seq
+            ][0]
+            t0 = time.perf_counter()
+            client.fetch(remote)
+            fetch_wall = time.perf_counter() - t0
+        finally:
+            server.close()
+        out["cluster_fetch_mb_per_sec"] = round(
+            payload_mb / fetch_wall, 1
+        )
+        _log(f"cluster: drill "
+             f"{'ok' if out['cluster_drill_ok'] else 'FAILED'} in "
+             f"{out['cluster_drill_wall_seconds']}s, verified fetch "
+             f"{out['cluster_fetch_mb_per_sec']} MB/s "
+             f"({payload_mb} MB snapshot)")
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+    return out
+
+
 def main() -> None:
     # Sink-less but ENABLED telemetry hub: the streamed/ooc sections'
     # prefetch pipelines feed their TransferStats into its registry
@@ -2581,6 +2656,11 @@ def main() -> None:
             extra.update(bench_analysis())
         except Exception as e:  # new section: never sink the headline
             extra["analysis_sanitizer_overhead_frac"] = f"failed: {e}"
+    if ONLY in ("", "cluster"):
+        try:
+            extra.update(bench_cluster())
+        except Exception as e:  # new section: never sink the headline
+            extra["cluster_drill_ok"] = f"failed: {e}"
     out = {
         "metric": "logistic_glm_rows_per_sec",
         "unit": "rows/s",

@@ -40,6 +40,7 @@ SUBSYSTEMS = frozenset({
     "solver", "solvers", "cd", "grid", "game", "glm", "watchdog", "checkpoint",
     "chaos", "serving", "tuning", "compile", "run", "telemetry",
     "evaluation", "model", "analysis", "freshness", "fleet", "slo",
+    "cluster",
 })
 
 #: Last name token: what the value measures.

@@ -236,6 +236,30 @@ KNOWN_SITES = {
         "between outer iterations; resume must replay the λ point to "
         "the SAME consensus trajectory (bitwise, the ISSUE 18 gate)"
     ),
+    "cluster.lease": (
+        "replicated-coordinator renewal, before a replica is attempted "
+        "(cluster/coordination.py ReplicatedQuotaCoordinator.renew; "
+        "ctx: host, replica) — a fault is the wire to THAT replica "
+        "dying: the walk must move on to the next replica, and only an "
+        "all-replica failure surfaces to the LeaseClient, which then "
+        "degrades to its LAST lease (never unlimited, never zero)"
+    ),
+    "cluster.heartbeat": (
+        "membership heartbeat, before the agent reaches the registry "
+        "(cluster/membership.py HeartbeatAgent.beat_once; ctx: host) — "
+        "a fault is the host partitioned from the registry: the beat "
+        "fails (cluster_heartbeat_failures_total), the loop keeps "
+        "trying, and a partition longer than the heartbeat TTL expires "
+        "the host from membership until it re-registers"
+    ),
+    "cluster.fetch": (
+        "publication blob fetch, before one file's HTTP GET "
+        "(cluster/distribution.py PublicationClient._get_blob; ctx: "
+        "seq, file) — a fault is the wire dying mid-distribution: the "
+        "client retries (cluster_fetch_retries), an exhausted retry "
+        "budget raises FetchError, and NOTHING half-fetched is ever "
+        "visible at the final path (staging dir + atomic rename)"
+    ),
 }
 
 

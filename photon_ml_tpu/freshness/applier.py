@@ -19,12 +19,14 @@ stale — now what?" runbook in docs/freshness.md keys off it).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import List, Optional
 
 from photon_ml_tpu import telemetry as telemetry_mod
 from photon_ml_tpu.freshness.publisher import (
+    SNAPSHOT_MODEL_DIR,
     Publication,
     read_publications,
     write_ack,
@@ -89,7 +91,7 @@ class DeltaApplier:
         results = []
         seq_before = self.applied_seq
         for pub in self.pending():
-            result = self._service.reload(pub.path, mode="delta")
+            result = self._apply(pub)
             results.append(result)
             self.applied_seq = pub.seq
             if result.status == "swapped":
@@ -110,6 +112,17 @@ class DeltaApplier:
             write_ack(self.root, self.subscriber_id, self.applied_seq)
         self._refresh_staleness()
         return results
+
+    def _apply(self, pub: Publication):
+        """One publication -> the matching reload path: deltas patch
+        the live model (``mode="delta"``), snapshots full-reload from
+        the artifact's ``model/`` subdir (a snapshot is a complete
+        model, not a patch — applying one re-bases the subscriber)."""
+        if pub.kind == "snapshot":
+            return self._service.reload(
+                os.path.join(pub.path, SNAPSHOT_MODEL_DIR)
+            )
+        return self._service.reload(pub.path, mode="delta")
 
     def _refresh_staleness(self) -> None:
         if self._servable_event_wall is None:

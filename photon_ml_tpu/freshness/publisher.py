@@ -52,13 +52,30 @@ therefore register an ack file under ``acks/`` (atomic write via
 :func:`write_ack`; :class:`~photon_ml_tpu.freshness.applier.DeltaApplier`
 does this when given a ``subscriber_id``), and retention refuses to
 prune any publication newer than the slowest registered ack — those
-sequences are reported as ``blocked`` instead of removed.  A root with
-no registered subscribers prunes on age alone.
+sequences are reported as ``blocked`` with the GUILTY subscriber ids
+(``blocking``), so the operator knows exactly which subscriber to chase
+or unregister (:func:`remove_ack` releases the prune).  A root with no
+registered subscribers prunes on age alone.
+
+Snapshot publications (cluster cold start)
+------------------------------------------
+
+Deltas patch a base the subscriber already has; a brand-new host has no
+base.  :meth:`DeltaPublisher.publish_snapshot` publishes a FULL model
+directory under the same journal protocol (``snapshot-<seq>/`` with a
+self-digested ``snapshot.json`` listing every file's sha256), so a cold
+host can bootstrap from the newest snapshot over the wire
+(photon_ml_tpu/cluster/distribution.py) and then catch up by deltas —
+no shared filesystem anywhere on the serving path.  Snapshots ride the
+same sequence space, retention, and ack discipline as deltas;
+:class:`Publication.kind` tells the apply side which reload path to
+take.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -74,6 +91,7 @@ from photon_ml_tpu.freshness.delta import (
     MANIFEST_FILE,
     DeltaError,
     ModelDelta,
+    _manifest_digest,
     _read_manifest,
     write_delta,
 )
@@ -82,7 +100,9 @@ from photon_ml_tpu.io.checkpoint import fsync_file
 
 @dataclasses.dataclass(frozen=True)
 class Publication:
-    """One committed delta publication, as subscribers see it."""
+    """One committed publication, as subscribers see it.  ``kind`` is
+    ``"delta"`` (incremental, delta.py layout) or ``"snapshot"`` (full
+    model dir + ``snapshot.json``, the cold-start bootstrap)."""
 
     seq: int
     path: str
@@ -90,6 +110,7 @@ class Publication:
     event_wall_epoch: Optional[float]
     n_changed_rows: int
     publish_wall_epoch: float
+    kind: str = "delta"
 
 
 class PublishAborted(RuntimeError):
@@ -103,7 +124,16 @@ ACKS_DIR = "acks"
 #: same safe alphabet as tenant slugs, no path separators or dots-only.
 _SUBSCRIBER_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 
-_DELTA_DIR_RE = re.compile(r"^delta-(\d+)$")
+_ARTIFACT_DIR_RE = re.compile(r"^(?:delta|snapshot)-(\d+)$")
+
+#: Snapshot artifact manifest filename and format tag (delta.py keeps
+#: ``delta.json`` / photon-model-delta-v1 for incremental artifacts).
+SNAPSHOT_MANIFEST = "snapshot.json"
+SNAPSHOT_FORMAT = "photon-model-snapshot-v1"
+#: Model files live under this subdir of a snapshot artifact, so the
+#: apply side reloads ``<artifact>/model`` without the manifest riding
+#: along inside the model directory.
+SNAPSHOT_MODEL_DIR = "model"
 
 
 def write_ack(
@@ -153,6 +183,97 @@ def read_acks(root: str) -> Dict[str, int]:
         except (json.JSONDecodeError, KeyError, TypeError, ValueError):
             continue
     return out
+
+
+def remove_ack(root: str, subscriber_id: str) -> bool:
+    """Unregister a subscriber from the root's ack sidecar, releasing
+    any retention hold its stale ack was keeping (``retain`` reports
+    the guilty id in ``blocking``).  Returns ``True`` when an ack file
+    was actually removed.  This is the operator's lever against a
+    subscriber that registered and then died without acking — the
+    runbook move after ``blocking`` names it."""
+    if not _SUBSCRIBER_ID_RE.match(subscriber_id):
+        raise ValueError(
+            f"subscriber id {subscriber_id!r} is not a safe filename "
+            "([A-Za-z0-9][A-Za-z0-9._-]*, max 64 chars)"
+        )
+    path = os.path.join(root, ACKS_DIR, subscriber_id + ".json")
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        return False
+    telemetry_mod.current().event(
+        "freshness.subscriber_unregistered",
+        root=root, subscriber_id=subscriber_id,
+    )
+    return True
+
+
+def _write_snapshot_manifest(
+    staging: str, event_wall_epoch: Optional[float]
+) -> dict:
+    """Digest every file under ``staging/model`` into a self-digested
+    ``snapshot.json`` (delta.py's manifest discipline: sha256 per file,
+    manifest_sha256 over the canonical JSON of the rest)."""
+    model_root = os.path.join(staging, SNAPSHOT_MODEL_DIR)
+    files: Dict[str, dict] = {}
+    for dirpath, _dirnames, filenames in os.walk(model_root):
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            rel = os.path.join(
+                SNAPSHOT_MODEL_DIR, os.path.relpath(full, model_root)
+            )
+            with open(full, "rb") as f:
+                payload = f.read()
+            files[rel] = {
+                "sha256": hashlib.sha256(payload).hexdigest(),
+                "nbytes": len(payload),
+            }
+    if not files:
+        raise DeltaError(
+            f"{model_root}: empty model directory — nothing to snapshot"
+        )
+    manifest = {
+        "format": SNAPSHOT_FORMAT,
+        "event_wall_epoch": event_wall_epoch,
+        "files": files,
+    }
+    manifest["manifest_sha256"] = _manifest_digest(manifest)
+    with open(os.path.join(staging, SNAPSHOT_MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=2)
+    return manifest
+
+
+def read_snapshot_manifest(directory: str) -> dict:
+    """Parse and digest-verify a snapshot artifact's ``snapshot.json``.
+    Raises :class:`DeltaError` on a missing/torn/tampered manifest —
+    the same refusal contract as delta.py's ``read_delta``."""
+    path = os.path.join(directory, SNAPSHOT_MANIFEST)
+    if not os.path.exists(path):
+        raise DeltaError(
+            f"{directory}: no {SNAPSHOT_MANIFEST} — not a snapshot "
+            "artifact (or the publish died before staging completed)"
+        )
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except ValueError as e:
+        raise DeltaError(
+            f"{path}: unparseable snapshot manifest ({e}) — the "
+            "artifact write was torn; re-publish the snapshot"
+        ) from e
+    if manifest.get("format") != SNAPSHOT_FORMAT:
+        raise DeltaError(
+            f"{path}: format {manifest.get('format')!r}, expected "
+            f"{SNAPSHOT_FORMAT!r}"
+        )
+    expected = manifest.get("manifest_sha256")
+    if _manifest_digest(manifest) != expected:
+        raise DeltaError(
+            f"{path}: manifest self-digest mismatch — the manifest was "
+            "modified after publish; refuse and re-publish"
+        )
+    return manifest
 
 
 class DeltaPublisher:
@@ -234,11 +355,20 @@ class DeltaPublisher:
         return records
 
     # -- paths --------------------------------------------------------------
-    def _final_dir(self, seq: int) -> str:
-        return os.path.join(self.root, f"delta-{seq:06d}")
+    def _final_dir(self, seq: int, artifact: str = "delta") -> str:
+        return os.path.join(self.root, f"{artifact}-{seq:06d}")
 
-    def _staging_dir(self, seq: int) -> str:
-        return self._final_dir(seq) + ".staging"
+    def _staging_dir(self, seq: int, artifact: str = "delta") -> str:
+        return self._final_dir(seq, artifact) + ".staging"
+
+    def _artifact_dirs(self, seq: int) -> List[str]:
+        """Every directory (final or staging, either kind) a sequence
+        number may occupy — retention removes whichever exists."""
+        return [
+            self._final_dir(seq, a) + suffix
+            for a in ("delta", "snapshot")
+            for suffix in ("", ".staging")
+        ]
 
     # -- resume -------------------------------------------------------------
     def resume(self) -> List[dict]:
@@ -258,21 +388,33 @@ class DeltaPublisher:
                 if r["kind"] != "begin" or r["seq"] in settled:
                     continue
                 seq = r["seq"]
-                final, staging = self._final_dir(seq), self._staging_dir(seq)
-                if os.path.exists(
-                    os.path.join(final, MANIFEST_FILE)
-                ):
+                artifact = r.get("artifact", "delta")
+                final = self._final_dir(seq, artifact)
+                staging = self._staging_dir(seq, artifact)
+                manifest_name = (
+                    SNAPSHOT_MANIFEST if artifact == "snapshot"
+                    else MANIFEST_FILE
+                )
+                if os.path.exists(os.path.join(final, manifest_name)):
                     # Crashed between the atomic rename and the commit
                     # record: the artifact is complete — verify and
                     # journal the commit an uninterrupted run would have.
-                    manifest = _read_manifest(final)
+                    manifest = (
+                        read_snapshot_manifest(final)
+                        if artifact == "snapshot"
+                        else _read_manifest(final)
+                    )
                     repair = {
                         "kind": "commit",
                         "seq": seq,
+                        "artifact": artifact,
                         "path": final,
                         "manifest_sha256": manifest["manifest_sha256"],
                         "event_wall_epoch": manifest.get("event_wall_epoch"),
-                        "n_changed_rows": _manifest_rows(manifest),
+                        "n_changed_rows": (
+                            0 if artifact == "snapshot"
+                            else _manifest_rows(manifest)
+                        ),
                         "publish_wall_epoch": r["publish_wall_epoch"],
                         "resumed": True,
                     }
@@ -337,28 +479,112 @@ class DeltaPublisher:
             )
         return _publication(record)
 
+    def publish_snapshot(
+        self,
+        model_dir: str,
+        event_wall_epoch: Optional[float] = None,
+    ) -> Publication:
+        """Publish a FULL model directory as the next sequenced
+        artifact (``snapshot-<seq>/model/`` + self-digested
+        ``snapshot.json``) under the same begin/stage/rename/commit
+        journal protocol as :meth:`publish` — a kill at any instant is
+        settled by the next :meth:`resume`.  This is the cold-start
+        anchor for publication-based model distribution: a host with no
+        base pulls the newest snapshot, then catches up by deltas."""
+        if not os.path.isdir(model_dir):
+            raise DeltaError(
+                f"{model_dir}: not a directory — publish_snapshot "
+                "takes a saved model directory"
+            )
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            publish_wall = time.time()
+            self._append({
+                "kind": "begin",
+                "seq": seq,
+                "artifact": "snapshot",
+                "publish_wall_epoch": publish_wall,
+                "event_wall_epoch": event_wall_epoch,
+            })
+            chaos_mod.maybe_fail("publish.delta", stage="journal", seq=seq)
+            staging = self._staging_dir(seq, "snapshot")
+            if os.path.isdir(staging):
+                shutil.rmtree(staging)
+            shutil.copytree(
+                model_dir, os.path.join(staging, SNAPSHOT_MODEL_DIR)
+            )
+            manifest = _write_snapshot_manifest(staging, event_wall_epoch)
+            chaos_mod.maybe_fail("publish.delta", stage="artifact", seq=seq)
+            final = self._final_dir(seq, "snapshot")
+            os.rename(staging, final)
+            chaos_mod.maybe_fail("publish.delta", stage="commit", seq=seq)
+            record = {
+                "kind": "commit",
+                "seq": seq,
+                "artifact": "snapshot",
+                "path": final,
+                "manifest_sha256": manifest["manifest_sha256"],
+                "event_wall_epoch": event_wall_epoch,
+                "n_changed_rows": 0,
+                "publish_wall_epoch": publish_wall,
+            }
+            self._append(record)
+            retention = (
+                self._retain_locked(self.retain_last)
+                if self.retain_last is not None
+                else None
+            )
+        hub = telemetry_mod.current()
+        hub.counter("freshness_snapshots_published_total").inc()
+        hub.counter("freshness_snapshot_bytes").inc(
+            sum(int(e["nbytes"]) for e in manifest["files"].values())
+        )
+        if retention is not None and retention["pruned"]:
+            hub.counter("freshness_retention_pruned_total").inc(
+                len(retention["pruned"])
+            )
+        return _publication(record)
+
     # -- retention ----------------------------------------------------------
     def retain(self, keep_last: int) -> dict:
         """Prune committed publications older than the newest
         ``keep_last``, compacting the journal and removing their
         artifact directories.  Returns a summary dict::
 
-            {"pruned": [seq...],   # removed this call
-             "blocked": [seq...],  # prunable by age, held by an ack
-             "kept": [seq...]}     # committed seqs still in the root
+            {"pruned": [seq...],    # removed this call
+             "blocked": [seq...],   # prunable by age, held by an ack
+             "blocking": {seq: [subscriber_id...]},  # who holds each
+             "kept": [seq...]}      # committed seqs still in the root
 
         Never removes an unsettled ``begin`` or the newest committed
         publication, and refuses any sequence a registered subscriber
-        (``acks/``) has not acked yet.  Crash-safe: the journal is
-        compacted by atomic rename BEFORE any artifact dir is removed,
-        and orphan dirs from a kill in between are swept by the next
-        retention."""
+        (``acks/``) has not acked yet — ``blocking`` names the guilty
+        subscriber per held sequence, so the operator can chase it or
+        :func:`remove_ack` it to release the prune.  Crash-safe: the
+        journal is compacted by atomic rename BEFORE any artifact dir
+        is removed, and orphan dirs from a kill in between are swept by
+        the next retention."""
         with self._lock:
             retention = self._retain_locked(keep_last)
+        hub = telemetry_mod.current()
         if retention["pruned"]:
-            telemetry_mod.current().counter(
+            hub.counter(
                 "freshness_retention_pruned_total"
             ).inc(len(retention["pruned"]))
+        if retention["blocked"]:
+            hub.counter(
+                "freshness_retention_blocked_total"
+            ).inc(len(retention["blocked"]))
+            hub.event(
+                "freshness.retention_blocked",
+                root=self.root,
+                blocked=retention["blocked"],
+                blocking={
+                    str(s): ids
+                    for s, ids in retention["blocking"].items()
+                },
+            )
         return retention
 
     def _retain_locked(self, keep_last: int) -> dict:
@@ -379,8 +605,15 @@ class DeltaPublisher:
             s for s in candidates if min_acked is None or s <= min_acked
         )
         blocked = sorted(set(candidates) - set(pruned))
+        blocking = {
+            s: sorted(sid for sid, acked in acks.items() if acked < s)
+            for s in blocked
+        }
         kept = sorted(set(committed) - set(pruned))
-        summary = {"pruned": pruned, "blocked": blocked, "kept": kept}
+        summary = {
+            "pruned": pruned, "blocked": blocked,
+            "blocking": blocking, "kept": kept,
+        }
         if not pruned:
             # Still sweep orphan dirs a prior kill may have left.
             self._sweep_orphans(records)
@@ -422,23 +655,24 @@ class DeltaPublisher:
         # orphan dirs (swept below / next time), never a journal that
         # references a missing artifact.
         for seq in sorted(drop):
-            for path in (self._final_dir(seq), self._staging_dir(seq)):
+            for path in self._artifact_dirs(seq):
                 if os.path.isdir(path):
                     shutil.rmtree(path)
         self._sweep_orphans(compacted)
         return summary
 
     def _sweep_orphans(self, records: List[dict]) -> None:
-        # Caller holds self._lock.  A delta-* dir whose seq no journal
-        # record references is a leftover from a kill between journal
-        # compaction and artifact removal — safe to delete (subscribers
-        # only ever follow commit records).  Retention records describe
-        # PRUNED seqs, so they don't count as references.
+        # Caller holds self._lock.  A delta-*/snapshot-* dir whose seq
+        # no journal record references is a leftover from a kill between
+        # journal compaction and artifact removal — safe to delete
+        # (subscribers only ever follow commit records).  Retention
+        # records describe PRUNED seqs, so they don't count as
+        # references.
         referenced = {
             r["seq"] for r in records if r["kind"] != "retention"
         }
         for name in os.listdir(self.root):
-            m = _DELTA_DIR_RE.match(name)
+            m = _ARTIFACT_DIR_RE.match(name)
             if m is None or int(m.group(1)) in referenced:
                 continue
             path = os.path.join(self.root, name)
@@ -516,4 +750,5 @@ def _publication(record: dict) -> Publication:
         event_wall_epoch=record.get("event_wall_epoch"),
         n_changed_rows=int(record.get("n_changed_rows", 0)),
         publish_wall_epoch=record["publish_wall_epoch"],
+        kind=record.get("artifact", "delta"),
     )

@@ -161,6 +161,28 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="metrics_ts.jsonl sampling interval when --output-dir is "
         "set (0 disables the time series)",
     )
+    p.add_argument(
+        "--membership", metavar="REGISTRY_URL", default=None,
+        help="register this server with a cluster membership registry "
+        "(photon_ml_tpu.cluster) and heartbeat for the lifetime of the "
+        "process; drained and removed on shutdown",
+    )
+    p.add_argument(
+        "--host-id", default=None,
+        help="membership host id (default: host:port of the listener)",
+    )
+    p.add_argument(
+        "--fleet-join", metavar="SERVING_URL", default=None,
+        help="one-shot admin verb: register SERVING_URL with the "
+        "--membership registry and exit; the MembershipWatcher joins "
+        "it into the live rotation (ops/README.md runbook)",
+    )
+    p.add_argument(
+        "--fleet-drain", metavar="HOST_ID", default=None,
+        help="one-shot admin verb: mark HOST_ID draining in the "
+        "--membership registry and exit; the watcher drains it from "
+        "the router once converged",
+    )
     return p
 
 
@@ -1313,6 +1335,39 @@ def main(argv=None) -> int:
         print(json.dumps(report, indent=2))
         return 0
 
+    if args.fleet_join or args.fleet_drain:
+        # Admin verbs against the discovery plane: membership is the
+        # source of truth, the MembershipWatcher converges the router.
+        if not args.membership:
+            print(
+                "--fleet-join / --fleet-drain need --membership "
+                "REGISTRY_URL (the registry is the source of truth; "
+                "the watcher converges the router)",
+                file=sys.stderr,
+            )
+            return 2
+        from photon_ml_tpu.cluster import RegistryClient
+
+        client = RegistryClient(args.membership)
+        if args.fleet_join:
+            url = args.fleet_join.rstrip("/")
+            hid = args.host_id or url.split("//", 1)[-1]
+            member = client.register(hid, url)
+            print(json.dumps({"joined": member}, indent=2))
+        if args.fleet_drain:
+            ok = client.drain(args.fleet_drain)
+            print(json.dumps(
+                {"drained": bool(ok), "host_id": args.fleet_drain},
+                indent=2,
+            ))
+            if not ok:
+                print(
+                    f"host id {args.fleet_drain!r} is not a member",
+                    file=sys.stderr,
+                )
+                return 1
+        return 0
+
     if args.selfcheck:
         def both(root: str) -> list[str]:
             # Separate output dirs: each pass owns its Telemetry hub and
@@ -1432,6 +1487,20 @@ def _run_service(args, service, workload) -> int:
                 f"({ingress.n_slots} slots x {ingress.slot_bytes} bytes)",
                 flush=True,
             )
+        agent = None
+        if args.membership:
+            from photon_ml_tpu.cluster import HeartbeatAgent
+
+            hid = args.host_id or f"{host}:{port}"
+            agent = HeartbeatAgent(
+                args.membership, hid, f"http://{host}:{port}"
+            ).start()
+            print(
+                f"membership: {hid!r} registered with "
+                f"{args.membership}, heartbeating every "
+                f"{agent.interval_s:g}s",
+                flush=True,
+            )
         print(
             f"serving on http://{host}:{port} "
             f"(/score /reload /healthz /livez /readyz /stats); "
@@ -1443,6 +1512,14 @@ def _run_service(args, service, workload) -> int:
         except KeyboardInterrupt:
             print("shutting down")
         finally:
+            if agent is not None:
+                # Graceful exit: drain first so the watcher finishes
+                # in-flight work, then leave the member set outright.
+                try:
+                    agent.client.drain(agent.host_id)
+                except Exception:  # noqa: BLE001 — expiry catches up
+                    pass
+                agent.stop(leave=True)
             if ingress is not None:
                 ingress.stop()
             server.shutdown()

@@ -703,6 +703,42 @@ class FleetRouter:
         )
         return hid
 
+    def join(self, base_url: str) -> int:
+        """Symmetric counterpart to :meth:`drain`: register a host into
+        the LIVE rotation without a router restart.  Unlike
+        :meth:`add_host` (which trusts the caller and routes
+        immediately), a joined host enters as ``down`` with an
+        immediate reconnect probe scheduled — it starts taking traffic
+        only after it answers ``/readyz``, so joining a host that is
+        still warming up never costs a request.  Re-joining a known URL
+        (drained/removed or currently down) revives the SAME host id
+        with fresh probe state.  Returns the host id."""
+        url = str(base_url).rstrip("/")
+        with self._lock:
+            host = next(
+                (h for h in self.hosts if h.base_url == url), None
+            )
+            if host is not None and host.state in ("healthy", "draining"):
+                # Already in rotation: joining is idempotent.
+                return host.hid
+            if host is None:
+                hid = max((h.hid for h in self.hosts), default=-1) + 1
+                host = _FleetHost(hid=hid, base_url=url)
+                self.hosts.append(host)
+            host.state = "down"
+            host.down_reason = "joining (awaiting first ready probe)"
+            host.probe_failures = 0
+            host.reconnect_attempt = 0
+            host.last_delay = None
+            host.next_reconnect_t = 0.0  # probe on the next tick
+        tel = telemetry_mod.current()
+        tel.counter("serving_fleet_joins_total").inc()
+        tel.gauge("serving_fleet_hosts_count").set(len(self.hosts))
+        tel.event(
+            "serving.fleet_host_joined", host=host.hid, url=url,
+        )
+        return host.hid
+
     # -- observability -----------------------------------------------------
     def readiness(self) -> tuple[bool, str]:
         healthy = self.healthy_count
@@ -920,6 +956,30 @@ class QuotaCoordinator:
             round(outstanding_total, 3)
         )
         return leases
+
+    def restore_grant(
+        self,
+        tenant: str,
+        host_id: str,
+        rate_rps: float,
+        demand_rps: float,
+        expires_at: float,
+    ) -> None:
+        """Seed one grant from a durable record (the cluster tier's
+        coordinator journal): a freshly-elected coordinator replica
+        replays the previous leader's journaled grants through here, so
+        its budget arithmetic starts from the SAME outstanding set the
+        old leader promised — failover never double-grants a budget
+        slice that is still live on some host.  Expired grants may be
+        restored too; the next renewal reclaims them normally."""
+        if tenant not in self.budgets:
+            return  # a tenant the new configuration no longer budgets
+        with self._lock:
+            self._grants[tenant][str(host_id)] = _Grant(
+                rate_rps=float(rate_rps),
+                demand_rps=float(demand_rps),
+                expires_at=float(expires_at),
+            )
 
     @staticmethod
     def _target_share(

@@ -421,6 +421,37 @@ SCENARIOS = {
             ),
         ],
     ),
+    "host_join_drain": Scenario(
+        "host_join_drain",
+        "fleet membership churns under load (cluster/membership.py): a "
+        "cold host registers mid-phase and the MembershipWatcher joins "
+        "it into the FleetRouter once its ready probe passes; later a "
+        "veteran host drains — in-flight requests finish, new traffic "
+        "re-spreads, the aggregator stops summing the departed host.  "
+        "Zero failed requests expected through both transitions",
+        [
+            ScenarioPhase("warm", 1.0),
+            ScenarioPhase("join", 1.5, action="join_host"),
+            ScenarioPhase("drain", 1.5, action="drain_host"),
+        ],
+    ),
+    "coordinator_failover": Scenario(
+        "coordinator_failover",
+        "the leader quota-coordinator replica is killed mid-phase "
+        "(cluster/coordination.py): hosts ride the degrade-to-last-"
+        "lease contract until a follower's leader lease claim wins, "
+        "replays the grant journal, and resumes exact enforcement — "
+        "failover within one lease TTL, over-admission bounded to one "
+        "lease window, zero failed requests throughout",
+        [
+            ScenarioPhase("baseline", 1.5),
+            ScenarioPhase("kill", 2.0, action="kill_coordinator"),
+            ScenarioPhase(
+                "recover", 1.5,
+                action="restart_coordinator", action_at_frac=0.1,
+            ),
+        ],
+    ),
     "quota_partition": Scenario(
         "quota_partition",
         "every host's LeaseClient loses its path to the "

@@ -138,6 +138,9 @@ class _HostState:
     failures: int = 0
     stale: bool = False
     identity: Optional[dict] = None
+    #: set when membership says the host left the fleet; the series is
+    #: marked stale immediately and DROPPED after ``stale_drop_s``.
+    departed_t: Optional[float] = None
 
 
 def _default_fetch(url: str, timeout_s: float) -> dict:
@@ -156,6 +159,15 @@ class FleetAggregator:
     aggregator appends ``/snapshot``).  ``fetch`` is injectable for
     tests: ``(url, timeout_s) -> snapshot dict``.  Drive it manually
     with :meth:`poll_once` or on a thread with :meth:`start`/``stop``.
+
+    The host set FOLLOWS membership: :meth:`sync_membership` (fed by
+    the cluster tier's ``MembershipWatcher``, or any discovery source)
+    adds new hosts, re-adopts returners, and marks departed hosts —
+    whose series are flagged stale immediately
+    (``fleet_host_stale_count{host=...} 1``), stop being scraped, and
+    are DROPPED from the exposition after ``stale_drop_s``.  A dead
+    host's last-seen numbers never sum forever into the fleet totals;
+    they age out on a bounded schedule an alert can ride.
     """
 
     def __init__(
@@ -167,9 +179,11 @@ class FleetAggregator:
         clock: Callable[[], float] = time.monotonic,
         fetch: Optional[Callable[[str, float], dict]] = None,
         max_samples: int = 4096,
+        stale_drop_s: float = 30.0,
     ):
         if not hosts:
             raise ValueError("FleetAggregator needs at least one host")
+        self.stale_drop_s = float(stale_drop_s)
         self.policies = list(policies)
         self.scrape_timeout_s = float(scrape_timeout_s)
         self.interval_s = float(interval_s)
@@ -201,6 +215,72 @@ class FleetAggregator:
         self._thread: Optional[threading.Thread] = None
         self._server = None
         self._server_thread: Optional[threading.Thread] = None
+
+    # -- membership ----------------------------------------------------------
+    def sync_membership(self, hosts: dict) -> dict:
+        """Converge the scraped host set onto ``hosts`` (host_id ->
+        metrics base URL): new ids join, departed ids are marked (stale
+        now, dropped after ``stale_drop_s``), returners are re-adopted
+        in place — their series resume under the same ``host`` label.
+        Returns ``{"added": [...], "departed": [...], "returned":
+        [...]}``.  Safe from any thread; the watcher calls it between
+        scrapes."""
+        now = self._clock()
+        added, departed, returned = [], [], []
+        with self._lock:
+            for hid, url in dict(hosts).items():
+                hid = str(hid)
+                hs = self._hosts.get(hid)
+                if hs is None:
+                    self._hosts[hid] = _HostState(
+                        host_id=hid, url=str(url).rstrip("/")
+                    )
+                    added.append(hid)
+                else:
+                    hs.url = str(url).rstrip("/")
+                    if hs.departed_t is not None:
+                        hs.departed_t = None
+                        returned.append(hid)
+            for hid, hs in self._hosts.items():
+                if hid not in hosts and hs.departed_t is None:
+                    hs.departed_t = now
+                    hs.stale = True
+                    departed.append(hid)
+            live = sum(
+                1 for hs in self._hosts.values()
+                if hs.departed_t is None
+            )
+            self.registry.gauge("fleet_hosts_count").set(live)
+            if added or departed or returned:
+                self.registry.counter(
+                    "fleet_membership_changes_total"
+                ).inc(len(added) + len(departed) + len(returned))
+        if added or departed or returned:
+            telemetry_mod.current().event(
+                "fleet.membership_changed",
+                added=added, departed=departed, returned=returned,
+            )
+        return {
+            "added": added, "departed": departed, "returned": returned,
+        }
+
+    def _drop_departed_locked(self, now: float) -> None:
+        # Caller holds self._lock.  A departed host's series stay
+        # visible (marked stale) for stale_drop_s, then disappear from
+        # the exposition entirely — bounded aging, not forever-sums.
+        drop = [
+            hid for hid, hs in self._hosts.items()
+            if hs.departed_t is not None
+            and now - hs.departed_t > self.stale_drop_s
+        ]
+        for hid in drop:
+            del self._hosts[hid]
+            self.registry.counter("fleet_hosts_dropped_total").inc()
+        for hid in drop:
+            telemetry_mod.current().event(
+                "fleet.host_dropped",
+                host=hid, stale_drop_s=self.stale_drop_s,
+            )
 
     # -- scraping ------------------------------------------------------------
     def _scrape_host(self, hs: _HostState, now: float) -> bool:
@@ -252,14 +332,17 @@ class FleetAggregator:
         — the loop's only job is to keep folding what it CAN see."""
         now = self._clock() if now is None else now
         with self._lock:
-            for hs in self._hosts.values():
-                self._scrape_host(hs, now)
+            self._drop_departed_locked(now)
+            for hs in list(self._hosts.values()):
+                if hs.departed_t is None:
+                    self._scrape_host(hs, now)
             self.registry.counter("fleet_scrapes_total").inc()
             staleness = max(
                 (
                     now - hs.last_success_t
                     for hs in self._hosts.values()
                     if hs.last_success_t is not None
+                    and hs.departed_t is None
                 ),
                 default=0.0,
             )
@@ -376,6 +459,7 @@ class FleetAggregator:
             hs.host_id: {
                 "url": hs.url,
                 "stale": hs.stale,
+                "departed": hs.departed_t is not None,
                 "staleness_s": (
                     None if hs.last_success_t is None
                     else round(now - hs.last_success_t, 6)
@@ -402,19 +486,26 @@ class FleetAggregator:
 
     def prometheus_text(self) -> str:
         """Fleet exposition: the unlabeled fleet-wide fold, then every
-        metric again per host as ``name{host="hid"}``."""
+        metric again per host as ``name{host="hid"}``, each host also
+        carrying ``fleet_host_stale_count{host=...}`` (1 = last scrape
+        failed or membership departed — the series is last-seen data,
+        not live)."""
         with self._lock:
             fleet = self.registry.snapshot()
             per_host = {
-                hs.host_id: hs.registry.snapshot()
+                hs.host_id: (hs.registry.snapshot(), hs.stale)
                 for hs in self._hosts.values()
                 if hs.last_success_t is not None
             }
         lines = _exposition_lines(fleet, None, emit_type=True)
+        lines.append("# TYPE fleet_host_stale_count gauge")
         for hid in sorted(per_host):
-            lines.extend(
-                _exposition_lines(per_host[hid], hid, emit_type=False)
+            snap, stale = per_host[hid]
+            lines.append(
+                f'fleet_host_stale_count{{host="{hid}"}} '
+                f"{1 if stale else 0}"
             )
+            lines.extend(_exposition_lines(snap, hid, emit_type=False))
         return "\n".join(lines) + "\n"
 
     # -- lifecycle -----------------------------------------------------------

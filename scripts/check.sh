@@ -78,6 +78,17 @@ env JAX_PLATFORMS=cpu python -m photon_ml_tpu.chaos --selfcheck
 echo "== freshness selfcheck (JAX_PLATFORMS=cpu) =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.freshness --selfcheck
 
+# The cluster selfcheck replays the 3-host control-plane drill under
+# open-loop load: the leader quota-coordinator replica is killed and a
+# peer takes over within one lease TTL (over-admission bounded to one
+# lease window by the journal replay), a cold host bootstraps from the
+# newest snapshot publication over HTTP (checksums end to end, scores
+# bit-identical) and joins via the membership registry while another
+# host drains — zero failed requests throughout (docs/serving.md
+# "Cluster").
+echo "== cluster selfcheck (JAX_PLATFORMS=cpu) =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.cluster --selfcheck
+
 echo "== tier-1 tests (JAX_PLATFORMS=cpu) =="
 if [[ "${1:-}" == "--fast" ]]; then
   # Streaming-parity smoke rides the fast lane: a tiny 4-chunk store,
@@ -95,6 +106,9 @@ if [[ "${1:-}" == "--fast" ]]; then
   # solver smoke pins registry dispatch (explicit --solver lbfgs is
   # bitwise the implicit routing) and consensus-ADMM landing within
   # 1e-5 of the resident OWL-QN optimum over logical shards.
+  # test_cluster covers the control plane: membership expiry/heal,
+  # coordinator leader failover + journal replay, and checksum-verified
+  # publication fetch (all three cluster.* chaos seams).
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_ops_plane.py \
     tests/test_watchdog.py \
@@ -102,6 +116,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     tests/test_serving_proc.py tests/test_freshness.py \
     tests/test_serving_wire.py \
     tests/test_distributed_tracing.py \
+    tests/test_cluster.py \
     tests/test_tuning.py tests/test_chaos.py \
     "tests/test_streaming.py::TestPipelineParity::test_async_window_bit_identical_to_sync_f32" \
     "tests/test_streaming.py::TestTransferAvoidance::test_fast_lane_compressed_cached_parity" \

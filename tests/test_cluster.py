@@ -1,0 +1,597 @@
+"""Cluster control plane tests (ISSUE 19): replicated quota
+coordination, service discovery, and publication-based distribution.
+
+The load-bearing contracts:
+
+- a membership record expires after ``heartbeat_ttl_s`` without a beat,
+  and beating an expired id CANNOT resurrect it (the registration
+  record is gone — the agent must re-register, which it does on its
+  own via the ``cluster.heartbeat`` seam's failure path);
+- the coordinator leader lease fails over: a killed leader's lease
+  expires, the next renewal elects a new term, and the journal replay
+  seeds the new leader with the dead leader's outstanding grants so
+  the budget invariant survives the handoff;
+- a ``cluster.lease`` fault on one replica moves the client's walk to
+  the next replica; every replica faulted is the full partition
+  (UNAVAILABLE — the lease client degrades, tested one tier down);
+- a fetched publication is checksum-verified end to end: a tampered
+  artifact byte is refused (``FetchError``), a transient drop on the
+  ``cluster.fetch`` seam retries, and nothing half-fetched is ever
+  visible at the final cache path;
+- retention is blocked by a registered-but-never-acking subscriber,
+  the summary NAMES the guilty id, and unregistering it releases the
+  prune (the runbook lever).
+"""
+
+import http.client
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from photon_ml_tpu import chaos
+from photon_ml_tpu.cluster import (
+    CoordinatorReplica,
+    FetchError,
+    HeartbeatAgent,
+    MembershipRegistry,
+    MembershipWatcher,
+    NotLeaderError,
+    PublicationClient,
+    PublicationServer,
+    RegistryClient,
+    RemoteApplier,
+    ReplicatedQuotaCoordinator,
+    cold_start,
+)
+from photon_ml_tpu.freshness.delta import DeltaError
+from photon_ml_tpu.freshness.publisher import (
+    DeltaPublisher,
+    read_acks,
+    remove_ack,
+    write_ack,
+)
+
+
+class _Clock:
+    """Injectable monotonic clock: liveness tests advance time instead
+    of sleeping through it."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# Membership registry
+# ---------------------------------------------------------------------------
+
+class TestMembershipRegistry:
+    def test_register_heartbeat_and_expiry(self):
+        clock = _Clock()
+        registry = MembershipRegistry(heartbeat_ttl_s=1.0, clock=clock)
+        member = registry.register("h0", "http://a:1/")
+        assert member["state"] == "alive"
+        assert member["url"] == "http://a:1"  # trailing slash normalized
+
+        # A beat inside the TTL keeps the member alive across what
+        # would otherwise be two expiry windows.
+        clock.advance(0.9)
+        assert registry.heartbeat("h0") is True
+        clock.advance(0.9)
+        assert "h0" in registry.members()
+
+        # Silence past the TTL expires it, and beating the expired id
+        # returns False — the registration record is gone.
+        clock.advance(1.1)
+        assert registry.members() == {}
+        assert registry.heartbeat("h0") is False
+
+        # Re-registering re-admits (the agent's healing path).
+        registry.register("h0", "http://a:1")
+        assert registry.members()["h0"]["state"] == "alive"
+
+    def test_drain_keeps_member_visible_and_leave_removes(self):
+        clock = _Clock()
+        registry = MembershipRegistry(heartbeat_ttl_s=5.0, clock=clock)
+        registry.register("h0", "http://a:1")
+        assert registry.drain("h0") is True
+        # Draining stays visible: the router needs to see it to finish
+        # its in-flight work before removal.
+        assert registry.members()["h0"]["state"] == "draining"
+        assert registry.drain("nope") is False
+
+        assert registry.leave("h0") is True
+        assert registry.members() == {}
+        assert registry.leave("h0") is False
+
+    def test_heartbeat_cannot_resurrect_a_draining_member_as_alive(self):
+        clock = _Clock()
+        registry = MembershipRegistry(heartbeat_ttl_s=5.0, clock=clock)
+        registry.register("h0", "http://a:1")
+        registry.drain("h0")
+        assert registry.heartbeat("h0") is True  # still a member...
+        assert registry.members()["h0"]["state"] == "draining"  # ...but
+
+
+class TestRegistryHTTP:
+    def test_protocol_roundtrip_over_the_wire(self):
+        registry = MembershipRegistry(heartbeat_ttl_s=5.0).serve()
+        try:
+            client = RegistryClient(registry.base_url)
+            member = client.register(
+                "h0", "http://a:1", metrics_url="http://a:2"
+            )
+            assert member["host_id"] == "h0"
+            assert member["metrics_url"] == "http://a:2"
+            assert set(client.members()) == {"h0"}
+            assert client.heartbeat("h0") is True
+            # Unknown id rides the 410 Gone contract back as False —
+            # the verdict the HeartbeatAgent re-registers on.
+            assert client.heartbeat("ghost") is False
+            assert client.drain("ghost") is False
+            assert client.drain("h0") is True
+            assert client.members()["h0"]["state"] == "draining"
+            assert client.leave("h0") is True
+            assert client.members() == {}
+        finally:
+            registry.close()
+
+
+class TestHeartbeatAgent:
+    def test_register_then_beat_then_heal_after_expiry(self):
+        clock = _Clock()
+        registry = MembershipRegistry(heartbeat_ttl_s=1.0, clock=clock)
+        agent = HeartbeatAgent(
+            registry, "h0", "http://a:1", interval_s=0.5
+        )
+        assert agent.beat_once() is True  # registers
+        assert agent.beat_once() is True  # beats
+        assert agent.beats == 1
+
+        # Expire the member (a stall longer than the TTL), then watch
+        # the agent heal: one False beat flips it back to registering,
+        # the next cycle re-admits the host.
+        clock.advance(1.5)
+        assert agent.beat_once() is False
+        assert agent.reregisters == 1
+        assert agent.beat_once() is True
+        assert registry.members()["h0"]["state"] == "alive"
+
+    def test_chaos_heartbeat_site_counts_failure_then_recovers(self):
+        registry = MembershipRegistry(heartbeat_ttl_s=5.0)
+        agent = HeartbeatAgent(
+            registry, "h0", "http://a:1", interval_s=0.5
+        )
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="cluster.heartbeat", at=0, count=1),
+        ])
+        with plan:
+            assert agent.beat_once() is False
+            assert agent.beat_failures == 1
+            # A lost beat is not fatal: the next cycle registers.
+            assert agent.beat_once() is True
+        assert plan.fired and plan.fired[0]["site"] == "cluster.heartbeat"
+        assert "h0" in registry.members()
+
+
+class _FakeRouter:
+    """Records join/drain calls; mimics FleetRouter.healthz()'s host
+    rows (url / hid / state)."""
+
+    def __init__(self):
+        self.hosts = {}  # url -> [hid, state]
+        self.joins = []
+        self.drains = []
+        self._n = 0
+
+    def healthz(self):
+        return {"hosts": [
+            {"url": url, "hid": hid, "state": state}
+            for url, (hid, state) in self.hosts.items()
+        ]}
+
+    def join(self, url):
+        self.joins.append(url)
+        hid = f"host{self._n}"
+        self._n += 1
+        self.hosts[url] = [hid, "healthy"]
+        return hid
+
+    def drain(self, hid, timeout_s=None):
+        self.drains.append(hid)
+        for entry in self.hosts.values():
+            if entry[0] == hid:
+                entry[1] = "removed"
+        return True
+
+
+class _FakeAggregator:
+    def __init__(self):
+        self.synced = []
+
+    def sync_membership(self, hosts):
+        self.synced.append(dict(hosts))
+
+
+class TestMembershipWatcher:
+    def test_converges_router_and_aggregator_to_membership(self):
+        registry = MembershipRegistry(heartbeat_ttl_s=60.0)
+        router, aggregator = _FakeRouter(), _FakeAggregator()
+        watcher = MembershipWatcher(registry, router, aggregator)
+
+        registry.register("h0", "http://a:1", metrics_url="http://a:2")
+        assert watcher.poll_once() is True
+        assert router.joins == ["http://a:1"]
+        # The aggregator sees metrics_url, not the serving url.
+        assert aggregator.synced[-1] == {"h0": "http://a:2"}
+
+        # Draining in the registry drains the router; the member stays
+        # in the aggregator view (departure needs leave/expiry).
+        registry.drain("h0")
+        watcher.poll_once()
+        assert router.drains == ["host0"]
+        assert "h0" in aggregator.synced[-1]
+
+        # A removed routed entry re-joins when the host comes back.
+        registry.leave("h0")
+        watcher.poll_once()
+        assert "h0" not in aggregator.synced[-1]
+        registry.register("h0", "http://a:1")
+        watcher.poll_once()
+        assert router.joins == ["http://a:1", "http://a:1"]
+
+    def test_registry_outage_keeps_last_converged_state(self):
+        # Nothing listens on this port: the read fails fast, and the
+        # watcher must keep the last converged state, not drain anyone.
+        router = _FakeRouter()
+        router.join("http://a:1")
+        watcher = MembershipWatcher(
+            RegistryClient("http://127.0.0.1:1", timeout_s=0.2), router
+        )
+        assert watcher.poll_once() is False
+        assert watcher.poll_failures == 1
+        assert router.drains == []
+
+
+# ---------------------------------------------------------------------------
+# Replicated quota coordination
+# ---------------------------------------------------------------------------
+
+def _replica_pair(tmp_path, clock, lease_ttl_s=10.0, leader_ttl_s=1.0):
+    store = str(tmp_path / "coord")
+    budgets = {"t": 100.0}
+    r0 = CoordinatorReplica(
+        "r0", store, budgets, lease_ttl_s=lease_ttl_s,
+        leader_ttl_s=leader_ttl_s, clock=clock, fsync=False,
+    )
+    r1 = CoordinatorReplica(
+        "r1", store, budgets, lease_ttl_s=lease_ttl_s,
+        leader_ttl_s=leader_ttl_s, clock=clock, fsync=False,
+    )
+    return r0, r1, ReplicatedQuotaCoordinator([r0, r1])
+
+
+class TestReplicatedCoordination:
+    def test_first_renew_elects_and_followers_refuse_with_hint(
+        self, tmp_path
+    ):
+        clock = _Clock()
+        r0, r1, rc = _replica_pair(tmp_path, clock)
+        leases = rc.renew("hA", {"t": 50.0})
+        assert leases["t"].rate_rps > 0
+        assert rc.leader() == "r0"
+        assert r0.term == 1 and r0.is_leader()
+        with pytest.raises(NotLeaderError) as exc:
+            r1.renew("hA", {"t": 50.0})
+        assert exc.value.leader_hint == "r0"
+
+    def test_kill_fails_over_and_replay_preserves_budget_bound(
+        self, tmp_path
+    ):
+        clock = _Clock()
+        r0, r1, rc = _replica_pair(tmp_path, clock)
+        a = rc.renew("hA", {"t": 100.0})["t"]
+        b = rc.renew("hB", {"t": 100.0})["t"]
+        assert a.rate_rps + b.rate_rps <= 100.0 + 1e-6
+
+        # Kill the leader.  Its lease is deliberately not released, so
+        # failover must ride the lease expiry.
+        r0.kill()
+        clock.advance(1.5)  # > leader_ttl_s, << lease_ttl_s
+        a2 = rc.renew("hA", {"t": 100.0})["t"]
+        assert rc.leader() == "r1"
+        assert rc.failovers == 1
+        assert r1.term == 2
+
+        # hB's grant was replayed from the journal: it is still live
+        # (its lease has not expired), so the new leader's grant to hA
+        # must leave room for it — the invariant survives the handoff.
+        b2 = rc.renew("hB", {"t": 100.0})["t"]
+        assert a2.rate_rps + b2.rate_rps <= 100.0 + 1e-6
+        records = r1._read_journal()
+        election = [
+            r for r in records
+            if r.get("kind") == "election" and r["term"] == 2
+        ]
+        assert election and election[0]["replayed_grants"] == 2
+
+        # A restarted replica comes back as a follower, never resumes
+        # its stale term.
+        r0.restart()
+        with pytest.raises(NotLeaderError):
+            r0.renew("hA", {"t": 100.0})
+
+    def test_torn_journal_tail_is_tolerated_on_replay(self, tmp_path):
+        clock = _Clock()
+        r0, r1, rc = _replica_pair(tmp_path, clock)
+        rc.renew("hA", {"t": 100.0})
+        # Simulate the journal writer dying mid-line.
+        with open(r0._journal_path, "a") as f:
+            f.write('{"kind": "gra')
+        r0.kill()
+        clock.advance(1.5)
+        leases = rc.renew("hA", {"t": 100.0})
+        assert leases["t"].rate_rps > 0
+        assert r1.term == 2
+
+    def test_chaos_lease_site_moves_the_walk_to_the_next_replica(
+        self, tmp_path
+    ):
+        clock = _Clock()
+        r0, r1, rc = _replica_pair(tmp_path, clock)
+        rc.renew("hA", {"t": 100.0})
+        # Expire the leader lease so the surviving replica CAN take
+        # over when the fault knocks out the path to r0.
+        clock.advance(1.5)
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="cluster.lease", at=0, count=1),
+        ])
+        with plan:
+            leases = rc.renew("hA", {"t": 100.0})
+        assert plan.fired and plan.fired[0]["site"] == "cluster.lease"
+        assert leases["t"].rate_rps > 0
+        assert rc.leader() == "r1"
+        assert rc.failovers == 1
+
+    def test_every_replica_faulted_is_the_full_partition(self, tmp_path):
+        clock = _Clock()
+        _r0, _r1, rc = _replica_pair(tmp_path, clock)
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="cluster.lease", at=0, count=10),
+        ])
+        with plan, pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            rc.renew("hA", {"t": 100.0})
+
+
+# ---------------------------------------------------------------------------
+# Publication-based model distribution
+# ---------------------------------------------------------------------------
+
+def _model_dir(tmp_path) -> str:
+    model = tmp_path / "model"
+    (model / "sub").mkdir(parents=True)
+    (model / "weights.bin").write_bytes(b"\x00\x01\x02" * 100)
+    (model / "meta.json").write_text('{"kind": "test-model"}')
+    (model / "sub" / "nested.bin").write_bytes(b"nested-bytes")
+    return str(model)
+
+
+def _read_tree(root: str) -> dict:
+    out = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            full = os.path.join(dirpath, name)
+            with open(full, "rb") as f:
+                out[os.path.relpath(full, root)] = f.read()
+    return out
+
+
+@pytest.fixture()
+def pub_root(tmp_path):
+    root = str(tmp_path / "pubroot")
+    publisher = DeltaPublisher(root, fsync=False)
+    publisher.publish_snapshot(_model_dir(tmp_path))
+    return root, publisher
+
+
+@pytest.fixture()
+def pub_server(pub_root):
+    root, publisher = pub_root
+    server = PublicationServer(root).serve()
+    yield root, publisher, server
+    server.close()
+
+
+class TestDistribution:
+    def test_fetch_is_bitwise_faithful_and_idempotent(
+        self, pub_server, tmp_path
+    ):
+        root, _publisher, server = pub_server
+        client = PublicationClient(
+            server.base_url, str(tmp_path / "cache")
+        )
+        pubs = client.publications()
+        assert [p.kind for p in pubs] == ["snapshot"]
+        local = client.fetch(pubs[0])
+        served = _read_tree(local)
+        original = {
+            k: v for k, v in _read_tree(
+                os.path.join(root, f"snapshot-{pubs[0].seq:06d}")
+            ).items()
+        }
+        assert served == original  # manifest rides along, byte-equal
+        # Second fetch returns the cached dir without touching the
+        # wire: the atomic rename is the completeness marker.
+        assert client.fetch(pubs[0]) == local
+        assert client.fetches == 1
+
+    def test_chaos_fetch_site_retries_then_exhausts(
+        self, pub_server, tmp_path
+    ):
+        _root, _publisher, server = pub_server
+        client = PublicationClient(
+            server.base_url, str(tmp_path / "cache-a")
+        )
+        pub = client.publications()[0]
+        plan = chaos.FaultPlan([
+            chaos.FaultSpec(site="cluster.fetch", at=0, count=1),
+        ])
+        with plan:
+            local = client.fetch(pub)
+        assert plan.fired and plan.fired[0]["site"] == "cluster.fetch"
+        assert os.path.isdir(local)
+        assert client.fetch_retries == 1
+
+        # Exhausted retries refuse the artifact, and nothing
+        # half-fetched is visible at the final path.
+        client2 = PublicationClient(
+            server.base_url, str(tmp_path / "cache-b"), retries=1,
+        )
+        with chaos.FaultPlan([
+            chaos.FaultSpec(site="cluster.fetch", at=0, count=50),
+        ]):
+            with pytest.raises(FetchError, match="attempts"):
+                client2.fetch(pub)
+        assert not os.path.isdir(client2._local_dir(pub))
+
+    def test_tampered_artifact_is_refused(self, pub_server, tmp_path):
+        root, _publisher, server = pub_server
+        client = PublicationClient(
+            server.base_url, str(tmp_path / "cache")
+        )
+        pub = client.publications()[0]
+        victim = os.path.join(
+            root, f"snapshot-{pub.seq:06d}", "model", "weights.bin"
+        )
+        with open(victim, "r+b") as f:
+            f.write(b"\xff")
+        with pytest.raises(FetchError, match="sha256 mismatch"):
+            client.fetch(pub)
+        assert not os.path.isdir(client._local_dir(pub))
+
+    def test_blob_route_refuses_path_traversal(self, pub_server):
+        _root, _publisher, server = pub_server
+        host, port = server.base_url[len("http://"):].split(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=5)
+        try:
+            # Raw http.client request: urllib would normalize the
+            # "../" away before it ever reached the server.
+            conn.request("GET", "/blob/1/../publish_journal.jsonl")
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 403
+            assert "escapes" in body["error"]
+        finally:
+            conn.close()
+
+    def test_cold_start_fetches_newest_snapshot_and_acks(
+        self, pub_server, tmp_path
+    ):
+        root, _publisher, server = pub_server
+        client = PublicationClient(
+            server.base_url, str(tmp_path / "cache")
+        )
+        model_dir, pub = cold_start(client, subscriber_id="cold1")
+        assert pub.kind == "snapshot"
+        assert os.path.basename(model_dir) == "model"
+        with open(os.path.join(model_dir, "meta.json")) as f:
+            assert json.load(f)["kind"] == "test-model"
+        # The ack registers the host with retention at the snapshot
+        # seq, so every later delta is held until applied.
+        assert read_acks(root)["cold1"] == pub.seq
+
+    def test_cold_start_without_snapshot_is_a_pointed_error(
+        self, tmp_path
+    ):
+        root = str(tmp_path / "empty-root")
+        DeltaPublisher(root, fsync=False)  # settles an empty root
+        server = PublicationServer(root).serve()
+        try:
+            client = PublicationClient(
+                server.base_url, str(tmp_path / "cache")
+            )
+            with pytest.raises(DeltaError, match="publish_snapshot"):
+                cold_start(client)
+        finally:
+            server.close()
+
+    def test_remote_applier_applies_in_order_and_never_retries(
+        self, pub_server, tmp_path
+    ):
+        root, publisher, server = pub_server
+        publisher.publish_snapshot(
+            os.path.join(root, "snapshot-000001", "model")
+        )
+        client = PublicationClient(
+            server.base_url, str(tmp_path / "cache")
+        )
+        service = SimpleNamespace(reloads=[])
+
+        def reload(path, mode=None):
+            service.reloads.append((os.path.basename(path), mode))
+            return SimpleNamespace(
+                status="swapped", stage=None, reason=None
+            )
+
+        service.reload = reload
+        applier = RemoteApplier(service, client, "subA", start_seq=0)
+        results = applier.poll_once()
+        assert [r.status for r in results] == ["swapped", "swapped"]
+        assert applier.applied_seq == 2
+        assert service.reloads == [("model", None), ("model", None)]
+        assert read_acks(root)["subA"] == 2
+
+        # A failed apply is recorded once and NEVER retried — the
+        # runbook escalates to a fresh cold start instead.
+        publisher.publish_snapshot(
+            os.path.join(root, "snapshot-000001", "model")
+        )
+        service.reload = lambda path, mode=None: SimpleNamespace(
+            status="rolled_back", stage="validate", reason="boom"
+        )
+        applier.poll_once()
+        assert applier.failed == [3]
+        assert applier.poll_once() == []
+        assert applier.failed == [3]
+
+
+# ---------------------------------------------------------------------------
+# Retention vs. remote subscribers (satellite: the never-acking host)
+# ---------------------------------------------------------------------------
+
+class TestRetentionBlockedBySubscriber:
+    def test_blocking_names_the_guilty_id_and_unregister_releases(
+        self, tmp_path
+    ):
+        root = str(tmp_path / "root")
+        publisher = DeltaPublisher(root, fsync=False)
+        model = _model_dir(tmp_path)
+        for _ in range(3):
+            publisher.publish_snapshot(model)  # seqs 1, 2, 3
+
+        # A subscriber registered at seq 1 and then went silent: seq 1
+        # prunes (it acked it), seq 2 is held — and the summary NAMES
+        # the holder, so the operator knows exactly who to chase.
+        write_ack(root, "laggard", 1)
+        summary = publisher.retain(keep_last=1)
+        assert summary["pruned"] == [1]
+        assert summary["blocked"] == [2]
+        assert summary["blocking"] == {2: ["laggard"]}
+        assert os.path.isdir(os.path.join(root, "snapshot-000002"))
+
+        # Unregistering the dead subscriber releases the prune.
+        assert remove_ack(root, "laggard") is True
+        summary = publisher.retain(keep_last=1)
+        assert summary["pruned"] == [2]
+        assert summary["blocked"] == []
+        assert summary["kept"] == [3]
+        assert not os.path.isdir(os.path.join(root, "snapshot-000002"))

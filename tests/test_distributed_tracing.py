@@ -561,3 +561,55 @@ class TestFleetAggregation:
         assert parsed[
             ("serving_requests_total", '{host="h1"}')] == 20.0
         assert parsed[("fleet_hosts_count", "")] == 2.0
+
+    def test_membership_sync_marks_departed_then_drops(self):
+        """Satellite: the scraped host set FOLLOWS membership — a
+        departed host is marked stale immediately, stops being
+        scraped, and is DROPPED from the exposition after
+        ``stale_drop_s``; a returner resumes under the same host
+        label.  A dead host's last-seen numbers never sum forever."""
+        t = {"now": 0.0}
+        hubs = {"h0": _host_hub(), "h1": _host_hub()}
+        for hub in hubs.values():
+            hub.counter("serving_requests_total").inc(5)
+        agg = FleetAggregator(
+            {hid: f"http://{hid}" for hid in hubs},
+            fetch=_snapshot_fetch(hubs),
+            clock=lambda: t["now"],
+            stale_drop_s=30.0,
+        )
+        agg.poll_once()
+        assert ('fleet_host_stale_count{host="h1"} 0'
+                in agg.prometheus_text())
+
+        out = agg.sync_membership({"h0": "http://h0"})
+        assert out == {"added": [], "departed": ["h1"], "returned": []}
+        t["now"] = 10.0
+        report = agg.poll_once()
+        assert report["hosts"]["h1"]["departed"] is True
+        # Departed hosts stop being scraped...
+        assert report["hosts"]["h1"]["scrapes"] == 1
+        # ...and their series are flagged stale in the exposition.
+        assert ('fleet_host_stale_count{host="h1"} 1'
+                in agg.prometheus_text())
+        counters = agg.registry.snapshot()["counters"]
+        assert counters["fleet_membership_changes_total"] == 1
+
+        # A returner is re-adopted in place, under the same label.
+        out = agg.sync_membership({"h0": "http://h0",
+                                   "h1": "http://h1"})
+        assert out["returned"] == ["h1"]
+        t["now"] = 20.0
+        report = agg.poll_once()
+        assert report["hosts"]["h1"]["departed"] is False
+        assert report["hosts"]["h1"]["stale"] is False
+
+        # Departed past stale_drop_s: dropped from the exposition
+        # entirely — bounded aging, not forever-sums.
+        agg.sync_membership({"h0": "http://h0"})
+        t["now"] = 60.0
+        report = agg.poll_once()
+        assert "h1" not in report["hosts"]
+        assert 'host="h1"' not in agg.prometheus_text()
+        counters = agg.registry.snapshot()["counters"]
+        assert counters["fleet_hosts_dropped_total"] == 1

@@ -348,7 +348,8 @@ class TestRetention:
             pub = self._publish_n(root, workload, 5)
             # keep_last == count: nothing to prune.
             assert pub.retain(5) == {
-                "pruned": [], "blocked": [], "kept": [1, 2, 3, 4, 5],
+                "pruned": [], "blocked": [], "blocking": {},
+                "kept": [1, 2, 3, 4, 5],
             }
             # keep the newest 3.
             s = pub.retain(3)

@@ -179,6 +179,40 @@ class TestFleetRouter:
             with pytest.raises(ValueError, match="unknown host id"):
                 fleet.router.drain(99)
 
+    def test_join_adds_a_live_host_without_restart(self, workload):
+        """Satellite: ``join()`` is ``drain()``'s symmetric
+        counterpart — a joined host enters as down-until-ready and
+        takes traffic only after its first ready probe, and re-joining
+        a drained URL revives the SAME host id."""
+        with _Fleet(workload, n_hosts=1) as fleet:
+            extra = LocalHost("hx", _service(workload)).start()
+            try:
+                hid = fleet.router.join(extra.base_url)
+                joined = next(
+                    h for h in fleet.router.healthz()["hosts"]
+                    if h["hid"] == hid
+                )
+                # Enters down (awaiting its first ready probe); the
+                # probe loop may already have admitted it.
+                assert joined["state"] in ("down", "healthy")
+                assert _wait_until(
+                    lambda: fleet.router.healthy_count == 2
+                ), fleet.router.healthz()
+                # Joining an in-rotation URL is idempotent.
+                assert fleet.router.join(extra.base_url) == hid
+                # Drain it out, re-join: the same id revives.
+                assert fleet.router.drain(hid, timeout_s=10.0)
+                assert fleet.router.join(extra.base_url) == hid
+                assert _wait_until(
+                    lambda: fleet.router.healthy_count == 2
+                ), fleet.router.healthz()
+                for i in range(6):
+                    assert np.isfinite(
+                        fleet.router.score(workload.request(i))["score"]
+                    )
+            finally:
+                extra.stop()
+
     def test_reconnect_backoff_resets_after_sustained_health(
         self, workload
     ):
