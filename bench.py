@@ -459,6 +459,219 @@ def bench_game_multi_re() -> dict:
     }
 
 
+def _game_scaling_problem(n_devices: int):
+    """Deterministic multi-random-effect CD problem for the device-scaling
+    leg — random effects ONLY, because the bitwise contract under test is
+    the bucket-shard plan's (the distributed fixed effect is allclose,
+    not bitwise, so it would mask the comparison)."""
+    import scipy.sparse as sp
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+    from photon_ml_tpu.game.data import build_random_effect_dataset
+    from photon_ml_tpu.game.descent import CoordinateDescent
+    from photon_ml_tpu.game.hierarchical import (
+        ShardedBucketRandomEffectCoordinate,
+    )
+    from photon_ml_tpu.optim.problem import (
+        GlmOptimizationConfig,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.optim.regularization import RegularizationContext
+    from photon_ml_tpu.parallel.distributed import data_mesh
+
+    rng = np.random.default_rng(7)
+    n_ent = 600 if SMALL else 4_000
+    sizes = np.minimum(rng.zipf(1.8, n_ent), 64)
+    n = int(sizes.sum())
+    users = np.repeat(
+        np.array([f"u{i}" for i in range(n_ent)], dtype=object), sizes
+    )[rng.permutation(n)]
+    items = np.array(
+        [f"i{rng.integers(max(2, n_ent // 5))}" for _ in range(n)],
+        dtype=object,
+    )
+    contexts = np.array(
+        [f"c{rng.integers(200)}" for _ in range(n)], dtype=object
+    )
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    weights = np.ones(n, np.float32)
+    opt = GlmOptimizationConfig(
+        optimizer=OptimizerConfig(max_iters=10, tolerance=1e-6),
+        regularization=RegularizationContext.l2(),
+    )
+    mesh = data_mesh() if n_devices > 1 else None
+    coords = []
+    plans = {}
+    for name, keys in (
+        ("per_user", users), ("per_item", items), ("per_context", contexts)
+    ):
+        Xe = sp.csr_matrix(
+            rng.normal(size=(n, GAME_RE_DIM)).astype(np.float32)
+        )
+        ds = build_random_effect_dataset(
+            keys, Xe, y, weights,
+            bucket_growth=GAME_BUCKET_GROWTH, device=mesh is None,
+        )
+        if mesh is not None:
+            coord = ShardedBucketRandomEffectCoordinate(
+                name, ds, mesh, "logistic", opt, reg_weight=1.0,
+                entity_key=name,
+            )
+            plans[name] = [coord.plan.n_split, coord.plan.n_packed]
+        else:
+            coord = RandomEffectCoordinate(
+                name, ds, "logistic", opt, reg_weight=1.0, entity_key=name
+            )
+        coords.append(coord)
+    base = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    return CoordinateDescent(coords), base, plans
+
+
+def _game_scaling_worker(n_devices: int) -> None:
+    """Subprocess body for ``bench.py --game-scaling-worker N`` (the XLA
+    host device count is fixed at backend init, so each scaling point
+    needs its own process).  Prints ONE JSON line: iters/sec plus a
+    sha256 over the final score vectors — the cross-device-count
+    bitwise-parity witness."""
+    import hashlib
+
+    import jax
+
+    assert jax.device_count() == n_devices, (
+        f"expected {n_devices} devices, got {jax.device_count()} — was "
+        "XLA_FLAGS=--xla_force_host_platform_device_count set?"
+    )
+    cd, base, plans = _game_scaling_problem(n_devices)
+    _log(f"scaling worker ({n_devices} devices): warmup...")
+    warm = cd.run(base, n_iterations=1)
+    _read_sync(warm.scores["per_context"])
+    _read_sync(cd.run(base, n_iterations=2).scores["per_context"])
+    per_iter = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        result = cd.run(base, n_iterations=2)
+        _read_sync(result.scores["per_context"])
+        per_iter.append((time.perf_counter() - t0) / 2)
+    digest = hashlib.sha256()
+    for coord in cd.coordinates:
+        digest.update(
+            np.asarray(result.scores[coord.name], np.float32).tobytes()
+        )
+    print(json.dumps({
+        "n_devices": n_devices,
+        "iters_per_sec": 1.0 / float(np.median(per_iter)),
+        "score_sha256": digest.hexdigest(),
+        "plans": plans,
+    }))
+
+
+def bench_game_device_scaling() -> dict:
+    """Hierarchical-execution scaling gate (ISSUE 20): multi-RE CD
+    iterations/sec at 1 vs 4 forced CPU host devices, with the sharded
+    run's final scores required BITWISE equal to the single-device
+    geometric-ladder baseline.  The >=1.5x speedup gate only arms when
+    >=4 CPU cores are actually visible — 4 forced host devices on fewer
+    cores timeshare, so a speedup there is unmeasurable by construction."""
+    import subprocess
+
+    results = {}
+    for nd in (1, 4):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={nd}",
+        )
+        _log(f"scaling: launching {nd}-device worker...")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--game-scaling-worker", str(nd)],
+            env=env, capture_output=True, text=True, timeout=1800,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{nd}-device scaling worker failed: "
+                f"{proc.stderr.strip().splitlines()[-5:]}"
+            )
+        results[nd] = json.loads(proc.stdout.strip().splitlines()[-1])
+    scaling = results[4]["iters_per_sec"] / results[1]["iters_per_sec"]
+    bitwise = results[1]["score_sha256"] == results[4]["score_sha256"]
+    cores = len(os.sched_getaffinity(0))
+    out = {
+        "game_scaling_iters_per_sec_1dev": round(
+            results[1]["iters_per_sec"], 3
+        ),
+        "game_scaling_iters_per_sec_4dev": round(
+            results[4]["iters_per_sec"], 3
+        ),
+        "game_scaling_speedup_4dev": round(scaling, 3),
+        "game_scaling_bitwise_ok": bitwise,
+        "game_scaling_plans_4dev": results[4]["plans"],
+    }
+    if cores >= 4:
+        out["game_scaling_gate_ok"] = bool(scaling >= 1.5 and bitwise)
+    else:
+        out["game_scaling_gate_ok"] = (
+            f"waived: {cores} CPU core(s) visible — 4 forced host devices "
+            "timeshare, parallel speedup unmeasurable (bitwise parity "
+            f"still checked: {'PASS' if bitwise else 'FAIL'})"
+        )
+        if not bitwise:
+            raise RuntimeError(
+                "sharded scores diverged bitwise from the single-device "
+                "ladder baseline"
+            )
+    _log(f"scaling: 1dev {results[1]['iters_per_sec']:.3f} it/s, "
+         f"4dev {results[4]['iters_per_sec']:.3f} it/s "
+         f"({scaling:.2f}x), bitwise {'PASS' if bitwise else 'FAIL'}, "
+         f"gate {out['game_scaling_gate_ok']}")
+    return out
+
+
+def bench_game_repack_ab() -> dict:
+    """Cost-model repacker A/B (ISSUE 20): realized padded FLOPs of the
+    bench zipf entity distribution under the geometric ladder vs the
+    repacker plan at the same program budget."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.game.data import build_random_effect_dataset
+
+    rng = np.random.default_rng(1)
+    n_ent = min(GAME_ENTITIES, 20_000)
+    sizes = np.minimum(rng.zipf(1.8, n_ent), GAME_ROW_CAP)
+    n = int(sizes.sum())
+    keys = np.repeat(
+        np.array([f"u{i}" for i in range(n_ent)], dtype=object), sizes
+    )
+    Xe = sp.csr_matrix(rng.normal(size=(n, GAME_RE_DIM)).astype(np.float32))
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    weights = np.ones(n, np.float32)
+    flops, blocks = {}, {}
+    for repack in ("geometric", "cost_model"):
+        ds = build_random_effect_dataset(
+            keys, Xe, y, weights, device=False,
+            bucket_growth=GAME_BUCKET_GROWTH, repack=repack,
+            program_budget=16,
+        )
+        flops[repack] = sum(
+            b.n_entities * b.rows_per_entity * b.block_dim
+            for b in ds.blocks
+        )
+        blocks[repack] = len(ds.blocks)
+    reduction = 100.0 * (1.0 - flops["cost_model"] / flops["geometric"])
+    _log(f"repack A/B: geometric {flops['geometric']:.3g} padded FLOPs "
+         f"({blocks['geometric']} programs) vs cost_model "
+         f"{flops['cost_model']:.3g} ({blocks['cost_model']} programs): "
+         f"{reduction:.1f}% reduction")
+    return {
+        "game_repack_padded_flops_geometric": flops["geometric"],
+        "game_repack_padded_flops_cost_model": flops["cost_model"],
+        "game_repack_programs": blocks,
+        "game_repack_flop_reduction_pct": round(reduction, 1),
+    }
+
+
 def bench_glm_driver() -> tuple[float, float]:
     """Wall-clock of the full legacy GLM driver on an a1a-shaped dataset
     (1605 train / 2000 validate rows, 123 binary features, 3-point λ grid)."""
@@ -2578,6 +2791,14 @@ def main() -> None:
             g["iters_per_sec"], "game_cd_iters_per_sec"
         )
         game_iters = g["iters_per_sec"]  # per-gbps extras at END (final median)
+        try:
+            extra.update(bench_game_repack_ab())
+        except Exception as e:  # new section: never sink the headline
+            extra["game_repack_flop_reduction_pct"] = f"failed: {e}"
+        try:
+            extra.update(bench_game_device_scaling())
+        except Exception as e:  # new section: never sink the headline
+            extra["game_scaling_gate_ok"] = f"failed: {e}"
         sample_chip()
     if ONLY in ("", "game", "multire"):
         try:
@@ -2741,4 +2962,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--game-scaling-worker":
+        _game_scaling_worker(int(sys.argv[2]))
+    else:
+        main()

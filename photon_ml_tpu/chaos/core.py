@@ -130,6 +130,19 @@ KNOWN_SITES = {
         "GAME coordinate-descent iteration boundary, after that "
         "iteration's checkpoint save (game/descent.py)"
     ),
+    "game.repack": (
+        "cost-model entity repacker, before the bucket plan is built "
+        "(game/data.py build_random_effect_dataset) — a kill here dies "
+        "before any block exists; the rebuilt dataset must be bitwise "
+        "identical to an uninterrupted build"
+    ),
+    "game.bucket_shard": (
+        "hierarchical random-effect execution, before one device "
+        "placement's bucket programs dispatch (game/hierarchical.py) — "
+        "a kill here aborts the coordinate update mid-dispatch; the "
+        "retried update must be bitwise identical to an uninterrupted "
+        "one (per-bucket solves are pure functions of offsets)"
+    ),
     "grid.point": (
         "λ-grid point boundary, after on_solved persisted the point "
         "(optim/problem.py grid_loop)"

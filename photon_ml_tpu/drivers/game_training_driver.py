@@ -146,6 +146,13 @@ def parse_coordinate_config(spec: dict):
             reg_weight=float(spec.get("reg_weight", 0.0)),
             max_rows_per_entity=spec.get("max_rows_per_entity"),
             bucket_growth=float(spec.get("bucket_growth", 2.0)),
+            # bucket-boundary policy: "geometric" | "cost_model" (the
+            # repacker, game/data.py) + its program budget and seed.
+            repack=str(spec.get("repack", "geometric")),
+            program_budget=int(spec.get("program_budget", 16)),
+            repack_seed=int(spec.get("repack_seed", 0)),
+            # mesh bucket-ladder placement threshold (game/hierarchical.py).
+            split_factor=float(spec.get("split_factor", 0.5)),
             # >0: train this coordinate out-of-core (entity blocks stay in
             # host RAM, streamed through HBM in pass groups bounded by this
             # many megabytes — game/ooc_random.py).
@@ -153,6 +160,9 @@ def parse_coordinate_config(spec: dict):
                 float(spec.get("device_budget_mb", 0)) * 2**20
             ),
             prefetch_depth=int(spec.get("prefetch_depth", 2)),
+            # MB of out-of-core static slice payloads kept HBM-resident
+            # across passes (hot working-set cache; bitwise neutral).
+            hot_budget_mb=float(spec.get("hot_budget_mb", 0.0)),
         )
     if spec["type"] in ("factored_random", "factored"):
         proj_rw = spec.get("projection_reg_weight")
@@ -168,6 +178,9 @@ def parse_coordinate_config(spec: dict):
             alternations=int(spec.get("alternations", 2)),
             max_rows_per_entity=spec.get("max_rows_per_entity"),
             bucket_growth=float(spec.get("bucket_growth", 2.0)),
+            repack=str(spec.get("repack", "geometric")),
+            program_budget=int(spec.get("program_budget", 16)),
+            repack_seed=int(spec.get("repack_seed", 0)),
             device_budget_bytes=int(
                 float(spec.get("device_budget_mb", 0)) * 2**20
             ),
@@ -307,6 +320,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="auto: with >1 device, shard rows (fixed effects) and the "
         "entity axis (random effects) over a mesh of all devices — the "
         "reference's Spark-cluster layout on ICI",
+    )
+    p.add_argument(
+        "--pipeline-coordinates",
+        action="store_true",
+        help="overlap coordinate updates' offset-independent host work "
+        "(the next coordinate prestages its first pass groups while the "
+        "current one solves — game/descent.py); bitwise identical to "
+        "the serial schedule",
     )
     p.add_argument(
         "--device-metrics",
@@ -619,6 +640,7 @@ def _run_impl(args, logger, tel) -> dict:
     estimator = GameEstimator(
         task, coordinate_configs, n_iterations=n_cd_iterations, logger=logger,
         mesh=mesh, device_metrics=args.device_metrics,
+        pipeline=args.pipeline_coordinates,
     )
     from photon_ml_tpu.utils.watchdog import (
         RetryPolicy,

@@ -53,6 +53,19 @@ class Coordinate:
     def train(self, offsets: Array, warm_state=None):
         raise NotImplementedError
 
+    def prestage(self, warm_state=None) -> None:
+        """Hint that ``train(..., warm_state)`` is about to be called.
+
+        The pipelined descent schedule (game/descent.py) issues this for
+        the NEXT coordinate before blocking on the current one's solve:
+        work that does not depend on the offsets — host-side slice
+        packing, warm-start staging — may start in the background.  The
+        contract is strictly a latency hint: results must stay bitwise
+        identical whether or not prestage ran, so the default is a
+        no-op and implementations must key any staged buffers to the
+        exact ``warm_state`` they were built from."""
+        return None
+
     def score(self, state) -> Array:
         raise NotImplementedError
 

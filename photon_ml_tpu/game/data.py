@@ -95,10 +95,25 @@ class RandomEffectDataset:
     passive_blocks: list[Optional[EntityBlock]] = dataclasses.field(
         default_factory=list
     )
+    # Padding accounting from build time (docs/performance.md
+    # "Hierarchical execution"): padded = Σ_blocks E·R·D over the
+    # realized block shapes, exact = Σ_entities r·max(d, 1).  Their
+    # ratio is the `game_bucket_padding_ratio` gauge and the repacker
+    # A/B's objective; 0 means the dataset predates the accounting
+    # (host-rebuilt scoring paths).
+    padded_flops: int = 0
+    exact_flops: int = 0
 
     @property
     def n_entities(self) -> int:
         return len(self.entity_to_slot)
+
+    @property
+    def padding_ratio(self) -> float:
+        """Padded/exact FLOPs of the realized bucket ladder (>= 1.0)."""
+        return (
+            self.padded_flops / self.exact_flops if self.exact_flops else 1.0
+        )
 
 
 @dataclasses.dataclass
@@ -144,6 +159,159 @@ def _round_up_geometric(n: int, growth: float, floor: int = 1) -> int:
     return v
 
 
+@dataclasses.dataclass(frozen=True)
+class RepackPlan:
+    """A cost-model bucket plan: K bucket shapes + the entity→bucket map.
+
+    ``shapes`` is ``(K, 2)`` int64 ``(rows, dims)`` sorted ascending;
+    ``assignment[e]`` is entity e's bucket.  ``padded_flops`` is the
+    plan's Σ n·R·D cost over PLAN shapes (realized blocks pad tighter —
+    to member maxima — so the realized ratio only improves on this).
+    """
+
+    shapes: np.ndarray  # (K, 2) int64
+    assignment: np.ndarray  # (n_entities,) int64
+    padded_flops: int
+    exact_flops: int
+
+
+#: Distinct (rows, dims) shapes above which the repacker pre-quantizes
+#: on a fine geometric grid before the O(K²)-per-merge greedy runs.
+_REPACK_MAX_DISTINCT = 256
+
+
+def plan_entity_buckets(
+    row_counts,
+    col_counts,
+    program_budget: int = 16,
+    seed: int = 0,
+) -> RepackPlan:
+    """Cost-model entity repacker: pick ≤ ``program_budget`` bucket
+    shapes minimizing padded FLOPs for the observed per-entity sizes.
+
+    Replaces the static geometric ladder with a plan driven by the
+    actual (row count, active-feature count) distribution
+    (data/stats.py ``entity_shape_histogram``).  Greedy agglomeration:
+    start from every distinct shape as its own bucket (zero padding,
+    too many compiled programs), then repeatedly merge the pair whose
+    merged bucket (elementwise-max shape) adds the fewest padded FLOPs,
+    until the compiled-program-count budget holds.  Fully
+    deterministic: shapes are processed in sorted order, ties break on
+    the first (lexicographically smallest) pair, and ``seed`` only
+    feeds the optional entity subsample for very large populations
+    (``entity_shape_histogram``).
+    """
+    from photon_ml_tpu.data.stats import entity_shape_histogram
+
+    if program_budget < 1:
+        raise ValueError(
+            f"program_budget must be >= 1, got {program_budget}"
+        )
+    shapes, counts, inverse = entity_shape_histogram(
+        row_counts, col_counts, seed=seed
+    )
+    exact = int(
+        np.sum(
+            np.asarray(row_counts, np.int64)
+            * np.maximum(np.asarray(col_counts, np.int64), 1)
+        )
+    )
+    if len(shapes) == 0:
+        return RepackPlan(
+            shapes=np.zeros((0, 2), np.int64),
+            assignment=np.zeros(0, np.int64),
+            padded_flops=0, exact_flops=0,
+        )
+
+    # Pre-quantize a pathologically diverse shape population so each
+    # greedy step stays a small dense matrix: snap to a fine geometric
+    # grid (far finer than the ladder this replaces) and re-unique.
+    shape_to_slot = np.arange(len(shapes))
+    if len(shapes) > _REPACK_MAX_DISTINCT:
+        growth = 1.05
+        while True:
+            q = np.stack(
+                [
+                    [_round_up_geometric(int(r), growth) for r in shapes[:, 0]],
+                    [_round_up_geometric(int(c), growth) for c in shapes[:, 1]],
+                ],
+                axis=1,
+            )
+            qshapes, qinv = np.unique(q, axis=0, return_inverse=True)
+            if len(qshapes) <= _REPACK_MAX_DISTINCT:
+                break
+            growth *= 1.1
+        qcounts = np.bincount(
+            qinv, weights=counts.astype(np.float64), minlength=len(qshapes)
+        ).astype(np.int64)
+        shape_to_slot = qinv
+        shapes, counts = qshapes.astype(np.int64), qcounts
+
+    # Greedy agglomeration over (R, D, n, cost) bucket rows.  `members`
+    # tracks which initial slots each surviving bucket absorbed.
+    R = shapes[:, 0].astype(np.int64)
+    D = shapes[:, 1].astype(np.int64)
+    N = counts.astype(np.int64)
+    C = N * R * D
+    members: list[list[int]] = [[i] for i in range(len(shapes))]
+    alive = np.ones(len(shapes), bool)
+
+    def _merge_pass(free_only: bool) -> None:
+        nonlocal R, D, N, C
+        while True:
+            idx = np.flatnonzero(alive)
+            if len(idx) <= 1 or (
+                not free_only and len(idx) <= program_budget
+            ):
+                break
+            Ra, Da, Na, Ca = R[idx], D[idx], N[idx], C[idx]
+            Rm = np.maximum(Ra[:, None], Ra[None, :])
+            Dm = np.maximum(Da[:, None], Da[None, :])
+            delta = (Na[:, None] + Na[None, :]) * Rm * Dm \
+                - Ca[:, None] - Ca[None, :]
+            iu = np.triu_indices(len(idx), k=1)
+            flat = delta[iu]
+            if free_only and flat.min() > 0:
+                break
+            # argmin over the upper triangle is (i, j)-lexicographic on
+            # ties — buckets were built from SORTED shapes, so the
+            # winner is deterministic.
+            k = int(np.argmin(flat))
+            a, b = idx[iu[0][k]], idx[iu[1][k]]
+            R[a] = max(R[a], R[b])
+            D[a] = max(D[a], D[b])
+            N[a] += N[b]
+            C[a] = N[a] * R[a] * D[a]
+            members[a].extend(members[b])
+            alive[b] = False
+
+    # Paid merges down to the program budget, then a free coalesce:
+    # merging can leave two buckets with IDENTICAL shapes (distinct
+    # ancestors growing to the same maxima) — folding those costs zero
+    # padding and saves a compiled program, so always take them.
+    _merge_pass(free_only=False)
+    _merge_pass(free_only=True)
+
+    kept = np.flatnonzero(alive)
+    order = np.lexsort((D[kept], R[kept]))
+    kept = kept[order]
+    plan_shapes = np.stack([R[kept], D[kept]], axis=1)
+    slot_to_bucket = np.empty(
+        int(shape_to_slot.max()) + 1 if len(shape_to_slot) else 0, np.int64
+    )
+    for bi, ki in enumerate(kept):
+        for slot in members[ki]:
+            slot_to_bucket[slot] = bi
+    assignment = slot_to_bucket[shape_to_slot[inverse]]
+    padded = int(np.sum(C[kept]))
+    return RepackPlan(
+        shapes=plan_shapes,
+        assignment=assignment,
+        padded_flops=padded,
+        exact_flops=exact,
+    )
+
+
 def build_random_effect_dataset(
     entity_keys: Sequence,
     rows_csr,  # scipy CSR (n_rows, d) — this coordinate's feature shard
@@ -154,6 +322,9 @@ def build_random_effect_dataset(
     device: bool = True,
     bucket_growth: float = 2.0,
     allow_missing: bool = False,
+    repack: str = "geometric",
+    program_budget: int = 16,
+    repack_seed: int = 0,
 ) -> RandomEffectDataset:
     """Group rows by entity, project to per-entity subspaces, bucket by size.
 
@@ -209,6 +380,8 @@ def build_random_effect_dataset(
                 entity_keys[keep], rows_csr[rows_kept], labels[keep],
                 weights[keep], max_rows_per_entity=max_rows_per_entity,
                 dtype=dtype, device=device, bucket_growth=bucket_growth,
+                repack=repack, program_budget=program_budget,
+                repack_seed=repack_seed,
             )
             # Re-point every block's row indices at the ORIGINAL row
             # space (scatter targets), keeping the sentinel padding slot.
@@ -304,24 +477,56 @@ def build_random_effect_dataset(
     act_counts = np.bincount(act_ent, minlength=n_ent).astype(np.int64)
     act_before = np.concatenate([[0], np.cumsum(act_counts)[:-1]])
 
-    # GROUP by the geometric (row count, active-feature count) grid, but
-    # PAD each block only to its members' actual maxima: the geometric
-    # key bounds the bucket COUNT (compile count per dataset), while the
-    # per-bucket entity count E already makes every block shape unique —
-    # so tight padding costs no extra compiles and cuts the padded bytes
-    # every objective evaluation touches (the zipf cap at 128 rows used
-    # to pad to the 256 grid point: 2x pure waste on the biggest block).
-    geo = {}
+    # GROUP entities into buckets, PADDING each block only to its
+    # members' actual maxima: the grouping key bounds the bucket COUNT
+    # (compile count per dataset), while the per-bucket entity count E
+    # already makes every block shape unique — so tight padding costs
+    # no extra compiles and cuts the padded bytes every objective
+    # evaluation touches.
+    #
+    # Two grouping policies (docs/performance.md "Hierarchical
+    # execution"):
+    #  - "geometric" (default): the static ladder — key by
+    #    (geo(rows), geo(dims)) on the floor·growth^k grid.
+    #  - "cost_model": plan_entity_buckets fits ≤ program_budget bucket
+    #    shapes to the OBSERVED size distribution, minimizing padded
+    #    FLOPs.  Same downstream machinery; only the membership map
+    #    changes.  NOTE: regrouping changes realized block shapes, and
+    #    XLA reduction tiling varies with padded length — repacked
+    #    coefficients are the same math but not bit-for-bit the
+    #    ladder's (unlike sharding/pipelining, which preserve the plan
+    #    and are bitwise; measured in docs/performance.md).
+    if repack == "cost_model":
+        from photon_ml_tpu.chaos import core as chaos_mod
 
-    def _geo(v: int) -> int:
-        if v not in geo:
-            geo[v] = _round_up_geometric(v, bucket_growth)
-        return geo[v]
+        chaos_mod.maybe_fail(
+            "game.repack", n_entities=n_ent, budget=program_budget
+        )
+        plan = plan_entity_buckets(
+            kept_counts, act_counts, program_budget=program_budget,
+            seed=repack_seed,
+        )
+        buckets: dict[tuple[int, int], list[int]] = {}
+        for g in range(n_ent):
+            bi = int(plan.assignment[g])
+            key = (int(plan.shapes[bi, 0]), int(plan.shapes[bi, 1]))
+            buckets.setdefault(key, []).append(g)
+    elif repack == "geometric":
+        geo = {}
 
-    buckets: dict[tuple[int, int], list[int]] = {}
-    for g in range(n_ent):
-        key = (_geo(int(kept_counts[g])), _geo(int(act_counts[g])))
-        buckets.setdefault(key, []).append(g)
+        def _geo(v: int) -> int:
+            if v not in geo:
+                geo[v] = _round_up_geometric(v, bucket_growth)
+            return geo[v]
+
+        buckets = {}
+        for g in range(n_ent):
+            key = (_geo(int(kept_counts[g])), _geo(int(act_counts[g])))
+            buckets.setdefault(key, []).append(g)
+    else:
+        raise ValueError(
+            f"repack must be 'geometric' or 'cost_model', got {repack!r}"
+        )
 
     # lane_of_ent/block_of_ent drive every flat scatter below.
     lane_of_ent = np.empty(n_ent, np.int64)
@@ -443,11 +648,23 @@ def build_random_effect_dataset(
             )
         )
 
-    return RandomEffectDataset(
+    padded_flops = int(
+        sum(b.n_entities * b.rows_per_entity * b.block_dim for b in blocks)
+    )
+    exact_flops = int(np.sum(kept_counts * np.maximum(act_counts, 1)))
+    ds = RandomEffectDataset(
         blocks=blocks,
         entity_ids=ids_per_block,
         entity_to_slot=entity_to_slot,
         n_global_rows=n_rows,
         n_features=d,
         passive_blocks=passive_blocks,
+        padded_flops=padded_flops,
+        exact_flops=exact_flops,
     )
+    from photon_ml_tpu import telemetry as telemetry_mod
+
+    telemetry_mod.current().gauge("game_bucket_padding_ratio").set(
+        ds.padding_ratio
+    )
+    return ds

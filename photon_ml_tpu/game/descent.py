@@ -79,12 +79,27 @@ class CoordinateDescentResult:
 
 
 class CoordinateDescent:
-    """Reference: ``CoordinateDescent.optimize(coordinates, iterations)``."""
+    """Reference: ``CoordinateDescent.optimize(coordinates, iterations)``.
 
-    def __init__(self, coordinates: Sequence[Coordinate]):
+    ``pipeline=True`` enables the hierarchical-execution overlap
+    schedule: before blocking on coordinate c's solve, the NEXT
+    coordinate's ``prestage`` hint fires, so its offset-independent host
+    work (out-of-core slice packing, warm-start staging) runs during
+    c's streamed solve/all-reduce.  The Gauss-Seidel data flow is
+    untouched — each coordinate still trains against the residual of
+    everything before it, in the same order — so the trajectory is
+    bitwise identical to the serial schedule (pinned by
+    tests/test_game_hierarchical.py); the overlap achieved lands on the
+    ``game_coordinate_overlap_seconds`` counter.
+    """
+
+    def __init__(
+        self, coordinates: Sequence[Coordinate], pipeline: bool = False
+    ):
         names = [c.name for c in coordinates]
         assert len(set(names)) == len(names), f"duplicate coordinate names: {names}"
         self.coordinates = list(coordinates)
+        self.pipeline = bool(pipeline)
 
     def run(
         self,
@@ -266,13 +281,23 @@ class CoordinateDescent:
 
         tel = telemetry_mod.current()
         flush_per_iteration = logger is not None or checkpointer is not None
+        trainable = [
+            c for c in self.coordinates if c.name not in locked
+        ]
         for it in range(start_it, n_iterations):
             it_t0 = time.perf_counter()
             with tel.span("cd_iteration", iteration=it):
-                for coord in self.coordinates:
-                    if coord.name in locked:
-                        continue  # partial retrain: contributes scores only
+                for ci, coord in enumerate(trainable):
                     offsets = total - scores[coord.name]
+                    if self.pipeline and ci + 1 < len(trainable):
+                        # Overlap hint: the next coordinate's
+                        # offset-independent host packing runs while
+                        # this one's solve owns the device/foreground.
+                        # Its warm state is untouched by this update
+                        # (only states[coord.name] changes below), so
+                        # the staged payloads stay valid.
+                        nxt = trainable[ci + 1]
+                        nxt.prestage(states[nxt.name])
                     upd_t0 = time.perf_counter()
                     # Coordinate/solver spans cover the HOST wall of the
                     # update: real wall for streamed/out-of-core

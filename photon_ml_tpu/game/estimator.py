@@ -94,6 +94,23 @@ class RandomEffectCoordinateConfig:
     #: geometric bucket grid for per-entity size bucketing (2.0 = pow2);
     #: larger values consolidate long tails into fewer compiled programs.
     bucket_growth: float = 2.0
+    #: bucket-boundary policy (game/data.py): "geometric" keeps the
+    #: classic growth ladder; "cost_model" runs the repacker —
+    #: boundaries chosen from the entity size histogram to minimize
+    #: padding FLOPs under the compiled-program budget (deterministic
+    #: under repack_seed).
+    repack: str = "geometric"
+    #: max compiled per-bucket programs the repacker may spend.
+    program_budget: int = 16
+    #: tie-break seed for the repacker (results are a pure function of
+    #: (histogram, budget, seed)).
+    repack_seed: int = 0
+    #: mesh placement threshold (game/hierarchical.py): a bucket whose
+    #: solve cost is >= split_factor × the ideal per-device share is
+    #: SPLIT over the mesh; smaller buckets pack whole onto devices by
+    #: cost-balanced assignment.  Applies to the mesh resident path and
+    #: the out-of-core path.
+    split_factor: float = 0.5
     #: >0 trains this coordinate OUT-OF-CORE: entity blocks stay in host
     #: RAM and stream through HBM in double-buffered pass groups bounded
     #: by this many bytes (game/ooc_random.py) — for random-effect
@@ -104,6 +121,12 @@ class RandomEffectCoordinateConfig:
     #: pass groups the ingest pipeline keeps in flight when out-of-core
     #: (each group sized to device_budget_bytes / prefetch_depth).
     prefetch_depth: int = 2
+    #: >0 keeps up to this many MB of out-of-core pass groups' STATIC
+    #: slice payloads resident across passes (the streamed fixed
+    #: effect's hot working-set cache, generalized): hot groups skip
+    #: host pack + h2d transfer and stream only warm starts /
+    #: coefficients.  Bitwise neutral.
+    hot_budget_mb: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -122,6 +145,11 @@ class FactoredRandomEffectCoordinateConfig:
     alternations: int = 2
     max_rows_per_entity: Optional[int] = None
     bucket_growth: float = 2.0
+    #: bucket-boundary policy + budget + seed — shared with the plain
+    #: random-effect config (identical dataset shape, shared cache).
+    repack: str = "geometric"
+    program_budget: int = 16
+    repack_seed: int = 0
     #: >0 trains this coordinate OUT-OF-CORE (game/ooc_factored.py):
     #: entity blocks stream in budget-bounded pass groups, latent vectors
     #: host-resident between passes, and the shared projection V fits by
@@ -153,6 +181,7 @@ class GameEstimator:
         logger=None,
         mesh=None,
         device_metrics: bool = False,
+        pipeline: bool = False,
     ):
         """``mesh``: a ``jax.sharding.Mesh`` with a ``"data"`` axis enables
         the multi-chip path — rows sharded for fixed effects (whole solver
@@ -165,13 +194,19 @@ class GameEstimator:
         only metric scalars do (the 1B-row validation contract; the
         reference computes metrics where the data lives).  Requires an
         ungrouped suite; evaluators with no device implementation fall
-        back to one host pullback."""
+        back to one host pullback.
+
+        ``pipeline``: overlap coordinate updates' offset-independent
+        host work — while one coordinate solves, the NEXT one prestages
+        its first pass groups (game/descent.py).  Results are bitwise
+        identical to the serial schedule."""
         self.task = losses_lib.get(task).name  # canonicalize aliases
         self.coordinate_configs = dict(coordinate_configs)
         self.device_metrics = device_metrics
         self.n_iterations = n_iterations
         self.logger = logger
         self.mesh = mesh
+        self.pipeline = bool(pipeline)
 
     def build_coordinates(self, shards, ids, response, weight=None, offset=None):
         """Build per-coordinate datasets + coordinate objects once.  Tuning
@@ -193,13 +228,18 @@ class GameEstimator:
                 cfg.streaming_chunk_rows,
             )
         # Plain and factored random effects need the SAME dataset shape,
-        # so they share cache entries deliberately.
+        # so they share cache entries deliberately.  The repack knobs
+        # change the realized block layout, so they are part of the
+        # dataset's identity.
         return (
             "random",
             cfg.feature_shard,
             cfg.entity_key,
             cfg.max_rows_per_entity,
             cfg.bucket_growth,
+            cfg.repack,
+            cfg.program_budget,
+            cfg.repack_seed,
         )
 
     def _build_coordinates(
@@ -319,6 +359,9 @@ class GameEstimator:
                             weight,
                             max_rows_per_entity=cfg.max_rows_per_entity,
                             bucket_growth=cfg.bucket_growth,
+                            repack=cfg.repack,
+                            program_budget=cfg.program_budget,
+                            repack_seed=cfg.repack_seed,
                             device=False,
                         )
                         cache[ooc_key] = dataset
@@ -354,6 +397,8 @@ class GameEstimator:
                         device_budget_bytes=cfg.device_budget_bytes,
                         mesh=self.mesh,
                         prefetch_depth=cfg.prefetch_depth,
+                        split_factor=cfg.split_factor,
+                        hot_budget_bytes=int(cfg.hot_budget_mb * 1e6),
                     ))
                     continue
                 if self.mesh is not None:
@@ -373,6 +418,9 @@ class GameEstimator:
                         weight,
                         max_rows_per_entity=cfg.max_rows_per_entity,
                         bucket_growth=cfg.bucket_growth,
+                        repack=cfg.repack,
+                        program_budget=cfg.program_budget,
+                        repack_seed=cfg.repack_seed,
                     )
                     cache[key] = dataset
                 if factored:
@@ -449,18 +497,24 @@ class GameEstimator:
         self, name, cfg, shard, ids, response, weight, cache, key,
         factored: bool = False,
     ):
-        """Entity-sharded random effect — plain or factored (mesh path);
-        same reuse rules as :meth:`_distributed_fixed`."""
+        """Mesh-sharded random effect — plain or factored; same reuse
+        rules as :meth:`_distributed_fixed`.  The plain path routes to
+        the hierarchical bucket-ladder coordinate (game/hierarchical.py):
+        big buckets split over the mesh, the long tail packs whole onto
+        devices.  The factored path keeps the legacy everything-split
+        layout (its projection accumulator cannot commit to devices)."""
         import copy
 
         from photon_ml_tpu.game.distributed import (
             entity_sharded_factored_coordinate,
-            EntityShardedRandomEffectCoordinate,
+        )
+        from photon_ml_tpu.game.hierarchical import (
+            ShardedBucketRandomEffectCoordinate,
         )
 
         cfg_sig = (
             (cfg.optimization, cfg.rank, cfg.alternations)
-            if factored else (cfg.optimization,)
+            if factored else (cfg.optimization, cfg.split_factor)
         )
         cache_key = ("dist", factored) + key
         cached = cache.get(cache_key)
@@ -488,7 +542,10 @@ class GameEstimator:
                 np.asarray(weight, np.float32),
                 max_rows_per_entity=cfg.max_rows_per_entity,
                 bucket_growth=cfg.bucket_growth,
-                device=False,  # EntitySharded places blocks on the mesh
+                repack=cfg.repack,
+                program_budget=cfg.program_budget,
+                repack_seed=cfg.repack_seed,
+                device=False,  # the coordinate places blocks on the mesh
             )
             cache[ds_key] = dataset
         if factored:
@@ -501,10 +558,11 @@ class GameEstimator:
                 entity_key=cfg.entity_key,
             )
         else:
-            coord = EntityShardedRandomEffectCoordinate(
+            coord = ShardedBucketRandomEffectCoordinate(
                 name, dataset, self.mesh, self.task, cfg.optimization,
                 cfg.reg_weight, feature_shard=cfg.feature_shard,
                 entity_key=cfg.entity_key,
+                split_factor=cfg.split_factor,
             )
         cache[cache_key] = (cfg_sig, coord)
         return coord
@@ -838,7 +896,7 @@ class GameEstimator:
                 "(factored coordinates save materialized coefficients "
                 "only)"
             )
-        cd = CoordinateDescent(coordinates)
+        cd = CoordinateDescent(coordinates, pipeline=self.pipeline)
         result = cd.run(
             jnp.asarray(base_offsets),
             n_iterations=self.n_iterations,

@@ -55,6 +55,22 @@ class OutOfCoreFactoredRandomEffectCoordinate(OutOfCoreRandomEffectCoordinate):
     double-buffer, and budget machinery as the plain OOC coordinate.
     """
 
+    # The projection step threads ONE device-resident (V, gradient)
+    # accumulator through every slice's program — a slice committed to
+    # device k would drag that accumulator across devices mid-pass, so
+    # this coordinate keeps the legacy everything-split mesh layout.
+    _supports_packed = False
+    # train/score here stream PROJECTED payloads with their own pack
+    # functions — the base class's cached raw-block trees would never
+    # be consumed, so the hot working-set cache stays off.
+    _supports_hot_cache = False
+
+    def prestage(self, warm_state=None) -> None:
+        # The factored train packs PROJECTED latent payloads, not the
+        # base class's (block, w0) slices — inherited prestage buffers
+        # would never be consumed, so opt out of the hint entirely.
+        return None
+
     def __init__(
         self,
         name: str,

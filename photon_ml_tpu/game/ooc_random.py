@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
+import time
 from typing import Optional
 
 import jax
@@ -39,6 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from photon_ml_tpu import telemetry as telemetry_mod
+from photon_ml_tpu.chaos import core as chaos_mod
 from photon_ml_tpu.data.prefetch import TransferStats, run_prefetched
 from photon_ml_tpu.game.coordinates import (
     RandomEffectCoordinate,
@@ -46,8 +50,10 @@ from photon_ml_tpu.game.coordinates import (
     _make_block_solver,
 )
 from photon_ml_tpu.game.data import EntityBlock, RandomEffectDataset
+from photon_ml_tpu.game.hierarchical import plan_bucket_shards
 from photon_ml_tpu.ops import losses as losses_lib
 from photon_ml_tpu.optim.problem import GlmOptimizationConfig
+from photon_ml_tpu.optim.streaming import HotChunkCache
 from photon_ml_tpu.parallel.distributed import DATA_AXIS
 
 Array = jax.Array
@@ -57,13 +63,18 @@ Array = jax.Array
 class _Slice:
     """One schedulable unit: lanes [lane_lo, lane_hi) of block ``block_idx``,
     padded to ``padded_e`` entities (uniform across the block's slices so
-    every slice of a block shares ONE compiled program)."""
+    every slice of a block shares ONE compiled program).  ``placement``
+    follows the block's :class:`~photon_ml_tpu.game.hierarchical
+    .BucketShardPlan` entry — ``("split",)`` shards the slice's entity
+    axis over the whole mesh, ``("pack", k)`` lands it whole on device k
+    (ignored when there is no mesh)."""
 
     block_idx: int
     lane_lo: int
     lane_hi: int
     padded_e: int
     bytes: int
+    placement: tuple = ("split",)
 
 
 def _lane_bytes(block: EntityBlock, passive: Optional[EntityBlock]) -> int:
@@ -171,6 +182,17 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
     a lane's math).  State is a list of HOST (E, D) numpy arrays.
     """
 
+    #: Subclasses whose jitted programs mix slice payloads with
+    #: whole-pass device state (the factored projection accumulator)
+    #: cannot commit slices to individual devices — they disable the
+    #: hierarchical plan and keep the legacy everything-split layout.
+    _supports_packed = True
+    #: Subclasses with their own payload formats (the factored variant
+    #: streams projected features, not raw blocks) opt out of the hot
+    #: working-set cache — the base-class train/score are the only
+    #: consumers of the cached slice trees.
+    _supports_hot_cache = True
+
     def __init__(
         self,
         name: str,
@@ -183,6 +205,8 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         device_budget_bytes: int = 256 * 2**20,
         mesh=None,
         prefetch_depth: int = 2,
+        split_factor: float = 0.5,
+        hot_budget_bytes: int = 0,
     ):
         # Deliberately NOT calling super().__init__: the resident
         # constructor jits one whole-dataset program, which is exactly what
@@ -218,7 +242,26 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         self._sharding = (
             None if mesh is None else NamedSharding(mesh, P(DATA_AXIS))
         )
-        self._quantum = 1 if mesh is None else int(mesh.devices.size)
+        self._devices = (
+            None if mesh is None else list(mesh.devices.flat)
+        )
+        # Hierarchical placement (game/hierarchical.py): big blocks split
+        # over the mesh, the long tail packs whole onto devices — the
+        # slices inherit their block's placement, so small buckets stop
+        # paying mesh-quantum padding and the devices' async dispatch
+        # overlaps their solves.
+        self.bucket_plan = (
+            None
+            if mesh is None or not self._supports_packed
+            else plan_bucket_shards(
+                dataset.blocks, len(self._devices),
+                split_factor=split_factor,
+            )
+        )
+        if self.bucket_plan is not None:
+            telemetry_mod.current().gauge(
+                "game_shard_imbalance_ratio"
+            ).set(self.bucket_plan.imbalance_ratio)
 
         for b in dataset.blocks:
             jax.tree.map(_host_leaf, b)
@@ -247,6 +290,41 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         )
         self._score_jit = _ooc_score_jit()
         self._zeros_jit = _ooc_zeros_jit(dataset.n_global_rows)
+        # Pipelined-descent prestage state: one background packer at a
+        # time, single-producer/single-consumer handed off via an Event
+        # (no shared mutable state beyond the record, so no lock).
+        self._plan_index = {
+            id(g): gi for gi, g in enumerate(self.pass_plan)
+        }
+        self._prestage_rec = None
+        # Hot working-set cache (optim/streaming.py HotChunkCache,
+        # generalized to per-device hot sets): a hot pass group's STATIC
+        # slice payloads — the already-placed block/score trees, sharded
+        # or device-committed per the bucket plan — stay resident, so
+        # repeat passes skip their host pack AND h2d transfer and stream
+        # only the dynamic part (warm starts / coefficients).  The same
+        # compiled programs serve hot and cold groups in the same order,
+        # so results are bitwise identical either way.  Blocks are
+        # immutable for the coordinate's lifetime, so entries never go
+        # stale; the wanted set is picked ONCE here, biggest transfers
+        # first (the importance of a static payload IS its wire bytes).
+        if hot_budget_bytes < 0:
+            raise ValueError(
+                f"hot_budget_bytes must be >= 0, got {hot_budget_bytes}"
+            )
+        self.hot_budget_bytes = int(hot_budget_bytes)
+        self._hot_cache = None
+        self._hot_bytes: dict = {}
+        if self.hot_budget_bytes and self._supports_hot_cache:
+            self._hot_cache = HotChunkCache(self.hot_budget_bytes)
+            for gi, group in enumerate(self.pass_plan):
+                for kind in ("train", "score"):
+                    self._hot_bytes[(kind, gi)] = (
+                        self._group_static_bytes(kind, group)
+                    )
+            self._hot_cache.replan(
+                self._hot_bytes, self._hot_bytes.__getitem__
+            )
 
     # -- pass planning -----------------------------------------------------
 
@@ -270,7 +348,6 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
                 f"cover the {self._budget_overhead_bytes()}-byte "
                 "whole-pass-resident overhead"
             )
-        q = self._quantum
         plan: list[list[_Slice]] = []
         group: list[_Slice] = []
         group_bytes = 0
@@ -278,6 +355,19 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
             passive = (
                 self.dataset.passive_blocks[bi]
                 if self.dataset.passive_blocks else None
+            )
+            # Placement sets the lane quantum: split slices need one
+            # shardable lane per mesh device, packed (and unmeshed)
+            # slices run whole on one device and pad nothing extra.
+            placement = (
+                ("split",)
+                if self.bucket_plan is None
+                else self.bucket_plan.placements[bi]
+            )
+            q = (
+                len(self._devices)
+                if self.mesh is not None and placement[0] == "split"
+                else 1
             )
             per_lane = _lane_bytes(block, passive) + self._extra_lane_bytes(
                 block
@@ -303,7 +393,9 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
             sub_e = ((sub_e + q - 1) // q) * q  # quantum-aligned
             for lo in range(0, e, sub_e):
                 hi = min(lo + sub_e, e)
-                s = _Slice(bi, lo, hi, sub_e, per_lane * sub_e)
+                s = _Slice(
+                    bi, lo, hi, sub_e, per_lane * sub_e, placement
+                )
                 if group and group_bytes + s.bytes > budget:
                     plan.append(group)
                     group, group_bytes = [], 0
@@ -312,6 +404,40 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         if group:
             plan.append(group)
         return plan
+
+    def _group_static_bytes(self, kind: str, group) -> int:
+        """Wire bytes of one pass group's pass-invariant payloads — the
+        train path's sliced blocks, or the score path's (X, row_index)
+        active/passive pairs.  Budget arithmetic for the hot cache; the
+        dynamic leaves (w0, coefs) stream every pass and don't count."""
+        total = 0
+        for s in group:
+            b = self.dataset.blocks[s.block_idx]
+            r, d = b.rows_per_entity, b.block_dim
+            if kind == "train":
+                # X, labels, weights, row_index (E,R) + col_map (E,D)
+                per = 4 * (r * d + 3 * r + d)
+            else:
+                per = 4 * (r * d + r)  # X + row_index
+                if self.dataset.passive_blocks:
+                    pb = self.dataset.passive_blocks[s.block_idx]
+                    if pb is not None:
+                        rp = pb.rows_per_entity
+                        per += 4 * (rp * d + rp)
+            total += per * s.padded_e
+        return total
+
+    def _probe_hot(self, kind: str) -> dict:
+        """Resident static trees by group index for this pass — one
+        locked cache probe per group, before any pipeline thread
+        starts (the streaming objective's hot/cold-split discipline)."""
+        hot: dict = {}
+        if self._hot_cache is not None:
+            for gi in range(len(self.pass_plan)):
+                d = self._hot_cache.get((kind, gi))
+                if d is not None:
+                    hot[gi] = d
+        return hot
 
     def _extra_lane_bytes(self, block: EntityBlock) -> int:
         """Subclass hook: additional device bytes one lane costs beyond
@@ -332,7 +458,35 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
             lambda x: jax.device_put(x, self._sharding), tree
         )
 
-    def _run_groups(self, make_host_group, consume):
+    def _put_group(self, group, payloads, pack_to_default=False):
+        """One pass group's transfer — one call per group on the
+        transfer thread (the bounded-memory tests hook this to count
+        dispatched-but-unconsumed groups)."""
+        return [
+            self._put_one(s.placement, p, pack_to_default)
+            for s, p in zip(group, payloads)
+        ]
+
+    def _put_one(self, placement, tree, pack_to_default=False):
+        """Placement-aware transfer for one slice payload.  Split slices
+        shard over the mesh; packed slices land whole on their assigned
+        device — except when ``pack_to_default`` (the score path: every
+        scatter folds into ONE accumulator, and a packed slice committed
+        to device k would force that accumulator to bounce devices)."""
+        if self._sharding is None:
+            return jax.device_put(tree)
+        if placement[0] == "pack":
+            if pack_to_default:
+                return jax.device_put(tree)
+            dev = self._devices[placement[1]]
+            return jax.tree.map(
+                lambda x: jax.device_put(x, dev), tree
+            )
+        return jax.tree.map(
+            lambda x: jax.device_put(x, self._sharding), tree
+        )
+
+    def _run_groups(self, make_host_group, consume, pack_to_default=False):
         """Prefetch-pipelined group runner (the chunk store's ingest
         pipeline, data/prefetch.py): a PACK thread slices the next
         groups on the host, a TRANSFER thread dispatches them and waits
@@ -347,14 +501,112 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
         self.live_groups_high_water = 0
         if not plan:
             return
+
         self.live_groups_high_water = run_prefetched(
             len(plan),
-            lambda gi: make_host_group(plan[gi]),
-            self._put,
+            lambda gi: (plan[gi], make_host_group(plan[gi])),
+            lambda item: self._put_group(*item, pack_to_default),
             lambda gi, dev: consume(plan[gi], dev),
             depth=self.prefetch_depth,
             stats=self.transfer_stats,
         )
+
+    # -- pipelined-descent prestage ----------------------------------------
+
+    def _train_state_init(self, warm_state) -> list[np.ndarray]:
+        return [
+            (
+                np.zeros((b.n_entities, b.block_dim), np.float32)
+                if warm_state is None
+                # copy: np.asarray of a jax array (checkpoint resume) is
+                # a read-only zero-copy view, and this buffer is written
+                # into.
+                else np.array(warm_state[bi], np.float32)
+            )
+            for bi, b in enumerate(self.dataset.blocks)
+        ]
+
+    def _train_host_group(self, group, state, with_blocks=True) -> list:
+        # with_blocks=False builds only the dynamic half (warm-start
+        # lanes) — the hot-cache path, where the sliced block already
+        # sits on device and packing it again would waste the savings.
+        sentinel = self.dataset.n_global_rows
+        out = []
+        for s in group:
+            block = self.dataset.blocks[s.block_idx]
+            w0 = state[s.block_idx][s.lane_lo:s.lane_hi]
+            pad = s.padded_e - w0.shape[0]
+            if pad:
+                w0 = np.pad(w0, ((0, pad), (0, 0)))
+            out.append((
+                _slice_block(
+                    block, s.lane_lo, s.lane_hi, s.padded_e, sentinel
+                ) if with_blocks else None,
+                w0,
+            ))
+        return out
+
+    def prestage(self, warm_state=None) -> None:
+        """Background-pack the first ``prefetch_depth`` pass groups' host
+        payloads while ANOTHER coordinate's solve owns the foreground
+        (the pipelined descent schedule, game/descent.py).
+
+        Packing is offset-independent — slices and warm-start lanes are
+        pure functions of (dataset, plan, warm_state) — so the staged
+        payloads are byte-identical to what ``train``'s pack thread
+        would build, and results stay bitwise the unpipelined run's.
+        The buffers are keyed to this exact ``warm_state`` object; a
+        train call with any other warm state discards them.  Host RAM
+        held is at most one pass budget (depth groups of budget/depth
+        bytes).  The overlap actually achieved lands on the
+        ``game_coordinate_overlap_seconds`` counter at take time."""
+        self._drop_prestage()
+        if not self.pass_plan:
+            return
+        n = min(self.prefetch_depth, len(self.pass_plan))
+        rec = {
+            "warm": warm_state,
+            "buf": {},
+            "t0": time.perf_counter(),
+            "t_end": None,
+        }
+
+        def work():
+            try:
+                state = self._train_state_init(warm_state)
+                for gi in range(n):
+                    rec["buf"][gi] = self._train_host_group(
+                        self.pass_plan[gi], state
+                    )
+            finally:
+                rec["t_end"] = time.perf_counter()
+
+        rec["thread"] = threading.Thread(
+            target=work, name="game-ooc-prestage", daemon=True
+        )
+        self._prestage_rec = rec
+        rec["thread"].start()
+
+    def _drop_prestage(self) -> None:
+        rec, self._prestage_rec = self._prestage_rec, None
+        if rec is not None:
+            rec["thread"].join()
+
+    def _take_prestage(self, warm_state) -> dict:
+        rec, self._prestage_rec = self._prestage_rec, None
+        if rec is None:
+            return {}
+        t_take = time.perf_counter()
+        rec["thread"].join()
+        if rec["warm"] is not warm_state:
+            # Stale hint (different warm start than announced): the
+            # payloads would carry the WRONG w0 lanes — drop them.
+            return {}
+        overlap = max(0.0, min(rec["t_end"], t_take) - rec["t0"])
+        telemetry_mod.current().counter(
+            "game_coordinate_overlap_seconds"
+        ).inc(overlap)
+        return rec["buf"]
 
     # -- coordinate surface ------------------------------------------------
 
@@ -368,45 +620,87 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
             jnp.float32,
         )
         offsets = jnp.asarray(offsets, jnp.float32)
-        sentinel = self.dataset.n_global_rows
-        state = [
-            (
-                np.zeros((b.n_entities, b.block_dim), np.float32)
-                if warm_state is None
-                # copy: np.asarray of a jax array (checkpoint resume) is a
-                # read-only zero-copy view, and this buffer is written into.
-                else np.array(warm_state[bi], np.float32)
+        # Each placement needs offsets on ITS device set — a committed
+        # input pinned elsewhere (e.g. the caller's score array on
+        # device 0) would clash inside the jit.  Split slices take a
+        # mesh-replicated copy; each packed device gets its own
+        # committed copy.  Staged once per train pass; identical bits
+        # everywhere, so this never perturbs results.
+        off_split = offsets
+        off_by_dev = {}
+        if self.mesh is not None:
+            off_split = jax.device_put(
+                offsets, NamedSharding(self.mesh, P())
             )
-            for bi, b in enumerate(self.dataset.blocks)
-        ]
+        if self.bucket_plan is not None:
+            packed_devs = {
+                s.placement[1]
+                for group in self.pass_plan
+                for s in group
+                if s.placement[0] == "pack"
+            }
+            off_by_dev = {
+                k: jax.device_put(offsets, self._devices[k])
+                for k in sorted(packed_devs)
+            }
+        prestaged = self._take_prestage(warm_state)
+        state = self._train_state_init(warm_state)
+        hot = self._probe_hot("train")
 
         def host_group(group):
-            out = []
-            for s in group:
-                block = self.dataset.blocks[s.block_idx]
-                w0 = state[s.block_idx][s.lane_lo:s.lane_hi]
-                pad = s.padded_e - w0.shape[0]
-                if pad:
-                    w0 = np.pad(w0, ((0, pad), (0, 0)))
-                out.append((
-                    _slice_block(
-                        block, s.lane_lo, s.lane_hi, s.padded_e, sentinel
-                    ),
-                    w0,
-                ))
-            return out
+            gi = self._plan_index[id(group)]
+            if gi in prestaged:
+                payload = prestaged.pop(gi)
+                if gi in hot:
+                    # Prestage packed full payloads before this pass
+                    # knew its hot set — keep just the dynamic half.
+                    payload = [(None, w0) for _blk, w0 in payload]
+                return payload
+            return self._train_host_group(
+                group, state, with_blocks=gi not in hot
+            )
 
         def consume(group, dev):
+            gi = self._plan_index[id(group)]
+            # The per-device dispatch seam (mirrors the resident
+            # hierarchical coordinate): a fault here aborts the update
+            # mid-pass; per-bucket solves are pure functions of
+            # (block, offsets, w0), so the retried update is bitwise
+            # the uninterrupted one.
+            chaos_mod.maybe_fail(
+                "game.bucket_shard",
+                coordinate=self.name,
+                slices=len(group),
+            )
+            resident = hot.get(gi)
+            blks = [
+                blk if blk is not None else resident[si]
+                for si, (blk, _w0) in enumerate(dev)
+            ]
             # Dispatch every solve in the group first (async), then pull —
-            # the pulls overlap the NEXT group's host slicing + transfer.
+            # the pulls overlap the NEXT group's host slicing + transfer,
+            # and packed slices' programs run concurrently on their
+            # assigned devices.
             results = [
-                self._solve_jit(blk, offsets, w0, l1, l2)
-                for blk, w0 in dev
+                self._solve_jit(
+                    blk,
+                    (
+                        off_by_dev[s.placement[1]]
+                        if s.placement[0] == "pack" and off_by_dev
+                        else off_split
+                    ),
+                    w0, l1, l2,
+                )
+                for s, blk, (_b, w0) in zip(group, blks, dev)
             ]
             for s, res in zip(group, results):
                 state[s.block_idx][s.lane_lo:s.lane_hi] = np.asarray(
                     res
                 )[: s.lane_hi - s.lane_lo]
+            if self._hot_cache is not None and resident is None:
+                self._hot_cache.maybe_admit(
+                    ("train", gi), blks, self._hot_bytes[("train", gi)]
+                )
 
         self._run_groups(host_group, consume)
         return state
@@ -414,18 +708,25 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
     def score(self, state) -> Array:
         sentinel = self.dataset.n_global_rows
         total = self._zeros_jit()
+        hot = self._probe_hot("score")
 
         def host_group(group):
             # Score-only slices: just X + row_index (+ coefs) cross the
             # wire — labels/weights/col_map are ~30% of the lane bytes
             # and the score einsum/scatter never reads them (h2d is the
-            # scarce resource on the tunneled chip).
+            # scarce resource on the tunneled chip).  A hot group's
+            # static pair is already resident; only coefs cross.
+            gi = self._plan_index[id(group)]
+            resident = gi in hot
             out = []
             for s in group:
                 coefs = _cut(
                     np.asarray(state[s.block_idx], np.float32),
                     s.lane_lo, s.lane_hi, s.padded_e, 0,
                 )
+                if resident:
+                    out.append((None, None, coefs))
+                    continue
                 block = self.dataset.blocks[s.block_idx]
                 active = (
                     _cut(block.X, s.lane_lo, s.lane_hi, s.padded_e, 0),
@@ -444,17 +745,32 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
                 out.append((active, passive, coefs))
             return out
 
-        def consume(_group, dev):
+        def consume(group, dev):
             nonlocal total
-            for active, passive, coefs in dev:
+            gi = self._plan_index[id(group)]
+            resident = hot.get(gi)
+            statics = []
+            for si, (active, passive, coefs) in enumerate(dev):
+                if active is None and resident is not None:
+                    active, passive = resident[si]
+                statics.append((active, passive))
                 total = self._score_jit(total, *active, coefs)
                 if passive is not None:
                     # Active/passive split: capped-out rows are never
                     # trained on but MUST be scored (coordinates train
                     # against each other's full contributions).
                     total = self._score_jit(total, *passive, coefs)
+            if self._hot_cache is not None and resident is None:
+                self._hot_cache.maybe_admit(
+                    ("score", gi), statics, self._hot_bytes[("score", gi)]
+                )
 
-        self._run_groups(host_group, consume)
+        # pack_to_default: the donated ``total`` accumulator lives on the
+        # default device; a payload committed to device k would drag it
+        # there and clash with the next slice.  Scatter order (slice
+        # order, active then passive) is placement-independent, so the
+        # score stays bitwise the unpacked one.
+        self._run_groups(host_group, consume, pack_to_default=True)
         return total[: self.dataset.n_global_rows]
 
     def _block_variances(self, block: EntityBlock, coefs, offsets):
@@ -472,7 +788,18 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
             if s.block_idx == bi
         )
         sentinel = self.dataset.n_global_rows
+        placement = (
+            ("split",)
+            if self.bucket_plan is None
+            else self.bucket_plan.placements[bi]
+        )
         offsets = jnp.asarray(offsets, jnp.float32)
+        if self.mesh is not None and placement[0] == "split":
+            # Same device-set normalization as train: sharded slice
+            # inputs need mesh-replicated offsets.
+            offsets = jax.device_put(
+                offsets, NamedSharding(self.mesh, P())
+            )
         l2 = jnp.asarray(
             self.config.regularization.l2_weight(1.0) * self.reg_weight,
             jnp.float32,
@@ -486,8 +813,13 @@ class OutOfCoreRandomEffectCoordinate(RandomEffectCoordinate):
             if pad:
                 c = np.pad(c, ((0, pad), (0, 0)))
             v = self._var_jit(
-                self._put(_slice_block(block, lo, hi, sub_e, sentinel)),
-                self._put(c), offsets, l2,
+                self._put_one(
+                    placement,
+                    _slice_block(block, lo, hi, sub_e, sentinel),
+                    pack_to_default=True,
+                ),
+                self._put_one(placement, c, pack_to_default=True),
+                offsets, l2,
             )
             out[lo:hi] = np.asarray(v)[: hi - lo]
         return out

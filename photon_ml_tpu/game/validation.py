@@ -199,4 +199,11 @@ class RandomEffectValidationScorer:
         )
 
     def score(self, state: list[Array]) -> Array:
+        # A mesh-sharded coordinate leaves blocks committed to different
+        # devices (packed vs split placements); jit rejects mixed committed
+        # inputs, so stage to one device first.  Transfers preserve bits.
+        shardings = {getattr(b, "sharding", None) for b in state}
+        if len(shardings) > 1:
+            dev = jax.devices()[0]
+            state = [jax.device_put(b, dev) for b in state]
         return self._score_jit(state, self._val_blocks, self._gather_idxs)

@@ -119,10 +119,19 @@ class HotChunkCache:
     Entries are never donated to XLA — chunk arguments are not in any
     program's ``donate_argnums`` — so a resident buffer stays valid
     across passes.
+
+    With ``n_devices > 1`` the cached buffers are mesh-sharded, so a
+    resident item pins only ``ceil(nbytes / n_devices)`` bytes on EACH
+    device; ``budget_bytes`` then bounds the PER-DEVICE resident bytes
+    (the quantity that actually competes with program HBM), not the
+    logical total.  Admission/replan arithmetic uses that per-device
+    cost throughout — the same budget number means the same per-device
+    pressure whether the stream is sharded or not.
     """
 
-    def __init__(self, budget_bytes: int):
+    def __init__(self, budget_bytes: int, n_devices: int = 1):
         self.budget_bytes = int(budget_bytes)
+        self.n_devices = max(1, int(n_devices))
         self._lock = sanitizers.tracked(
             threading.Lock(), "streaming.hot_cache"
         )
@@ -156,13 +165,14 @@ class HotChunkCache:
     def maybe_admit(self, i: int, dev, nbytes: int) -> bool:
         """Admit item ``i``'s just-transferred device buffers iff the
         last replan wants it and it fits the remaining budget."""
+        cost = -(-int(nbytes) // self.n_devices)  # per-device ceil
         with self._lock:
             if i in self._entries or i not in self._want:
                 return False
-            if self._bytes + nbytes > self.budget_bytes:
+            if self._bytes + cost > self.budget_bytes:
                 return False
-            self._entries[i] = (dev, int(nbytes))
-            self._bytes += int(nbytes)
+            self._entries[i] = (dev, cost)
+            self._bytes += cost
             self.admissions += 1
             return True
 
@@ -187,7 +197,7 @@ class HotChunkCache:
             want: set = set()
             budget = self.budget_bytes
             for i in sorted(scores, key=lambda j: (-scores[j], j)):
-                nb = item_nbytes(i)
+                nb = -(-int(item_nbytes(i)) // self.n_devices)
                 if nb <= budget:
                     want.add(i)
                     budget -= nb
@@ -316,11 +326,12 @@ class StreamingObjective:
             raise ValueError(
                 f"hot_budget_bytes must be >= 0, got {hot_budget_bytes}"
             )
-        if hot_budget_bytes and mesh is not None:
+        if hot_budget_bytes and mesh is not None and jax.process_count() > 1:
             raise ValueError(
-                "the hot working-set cache is single-device only: a "
-                "cached chunk would pin sharded buffers across the mesh "
-                "— pass hot_budget_bytes=0 with a mesh"
+                "the hot working-set cache is single-host only: on a "
+                "pod each process would pin a divergent resident set "
+                "and the SPMD dispatch order would skew across hosts — "
+                "pass hot_budget_bytes=0 in multi-host mode"
             )
         self.stream = stream
         self.mesh = mesh
@@ -422,8 +433,10 @@ class StreamingObjective:
             self._wire = [
                 self._codec.encode(bufs) for bufs in stream.staged
             ]
-        # Importance-aware HBM working set (single-device; see class
-        # docstring for the admit-next-pass lifecycle).
+        # Importance-aware HBM working set (see class docstring for the
+        # admit-next-pass lifecycle).  Under a mesh the cached buffers
+        # are the sharded wire trees, so the budget counts per-device
+        # bytes — n_devices divides each entry's cost.
         self.hot_budget_bytes = int(hot_budget_bytes)
         if hot_budget_bytes and stream.staged is None:
             raise ValueError(
@@ -432,7 +445,12 @@ class StreamingObjective:
                 "staged size)"
             )
         self._hot_cache = (
-            HotChunkCache(hot_budget_bytes) if hot_budget_bytes else None
+            HotChunkCache(
+                hot_budget_bytes,
+                n_devices=(1 if mesh is None else int(mesh.devices.size)),
+            )
+            if hot_budget_bytes
+            else None
         )
 
         obj = self.objective

@@ -108,7 +108,10 @@ if [[ "${1:-}" == "--fast" ]]; then
   # 1e-5 of the resident OWL-QN optimum over logical shards.
   # test_cluster covers the control plane: membership expiry/heal,
   # coordinator leader failover + journal replay, and checksum-verified
-  # publication fetch (all three cluster.* chaos seams).
+  # publication fetch (all three cluster.* chaos seams).  The
+  # hierarchical-GAME smoke runs one sharded-vs-single parity leg on
+  # the forced multi-device mesh (resident + out-of-core, BITWISE) —
+  # the invariant the mesh bucket-shard plan rests on.
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_ops_plane.py \
     tests/test_watchdog.py \
@@ -123,6 +126,8 @@ if [[ "${1:-}" == "--fast" ]]; then
     "tests/test_serving_fleet.py::TestFleetRouter::test_host_kill_under_load_costs_zero_failures" \
     "tests/test_solvers.py::TestDispatchParity::test_resident_bitwise" \
     "tests/test_solvers.py::TestADMM::test_logical_shards_match_owlqn" \
+    "tests/test_game_hierarchical.py::TestShardedParity::test_resident_bitwise[per_user-shape0]" \
+    "tests/test_game_hierarchical.py::TestShardedParity::test_out_of_core_bitwise[per_user-shape0]" \
     -m 'not slow' -q -p no:cacheprovider
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -277,15 +277,15 @@ class TestBoundedMemory:
         assert len(ooc.pass_plan) >= 3
         counts = {"put": 0, "consume": 0}
         violations = []
-        orig_put = ooc._put
+        orig_put = ooc._put_group
 
-        def tracked_put(tree):
+        def tracked_put(group, payloads, pack_to_default=False):
             counts["put"] += 1
             if counts["put"] - counts["consume"] > 2:
                 violations.append(dict(counts))
-            return orig_put(tree)
+            return orig_put(group, payloads, pack_to_default)
 
-        ooc._put = tracked_put
+        ooc._put_group = tracked_put
 
         def consume(group, dev):
             counts["consume"] += 1
@@ -324,10 +324,17 @@ class TestMesh:
         res, ooc = _coords(
             "logistic", _config(), resident, host, 200_000, mesh=mesh
         )
-        # Slices are padded to mesh-size multiples (shardable lanes).
+        # Hierarchical placement: split slices are padded to mesh-size
+        # multiples (shardable lanes); packed slices run whole on one
+        # device and carry no mesh-quantum padding.
+        assert ooc.bucket_plan is not None
         for group in ooc.pass_plan:
             for s in group:
-                assert s.padded_e % 8 == 0
+                if s.placement[0] == "split":
+                    assert s.padded_e % 8 == 0
+                else:
+                    assert s.placement[0] == "pack"
+                    assert 0 <= s.placement[1] < 8
         offsets = jnp.zeros(len(y), jnp.float32)
         st_res, st_ooc = res.train(offsets), ooc.train(offsets)
         # Sharded lowering reorders float ops inside the iterative solver
